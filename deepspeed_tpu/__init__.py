@@ -1,0 +1,113 @@
+"""deepspeed_tpu: a TPU-native distributed training & inference framework.
+
+Public API mirrors the reference's ``deepspeed/__init__.py`` (initialize :52,
+init_inference :233, init_distributed :29, add_config_arguments :210) while
+the machinery underneath is JAX/XLA/Pallas over a device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional, Tuple, Union
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from . import comm as _comm_pkg  # noqa: F401
+from .comm.comm import init_distributed
+from .parallel.mesh import (MeshManager, ParallelDims, get_mesh_manager,
+                            initialize_mesh)
+from .runtime.config import DeepSpeedConfig
+from .runtime.engine import DeepSpeedEngine
+from .runtime.model import ModelSpec, from_gpt
+from .utils.logging import logger
+
+
+def _load_raw_config(config: Union[str, Dict, None],
+                     config_params: Union[str, Dict, None]) -> Dict:
+    cfg = config if config is not None else config_params
+    if cfg is None:
+        raise ValueError("DeepSpeed requires a config (path or dict)")
+    if isinstance(cfg, (str, os.PathLike)):
+        with open(cfg) as f:
+            return json.load(f)
+    return dict(cfg)
+
+
+def _mesh_from_config(raw: Dict, mesh_manager: Optional[MeshManager]) -> MeshManager:
+    if mesh_manager is not None:
+        from .parallel.mesh import set_mesh_manager
+        set_mesh_manager(mesh_manager)
+        return mesh_manager
+    tp = raw.get("tensor_parallel", {})
+    tp_size = tp.get("size", tp.get("tp_size", 1)) if tp else 1
+    sp = raw.get("sequence_parallel", {})
+    sp_size = sp.get("size", 1) if sp else 1
+    pipe = raw.get("pipeline", {})
+    pp_size = pipe.get("stages", 1) if isinstance(pipe, dict) else 1
+    moe = raw.get("moe", {})
+    ep_size = moe.get("ep_size", 1) if isinstance(moe, dict) else 1
+    mesh_dims = raw.get("mesh", None)
+    if mesh_dims:
+        dims = ParallelDims(dp=mesh_dims.get("dp", -1), tp=mesh_dims.get("tp", tp_size),
+                            pp=mesh_dims.get("pp", pp_size), sp=mesh_dims.get("sp", sp_size),
+                            ep=mesh_dims.get("ep", ep_size))
+    else:
+        dims = ParallelDims(dp=-1, tp=tp_size, pp=pp_size, sp=sp_size, ep=ep_size)
+    return initialize_mesh(dims)
+
+
+def initialize(args=None,
+               model: Optional[ModelSpec] = None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config: Union[str, Dict, None] = None,
+               config_params: Union[str, Dict, None] = None,
+               mesh_manager: Optional[MeshManager] = None,
+               rng=None) -> Tuple[DeepSpeedEngine, Any, Any, Any]:
+    """Initialize the engine (reference deepspeed/__init__.py:52).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    logger.info(f"deepspeed_tpu v{__version__} initialize")
+    if config is None and args is not None and hasattr(args, "deepspeed_config") \
+            and args.deepspeed_config is not None:
+        config = args.deepspeed_config
+    raw = _load_raw_config(config, config_params)
+    mm = _mesh_from_config(raw, mesh_manager)
+
+    engine = DeepSpeedEngine(
+        args=args,
+        model=model,
+        optimizer=optimizer,
+        model_parameters=model_parameters,
+        training_data=training_data,
+        lr_scheduler=lr_scheduler,
+        mpu=mpu,
+        dist_init_required=dist_init_required,
+        collate_fn=collate_fn,
+        config=raw,
+        mesh_manager=mm,
+        rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Add --deepspeed / --deepspeed_config args (reference :210)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse.SUPPRESS)
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help=argparse.SUPPRESS)
+    return parser

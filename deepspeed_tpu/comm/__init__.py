@@ -1,0 +1,2 @@
+from .comm import *  # noqa: F401,F403
+from . import collectives  # noqa: F401

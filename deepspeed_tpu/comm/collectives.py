@@ -1,0 +1,124 @@
+"""In-graph collectives: the compute-plane half of the comm backend.
+
+The reference routes every collective through ``deepspeed.comm`` onto NCCL
+(comm/comm.py:500 etc.).  On TPU the equivalents are XLA collectives bound to
+mesh-axis names inside ``shard_map``/``pjit`` regions; these helpers are thin,
+uniformly-named wrappers so runtime code (ZeRO reductions, MoE all-to-all,
+pipeline p2p) reads like the reference's comm calls while lowering to ICI
+collectives.
+
+``group`` everywhere is a mesh-axis name or tuple of names (see
+``deepspeed_tpu/parallel/mesh.py`` for the canonical groups).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisGroup = Union[str, Tuple[str, ...], Sequence[str]]
+
+
+def _axes(group: AxisGroup) -> Tuple[str, ...]:
+    if isinstance(group, str):
+        return (group,)
+    return tuple(group)
+
+
+def all_reduce(x, group: AxisGroup, op: str = "sum"):
+    """psum/pmax/pmin over the group's mesh axes (ref comm.py:500 all_reduce)."""
+    axes = _axes(group)
+    if op == "sum":
+        return lax.psum(x, axes)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axes)
+    if op == "max":
+        return lax.pmax(x, axes)
+    if op == "min":
+        return lax.pmin(x, axes)
+    if op == "prod":
+        # XLA has no pprod: |product| via exp(psum(log|x|)), sign via
+        # negative-count parity, zeros handled explicitly.
+        has_zero = lax.psum((x == 0).astype(jnp.float32), axes) > 0
+        neg_count = lax.psum((x < 0).astype(jnp.int32), axes)
+        sign = 1.0 - 2.0 * (neg_count % 2).astype(jnp.float32)
+        safe = jnp.where(x == 0, jnp.ones_like(x), jnp.abs(x))
+        mag = jnp.exp(lax.psum(jnp.log(safe), axes))
+        return jnp.where(has_zero, jnp.zeros_like(mag), sign * mag)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def pmean(x, group: AxisGroup):
+    return lax.pmean(x, _axes(group))
+
+
+def all_gather(x, group: AxisGroup, axis: int = 0, tiled: bool = True):
+    """Concatenating all-gather along ``axis`` (ref comm.py:304 all_gather_base)."""
+    axes = _axes(group)
+    out = x
+    for a in reversed(axes):  # innermost axis gathered first → contiguous layout
+        out = lax.all_gather(out, a, axis=axis, tiled=tiled)
+    return out
+
+
+def reduce_scatter(x, group: AxisGroup, axis: int = 0):
+    """Sum-reduce then scatter chunks along ``axis`` (ref comm.py:289)."""
+    axes = _axes(group)
+    out = x
+    for a in axes:
+        out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+    return out
+
+
+def all_to_all(x, group: AxisGroup, split_axis: int, concat_axis: int):
+    """MoE dispatch/combine collective (ref comm.py:355 all_to_all_single)."""
+    axes = _axes(group)
+    assert len(axes) == 1, "all_to_all over a single mesh axis only"
+    return lax.all_to_all(x, axes[0], split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)
+
+
+def ppermute(x, group: AxisGroup, perm):
+    """Point-to-point ring shift — the pipeline/ring-attention primitive."""
+    axes = _axes(group)
+    assert len(axes) == 1
+    return lax.ppermute(x, axes[0], perm=perm)
+
+
+def ring_shift(x, group: AxisGroup, shift: int = 1):
+    """Send to (i+shift) mod n along the group axis; used by ring attention."""
+    n = group_size(group)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return ppermute(x, group, perm)
+
+
+def broadcast(x, group: AxisGroup, src: int = 0):
+    """Every member takes src's value: select + psum."""
+    axes = _axes(group)
+    idx = axis_index(group)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axes)
+
+
+def axis_index(group: AxisGroup):
+    """Linear index of this shard within the group (row-major over axes)."""
+    axes = _axes(group)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def group_size(group: AxisGroup) -> int:
+    n = 1
+    for a in _axes(group):
+        n *= lax.axis_size(a)
+    return n
+
+
+def pextract(x, group: AxisGroup, src: int):
+    """Value held by member ``src`` (broadcast-from)."""
+    return broadcast(x, group, src=src)

@@ -1,0 +1,1 @@
+from . import gpt, partitioning  # noqa: F401

@@ -1,0 +1,230 @@
+"""GPT-2/3-family causal transformer, TPU-first.
+
+This is the flagship model family used by the benchmark configs
+(BASELINE.md: GPT-2 125M/1.3B/13B, GPT-3 6.7B).  Design choices that differ
+deliberately from a torch port:
+
+- **scan over layers**: block params are stacked on a leading ``layers`` dim
+  and the decoder body is one ``lax.scan`` — compile time is O(1) in depth,
+  and the stacked layout is exactly what pipeline partitioning slices.
+- **logical axes**: every param carries logical axis names
+  (``models/partitioning.py``) so TP/FSDP/MoE shardings are rule-table swaps.
+- **bf16 compute, fp32 logits/loss**: matmuls in ``config.dtype`` feed the
+  MXU; the loss path upcasts, matching the reference's fp16 master-weight
+  discipline without loss-scale fragility on TPU.
+- **remat**: ``config.remat`` wraps each block in ``jax.checkpoint`` — the
+  counterpart of the reference's activation checkpointing
+  (runtime/activation_checkpointing/checkpointing.py:499).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .partitioning import EMBED, HEADS, KV, LAYERS, MLP, SEQ, VOCAB
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None          # default 4*d_model
+    dtype: Any = jnp.bfloat16           # activation/compute dtype
+    param_dtype: Any = jnp.float32      # storage dtype of master params
+    dropout: float = 0.0
+    remat: bool = False
+    use_flash_attention: bool = True    # pallas kernel when available
+    vocab_round_to: int = 128           # pad vocab to a lane multiple
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        r = self.vocab_round_to
+        return ((self.vocab_size + r - 1) // r) * r
+
+    def num_params(self) -> int:
+        d, v, L = self.d_model, self.padded_vocab, self.n_layer
+        per_layer = (4 * d * d + 3 * d) + (2 * d * self.ffn_dim + d + self.ffn_dim) + 4 * d
+        return v * d + self.max_seq_len * d + L * per_layer + 2 * d
+
+
+# canonical size presets (BASELINE.md tracked configs)
+GPT2_125M = GPTConfig(n_layer=12, n_head=12, d_model=768)
+GPT2_350M = GPTConfig(n_layer=24, n_head=16, d_model=1024)
+GPT2_760M = GPTConfig(n_layer=24, n_head=16, d_model=1536)
+GPT2_1_3B = GPTConfig(n_layer=24, n_head=32, d_model=2048)
+GPT3_6_7B = GPTConfig(n_layer=32, n_head=32, d_model=4096, max_seq_len=2048)
+GPT2_13B = GPTConfig(n_layer=40, n_head=40, d_model=5120, max_seq_len=2048)
+
+PRESETS = {
+    "gpt2-125m": GPT2_125M,
+    "gpt2-350m": GPT2_350M,
+    "gpt2-760m": GPT2_760M,
+    "gpt2-1.3b": GPT2_1_3B,
+    "gpt3-6.7b": GPT3_6_7B,
+    "gpt2-13b": GPT2_13B,
+}
+
+
+# --------------------------------------------------------------------- init
+
+def _normal(rng, shape, std, dtype):
+    return (jax.random.normal(rng, shape) * std).astype(dtype)
+
+
+def init(config: GPTConfig, rng: jax.Array) -> PyTree:
+    """Materialize the parameter tree (use under jax.eval_shape for zero.Init)."""
+    d, v, L = config.d_model, config.padded_vocab, config.n_layer
+    h, hd, f = config.n_head, config.head_dim, config.ffn_dim
+    pdt = config.param_dtype
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+    keys = jax.random.split(rng, 8)
+
+    block = {
+        "ln1_scale": jnp.ones((L, d), pdt),
+        "ln1_bias": jnp.zeros((L, d), pdt),
+        "wqkv": _normal(keys[0], (L, d, 3, h, hd), std, pdt),
+        "bqkv": jnp.zeros((L, 3, h, hd), pdt),
+        "wo": _normal(keys[1], (L, h, hd, d), resid_std, pdt),
+        "bo": jnp.zeros((L, d), pdt),
+        "ln2_scale": jnp.ones((L, d), pdt),
+        "ln2_bias": jnp.zeros((L, d), pdt),
+        "wi": _normal(keys[2], (L, d, f), std, pdt),
+        "bi": jnp.zeros((L, f), pdt),
+        "wo_mlp": _normal(keys[3], (L, f, d), resid_std, pdt),
+        "bo_mlp": jnp.zeros((L, d), pdt),
+    }
+    return {
+        "wte": _normal(keys[4], (v, d), std, pdt),
+        "wpe": _normal(keys[5], (config.max_seq_len, d), std, pdt),
+        "blocks": block,
+        "lnf_scale": jnp.ones((d,), pdt),
+        "lnf_bias": jnp.zeros((d,), pdt),
+    }
+
+
+def logical_axes(config: GPTConfig) -> PyTree:
+    """Per-dim logical axis names mirroring ``init``'s tree."""
+    return {
+        "wte": (VOCAB, EMBED),
+        "wpe": (SEQ, EMBED),
+        "blocks": {
+            "ln1_scale": (LAYERS, EMBED),
+            "ln1_bias": (LAYERS, EMBED),
+            "wqkv": (LAYERS, EMBED, None, HEADS, KV),
+            "bqkv": (LAYERS, None, HEADS, KV),
+            "wo": (LAYERS, HEADS, KV, EMBED),
+            "bo": (LAYERS, EMBED),
+            "ln2_scale": (LAYERS, EMBED),
+            "ln2_bias": (LAYERS, EMBED),
+            "wi": (LAYERS, EMBED, MLP),
+            "bi": (LAYERS, MLP),
+            "wo_mlp": (LAYERS, MLP, EMBED),
+            "bo_mlp": (LAYERS, EMBED),
+        },
+        "lnf_scale": (EMBED,),
+        "lnf_bias": (EMBED,),
+    }
+
+
+# -------------------------------------------------------------------- apply
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, config: GPTConfig):
+    """Causal MHA. q,k,v: [B, S, H, D]."""
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, layer_params, config: GPTConfig):
+    """One transformer block on [B, S, d]."""
+    cdt = config.dtype
+    p = layer_params
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) + p["bqkv"].astype(cdt)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = _attention(q, k, v, config)
+    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
+    x = x + attn_out
+    h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
+    ff = jax.nn.gelu(ff, approximate=True)
+    ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
+    return x + ff_out
+
+
+def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, padded_vocab] f32."""
+    cdt = config.dtype
+    B, S = tokens.shape
+    pos = jnp.arange(S)
+    x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[pos][None]
+
+    block_fn = partial(_block, config=config)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer_params):
+        return block_fn(carry, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # tied embedding head; logits in fp32 for a stable softmax/loss
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits
+
+
+def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTConfig) -> jnp.ndarray:
+    """Mean next-token cross-entropy. batch: {'tokens': [B,S+1]} or input/target."""
+    if "input_ids" in batch:
+        inputs, targets = batch["input_ids"], batch["labels"]
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = apply(params, inputs, config)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = (targets >= 0).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def flops_per_token(config: GPTConfig) -> float:
+    """6N + attention flops per token (for MFU accounting)."""
+    d, L, S = config.d_model, config.n_layer, config.max_seq_len
+    n_params = (config.padded_vocab * d + S * d + L * (12 * d * d + 13 * d) + 2 * d)
+    return 6.0 * n_params + 12.0 * L * d * S  # fwd+bwd matmul + attention term

@@ -1,0 +1,120 @@
+"""Logical-axis partitioning: how model params map onto the mesh.
+
+The reference has no declarative sharding — ZeRO partitions flat buffers by
+rank arithmetic (stage_1_and_2.py:98) and inference TP slices weights
+imperatively (module_inject/replace_module.py:18).  The TPU-native design
+annotates every param dimension with a *logical* axis name; a rule table maps
+logical axes → mesh axes, and the same param tree serves TP (model axis),
+ZeRO-3/FSDP (data axes), or any hybrid by swapping rule tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+
+PyTree = Any
+
+# Logical axis vocabulary used by the model zoo.
+EMBED = "embed"          # d_model
+MLP = "mlp"              # ffn hidden
+HEADS = "heads"          # attention heads
+KV = "kv"                # per-head dim
+VOCAB = "vocab"          # vocabulary
+SEQ = "seq"              # sequence positions (wpe)
+LAYERS = "layers"        # scan-stacked layer dim
+EXPERT = "expert"        # MoE expert dim
+UNSHARDED = None
+
+# ---------------------------------------------------------------------------
+# rule tables: logical axis -> mesh axis (or None). First-match wins per dim;
+# a mesh axis may be used at most once per param.
+# ---------------------------------------------------------------------------
+
+#: pure tensor parallelism (Megatron-style): column-split mlp/heads/vocab
+TP_RULES: Dict[str, Any] = {
+    VOCAB: MODEL_AXIS,
+    MLP: MODEL_AXIS,
+    HEADS: MODEL_AXIS,
+    EXPERT: EXPERT_AXIS,
+    EMBED: None,
+    KV: None,
+    SEQ: None,
+    LAYERS: None,
+}
+
+#: ZeRO-3/FSDP addition: shard the embed dim over the dp axes
+FSDP_RULES: Dict[str, Any] = {
+    VOCAB: MODEL_AXIS,
+    MLP: MODEL_AXIS,
+    HEADS: MODEL_AXIS,
+    EXPERT: EXPERT_AXIS,
+    EMBED: (DATA_AXIS,),
+    KV: None,
+    SEQ: None,
+    LAYERS: None,
+}
+
+
+def spec_for_axes(logical_axes: Sequence[Optional[str]],
+                  rules: Dict[str, Any]) -> P:
+    """PartitionSpec for one param given its per-dim logical axes."""
+    used = set()
+    spec = []
+    for ax in logical_axes:
+        mesh_ax = rules.get(ax) if ax is not None else None
+        if mesh_ax is None:
+            spec.append(None)
+            continue
+        key = tuple(mesh_ax) if isinstance(mesh_ax, (tuple, list)) else (mesh_ax,)
+        if any(k in used for k in key):
+            spec.append(None)  # mesh axis already consumed by another dim
+            continue
+        used.update(key)
+        spec.append(mesh_ax if not isinstance(mesh_ax, list) else tuple(mesh_ax))
+    return P(*spec)
+
+
+def tree_specs(axes_tree: PyTree, rules: Dict[str, Any]) -> PyTree:
+    """Map a tree of per-param logical-axis tuples to PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda axes: spec_for_axes(axes, rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x))
+
+
+def tree_shardings(axes_tree: PyTree, mesh: Mesh, rules: Dict[str, Any]) -> PyTree:
+    specs = tree_specs(axes_tree, rules)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def divisible(dim: int, mesh: Mesh, mesh_axes) -> bool:
+    if mesh_axes is None:
+        return True
+    axes = (mesh_axes,) if isinstance(mesh_axes, str) else tuple(mesh_axes)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return dim % n == 0
+
+
+def validate_specs(params_shapes: PyTree, specs: PyTree, mesh: Mesh) -> PyTree:
+    """Drop shardings whose dims don't divide the mesh extent (→ replicate)."""
+    def fix(shape_struct, spec):
+        shape = shape_struct.shape if hasattr(shape_struct, "shape") else shape_struct
+        new = []
+        for i, s in enumerate(spec):
+            if s is None or (i < len(shape) and divisible(shape[i], mesh, s)):
+                new.append(s)
+            else:
+                new.append(None)
+        return P(*new)
+    return jax.tree_util.tree_map(fix, params_shapes, specs,
+                                  is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,4 @@
+from .optimizer import TpuOptimizer, get_optimizer_class, register_optimizer  # noqa: F401
+from .adam.fused_adam import FusedAdam, SGD  # noqa: F401
+from .lamb.fused_lamb import FusedLamb  # noqa: F401
+from .adagrad.cpu_adagrad import Adagrad, DeepSpeedCPUAdagrad  # noqa: F401

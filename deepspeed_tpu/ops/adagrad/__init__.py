@@ -1,0 +1,1 @@
+from .cpu_adagrad import Adagrad, DeepSpeedCPUAdagrad  # noqa: F401

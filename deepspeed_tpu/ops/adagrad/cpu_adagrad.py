@@ -1,0 +1,59 @@
+"""Adagrad.
+
+Counterpart of the reference's ``deepspeed/ops/adagrad/cpu_adagrad.py``
+(``DeepSpeedCPUAdagrad`` over ``csrc/adagrad/cpu_adagrad.cpp`` SIMD kernels).
+The functional device form lives here; the host-offloaded C++ SIMD path (used
+when optimizer state is CPU-offloaded) is provided by
+``deepspeed_tpu/ops/native/cpu_optimizer.cpp`` through the op_builder
+registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer import TpuOptimizer, register_optimizer
+
+PyTree = Any
+
+
+@register_optimizer("adagrad", "deepspeedcpuadagrad")
+class Adagrad(TpuOptimizer):
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params=None, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, **kwargs):
+        super().__init__(params, lr=lr, weight_decay=weight_decay)
+        self.eps = eps
+
+    def init(self, params: PyTree) -> PyTree:
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "sum_sq": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, dtype=jnp.float32), params),
+        }
+
+    def update(self, grads, state, params, hyper) -> Tuple[PyTree, PyTree]:
+        lr = hyper["lr"]
+        wd = hyper.get("weight_decay", 0.0)
+        step = state["step"] + 1
+
+        def leaf(p, g, ss):
+            g32 = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+            ss_new = ss + jnp.square(g32)
+            p_new = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(ss_new) + self.eps)
+            return p_new.astype(p.dtype), ss_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state["sum_sq"])
+        out = [leaf(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        new_s = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        return new_p, {"step": step, "sum_sq": new_s}
+
+
+DeepSpeedCPUAdagrad = Adagrad

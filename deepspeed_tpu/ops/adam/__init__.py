@@ -1,0 +1,1 @@
+from .fused_adam import FusedAdam, SGD  # noqa: F401

@@ -1,0 +1,89 @@
+"""Fused LAMB.
+
+Counterpart of the reference's ``deepspeed/ops/lamb/fused_lamb.py`` (CUDA
+kernel ``csrc/lamb/fused_lamb_cuda_kernel.cu``, frontend
+``fused_lamb_cuda.cpp:108``).  Per-tensor trust-ratio reductions — the part
+the CUDA kernel does with two-pass block reductions — are plain ``jnp.norm``
+calls that XLA fuses with the elementwise update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer import TpuOptimizer, register_optimizer
+
+PyTree = Any
+
+
+@register_optimizer("lamb", "fusedlamb")
+class FusedLamb(TpuOptimizer):
+    """LAMB with the reference constructor surface (max/min_coeff clamp)."""
+
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params=None, lr: float = 1e-3, bias_correction: bool = True,
+                 betas=(0.9, 0.999), eps: float = 1e-8, eps_inside_sqrt: bool = False,
+                 weight_decay: float = 0.0, max_grad_norm: float = 0.0,
+                 max_coeff: float = 10.0, min_coeff: float = 0.01,
+                 amsgrad: bool = False, **kwargs):
+        if amsgrad:
+            raise RuntimeError("FusedLamb does not support the AMSGrad variant")
+        super().__init__(params, lr=lr, weight_decay=weight_decay)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.eps_inside_sqrt = eps_inside_sqrt
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.bias_correction = bias_correction
+
+    def init(self, params: PyTree) -> PyTree:
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "exp_avg": jax.tree_util.tree_map(zeros, params),
+            "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
+        }
+
+    def update(self, grads, state, params, hyper) -> Tuple[PyTree, PyTree]:
+        lr = hyper["lr"]
+        wd = hyper.get("weight_decay", 0.0)
+        beta1, beta2 = self.betas
+        step = state["step"] + 1
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
+            bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = beta1 * m + (1.0 - beta1) * g32
+            v_new = beta2 * v + (1.0 - beta2) * jnp.square(g32)
+            if self.eps_inside_sqrt:
+                denom = jnp.sqrt(v_new / bc2 + self.eps)
+            else:
+                denom = jnp.sqrt(v_new / bc2) + self.eps
+            update = (m_new / bc1) / denom + wd * p32
+            # per-tensor trust ratio (the lamb_coeff of the CUDA kernel)
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(update)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                jnp.float32(1.0))
+            return (p32 - lr * trust * update).astype(p.dtype), m_new, v_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["exp_avg"])
+        flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
+        out = [leaf(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        new_v = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+        return new_p, {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v}

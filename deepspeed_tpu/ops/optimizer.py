@@ -1,0 +1,97 @@
+"""Functional optimizer protocol for the TPU runtime.
+
+The reference's optimizers are stateful torch objects (``FusedAdam``
+``csrc/adam/multi_tensor_adam.cu`` via ``ops/adam/fused_adam.py``); on TPU an
+optimizer is a pure function over pytrees so it can live inside the jitted
+train step, have its state sharded by ZeRO, and be donated buffer-for-buffer.
+
+Two layers:
+
+- ``TpuOptimizer``: the functional core — ``init(params) -> state`` and
+  ``update(grads, state, params, hyper) -> (new_params, new_state)``.
+  ``hyper`` is a dict of *traced* scalars (lr, weight_decay, ...) so LR
+  schedules never recompile.
+- ``param_groups``: a host-side list of dicts (``[{"lr": ...}]``) kept for
+  API parity with torch/reference LR schedulers, which mutate ``group["lr"]``
+  (``runtime/lr_schedules.py``).  The engine reads it back each step and
+  feeds the value into the traced update.
+
+The reference's "multi-tensor apply" machinery (multi_tensor_apply.cuh) is
+unnecessary: a ``tree_map`` of elementwise updates compiles into fused XLA
+loops over every leaf.  A Pallas fused kernel variant is provided in
+``deepspeed_tpu/ops/pallas/fused_adam.py`` for the flat-buffer path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+# Registry: name (lowercase) -> optimizer class
+_OPTIMIZER_REGISTRY: Dict[str, type] = {}
+
+
+def register_optimizer(*names: str):
+    def deco(cls):
+        for n in names:
+            _OPTIMIZER_REGISTRY[n.lower()] = cls
+        return cls
+    return deco
+
+
+def get_optimizer_class(name: str) -> type:
+    key = name.lower()
+    if key not in _OPTIMIZER_REGISTRY:
+        raise ValueError(f"Unknown optimizer {name!r}; known: {sorted(_OPTIMIZER_REGISTRY)}")
+    return _OPTIMIZER_REGISTRY[key]
+
+
+class TpuOptimizer:
+    """Base functional optimizer with torch-like ``param_groups`` on the host."""
+
+    #: hyperparameters that are traced scalars fed per-step (never recompile)
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params: Optional[PyTree] = None, lr: float = 1e-3,
+                 weight_decay: float = 0.0, **kwargs):
+        self.defaults = dict(lr=lr, weight_decay=weight_decay, **kwargs)
+        self.param_groups: List[Dict[str, Any]] = [dict(self.defaults)]
+        self._state: Optional[PyTree] = None
+
+    # -- functional core ---------------------------------------------------
+    def init(self, params: PyTree) -> PyTree:
+        raise NotImplementedError
+
+    def update(self, grads: PyTree, state: PyTree, params: PyTree,
+               hyper: Dict[str, jnp.ndarray]) -> Tuple[PyTree, PyTree]:
+        raise NotImplementedError
+
+    # -- host-side helpers -------------------------------------------------
+    def current_hyperparams(self) -> Dict[str, float]:
+        """Scalars for this step, read from param_groups (scheduler-mutable)."""
+        group = self.param_groups[0]
+        return {k: group.get(k, self.defaults.get(k, 0.0)) for k in self.TRACED_HYPERPARAMS}
+
+    @property
+    def state_spec_like(self) -> Callable[[PyTree], PyTree]:
+        """eval_shape-able init for sharding planning without materializing."""
+        return self.init
+
+    def state_dict(self) -> Dict:
+        return {"param_groups": self.param_groups}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        if "param_groups" in sd:
+            self.param_groups = sd["param_groups"]
+
+
+def bias_correction(step: jnp.ndarray, beta: float) -> jnp.ndarray:
+    return 1.0 - jnp.power(beta, step)
+
+
+def tree_cast(tree: PyTree, dtype) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)

@@ -1,0 +1,190 @@
+"""Device-mesh construction and global parallelism state.
+
+This module is the TPU-native replacement for the reference's process-group
+machinery (``deepspeed/utils/groups.py``, ``runtime/pipe/topology.py``'s
+``PipelineParallelGrid`` at topology.py:249): instead of carving NCCL
+communicators out of a rank grid, we lay all devices out on a single
+`jax.sharding.Mesh` with named axes and express every "group" as a mesh-axis
+name (or tuple of names).  XLA then lowers collectives over those axes onto
+ICI rings automatically.
+
+Canonical axis order (outermost → innermost): ``('pipe','data','expert','seq','model')``.
+- ``model`` (tensor parallel) is innermost so TP collectives ride the
+  fastest ICI links; ``pipe`` is outermost as its p2p traffic is lightest.
+- ZeRO shards along ``('data',)`` (optionally ``('data','expert')`` folded).
+- Expert parallelism subdivides the data axis: dp = ep × edp, mirroring the
+  reference's expert/expert-data groups (utils/groups.py:109).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.logging import logger
+
+# Canonical mesh axis names.
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDims:
+    """Degrees of each parallelism dimension. ``dp=-1`` infers from device count."""
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> "ParallelDims":
+        dp = self.dp
+        fixed = self.tp * self.pp * self.sp
+        if dp == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by tp*pp*sp={fixed}")
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"dp*tp*pp*sp = {dp * fixed} != device count {n_devices}")
+        if self.ep > dp:
+            raise ValueError(f"expert parallel degree {self.ep} > data degree {dp}")
+        if dp % self.ep != 0:
+            raise ValueError(f"dp={dp} not divisible by ep={self.ep}")
+        return ParallelDims(dp=dp, tp=self.tp, pp=self.pp, sp=self.sp, ep=self.ep)
+
+
+def build_mesh(dims: ParallelDims, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the canonical 5-axis mesh ``(pipe, data, expert, seq, model)``.
+
+    The ``data`` axis is split as ``data = dp/ep`` and ``expert = ep`` so a
+    single mesh serves both dense layers (sharded over ``('data','expert')``
+    jointly — the full dp world) and MoE layers (``expert`` = expert
+    parallelism, ``data`` = expert-data parallelism).  This folds the
+    reference's separate expert/expert-data process groups
+    (utils/groups.py:109,209) into one static mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    dims = dims.resolve(len(devices))
+    edp = dims.dp // dims.ep
+    shape = (dims.pp, edp, dims.ep, dims.sp, dims.tp)
+
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception as e:  # pragma: no cover - fallback for odd device sets
+        logger.debug(f"mesh_utils.create_device_mesh failed ({e}); using reshape order")
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+# Axis-name aliases for common "groups": any collective over these names is
+# the TPU equivalent of the reference's corresponding process group.
+DP_GROUP: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS)  # full data-parallel world
+EDP_GROUP: Tuple[str, ...] = (DATA_AXIS,)             # expert-data parallel
+EP_GROUP: Tuple[str, ...] = (EXPERT_AXIS,)            # expert parallel
+TP_GROUP: Tuple[str, ...] = (MODEL_AXIS,)             # tensor/model parallel
+PP_GROUP: Tuple[str, ...] = (PIPE_AXIS,)              # pipeline parallel
+SP_GROUP: Tuple[str, ...] = (SEQ_AXIS,)               # sequence/context parallel
+
+
+class MeshManager:
+    """Holds the live mesh + dims; the analogue of ``PipelineParallelGrid``.
+
+    The reference grid exposes ``get_data_parallel_rank()`` etc.
+    (topology.py:310-370); here those become mesh-axis sizes/indices, mostly
+    consumed through sharding specs rather than imperatively.
+    """
+
+    def __init__(self, dims: ParallelDims, devices: Optional[Sequence] = None):
+        self.dims = dims.resolve(len(devices if devices is not None else jax.devices()))
+        self.mesh = build_mesh(self.dims, devices)
+
+    # --- world/axis sizes -------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, *axes: str) -> int:
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    @property
+    def dp_world_size(self) -> int:
+        return self.axis_size(*DP_GROUP)
+
+    @property
+    def tp_world_size(self) -> int:
+        return self.axis_size(*TP_GROUP)
+
+    @property
+    def pp_world_size(self) -> int:
+        return self.axis_size(*PP_GROUP)
+
+    @property
+    def sp_world_size(self) -> int:
+        return self.axis_size(*SP_GROUP)
+
+    @property
+    def ep_world_size(self) -> int:
+        return self.axis_size(*EP_GROUP)
+
+    # --- sharding helpers -------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def data_sharding(self, extra_dims: int = 0) -> NamedSharding:
+        """Batch sharding: leading dim over the full dp (+seq if sp>1 folds there)."""
+        spec = [DP_GROUP] + [None] * extra_dims
+        return NamedSharding(self.mesh, P(*spec))
+
+    def __repr__(self) -> str:
+        return f"MeshManager(dims={self.dims}, mesh_shape={dict(self.mesh.shape)})"
+
+
+# --- global singleton (parity with deepspeed.utils.groups module state) ----
+_MESH_MANAGER: Optional[MeshManager] = None
+
+
+def initialize_mesh(dims: Optional[ParallelDims] = None,
+                    devices: Optional[Sequence] = None) -> MeshManager:
+    global _MESH_MANAGER
+    _MESH_MANAGER = MeshManager(dims or ParallelDims(), devices)
+    return _MESH_MANAGER
+
+
+def get_mesh_manager() -> MeshManager:
+    global _MESH_MANAGER
+    if _MESH_MANAGER is None:
+        _MESH_MANAGER = MeshManager(ParallelDims())
+    return _MESH_MANAGER
+
+
+def set_mesh_manager(mgr: MeshManager) -> None:
+    global _MESH_MANAGER
+    _MESH_MANAGER = mgr
+
+
+def reset_mesh_manager() -> None:
+    global _MESH_MANAGER
+    _MESH_MANAGER = None

@@ -1,0 +1,132 @@
+"""Cartesian process topology with named axes.
+
+TPU-native counterpart of the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology`` at :9, ``PipeDataParallelTopology`` at :232,
+``PipeModelDataParallelTopology`` at :243).  The reference maps ranks onto a
+cartesian grid and then carves torch process groups out of it; here the same
+grid maps global JAX device indices onto a `jax.sharding.Mesh`, and "process
+groups" become mesh-axis names (see ``deepspeed_tpu/parallel/mesh.py``).
+
+The rank-ordering convention matches the reference: the LAST axis in ``axes``
+is fastest-varying (row-major over the axis list).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List, Sequence
+
+
+class ProcessTopology:
+    """Maps n-dimensional cartesian coordinates to linear global ranks.
+
+    ``axes`` orders axes from outermost (slowest varying) to innermost
+    (fastest varying), identical to the reference's convention, so a
+    topology built with the same axes/dims assigns the same coordinates to
+    the same ranks as the reference does.
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        if len(axes) != len(dims):
+            raise ValueError(f"axes {axes} and dims {dims} must have equal length")
+        if len(set(axes)) != len(axes):
+            raise ValueError(f"duplicate axis names in {axes}")
+        self.axes = list(axes)
+        self.dims = list(dims)
+
+        # namedtuple mapping a rank -> its coordinate on every axis
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+
+        self.mapping: Dict["ProcessTopology.ProcessCoord", int] = {}
+        for rank, coord in enumerate(product(*(range(d) for d in self.dims))):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = rank
+
+    def get_rank(self, **coord_kwargs: int) -> int:
+        """Rank of the process at the given full coordinate."""
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() requires all axes {self.axes}, got {coord_kwargs}")
+        key = self.ProcessCoord(**coord_kwargs)
+        if key not in self.mapping:
+            raise KeyError(f"coord {coord_kwargs} not in topology {self}")
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return list(self.axes)
+
+    def get_rank_repr(self, rank: int, omit_axes: Sequence[str] = ("data", "pipe"),
+                      inner_sep: str = "_", outer_sep: str = "-") -> str:
+        """String like ``model_00-expert_01`` used in checkpoint filenames."""
+        omit = set(omit_axes)
+        coord = self.get_coord(rank)
+        parts = []
+        for axis in self.axes:
+            if axis in omit:
+                continue
+            parts.append(f"{axis}{inner_sep}{getattr(coord, axis):02d}")
+        return outer_sep.join(parts)
+
+    def get_dim(self, axis: str) -> int:
+        """Size of one axis (0 if the axis does not exist)."""
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        """Coordinate namedtuple of a given rank."""
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise KeyError(f"rank {rank} not in topology {self}")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that would communicate along ``axis``.
+
+        E.g. for axes=['pipe','data'] dims=[2,2], axis='data' returns
+        [[0,1],[2,3]] — each inner list varies only along ``axis``.
+        """
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists: List[List[int]] = []
+        for other_coord in product(*(range(self.get_dim(a)) for a in other_axes)):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs: int) -> List[int]:
+        """All ranks whose coordinates match the given axis=value filters."""
+        def matches(coord) -> bool:
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(r for c, r in self.mapping.items() if matches(c))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        """All ranks with coordinate ``idx`` on ``axis``."""
+        return sorted(r for c, r in self.mapping.items() if getattr(c, axis) == idx)
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self) -> str:
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Pipeline × data hybrid (reference topology.py:232): axes ['pipe','data'].
+
+    Data-parallel peers are adjacent in rank space, which on TPU maps the
+    data axis onto the fastest ICI links.
+    """
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pipeline × model(tensor) × data hybrid (reference topology.py:243)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])

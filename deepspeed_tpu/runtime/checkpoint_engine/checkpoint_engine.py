@@ -1,0 +1,31 @@
+"""Pluggable checkpoint backend ABC.
+
+Counterpart of the reference's
+``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py`` — the interface
+behind which Torch (sync) and Nebula (async) engines sit.  The TPU build's
+implementations: ``NativeCheckpointEngine`` (sync, numpy-based) and an
+orbax-backed async engine (``orbax_checkpoint_engine.py``) filling Nebula's
+role.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag: str) -> None:
+        """Log/prepare for a checkpoint under ``tag``."""
+
+    def save(self, state_dict: Any, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Flush/fsync everything belonging to ``tag``; True on success."""
+        return True

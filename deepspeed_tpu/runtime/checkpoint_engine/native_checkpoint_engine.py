@@ -1,0 +1,167 @@
+"""Synchronous numpy checkpoint engine + engine-state save/load helpers.
+
+Fills the role of the reference's ``TorchCheckpointEngine``
+(``runtime/checkpoint_engine/torch_checkpoint_engine.py``) and the engine's
+``_save_checkpoint``/``_load_checkpoint`` (engine.py:3150/:2669).  Layout:
+
+    <dir>/<tag>/model_states.npz        # params (+ scale/counters meta json)
+    <dir>/<tag>/optim_states.npz        # master + optimizer state
+    <dir>/<tag>/client_state.json
+    <dir>/latest                        # text file naming the newest tag
+
+Arrays are stored full (gathered); ZeRO-sharded state re-shards on load via
+the engine's sharding plan, which is what gives dp-degree-elastic resume
+(the reference needs explicit elastic-checkpoint merge logic,
+engine.py:2905; here re-sharding any full array is a device_put).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+from .checkpoint_engine import CheckpointEngine
+
+PyTree = Any
+
+SEP = "/"
+
+
+def flatten_tree(tree: PyTree, prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(flatten_tree(tree[k], f"{prefix}{k}{SEP}"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(flatten_tree(v, f"{prefix}{i}{SEP}"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def unflatten_into(template: PyTree, flat: Dict[str, np.ndarray], prefix: str = "") -> PyTree:
+    """Rebuild arrays following ``template``'s structure from flat storage."""
+    if isinstance(template, dict):
+        return {k: unflatten_into(template[k], flat, f"{prefix}{k}{SEP}")
+                for k in template}
+    if isinstance(template, (list, tuple)):
+        return type(template)(unflatten_into(v, flat, f"{prefix}{i}{SEP}")
+                              for i, v in enumerate(template))
+    key = prefix[:-1]
+    if key not in flat:
+        raise KeyError(f"checkpoint missing tensor {key!r}")
+    return flat[key]
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    def save(self, state_dict: PyTree, path: str) -> None:
+        flat = flatten_tree(state_dict)
+        arrays = {}
+        for k, v in flat.items():
+            a = np.asarray(jax.device_get(v))
+            if a.dtype.kind == "V" or a.dtype.name in ("bfloat16", "float8_e4m3fn",
+                                                       "float8_e5m2"):
+                # not portable through npz; widen losslessly (template dtype
+                # restores the narrow type on load)
+                a = a.astype(np.float32)
+            arrays[k] = a
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        np.savez(path, **arrays)
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+
+def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
+                           client_state: Dict[str, Any], separate_master: bool,
+                           save_latest: bool = True) -> None:
+    eng = NativeCheckpointEngine()
+    ckpt_dir = os.path.join(save_dir, tag)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    model_state = {"params": state["params"], "scale": state["scale"]}
+    # grad_acc is saved so a checkpoint taken mid-accumulation-window resumes
+    # with its partial gradients instead of silently dropping them
+    optim_state = {"opt_state": state["opt_state"], "grad_acc": state["grad_acc"]}
+    if separate_master:
+        optim_state["master"] = state["master"]
+    eng.save(model_state, os.path.join(ckpt_dir, "model_states.npz"))
+    eng.save(optim_state, os.path.join(ckpt_dir, "optim_states.npz"))
+    with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+        json.dump(client_state, f, default=str)
+    if save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(tag)
+    logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
+
+
+def _put_like(template: PyTree, loaded: PyTree, shardings: Optional[PyTree] = None) -> PyTree:
+    def put(t, l, s=None):
+        arr = jnp.asarray(l, dtype=t.dtype)
+        if s is not None:
+            return jax.device_put(arr, s)
+        return jax.device_put(arr, t.sharding) if hasattr(t, "sharding") else arr
+    if shardings is None:
+        return jax.tree_util.tree_map(put, template, loaded)
+    return jax.tree_util.tree_map(put, template, loaded, shardings)
+
+
+def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, Any],
+                           shardings: Optional[Dict[str, Any]] = None,
+                           load_optimizer_states: bool = True,
+                           separate_master: bool = True
+                           ) -> Tuple[Optional[Dict], Dict]:
+    eng = NativeCheckpointEngine()
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest_path):
+            logger.warning(f"no 'latest' file under {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, tag)
+    if not os.path.isdir(ckpt_dir):
+        logger.warning(f"checkpoint dir {ckpt_dir} missing; nothing loaded")
+        return None, {}
+
+    sh = shardings or {}
+    model_flat = eng.load(os.path.join(ckpt_dir, "model_states.npz"))
+    params = unflatten_into(state["params"], model_flat, "params" + SEP)
+    scale = unflatten_into(state["scale"], model_flat, "scale" + SEP)
+    new_state = dict(state)
+    new_state["params"] = _put_like(state["params"], params, sh.get("params"))
+    new_state["scale"] = _put_like(state["scale"], scale, sh.get("scale"))
+
+    if load_optimizer_states:
+        optim_flat = eng.load(os.path.join(ckpt_dir, "optim_states.npz"))
+        opt = unflatten_into(state["opt_state"], optim_flat, "opt_state" + SEP)
+        new_state["opt_state"] = _put_like(state["opt_state"], opt, sh.get("opt_state"))
+        if any(k.startswith("grad_acc" + SEP) for k in optim_flat):
+            acc = unflatten_into(state["grad_acc"], optim_flat, "grad_acc" + SEP)
+            new_state["grad_acc"] = _put_like(state["grad_acc"], acc, sh.get("grads"))
+        if separate_master:
+            master = unflatten_into(state["master"], optim_flat, "master" + SEP)
+            new_state["master"] = _put_like(state["master"], master, sh.get("master"))
+        else:
+            new_state["master"] = new_state["params"]
+    else:
+        new_state["master"] = (new_state["params"] if not separate_master
+                               else state["master"])
+
+    client_path = os.path.join(ckpt_dir, "client_state.json")
+    client_state = {}
+    if os.path.exists(client_path):
+        with open(client_path) as f:
+            client_state = json.load(f)
+    logger.info(f"loaded checkpoint {tag} from {ckpt_dir}")
+    return new_state, client_state

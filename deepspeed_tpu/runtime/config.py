@@ -1,0 +1,266 @@
+"""The DeepSpeed-style JSON config.
+
+Counterpart of the reference's ``deepspeed/runtime/config.py``
+(``DeepSpeedConfig`` :717, batch algebra ``_set_batch_related_parameters``
+:954).  Accepts the same JSON (path or dict); key names are shared via
+``runtime/constants.py`` so reference configs load unchanged.  The dp world
+size used for batch arithmetic is the full data-parallel extent of the mesh
+(``data × expert`` axes), not a torch world size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from ..utils.logging import logger
+from .config_utils import (DeepSpeedConfigModel, ScientificNotationEncoder,
+                           dict_raise_error_on_duplicate_keys, get_scalar_param)
+from .constants import *  # noqa: F401,F403 - key names
+from . import constants as C
+from .zero.config import DeepSpeedZeroConfig, ZERO_OPTIMIZATION
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class CommsLoggerConfig:
+    def __init__(self, d: Dict):
+        self.enabled = get_scalar_param(d, C.COMMS_LOGGER_ENABLED, C.COMMS_LOGGER_ENABLED_DEFAULT)
+        self.verbose = get_scalar_param(d, C.COMMS_LOGGER_VERBOSE, C.COMMS_LOGGER_VERBOSE_DEFAULT)
+        self.prof_all = get_scalar_param(d, C.COMMS_LOGGER_PROF_ALL, C.COMMS_LOGGER_PROF_ALL_DEFAULT)
+        self.debug = get_scalar_param(d, C.COMMS_LOGGER_DEBUG, C.COMMS_LOGGER_DEBUG_DEFAULT)
+        self.prof_ops = get_scalar_param(d, C.COMMS_LOGGER_PROF_OPS, C.COMMS_LOGGER_PROF_OPS_DEFAULT)
+
+
+class DeepSpeedConfig:
+    """Parse + validate a DeepSpeed JSON config for the TPU runtime."""
+
+    def __init__(self, config: Union[str, Dict], mpu=None, mesh_manager=None):
+        if isinstance(config, (str, os.PathLike)):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(
+                    f"Expected a string path to an existing DeepSpeed config, got {config}")
+            with open(config, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path or dict, got {type(config)}")
+
+        # dp extent for batch arithmetic
+        if mesh_manager is not None:
+            self.world_size = mesh_manager.dp_world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            try:
+                import jax
+                self.world_size = jax.device_count()
+            except Exception:
+                self.world_size = 1
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------ params
+    def _initialize_params(self, pd: Dict[str, Any]) -> None:
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+
+        # precision sections
+        fp16_dict = pd.get(C.FP16, {})
+        self.fp16_enabled = get_scalar_param(fp16_dict, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+        self.fp16_auto_cast = get_scalar_param(fp16_dict, C.FP16_AUTO_CAST, C.FP16_AUTO_CAST_DEFAULT)
+        self.fp16_master_weights_and_gradients = get_scalar_param(
+            fp16_dict, C.FP16_MASTER_WEIGHTS_AND_GRADS, C.FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT)
+        self.loss_scale = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = get_scalar_param(
+            fp16_dict, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = get_scalar_param(
+            fp16_dict, C.FP16_LOSS_SCALE_WINDOW, C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = get_scalar_param(fp16_dict, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = get_scalar_param(
+            fp16_dict, C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)
+
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
+        self.bfloat16_enabled = get_scalar_param(bf16_dict, C.BFLOAT16_ENABLED, C.BFLOAT16_ENABLED_DEFAULT)
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 modes cannot both be enabled")
+
+        amp_dict = pd.get(C.AMP, {})
+        self.amp_enabled = get_scalar_param(amp_dict, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
+        self.amp_params = {k: v for k, v in amp_dict.items() if k != C.AMP_ENABLED}
+
+        self.communication_data_type = get_scalar_param(
+            pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        data_types = pd.get(C.DATA_TYPES, {})
+        self.grad_accum_dtype = get_scalar_param(
+            data_types, C.GRAD_ACCUM_DTYPE, C.GRAD_ACCUM_DTYPE_DEFAULT)
+
+        # optimizer / scheduler
+        opt_dict = pd.get(C.OPTIMIZER, None)
+        self.optimizer_name = opt_dict.get(C.TYPE).lower() if opt_dict and opt_dict.get(C.TYPE) else None
+        self.optimizer_params = dict(opt_dict.get(C.OPTIMIZER_PARAMS, {})) if opt_dict else None
+        self.optimizer_legacy_fusion = get_scalar_param(
+            opt_dict or {}, C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd, C.ZERO_ALLOW_UNTESTED_OPTIMIZER, C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+        self.zero_force_ds_cpu_optimizer = get_scalar_param(
+            pd, C.ZERO_FORCE_DS_CPU_OPTIMIZER, C.ZERO_FORCE_DS_CPU_OPTIMIZER_DEFAULT)
+
+        sched_dict = pd.get(C.SCHEDULER, None)
+        self.scheduler_name = sched_dict.get(C.TYPE) if sched_dict else None
+        self.scheduler_params = dict(sched_dict.get(C.SCHEDULER_PARAMS, {})) if sched_dict else None
+
+        # zero
+        self.zero_config = DeepSpeedZeroConfig.from_dict(pd.get(ZERO_OPTIMIZATION, {}))
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        # comms logger
+        self.comms_logger = CommsLoggerConfig(pd.get(C.COMMS_LOGGER, {}))
+        self.comms_logger_enabled = self.comms_logger.enabled
+
+        # checkpoint section
+        ckpt_dict = pd.get(C.CHECKPOINT, {})
+        self.checkpoint_tag_validation_mode = get_scalar_param(
+            ckpt_dict, C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT).lower().capitalize()
+        self.checkpoint_tag_validation_enabled = self.checkpoint_tag_validation_mode != "Ignore"
+        self.checkpoint_tag_validation_fail = self.checkpoint_tag_validation_mode == "Fail"
+        self.load_universal_checkpoint = get_scalar_param(
+            ckpt_dict, C.LOAD_UNIVERSAL_CHECKPOINT, C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+
+        # pld
+        pld_dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
+        self.pld_enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.pld_params = pld_dict if self.pld_enabled else False
+
+        # curriculum
+        curr_dict = pd.get(C.CURRICULUM_LEARNING, {})
+        self.curriculum_enabled = get_scalar_param(curr_dict, C.CURRICULUM_ENABLED, C.CURRICULUM_ENABLED_DEFAULT)
+        self.curriculum_params = curr_dict if self.curriculum_enabled else False
+
+        # eigenvalue (MoQ)
+        eig = pd.get(C.EIGENVALUE, {})
+        self.eigenvalue_enabled = get_scalar_param(eig, C.EIGENVALUE_ENABLED, C.EIGENVALUE_ENABLED_DEFAULT)
+        self.eigenvalue_verbose = get_scalar_param(eig, C.EIGENVALUE_VERBOSE, C.EIGENVALUE_VERBOSE_DEFAULT)
+        self.eigenvalue_max_iter = get_scalar_param(eig, C.EIGENVALUE_MAX_ITER, C.EIGENVALUE_MAX_ITER_DEFAULT)
+        self.eigenvalue_tol = get_scalar_param(eig, C.EIGENVALUE_TOL, C.EIGENVALUE_TOL_DEFAULT)
+        self.eigenvalue_stability = get_scalar_param(eig, C.EIGENVALUE_STABILITY, C.EIGENVALUE_STABILITY_DEFAULT)
+        self.eigenvalue_gas_boundary_resolution = get_scalar_param(
+            eig, C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION, C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT)
+        self.eigenvalue_layer_name = get_scalar_param(eig, C.EIGENVALUE_LAYER_NAME, C.EIGENVALUE_LAYER_NAME_DEFAULT)
+        self.eigenvalue_layer_num = get_scalar_param(eig, C.EIGENVALUE_LAYER_NUM, C.EIGENVALUE_LAYER_NUM_DEFAULT)
+
+        # activation checkpointing
+        act_dict = pd.get(C.ACTIVATION_CHECKPOINTING, {})
+        self.activation_checkpointing_config = act_dict
+
+        # monitor backends (full configs parsed in deepspeed_tpu.monitor)
+        self.monitor_config_dict = {
+            k: pd.get(k, {}) for k in (C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV)
+        }
+        self.flops_profiler_config_dict = pd.get(C.FLOPS_PROFILER, {})
+        self.autotuning_config_dict = pd.get(C.AUTOTUNING, {})
+        self.elasticity_config_dict = pd.get(C.ELASTICITY, {})
+        self.compression_config_dict = pd.get("compression_training", {})
+        self.sparse_attention = pd.get(C.SPARSE_ATTENTION, None)
+        self.data_efficiency_config_dict = pd.get("data_efficiency", {})
+
+        # TPU-specific parallelism sections
+        tp = pd.get(C.TENSOR_PARALLEL, {})
+        self.tensor_parallel_size = tp.get("size", tp.get("tp_size", 1)) if tp.get("enabled", bool(tp)) else 1
+        sp = pd.get(C.SEQUENCE_PARALLEL, {})
+        self.sequence_parallel_size = sp.get("size", 1) if sp.get("enabled", bool(sp)) else 1
+        self.sequence_parallel_mode = sp.get("mode", "ring")
+        self.mesh_dims = pd.get(C.MESH, None)
+
+        pipe = pd.get(C.PIPELINE, {})
+        self.pipeline = pipe
+
+    # ------------------------------------------------------------- batch math
+    def _batch_assertion(self) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all three provided — just check
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            return
+        if train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs "
+                "to be provided")
+
+    def _configure_train_batch_size(self) -> None:
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    # ---------------------------------------------------------------- checks
+    def _do_sanity_check(self) -> None:
+        if self.fp16_enabled and self.fp16_master_weights_and_gradients:
+            if not (self.zero_enabled and self.zero_optimization_stage in (1, 2) and
+                    self.zero_config.cpu_offload):
+                raise DeepSpeedConfigError(
+                    "fp16_master_weights_and_grads requires ZeRO stage 1/2 with "
+                    "cpu offload (reference engine.py constraint)")
+        if self.optimizer_name is None and self.optimizer_params is not None:
+            raise DeepSpeedConfigError("optimizer params given without optimizer type")
+
+    def print_user_config(self) -> str:
+        return json.dumps(self._param_dict, sort_keys=True, indent=4,
+                          cls=ScientificNotationEncoder, default=str)
+
+    def print(self, name: str = "DeepSpeedConfig") -> None:
+        logger.info(f"{name}:\n{self.print_user_config()}")

@@ -1,0 +1,95 @@
+"""Config parsing helpers and the typed-config base class.
+
+Counterpart of the reference's ``deepspeed/runtime/config_utils.py``:
+``get_scalar_param``-style dict access plus a ``DeepSpeedConfigModel``
+equivalent.  The reference uses pydantic; here a small dataclass-based model
+provides the same surface (unknown-key warnings, deprecated-field aliasing,
+``.to_dict()``) without the dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Type, TypeVar
+
+from ..utils.logging import logger
+
+T = TypeVar("T", bound="DeepSpeedConfigModel")
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the JSON config (reference behavior)."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        dupes = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {dupes}")
+    return d
+
+
+@dataclasses.dataclass
+class DeepSpeedConfigModel:
+    """Dataclass base with dict round-tripping and deprecated-field aliasing.
+
+    Subclasses may define ``_deprecated_fields = {"old_key": "new_key"}``;
+    old keys in the input dict are remapped with a warning, matching the
+    reference's pydantic ``new_param``/``deprecated`` machinery
+    (config_utils.py / zero/config.py:78).
+    """
+
+    _deprecated_fields: Dict[str, str] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[Dict[str, Any]] = None, **overrides) -> T:
+        data = dict(data or {})
+        data.update(overrides)
+        deprecated = {}
+        for f in dataclasses.fields(cls):
+            if f.name == "_deprecated_fields":
+                deprecated = f.default_factory() if callable(f.default_factory) else {}
+        # allow subclasses to declare as class attr too
+        deprecated = dict(getattr(cls, "DEPRECATED_FIELDS", deprecated))
+        for old, new in deprecated.items():
+            if old in data:
+                logger.warning(
+                    f"Config parameter {old} is deprecated, use {new} instead")
+                data.setdefault(new, data.pop(old))
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        known = {k: v for k, v in data.items() if k in field_names}
+        unknown = [k for k in data if k not in field_names and k != "_deprecated_fields"]
+        if unknown:
+            logger.warning(f"{cls.__name__}: ignoring unknown config keys {unknown}")
+        return cls(**known)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dataclasses.asdict(self)
+        out.pop("_deprecated_fields", None)
+        return out
+
+    def __str__(self) -> str:
+        return f"{type(self).__name__}({json.dumps(self.to_dict(), default=str)})"
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """Print large/small floats in scientific notation (reference class)."""
+
+    def iterencode(self, o, _one_shot=False):
+        if isinstance(o, float) and (abs(o) >= 1e3 or (0 < abs(o) < 1e-3)):
+            return iter([f"{o:e}"])
+        return super().iterencode(o, _one_shot=_one_shot)

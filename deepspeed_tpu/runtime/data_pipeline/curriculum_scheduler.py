@@ -1,0 +1,107 @@
+"""Curriculum learning scheduler.
+
+Counterpart of the reference's
+``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler`` :8): fixed_linear / fixed_root / fixed_discrete /
+custom difficulty schedules.  The engine injects the current difficulty as
+``curriculum_seqlen`` (reference engine.py:1704-1710); on TPU the model pads
+or slices to bucketed sequence lengths so jit recompiles only per bucket.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+CURRICULUM_LEARNING_MIN_DIFFICULTY = "min_difficulty"
+CURRICULUM_LEARNING_MAX_DIFFICULTY = "max_difficulty"
+CURRICULUM_LEARNING_SCHEDULE_TYPE = "schedule_type"
+CURRICULUM_LEARNING_SCHEDULE_CONFIG = "schedule_config"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        assert CURRICULUM_LEARNING_MIN_DIFFICULTY in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_MIN_DIFFICULTY}'"
+        assert CURRICULUM_LEARNING_MAX_DIFFICULTY in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_MAX_DIFFICULTY}'"
+        assert CURRICULUM_LEARNING_SCHEDULE_TYPE in config, \
+            f"Curriculum learning requires the config '{CURRICULUM_LEARNING_SCHEDULE_TYPE}'"
+        self.state = {
+            "min_difficulty": config[CURRICULUM_LEARNING_MIN_DIFFICULTY],
+            "max_difficulty": config[CURRICULUM_LEARNING_MAX_DIFFICULTY],
+            "current_difficulty": config[CURRICULUM_LEARNING_MIN_DIFFICULTY],
+            "schedule_type": config[CURRICULUM_LEARNING_SCHEDULE_TYPE],
+        }
+        self.first_step = True
+        schedule_type = config[CURRICULUM_LEARNING_SCHEDULE_TYPE]
+        sched_cfg = config.get(CURRICULUM_LEARNING_SCHEDULE_CONFIG, {})
+        if schedule_type == FIXED_LINEAR:
+            assert "total_curriculum_step" in sched_cfg and "difficulty_step" in sched_cfg
+        elif schedule_type == FIXED_ROOT:
+            assert "total_curriculum_step" in sched_cfg and "difficulty_step" in sched_cfg \
+                and "root_degree" in sched_cfg
+        elif schedule_type == FIXED_DISCRETE:
+            assert "difficulty" in sched_cfg and "max_step" in sched_cfg
+            assert len(sched_cfg["max_step"]) > 0
+            assert len(sched_cfg["difficulty"]) > 0
+            assert len(sched_cfg["difficulty"]) == len(sched_cfg["max_step"]) + 1
+        elif schedule_type == CUSTOM:
+            self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        else:
+            raise RuntimeError(f"Unsupported curriculum schedule type {schedule_type}")
+        self.state["schedule"] = sched_cfg
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> Dict:
+        return self.state
+
+    def set_state(self, state: Dict) -> None:
+        self.state = state
+
+    def _fixed_root_get_difficulty(self, global_steps: int, root_degree: Optional[int] = None) -> int:
+        s = self.state["schedule"]
+        if root_degree is None:
+            root_degree = s["root_degree"]
+        next_diff = (global_steps / s["total_curriculum_step"]) ** (1.0 / root_degree)
+        next_diff = math.floor(
+            next_diff * (self.state["max_difficulty"] - self.state["min_difficulty"])
+            + self.state["min_difficulty"])
+        next_diff -= next_diff % s["difficulty_step"]
+        return min(next_diff, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == FIXED_LINEAR:
+            return self._fixed_root_get_difficulty(global_steps, 1)
+        if stype == FIXED_ROOT:
+            return self._fixed_root_get_difficulty(global_steps)
+        if stype == FIXED_DISCRETE:
+            s = self.state["schedule"]
+            for i, step in enumerate(s["max_step"]):
+                if global_steps <= step:
+                    return s["difficulty"][i]
+            return s["difficulty"][-1]
+        if stype == CUSTOM:
+            assert self.custom_get_difficulty is not None, \
+                "custom curriculum requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"Unsupported schedule type {stype}")
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

@@ -1,0 +1,81 @@
+"""Data loading.
+
+Counterpart of the reference's ``deepspeed/runtime/dataloader.py``
+(``DeepSpeedDataLoader`` + DistributedSampler wiring, 113 LoC) and
+``RepeatingLoader``.  The torch loader gives each rank its dp-shard of the
+batch; under single-controller JAX the loader yields *global* batches (numpy)
+and the engine places them sharded over the dp mesh axes — same data-parallel
+semantics, one process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+PyTree = Any
+
+
+def _default_collate(items: Sequence) -> PyTree:
+    """Stack a list of samples into batched numpy arrays (dict/tuple/array)."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([it[i] for it in items])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+class DeepSpeedDataLoader:
+    """Batching iterator over an indexable dataset, global-batch semantics."""
+
+    def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = True,
+                 mesh_manager=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.len
+
+    def __iter__(self) -> Iterator[PyTree]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        for start in range(0, self.len * self.batch_size, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+class RepeatingLoader:
+    """Endlessly cycle a loader (reference ``RepeatingLoader`` dataloader.py)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

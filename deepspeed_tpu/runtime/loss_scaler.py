@@ -1,0 +1,100 @@
+"""Static and dynamic loss scaling, jit-resident.
+
+Counterpart of the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler``/``DynamicLossScaler``, file :225).  The scaler state lives in
+the training state pytree as traced scalars and updates with ``jnp.where`` —
+no host round-trip or recompile on overflow, unlike the CUDA path which syncs
+to decide whether to skip the step.
+
+fp16 isn't the natural TPU dtype (bf16 needs no scaling and is the default),
+but the full fp16 semantics are preserved for parity: initial scale 2^power,
+growth after ``scale_window`` good steps, halving + hysteresis on overflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScalerConfig:
+    enabled: bool = False            # False → scale pinned at 1 (bf16/fp32)
+    static_scale: float = 0.0        # >0 → static scaling, no dynamics
+    init_scale: float = 2.0 ** 16
+    scale_window: int = 1000
+    scale_factor: float = 2.0
+    min_scale: float = 1.0
+    delayed_shift: int = 2           # hysteresis
+
+    @classmethod
+    def from_ds_config(cls, ds_config) -> "LossScalerConfig":
+        if not ds_config.fp16_enabled:
+            return cls(enabled=False)
+        return cls(
+            enabled=True,
+            static_scale=float(ds_config.loss_scale),
+            init_scale=2.0 ** ds_config.initial_scale_power,
+            scale_window=ds_config.loss_scale_window,
+            min_scale=ds_config.min_loss_scale,
+            delayed_shift=ds_config.hysteresis,
+        )
+
+    @property
+    def dynamic(self) -> bool:
+        return self.enabled and self.static_scale == 0
+
+
+def init_state(config: LossScalerConfig) -> Dict[str, jnp.ndarray]:
+    scale = config.init_scale if config.dynamic else (
+        config.static_scale if config.enabled else 1.0)
+    return {
+        "loss_scale": jnp.asarray(scale, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+        "hysteresis": jnp.asarray(config.delayed_shift, jnp.int32),
+    }
+
+
+def update_state(state: Dict[str, jnp.ndarray], overflow: jnp.ndarray,
+                 config: LossScalerConfig) -> Dict[str, jnp.ndarray]:
+    """Advance scaler state given this step's overflow flag (traced)."""
+    if not config.dynamic:
+        return {**state, "good_steps": state["good_steps"] + 1}
+    scale, good, hyst = state["loss_scale"], state["good_steps"], state["hysteresis"]
+
+    hyst_after = jnp.where(overflow, jnp.maximum(hyst - 1, 0), hyst)
+    drop = jnp.logical_and(overflow, hyst_after <= 0)
+    scale_down = jnp.maximum(scale / config.scale_factor, config.min_scale)
+
+    window_full = good + 1 >= config.scale_window
+    grow = jnp.logical_and(jnp.logical_not(overflow), window_full)
+    scale_up = scale * config.scale_factor
+
+    new_scale = jnp.where(drop, scale_down, jnp.where(grow, scale_up, scale))
+    new_good = jnp.where(overflow, 0, jnp.where(grow, 0, good + 1))
+    new_hyst = jnp.where(overflow, jnp.where(drop, config.delayed_shift, hyst_after),
+                         jnp.asarray(config.delayed_shift, jnp.int32))
+    return {"loss_scale": new_scale, "good_steps": new_good, "hysteresis": new_hyst}
+
+
+class LossScaler:
+    """Host-facing wrapper for API parity (``cur_scale`` etc.)."""
+
+    def __init__(self, config: LossScalerConfig):
+        self.config = config
+        self.state = init_state(config)
+
+    @property
+    def cur_scale(self) -> float:
+        return float(self.state["loss_scale"])
+
+    @property
+    def dynamic(self) -> bool:
+        return self.config.dynamic

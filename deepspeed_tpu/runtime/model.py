@@ -1,0 +1,61 @@
+"""ModelSpec: what the engine trains.
+
+The reference wraps an ``nn.Module`` (engine.py:182 takes ``model``); the TPU
+engine trains a *functional* model: a pure loss function over a param pytree.
+``ModelSpec`` carries that function plus everything the runtime needs to
+shard and initialize it.  ``from_gpt`` adapts the in-tree GPT family; HF/Flax
+models adapt through ``deepspeed_tpu.module_inject``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    #: (params, batch) -> scalar loss. Must be pure/jittable. Models cast
+    #: params to their compute dtype internally.
+    loss_fn: Callable[[PyTree, Any], Any]
+    #: rng -> params (fp32 master values). Run under jax.eval_shape for
+    #: abstract init (the zero.Init equivalent — no monkey-patching needed).
+    init_fn: Optional[Callable[[jax.Array], PyTree]] = None
+    #: pre-materialized params (alternative to init_fn)
+    params: Optional[PyTree] = None
+    #: tree of per-dim logical axis names (models/partitioning.py vocabulary)
+    logical_axes: Optional[PyTree] = None
+    #: optional forward fn (params, inputs) -> outputs, for eval/inference
+    apply_fn: Optional[Callable] = None
+    name: str = "model"
+    #: free-form extras (model config etc.)
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def param_shapes(self, rng: Optional[jax.Array] = None) -> PyTree:
+        if self.params is not None:
+            return jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), self.params)
+        assert self.init_fn is not None, "ModelSpec needs params or init_fn"
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return jax.eval_shape(self.init_fn, rng)
+
+
+def from_gpt(config, dtype=None) -> ModelSpec:
+    """Adapt ``deepspeed_tpu.models.gpt`` to a ModelSpec."""
+    from ..models import gpt
+
+    if dtype is not None:
+        config = dataclasses.replace(config, dtype=dtype)
+
+    return ModelSpec(
+        loss_fn=lambda params, batch: gpt.loss_fn(params, batch, config),
+        init_fn=lambda rng: gpt.init(config, rng),
+        logical_axes=gpt.logical_axes(config),
+        apply_fn=lambda params, tokens: gpt.apply(params, tokens, config),
+        name="gpt",
+        meta={"config": config},
+    )

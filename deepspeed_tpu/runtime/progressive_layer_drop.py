@@ -1,0 +1,30 @@
+"""Progressive Layer Dropping schedule.
+
+Counterpart of the reference's ``deepspeed/runtime/progressive_layer_drop.py``
+(file :33): theta(t) = (1 - theta_0) * exp(-gamma * t) inverted into a keep
+probability that decays toward ``theta``.  The engine passes the current
+theta into the model each step (models consume it as a per-layer keep prob
+inside ``lax.scan``).
+"""
+
+from __future__ import annotations
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        def _prob(x, gamma, p):
+            import math
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

@@ -1,0 +1,167 @@
+"""Runtime utilities.
+
+Counterpart of the reference's ``deepspeed/runtime/utils.py``:
+``partition_uniform``/``partition_balanced`` (:575,:641) for pipeline layer
+placement, ``clip_grad_norm_``, ``CheckOverflow``, ``see_memory_usage``.
+Gradient-norm/overflow logic here is functional (pytree → scalar) so it runs
+inside the jitted step; "model-parallel allreduce" of norms is implicit —
+grads are global arrays, so a plain ``jnp`` reduction already spans every
+shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+PyTree = Any
+
+
+# --------------------------------------------------------------- partitioning
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Split num_items into num_parts as evenly as possible (ref utils.py:575).
+
+    Returns part boundaries of length num_parts+1.
+    """
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items - chunk * num_parts
+    for p in range(1, num_parts + 1):
+        parts[p] = parts[p - 1] + chunk + (1 if p <= residual else 0)
+    assert parts[-1] == num_items
+    return parts
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int, eps: float = 1e-3) -> List[int]:
+    """Weighted balanced partition via binary search over bottleneck cost
+    (reference ``partition_balanced`` utils.py:641)."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    prefix = [0.0] + prefix_sum_inc(weights)
+
+    def feasible(limit: float) -> Optional[List[int]]:
+        parts = [0]
+        for _ in range(num_parts):
+            start = parts[-1]
+            target = prefix[start] + limit
+            # furthest end with cost <= limit
+            lo, hi = start, num_items
+            while lo < hi:
+                mid = (lo + hi + 1) // 2
+                if prefix[mid] - prefix[start] <= limit:
+                    lo = mid
+                else:
+                    hi = mid - 1
+            if lo == start and start < num_items:
+                return None  # single item exceeds limit
+            parts.append(lo)
+        return parts if parts[-1] == num_items else None
+
+    lo = max(weights)
+    hi = prefix[-1]
+    while hi - lo > eps * max(1.0, hi):
+        mid = (lo + hi) / 2
+        if feasible(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    parts = feasible(hi)
+    assert parts is not None
+    # pad monotonically if search returned short
+    while len(parts) < num_parts + 1:
+        parts.append(num_items)
+    return parts
+
+
+# ------------------------------------------------------------ grads / norms
+
+def global_grad_norm(grads: PyTree, norm_type: float = 2.0) -> jnp.ndarray:
+    """Global norm over all leaves, fp32 (ref ``get_grad_norm``/``clip_grad_norm_``)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(grads) if l is not None]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    acc = sum(jnp.sum(jnp.abs(l.astype(jnp.float32)) ** norm_type) for l in leaves)
+    return acc ** (1.0 / norm_type)
+
+
+def clip_grads_by_global_norm(grads: PyTree, max_norm: float,
+                              precomputed_norm: Optional[jnp.ndarray] = None
+                              ) -> Tuple[PyTree, jnp.ndarray]:
+    """Scale grads so the global norm ≤ max_norm (ref ``clip_grad_norm_``)."""
+    norm = precomputed_norm if precomputed_norm is not None else global_grad_norm(grads)
+    clip_coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, norm
+
+
+def has_overflow(grads: PyTree) -> jnp.ndarray:
+    """True iff any leaf contains inf/nan (ref ``CheckOverflow``/``_has_inf_or_nan``).
+
+    Computed as a fused all-finite check so it stays inside the jitted step —
+    the reference does a separate device→host sync + dp/mp allreduce
+    (stage_1_and_2.py ``check_overflow``); here the allreduce is implicit in
+    the global-array reduction.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), bool)
+    finite = jnp.array(True)
+    for l in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l.astype(jnp.float32))))
+    return jnp.logical_not(finite)
+
+
+# ----------------------------------------------------------------- memory
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log device + host memory (ref ``see_memory_usage`` runtime/utils.py)."""
+    if not force:
+        return
+    lines = [message]
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                used = stats.get("bytes_in_use", 0) / 2**30
+                limit = stats.get("bytes_limit", 0) / 2**30
+                lines.append(f"  {d}: {used:.2f}GB in use / {limit:.2f}GB limit")
+    except Exception:
+        pass
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        lines.append(f"  host: {vm.used / 2**30:.2f}GB used ({vm.percent}%)")
+    except Exception:
+        pass
+    logger.info("\n".join(lines))
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """Pretty call repr used by pipeline instruction logging (ref utils.py)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    return name + ")"

@@ -1,0 +1,2 @@
+from .config import DeepSpeedZeroConfig  # noqa: F401
+from .partitioner import ZeroPartitioner, ZeroShardings  # noqa: F401

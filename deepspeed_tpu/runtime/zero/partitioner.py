@@ -1,0 +1,167 @@
+"""ZeRO stages as declarative mesh sharding.
+
+The heart of ZeRO on TPU.  The reference implements stages 1/2 with a
+hand-rolled flat-buffer partitioner + backward-hook bucketer
+(``runtime/zero/stage_1_and_2.py:98``) and stage 3 with a trace-based
+parameter coordinator (``stage3.py:66``, ``partitioned_param_coordinator.py``).
+On TPU the *mechanism* is sharding annotations — XLA inserts exactly the
+collectives those 5000 lines schedule by hand:
+
+  stage 0: params/grads/opt-state replicated; grads all-reduced (psum).
+  stage 1: opt-state + master fp32 weights sharded over dp; grads
+           all-reduced; the weight update computes on shards and the new
+           params all-gather back (weight-update sharding, a.k.a. the
+           optimizer partition of stage_1_and_2.py ``step``:1746).
+  stage 2: + gradients annotated dp-sharded, so XLA lowers the backward
+           epilogue to reduce-scatter (the IPG bucket path :868).
+  stage 3: + parameters *stored* dp-sharded (FSDP); the forward/backward
+           all-gathers that ``fetch_sub_module`` issues per-module
+           (partitioned_param_coordinator.py:239) become XLA-scheduled
+           gathers, overlapped by the latency-hiding scheduler.
+
+Per-param placement policy: shard the largest dim divisible by the dp extent
+that isn't already claimed by tensor parallelism; params smaller than
+``param_persistence_threshold`` stay replicated — the same role the
+persistence threshold plays in the reference (parameter_offload.py:310).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS, EXPERT_AXIS, MeshManager
+from ...utils.logging import logger
+from .config import DeepSpeedZeroConfig
+
+PyTree = Any
+
+#: dp axes ZeRO shards across (full data-parallel world)
+ZERO_AXES: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS)
+
+
+def _spec_leaf(x) -> bool:
+    return isinstance(x, P)
+
+
+def _dp_extent(mesh: Mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in ZERO_AXES if a in mesh.shape]))
+
+
+def _add_dp_to_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh,
+                    threshold: int = 0) -> P:
+    """Shard the largest free, divisible dim of ``shape`` over the dp axes."""
+    dp = _dp_extent(mesh)
+    if dp <= 1 or int(np.prod(shape)) <= threshold:
+        return spec
+    spec_t = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    used = set()
+    for s in spec_t:
+        if s is None:
+            continue
+        for a in (s if isinstance(s, (tuple, list)) else (s,)):
+            used.add(a)
+    if any(a in used for a in ZERO_AXES):
+        return P(*spec_t)  # already dp-sharded (e.g. FSDP rule on embed)
+    # choose the largest divisible unclaimed dim
+    best, best_size = None, 0
+    for i, (dim, s) in enumerate(zip(shape, spec_t)):
+        if s is None and dim % dp == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best is None:
+        return P(*spec_t)  # indivisible everywhere → stays replicated
+    new = list(spec_t)
+    new[best] = ZERO_AXES if len(ZERO_AXES) > 1 else ZERO_AXES[0]
+    return P(*new)
+
+
+@dataclasses.dataclass
+class ZeroShardings:
+    """Sharding plan for one training state."""
+
+    params: PyTree          # NamedSharding tree for stored params
+    grads: PyTree           # for grad accumulation buffers
+    master: PyTree          # fp32 master copies (stages >=1; == params at 0)
+    opt_state_fn: Any       # callable: opt_state shape tree -> sharding tree
+
+
+class ZeroPartitioner:
+    """Builds the sharding plan from the zero config + base (TP) specs."""
+
+    def __init__(self, zero_config: DeepSpeedZeroConfig, mesh_manager: MeshManager,
+                 base_specs: PyTree, param_shapes: PyTree):
+        self.config = zero_config
+        self.mm = mesh_manager
+        self.mesh = mesh_manager.mesh
+        self.stage = zero_config.stage
+        self.base_specs = base_specs
+        self.param_shapes = param_shapes
+
+    # -- spec trees --------------------------------------------------------
+    def _fsdp_specs(self, threshold: int = 0) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda spec, shp: _add_dp_to_spec(
+                spec, shp.shape if hasattr(shp, "shape") else shp, self.mesh, threshold),
+            self.base_specs, self.param_shapes, is_leaf=_spec_leaf)
+
+    def param_specs(self) -> PyTree:
+        if self.stage >= 3:
+            return self._fsdp_specs(threshold=self.config.param_persistence_threshold)
+        return self.base_specs
+
+    def grad_specs(self) -> PyTree:
+        if self.stage >= 2:
+            return self._fsdp_specs()
+        return self.base_specs
+
+    def master_specs(self) -> PyTree:
+        if self.stage >= 1:
+            return self._fsdp_specs()
+        return self.base_specs
+
+    # -- shardings ---------------------------------------------------------
+    def _to_shardings(self, specs: PyTree) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_spec_leaf)
+
+    def plan(self) -> ZeroShardings:
+        param_sh = self._to_shardings(self.param_specs())
+        grad_sh = self._to_shardings(self.grad_specs())
+        master_sh = self._to_shardings(self.master_specs())
+        master_specs = self.master_specs()
+        params_treedef = jax.tree_util.tree_structure(
+            self.param_shapes, is_leaf=lambda x: hasattr(x, "shape"))
+
+        def opt_state_shardings(opt_state_shapes: PyTree) -> PyTree:
+            """Shard params-shaped subtrees like the master partition;
+            everything else (step counters, scalars) replicated."""
+            def shard_subtree(sub):
+                try:
+                    sub_def = jax.tree_util.tree_structure(sub)
+                    if sub_def == params_treedef:
+                        return master_sh
+                except Exception:
+                    pass
+                return jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, P()), sub)
+
+            if isinstance(opt_state_shapes, dict):
+                return {k: shard_subtree(v) for k, v in opt_state_shapes.items()}
+            return jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P()), opt_state_shapes)
+
+        return ZeroShardings(params=param_sh, grads=grad_sh, master=master_sh,
+                             opt_state_fn=opt_state_shardings)
+
+    def describe(self) -> str:
+        dp = _dp_extent(self.mesh)
+        return (f"ZeRO stage {self.stage} over dp={dp} "
+                f"(axes {ZERO_AXES}): params "
+                f"{'sharded' if self.stage >= 3 else 'replicated'}, grads "
+                f"{'sharded' if self.stage >= 2 else 'replicated'}, opt-state "
+                f"{'sharded' if self.stage >= 1 else 'replicated'}")

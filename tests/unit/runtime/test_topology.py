@@ -1,0 +1,58 @@
+"""ProcessTopology tests (mirror reference tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology,
+                                             ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list("row", 0) == [0, 1]
+    assert topo.get_axis_list("col", 0) == [0, 2]
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2 and topo.get_dim("b") == 3 and topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # last axis varies fastest: rank = pipe*2 + data
+    assert topo.get_axis_comm_lists("data") == [[0, 1], [2, 3]]
+    assert topo.get_axis_comm_lists("pipe") == [[0, 2], [1, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # axes order is [pipe, data, model]
+    ranks = topo.filter_match(pipe=0)
+    assert ranks == [0, 1, 2, 3]
+    assert topo.filter_match(pipe=1, model=1) == [5, 7]
+
+
+def test_topology_coord_roundtrip():
+    topo = ProcessTopology(axes=["x", "y"], dims=[3, 2])
+    for r in range(6):
+        c = topo.get_coord(r)
+        assert topo.get_rank(x=c.x, y=c.y) == r
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.get_rank_repr(0) == "model_00"
+    assert topo.get_rank_repr(1) == "model_01"
+
+
+def test_duplicate_axes_rejected():
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a", "a"], dims=[2, 2])
