@@ -169,16 +169,22 @@ def _attention(q, k, v, config: GPTConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer_params, config: GPTConfig):
-    """One transformer block on [B, S, d]."""
+def _attn_residual(x, p, config: GPTConfig):
+    """LN1 + causal MHA + output projection, added residually. [B,S,d]."""
     cdt = config.dtype
-    p = layer_params
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) + p["bqkv"].astype(cdt)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     attn = _attention(q, k, v, config)
     attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
-    x = x + attn_out
+    return x + attn_out
+
+
+def _block(x, layer_params, config: GPTConfig):
+    """One transformer block on [B, S, d]."""
+    cdt = config.dtype
+    p = layer_params
+    x = _attn_residual(x, p, config)
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
     ff = jax.nn.gelu(ff, approximate=True)

@@ -1,0 +1,4 @@
+from .layer import MoE  # noqa: F401
+from .sharded_moe import TopKGate, top1gating, top2gating  # noqa: F401
+from .experts import experts_apply, experts_init  # noqa: F401
+from .utils import has_moe_layers, split_moe_param_tree  # noqa: F401

@@ -1,0 +1,54 @@
+"""Expert FFN stacks.
+
+Counterpart of the reference's ``deepspeed/moe/experts.py`` (``Experts`` :9 —
+num_local_experts module copies with params tagged ``allreduce=False`` and a
+``group_name``).  Here ALL experts live in one stacked param tree with the
+leading expert dim sharded over the expert mesh axis; "local experts" is a
+storage consequence of that sharding, and the expert-dp-only gradient
+reduction the reference implements with tagged params + a second allreduce
+(engine.py:2324) falls out of the sharding automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..models.partitioning import EMBED, EXPERT, MLP
+
+PyTree = Any
+
+
+def experts_init(rng: jax.Array, num_experts: int, d_model: int, d_ff: int,
+                 dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    k1, k2 = jax.random.split(rng)
+    std = 0.02
+    return {
+        "wi": (jax.random.normal(k1, (num_experts, d_model, d_ff)) * std).astype(dtype),
+        "bi": jnp.zeros((num_experts, d_ff), dtype),
+        "wo": (jax.random.normal(k2, (num_experts, d_ff, d_model)) * std).astype(dtype),
+        "bo": jnp.zeros((num_experts, d_model), dtype),
+    }
+
+
+def experts_logical_axes() -> Dict[str, tuple]:
+    return {
+        "wi": (EXPERT, EMBED, MLP),
+        "bi": (EXPERT, MLP),
+        "wo": (EXPERT, MLP, EMBED),
+        "bo": (EXPERT, EMBED),
+    }
+
+
+def experts_apply(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                  compute_dtype=None) -> jnp.ndarray:
+    """x: [E, C, d] → [E, C, d]; per-expert FFN, batched over the expert dim."""
+    cdt = compute_dtype or x.dtype
+    h = jnp.einsum("ecd,edf->ecf", x, params["wi"].astype(cdt)) + \
+        params["bi"].astype(cdt)[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(cdt)) + \
+        params["bo"].astype(cdt)[:, None, :]
+    return out

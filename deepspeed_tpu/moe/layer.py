@@ -1,0 +1,95 @@
+"""Public MoE layer.
+
+Counterpart of the reference's ``deepspeed/moe/layer.py`` (``MoE`` :15, with
+optional Residual-MoE :108-133 per DeepSpeed-MoE).  Process-group creation
+(``_create_process_groups`` :90) has no runtime action here: expert
+parallelism is the mesh's ``expert`` axis, fixed at mesh construction
+(``parallel/mesh.py``), which mirrors ``ep_size`` semantics — experts are
+partitioned ep-ways, each group of dp/ep devices holds one expert shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.partitioning import EMBED, MLP
+from .experts import experts_apply, experts_init, experts_logical_axes
+from .sharded_moe import TopKGate, moe_layer_forward
+
+PyTree = Any
+
+
+class MoE:
+    """Mixture of Experts layer (functional init/apply, reference MoE surface)."""
+
+    def __init__(self, hidden_size: int, num_experts: int = 1, ep_size: int = 1,
+                 k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 use_residual: bool = False, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, use_rts: bool = True,
+                 expert_intermediate_size: Optional[int] = None):
+        assert num_experts % ep_size == 0, \
+            f"number of experts ({num_experts}) must be divisible by ep_size ({ep_size})"
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.num_local_experts = num_experts // ep_size
+        self.use_residual = use_residual
+        self.d_ff = expert_intermediate_size or 4 * hidden_size
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity,
+                             noisy_gate_policy, drop_tokens, use_rts)
+
+    # -- params ------------------------------------------------------------
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> PyTree:
+        kg, ke, kr, kw, kc = jax.random.split(rng, 5)
+        params = {
+            "gate": self.gate.init(kg),
+            "experts": experts_init(ke, self.num_experts, self.hidden_size,
+                                    self.d_ff, dtype),
+        }
+        if self.use_residual:
+            std = 0.02
+            params["residual_mlp"] = {
+                "wi": (jax.random.normal(kr, (self.hidden_size, self.d_ff)) * std).astype(dtype),
+                "bi": jnp.zeros((self.d_ff,), dtype),
+                "wo": (jax.random.normal(kw, (self.d_ff, self.hidden_size)) * std).astype(dtype),
+                "bo": jnp.zeros((self.hidden_size,), dtype),
+            }
+            params["coefficient"] = (jax.random.normal(kc, (self.hidden_size, 2)) * std
+                                     ).astype(dtype)
+        return params
+
+    def logical_axes(self) -> PyTree:
+        axes = {
+            "gate": {"wg": (EMBED, None)},
+            "experts": experts_logical_axes(),
+        }
+        if self.use_residual:
+            axes["residual_mlp"] = {"wi": (EMBED, MLP), "bi": (MLP,),
+                                    "wo": (MLP, EMBED), "bo": (EMBED,)}
+            axes["coefficient"] = (EMBED, None)
+        return axes
+
+    # -- forward -----------------------------------------------------------
+    def apply(self, params: PyTree, x: jnp.ndarray, train: bool = True,
+              rng=None, constrain=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """x: [B,S,d] → (out [B,S,d], l_aux, exp_counts)."""
+        out, l_aux, exp_counts = moe_layer_forward(
+            self.gate, params["gate"],
+            lambda p, xe: experts_apply(p, xe, compute_dtype=x.dtype),
+            params["experts"], x, train=train, rng=rng, constrain=constrain)
+        if self.use_residual:
+            # Residual-MoE (reference layer.py:108): out = moe + coef-mixed mlp
+            r = params["residual_mlp"]
+            h = jax.nn.gelu(x @ r["wi"].astype(x.dtype) + r["bi"].astype(x.dtype),
+                            approximate=True)
+            mlp_out = h @ r["wo"].astype(x.dtype) + r["bo"].astype(x.dtype)
+            coef = jax.nn.softmax(
+                (x @ params["coefficient"].astype(x.dtype)).astype(jnp.float32),
+                axis=-1).astype(x.dtype)
+            out = out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+        return out, l_aux, exp_counts

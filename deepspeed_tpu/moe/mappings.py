@@ -1,0 +1,34 @@
+"""Token gather/drop along the sequence dim for TP×EP interaction.
+
+Counterpart of the reference's ``deepspeed/moe/mappings.py``
+(``gather_tokens`` :27 / ``drop_tokens`` :50 with autograd fns :62,:78):
+when tensor parallelism is active, tokens entering the (expert-parallel) MoE
+block are de-duplicated across TP ranks by dropping each rank's slice of the
+sequence, then re-gathered afterwards.  In-graph, over the ``model`` mesh
+axis; gradients follow automatically from the collective's transpose (the
+reference needs hand-written autograd Functions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.mesh import MODEL_AXIS
+
+
+def gather_tokens(x: jnp.ndarray, dim: int = 1) -> jnp.ndarray:
+    """All-gather token slices along ``dim`` over the TP axis (in shard_map)."""
+    return lax.all_gather(x, MODEL_AXIS, axis=dim, tiled=True)
+
+
+def drop_tokens(x: jnp.ndarray, dim: int = 1) -> jnp.ndarray:
+    """Keep only this TP rank's slice of the sequence (in shard_map)."""
+    tp = lax.axis_size(MODEL_AXIS)
+    idx = lax.axis_index(MODEL_AXIS)
+    assert x.shape[dim] % tp == 0, (
+        f"sequence dim {x.shape[dim]} not divisible by tensor-parallel size "
+        f"{tp} (reference mappings.py:56 asserts the same)")
+    chunk = x.shape[dim] // tp
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)

@@ -1,0 +1,206 @@
+"""GShard-style gating + expert dispatch, declaratively sharded.
+
+Counterpart of the reference's ``deepspeed/moe/sharded_moe.py``
+(``top1gating`` :177, ``top2gating`` :278, ``MOELayer`` :439 whose forward
+:491 runs gate → einsum dispatch → ``_AllToAll`` :89 → experts → all-to-all →
+combine).  The TPU-native difference: there is no explicit all-to-all call.
+Tokens are sharded over the (data, expert) mesh axes and expert weights over
+the expert axis; the dispatch/combine einsums carry sharding constraints, and
+XLA lowers the resharding into exactly the all-to-all pattern the reference
+hand-codes — fused with the surrounding compute where profitable.
+
+Gating math follows the GShard recipe: capacity = ceil(tokens/experts ×
+capacity_factor), random token priority (optional), auxiliary load-balance
+loss l_aux = E · Σ_e (fraction_tokens_e × mean_gate_e).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS
+
+# gate weights dtype is fp32 for numerical stability (reference keeps gates fp32)
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    cap = int(num_tokens * capacity_factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4, used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               rng: Optional[jax.Array] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True) -> Tuple:
+    """Top-1 gating (reference sharded_moe.py:177).
+
+    logits: [tokens, E] fp32.  Returns (l_aux, combine_weights [t,E,C],
+    dispatch_mask [t,E,C], exp_counts [E]).
+    """
+    noise_rng = rts_rng = None
+    if rng is not None:
+        noise_rng, rts_rng = jax.random.split(rng)
+    if noisy_gate_policy == "RSample" and noise_rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(noise_rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    tokens, num_experts = logits.shape
+    if drop_tokens:
+        capacity = _capacity(tokens, num_experts, capacity_factor, min_capacity)
+    else:
+        # no-drop mode: capacity must be static under jit, so reserve the
+        # worst case (all tokens to one expert) instead of the reference's
+        # dynamic raise-to-max (sharded_moe.py:214) — same guarantee,
+        # memory-heavier; use only with few experts
+        capacity = tokens
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    indices1 = jnp.argmax(logits_w_noise, axis=-1)                    # [t]
+    mask1 = _one_hot(indices1, num_experts)                           # [t,E]
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None]
+
+    exp_counts = jnp.sum(mask1, axis=0)                               # [E]
+
+    # load-balancing aux loss
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * num_experts
+
+    # token position within its expert's queue; random tie-break priority
+    if use_rts and rts_rng is not None:
+        priority = jax.random.uniform(rts_rng, (tokens,))
+        order = jnp.argsort(-priority)
+        # positions assigned in priority order
+        mask1_sorted = mask1[order]
+        pos_sorted = jnp.cumsum(mask1_sorted, axis=0) - mask1_sorted
+        inv = jnp.argsort(order)
+        positions = jnp.sum(pos_sorted[inv] * mask1, axis=-1)         # [t]
+    else:
+        pos = jnp.cumsum(mask1, axis=0) - mask1
+        positions = jnp.sum(pos * mask1, axis=-1)
+
+    if drop_tokens:
+        keep = positions < capacity
+        mask1 = mask1 * keep[:, None]
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)                          # [t]
+    pos_oh = _one_hot(positions.astype(jnp.int32), capacity)          # [t,C]
+    combine = gates1[:, None, None] * mask1[:, :, None] * pos_oh[:, None, :]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4) -> Tuple:
+    """Top-2 gating (reference sharded_moe.py:278)."""
+    tokens, num_experts = logits.shape
+    capacity = _capacity(tokens, num_experts, 2 * capacity_factor, min_capacity)
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(indices1, num_experts)
+    logits_wo_1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    indices2 = jnp.argmax(logits_wo_1, axis=-1)
+    mask2 = _one_hot(indices2, num_experts)
+
+    # positions: expert-1 tokens first, then expert-2 tokens stack after
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * num_experts
+
+    positions1 = jnp.sum(pos1 * mask1, axis=-1)
+    positions2 = jnp.sum(pos2 * mask2, axis=-1)
+    mask1 = mask1 * (positions1 < capacity)[:, None]
+    mask2 = mask2 * (positions2 < capacity)[:, None]
+    exp_counts = jnp.sum(mask1, axis=0) + jnp.sum(mask2, axis=0)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)
+    gates2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.clip(gates1 + gates2, 1e-9, None)
+    gates1, gates2 = gates1 / denom, gates2 / denom
+
+    pos1_oh = _one_hot(positions1.astype(jnp.int32), capacity)
+    pos2_oh = _one_hot(positions2.astype(jnp.int32), capacity)
+    combine = (gates1[:, None, None] * mask1[:, :, None] * pos1_oh[:, None, :] +
+               gates2[:, None, None] * mask2[:, :, None] * pos2_oh[:, None, :])
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Gate config/apply holder (reference ``TopKGate`` sharded_moe.py:351)."""
+
+    def __init__(self, model_dim: int, num_experts: int, k: int = 1,
+                 capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, use_rts: bool = True):
+        assert k in (1, 2), "Only top-1 and top-2 gatings are supported"
+        self.model_dim = model_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+
+    def init(self, rng: jax.Array):
+        w = jax.random.normal(rng, (self.model_dim, self.num_experts)) * 0.02
+        return {"wg": w.astype(jnp.float32)}
+
+    def __call__(self, params, x, train: bool = True, rng=None):
+        """x: [tokens, d] → (l_aux, combine, dispatch, exp_counts)."""
+        logits = x.astype(jnp.float32) @ params["wg"]
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity,
+                              noisy_gate_policy=self.noisy_gate_policy if train else None,
+                              rng=rng, drop_tokens=self.drop_tokens,
+                              use_rts=self.use_rts and train)
+        return top2gating(logits, cf, self.min_capacity)
+
+
+def moe_layer_forward(gate: TopKGate, gate_params, expert_fn, expert_params,
+                      x: jnp.ndarray, train: bool = True, rng=None,
+                      constrain=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The MOELayer forward (reference MOELayer.forward sharded_moe.py:491).
+
+    x: [B, S, d] (batch sharded over (data, expert) axes).
+    expert_fn(expert_params, xe) maps [E, C, d] → [E, C, d] with the leading
+    expert dim sharded over the expert mesh axis.
+    Returns (output [B,S,d], l_aux, exp_counts).
+    """
+    B, S, d = x.shape
+    tokens = x.reshape(B * S, d)
+    l_aux, combine, dispatch, exp_counts = gate(gate_params, tokens, train, rng)
+
+    # dispatch: [t,E,C] × [t,d] → [E,C,d]; XLA lowers the token→expert
+    # resharding (constraint below) to the all-to-all of the reference (:89)
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), tokens)
+    if constrain is not None:
+        dispatched = constrain(dispatched, P(EXPERT_AXIS, DATA_AXIS, None))
+    expert_out = expert_fn(expert_params, dispatched)
+    if constrain is not None:
+        expert_out = constrain(expert_out, P(EXPERT_AXIS, DATA_AXIS, None))
+    # combine: second all-to-all + weighted sum back to token layout
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    if constrain is not None:
+        out = constrain(out, P((DATA_AXIS, EXPERT_AXIS), None))
+    return out.reshape(B, S, d), l_aux, exp_counts

@@ -1,0 +1,47 @@
+"""MoE param-tree utilities.
+
+Counterpart of the reference's ``deepspeed/moe/utils.py``
+(``is_moe_param`` :18, ``split_params_into_different_moe_groups_for_optimizer``
+:62).  The reference splits torch param groups so ZeRO partitions expert
+params over expert-data groups only; here the split operates on path-keyed
+pytrees and informs the partitioner which subtrees are expert-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+
+PyTree = Any
+
+
+def is_moe_param_path(path: Tuple) -> bool:
+    """True for expert-sharded params only.  The gate weight is deliberately
+    excluded: it is dense/replicated and must be reduced over the full dp
+    world (the reference's is_moe_param, moe/utils.py:18, likewise excludes
+    the gate)."""
+    keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+    return any(k == "experts" or "expert" in k for k in keys)
+
+
+def split_moe_param_tree(params: PyTree) -> Tuple[PyTree, PyTree]:
+    """Split into (dense_tree, expert_tree) with None holes (reference :62)."""
+    def pick(pred):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: leaf if pred(path) else None, params)
+    dense = pick(lambda p: not is_moe_param_path(p))
+    expert = pick(is_moe_param_path)
+    return dense, expert
+
+
+def has_moe_layers(params: PyTree) -> bool:
+    found = [False]
+
+    def visit(path, leaf):
+        if is_moe_param_path(path):
+            found[0] = True
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return found[0]

@@ -1,0 +1,148 @@
+"""Pipeline model description: LayerSpec / TiedLayerSpec / PipelineModule.
+
+Counterpart of the reference's ``deepspeed/runtime/pipe/module.py``
+(``LayerSpec`` :23, ``TiedLayerSpec`` :71, ``PipelineModule`` :85 with
+``_partition_layers`` :361).  The description surface is kept — a list of
+layer specs partitioned across stages by ``parameters|uniform|type:regex`` —
+but the execution target differs: stages are not per-process sub-modules,
+they are slices of a layer-stacked param tree over the mesh ``pipe`` axis,
+executed by the SPMD schedule in ``runtime/pipe/spmd.py``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+PyTree = Any
+
+
+class LayerSpec:
+    """Deferred layer: builds params lazily (reference module.py:23).
+
+    ``typename`` is any callable returning ``(init_fn, apply_fn)`` or an
+    object with ``.init``/``.apply``; args/kwargs are stored for deferred
+    construction so a 100B-layer list costs nothing until partitioned.
+    """
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable type")
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+    def __repr__(self) -> str:
+        return f"LayerSpec({self.name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with every other layer of the same key
+    (reference module.py:71 — e.g. tied embedding/head).  In the SPMD design
+    tied params are stored once, passed replicated over the pipe axis, and
+    their gradient psum over ``pipe`` happens in the shard_map transpose —
+    the reference's ``allreduce_tied_weight_gradients`` (module.py:417) with
+    no explicit call.
+    """
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, tied_weight_attr: str = "weight",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Partition a layer list over ``num_stages`` (reference module.py:85).
+
+    partition_method:
+      - "uniform": equal layer counts
+      - "parameters": balance by per-layer parameter count (default)
+      - "type:regex": balance by count of layers whose name matches regex
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None,
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False, base_seed: int = 1234):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.parts = self._partition_layers()
+
+    # -- weights for balancing --------------------------------------------
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return [1.0] * len(self.layer_specs)
+        if method == "parameters":
+            weights = []
+            for spec in self.layer_specs:
+                w = self._param_count(spec)
+                weights.append(float(max(w, 1)))
+            return weights
+        if method.startswith("type:"):
+            regex = method.split(":", 1)[1]
+            return [1.0 if re.search(regex, s.name, re.IGNORECASE) else 0.0
+                    for s in self.layer_specs]
+        raise NotImplementedError(f"Partitioning method {self.partition_method} not implemented")
+
+    @staticmethod
+    def _param_count(spec: LayerSpec) -> int:
+        try:
+            built = spec.build()
+            init_fn = built[0] if isinstance(built, tuple) else getattr(built, "init", None)
+            if init_fn is None:
+                return 1
+            shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+            return sum(int(jax.numpy.prod(jax.numpy.array(l.shape)))
+                       for l in jax.tree_util.tree_leaves(shapes)) or 1
+        except Exception:
+            return 1
+
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method == "uniform":
+            parts = partition_uniform(n, self.num_stages)
+        else:
+            parts = partition_balanced(self._layer_weights(), self.num_stages)
+        logger.info(f"PipelineModule: {n} layers over {self.num_stages} stages "
+                    f"→ boundaries {parts} (method={self.partition_method})")
+        return parts
+
+    # -- queries -----------------------------------------------------------
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def layers_of_stage(self, stage: int) -> List[LayerSpec]:
+        return self.layer_specs[self.parts[stage]:self.parts[stage + 1]]
+
+    def tied_keys(self) -> List[str]:
+        return sorted({s.key for s in self.layer_specs if isinstance(s, TiedLayerSpec)})
+
+    def topology(self):
+        from ...parallel.topology import PipeDataParallelTopology
+        return PipeDataParallelTopology(self.num_stages, 1)

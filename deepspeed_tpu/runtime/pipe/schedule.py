@@ -1,0 +1,259 @@
+"""Pipeline instruction schedules.
+
+Counterpart of the reference's ``deepspeed/runtime/pipe/schedule.py``
+(``TrainSchedule`` :182 — interleaved 1F1B with ``total_steps =
+2*(micro_batches+stages-1)`` :192; ``InferenceSchedule`` :129; instruction
+classes :317-476).  Two roles here:
+
+1. API parity: the same instruction-stream generators, usable for
+   host-dispatched execution and for tests/inspection.
+2. The arithmetic (``num_pipe_buffers``, step counts, which microbatch is
+   live on which stage at which tick) is shared with the SPMD in-jit
+   schedule (``spmd.py``), which executes the same dataflow as one XLA
+   program — the forward ticks below become the `lax.scan` steps, and the
+   backward instructions fall out of autodiff's transpose of the ppermute
+   chain.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..utils import call_to_str
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        return call_to_str(self.name, **self.kwargs)
+
+    def __eq__(self, other):
+        return self.name == getattr(other, "name", None) and \
+            self.kwargs == getattr(other, "kwargs", None)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class ForwardPass(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class BackwardPass(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class SendActivation(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class RecvActivation(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class SendGrad(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class RecvGrad(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class PipeSchedule:
+    """Base schedule (reference schedule.py:9): yields lists of instructions
+    per step for one stage of the pipeline."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:  # pragma: no cover
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (reference schedule.py:129)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """Interleaved 1F1B (reference TrainSchedule.steps schedule.py:189).
+
+    ``total_steps = 2 * (micro_batches + stages - 1)``; even ticks run
+    forwards, odd ticks run backwards, offset by stage, ending with
+    ReduceTiedGrads → ReduceGrads → OptimizerStep (:234-237).
+    """
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # data exchange with neighbors
+            if self._valid_micro_batch(prev_micro_batch_id):
+                prev_buffer = self._buffer_idx(prev_micro_batch_id)
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(SendGrad(prev_buffer))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(SendActivation(prev_buffer))
+            if self._valid_micro_batch(micro_batch_id):
+                curr_buffer = self._buffer_idx(micro_batch_id)
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(curr_buffer))
+                    elif self._valid_stage(self.prev_stage):
+                        cmds.append(RecvActivation(curr_buffer))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(RecvGrad(curr_buffer))
+
+            # compute
+            if self._valid_micro_batch(micro_batch_id):
+                curr_buffer = self._buffer_idx(micro_batch_id)
+                cmds.append(ForwardPass(curr_buffer) if is_forward
+                            else BackwardPass(curr_buffer))
+
+            # epilogue on the final tick
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id: int):
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        elif _is_odd(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        else:
+            raise RuntimeError("unreachable")
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id: int) -> int:
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id: int) -> int:
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id: int) -> int:
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id: int) -> int:
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + self.stage_id // 2
+
+    def num_pipe_buffers(self) -> int:
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference schedule.py:477 region)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0
