@@ -83,7 +83,14 @@ def initialize(args=None,
     raw = _load_raw_config(config, config_params)
     mm = _mesh_from_config(raw, mesh_manager)
 
-    engine = DeepSpeedEngine(
+    # pipelined models get the PipelineEngine (reference __init__.py:124-148
+    # routes PipelineModule to PipelineEngine the same way)
+    engine_cls = DeepSpeedEngine
+    if model is not None and getattr(model, "meta", {}).get("pipeline"):
+        from .runtime.pipe.engine import PipelineEngine
+        engine_cls = PipelineEngine
+
+    engine = engine_cls(
         args=args,
         model=model,
         optimizer=optimizer,

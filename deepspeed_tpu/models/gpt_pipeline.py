@@ -1,0 +1,127 @@
+"""Pipeline-parallel GPT.
+
+The flagship pipeline config (BASELINE.md: GPT-3 6.7B, 4-stage + ZeRO-1).
+Reuses the dense GPT family (``models/gpt.py``) with the block stack's layer
+dim sharded over the ``pipe`` mesh axis and execution delegated to the SPMD
+schedule (``runtime/pipe/spmd.py``).  Embedding and head weights (tied
+``wte``) are replicated over the pipe axis; their gradients psum over
+``pipe`` in the shard_map transpose — the reference's tied-weight allreduce
+(``runtime/pipe/module.py:417``) without an explicit call.
+
+ZeRO-2/3 cannot compose with the pipelined loss (params enter a
+pipe-manual region), matching the reference restriction
+(``runtime/pipe/engine.py`` asserts ZeRO <= 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import PIPE_AXIS
+from ..runtime.pipe.spmd import pipeline_loss
+from .gpt import GPTConfig, _block, _layer_norm, init as gpt_init, logical_axes as gpt_axes
+from .partitioning import LAYERS
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTPipeConfig(GPTConfig):
+    num_stages: int = 2
+    num_micro_batches: int = 4
+
+    def __post_init__(self):
+        assert self.n_layer % self.num_stages == 0, \
+            f"n_layer {self.n_layer} must divide evenly into {self.num_stages} stages"
+
+
+def split_params(config: GPTPipeConfig, params: PyTree) -> Tuple[PyTree, PyTree]:
+    """(stage_params, shared_params): blocks vs embeddings/final-LN."""
+    stage = {"blocks": params["blocks"]}
+    shared = {k: v for k, v in params.items() if k != "blocks"}
+    return stage, shared
+
+
+def stage_specs(config: GPTPipeConfig) -> PyTree:
+    """PartitionSpecs for the stage tree: layer dim over the pipe axis."""
+    axes = gpt_axes(config)["blocks"]
+    return {"blocks": jax.tree_util.tree_map(
+        lambda a: P(PIPE_AXIS, *([None] * (len(a) - 1))), axes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            y is None or isinstance(y, str) for y in x))}
+
+
+def _stage_fn(stage_params, x, config: GPTPipeConfig):
+    """Apply this stage's layer slice (scan over local layers)."""
+    def body(carry, layer_params):
+        return _block(carry, layer_params, config), None
+
+    out, _ = lax.scan(body, x, stage_params["blocks"])
+    return out
+
+
+def _embed_fn(shared, micro_batch, config: GPTPipeConfig):
+    tokens = micro_batch["tokens"][:, :-1]
+    cdt = config.dtype
+    S = tokens.shape[1]
+    pos = jnp.arange(S)
+    return shared["wte"].astype(cdt)[tokens] + shared["wpe"].astype(cdt)[pos][None]
+
+
+def _loss_head_fn(shared, x, micro_batch, config: GPTPipeConfig):
+    targets = micro_batch["tokens"][:, 1:]
+    x = _layer_norm(x, shared["lnf_scale"], shared["lnf_bias"])
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        shared["wte"].astype(jnp.float32))
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    return jnp.sum((logz - gold) * mask), jnp.sum(mask)
+
+
+def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTPipeConfig,
+            mesh: Mesh) -> jnp.ndarray:
+    """batch['tokens']: [M*mb, S+1] → mean loss over all microbatches."""
+    M = config.num_micro_batches
+    tokens = batch["tokens"]
+    assert tokens.shape[0] % M == 0, \
+        f"batch {tokens.shape[0]} not divisible by num_micro_batches {M}"
+    micro = {"tokens": tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])}
+    stage_params, shared = split_params(config, params)
+    return pipeline_loss(
+        stage_fn=partial(_stage_fn, config=config),
+        embed_fn=partial(_embed_fn, config=config),
+        loss_head_fn=partial(_loss_head_fn, config=config),
+        stage_params=stage_params,
+        shared_params=shared,
+        micro_inputs=micro,
+        mesh=mesh,
+        num_micro=M,
+        stage_spec_tree=stage_specs(config),
+        remat_stage=config.remat or True,
+    )
+
+
+def model_spec(config: GPTPipeConfig, mesh: Mesh):
+    from ..models.partitioning import TP_RULES
+    from ..runtime.model import ModelSpec
+
+    rules = dict(TP_RULES)
+    rules[LAYERS] = PIPE_AXIS  # layer-stacked dim lives on the pipe axis
+
+    return ModelSpec(
+        loss_fn=lambda p, b: loss_fn(p, b, config, mesh),
+        init_fn=lambda rng: gpt_init(config, rng),
+        logical_axes=gpt_axes(config),
+        apply_fn=None,
+        name="gpt-pipeline",
+        meta={"config": config, "pipeline": True},
+        partition_rules=rules,
+    )

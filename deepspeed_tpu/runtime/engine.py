@@ -193,7 +193,10 @@ class DeepSpeedEngine:
             # no annotations: everything replicated at base level
             base = jax.tree_util.tree_map(lambda _: P(), shapes)
         else:
-            rules = FSDP_RULES if self._config.zero_optimization_stage >= 3 else TP_RULES
+            if self.module.partition_rules is not None:
+                rules = self.module.partition_rules
+            else:
+                rules = FSDP_RULES if self._config.zero_optimization_stage >= 3 else TP_RULES
             base = tree_specs(axes, rules)
             base = validate_specs(shapes, base, self.mesh)
         self.zero_partitioner = ZeroPartitioner(

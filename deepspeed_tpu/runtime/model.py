@@ -34,6 +34,9 @@ class ModelSpec:
     name: str = "model"
     #: free-form extras (model config etc.)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: optional logical-axis → mesh-axis rule override (e.g. pipelined models
+    #: map LAYERS → 'pipe'); None → engine picks TP/FSDP rules by ZeRO stage
+    partition_rules: Optional[Dict[str, Any]] = None
 
     def param_shapes(self, rng: Optional[jax.Array] = None) -> PyTree:
         if self.params is not None:

@@ -1,0 +1,3 @@
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .schedule import (InferenceSchedule, TrainSchedule)  # noqa: F401
+from .engine import PipelineEngine  # noqa: F401

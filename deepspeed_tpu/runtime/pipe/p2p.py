@@ -1,0 +1,51 @@
+"""Stage-to-stage point-to-point helpers.
+
+Counterpart of the reference's ``deepspeed/runtime/pipe/p2p.py`` (184 LoC of
+send/recv/isend/irecv over stage pairs with odd/even ordering to avoid NCCL
+deadlock).  On TPU a stage boundary is a ``lax.ppermute`` over the ``pipe``
+mesh axis inside the jitted schedule: deadlock-free by construction (XLA
+schedules the collective), and the async variants are XLA's
+latency-hiding overlap rather than explicit handles.  These helpers exist
+for code written against the reference surface; the SPMD schedule
+(``spmd.py``) uses ``send_forward``/``send_backward`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...parallel.mesh import PIPE_AXIS
+
+
+def _rotation(n: int, shift: int):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def send_forward(x, num_stages: int):
+    """Stage s → s+1 ring rotation (in-jit, inside the pipe-manual region)."""
+    return lax.ppermute(x, PIPE_AXIS, _rotation(num_stages, 1))
+
+
+def send_backward(x, num_stages: int):
+    """Stage s → s-1 (the gradient direction)."""
+    return lax.ppermute(x, PIPE_AXIS, _rotation(num_stages, -1))
+
+
+def send_to(x, src: int, dst: int):
+    """Single-pair transfer (reference send/recv): everyone else gets zeros."""
+    return lax.ppermute(x, PIPE_AXIS, [(src, dst)])
+
+
+# reference-surface aliases -------------------------------------------------
+
+def send(tensor, dest_stage: int, num_stages: Optional[int] = None):
+    src = dest_stage - 1 if num_stages is None else None
+    return send_to(tensor, src if src is not None else 0, dest_stage)
+
+
+def recv(tensor_shape_like, src_stage: int, dst_stage: Optional[int] = None):
+    return send_to(tensor_shape_like, src_stage,
+                   dst_stage if dst_stage is not None else src_stage + 1)
