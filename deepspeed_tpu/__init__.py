@@ -85,6 +85,13 @@ def initialize(args=None,
 
     # pipelined models get the PipelineEngine (reference __init__.py:124-148
     # routes PipelineModule to PipelineEngine the same way)
+    from .runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        raise TypeError(
+            "initialize() needs a ModelSpec, not a raw PipelineModule — wrap "
+            "it (e.g. models.gpt_pipeline.model_spec for GPT, or build a "
+            "ModelSpec whose meta includes {'pipeline': True}) so the engine "
+            "knows the loss/init functions to jit")
     engine_cls = DeepSpeedEngine
     if model is not None and getattr(model, "meta", {}).get("pipeline"):
         from .runtime.pipe.engine import PipelineEngine

@@ -47,6 +47,7 @@ class GPTConfig:
     remat: bool = False
     use_flash_attention: bool = True    # pallas kernel when available
     vocab_round_to: int = 128           # pad vocab to a lane multiple
+    sequence_parallel: Optional[str] = None  # None | 'ring' | 'ulysses'
 
     @property
     def ffn_dim(self) -> int:
@@ -160,6 +161,13 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 
 def _attention(q, k, v, config: GPTConfig):
     """Causal MHA. q,k,v: [B, S, H, D]."""
+    if config.sequence_parallel:
+        from ..parallel.mesh import SEQ_AXIS, get_mesh_manager
+        mm = get_mesh_manager(optional=True)
+        if mm is not None and mm.mesh.shape.get(SEQ_AXIS, 1) > 1:
+            from ..parallel.sequence import sp_attention
+            return sp_attention(q, k, v, impl=config.sequence_parallel,
+                                causal=True, mesh=mm.mesh)
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -198,6 +206,15 @@ def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray
     B, S = tokens.shape
     pos = jnp.arange(S)
     x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[pos][None]
+
+    if config.sequence_parallel:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS,
+                                     get_mesh_manager)
+        mm = get_mesh_manager(optional=True)
+        if mm is not None and mm.mesh.shape.get(SEQ_AXIS, 1) > 1:
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mm.mesh, P((DATA_AXIS, EXPERT_AXIS), SEQ_AXIS, None)))
 
     block_fn = partial(_block, config=config)
     if config.remat:

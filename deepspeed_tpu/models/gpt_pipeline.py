@@ -40,6 +40,10 @@ class GPTPipeConfig(GPTConfig):
     def __post_init__(self):
         assert self.n_layer % self.num_stages == 0, \
             f"n_layer {self.n_layer} must divide evenly into {self.num_stages} stages"
+        # SP's shard_map cannot nest inside the pipe-manual region of the
+        # SPMD 1F1B schedule; reject the combination up front.
+        assert not self.sequence_parallel, \
+            "sequence_parallel does not compose with the SPMD pipeline engine"
 
 
 def split_params(config: GPTPipeConfig, params: PyTree) -> Tuple[PyTree, PyTree]:
@@ -105,7 +109,7 @@ def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTPipeConfig
         mesh=mesh,
         num_micro=M,
         stage_spec_tree=stage_specs(config),
-        remat_stage=config.remat or True,
+        remat_stage=config.remat,
     )
 
 

@@ -4,3 +4,4 @@ from .mesh import (MeshManager, ParallelDims, build_mesh, get_mesh_manager,  # n
                    DP_GROUP, EDP_GROUP, EP_GROUP, TP_GROUP, PP_GROUP, SP_GROUP)
 from .topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,  # noqa: F401
                        ProcessTopology)
+from .sequence import (ring_attention, sp_attention, ulysses_attention)  # noqa: F401

@@ -173,9 +173,12 @@ def initialize_mesh(dims: Optional[ParallelDims] = None,
     return _MESH_MANAGER
 
 
-def get_mesh_manager() -> MeshManager:
+def get_mesh_manager(optional: bool = False) -> Optional["MeshManager"]:
+    """The global mesh manager; ``optional=True`` returns None if unset."""
     global _MESH_MANAGER
     if _MESH_MANAGER is None:
+        if optional:
+            return None
         _MESH_MANAGER = MeshManager(ParallelDims())
     return _MESH_MANAGER
 

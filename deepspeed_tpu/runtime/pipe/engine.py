@@ -34,6 +34,12 @@ class PipelineEngine(DeepSpeedEngine):
                                      self.gradient_accumulation_steps())
         self._force_grad_boundary = False
 
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """train_batch consumes ALL microbatches in-graph, so the optimizer
+        must step on every call regardless of gas counting — the reference
+        forces the boundary the same way (pipe/engine.py:252,:1160)."""
+        return self._force_grad_boundary or super().is_gradient_accumulation_boundary()
+
     def train_batch(self, data_iter: Optional[Iterator] = None, batch=None):
         """One full training step over a global batch (reference :296).
 
@@ -46,7 +52,11 @@ class PipelineEngine(DeepSpeedEngine):
         self.tput_timer.start()
         loss = self.forward(batch)
         self.backward(loss)
-        self.step()
+        self._force_grad_boundary = True
+        try:
+            self.step()
+        finally:
+            self._force_grad_boundary = False
         self.tput_timer.stop(global_step=True)
         agg_loss = loss  # already psum-aggregated over stages in-graph
         if self.global_steps % self.steps_per_print() == 0:

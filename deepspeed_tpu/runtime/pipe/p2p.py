@@ -42,10 +42,21 @@ def send_to(x, src: int, dst: int):
 # reference-surface aliases -------------------------------------------------
 
 def send(tensor, dest_stage: int, num_stages: Optional[int] = None):
-    src = dest_stage - 1 if num_stages is None else None
-    return send_to(tensor, src if src is not None else 0, dest_stage)
+    """Forward-direction transfer into ``dest_stage`` from its predecessor
+    (wrapping when ``num_stages`` is known; reference p2p.py sends stage→stage+1)."""
+    if dest_stage > 0:
+        src = dest_stage - 1
+    else:
+        assert num_stages is not None, "send to stage 0 needs num_stages to wrap"
+        src = num_stages - 1
+    return send_to(tensor, src, dest_stage)
 
 
-def recv(tensor_shape_like, src_stage: int, dst_stage: Optional[int] = None):
-    return send_to(tensor_shape_like, src_stage,
-                   dst_stage if dst_stage is not None else src_stage + 1)
+def recv(tensor_shape_like, src_stage: int, dst_stage: Optional[int] = None,
+         num_stages: Optional[int] = None):
+    """Receive at ``src_stage``'s successor (or an explicit ``dst_stage``)."""
+    if dst_stage is None:
+        dst_stage = src_stage + 1
+        if num_stages is not None:
+            dst_stage %= num_stages
+    return send_to(tensor_shape_like, src_stage, dst_stage)
