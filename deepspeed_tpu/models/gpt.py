@@ -168,13 +168,12 @@ def _attention(q, k, v, config: GPTConfig):
             from ..parallel.sequence import sp_attention
             return sp_attention(q, k, v, impl=config.sequence_parallel,
                                 causal=True, mesh=mm.mesh)
-    B, S, H, D = q.shape
-    scale = 1.0 / math.sqrt(D)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    from ..ops.pallas import flash_attention, mha_reference
+    if config.use_flash_attention:
+        # pallas kernel on TPU; internally falls back to the dense
+        # reference on other backends or non-tiling shapes
+        return flash_attention(q, k, v, causal=True)
+    return mha_reference(q, k, v, causal=True)
 
 
 def _attn_residual(x, p, config: GPTConfig):

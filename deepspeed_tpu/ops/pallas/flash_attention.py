@@ -1,0 +1,338 @@
+"""Flash attention (causal / full) as a Pallas TPU kernel, fwd + bwd.
+
+TPU-native counterpart of the reference's fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu`` + strided-batch-gemm attention in
+``csrc/includes/strided_batch_gemm.h``, and the inference
+``softmax_context`` path of ``csrc/transformer/inference/csrc/pt_binding.cpp``).
+Rather than separate gemm/softmax launches stitched on streams, one Pallas
+kernel streams (block_k, D) K/V tiles through VMEM against a resident Q
+block with the online-softmax recurrence, so the S×S score matrix never
+exists in HBM and VMEM stays O(block · D) regardless of sequence length.
+
+Grid layout is (batch·heads, q_blocks, k_blocks) with the k dimension
+innermost: Pallas revisits the same output block across the k sweep and
+pipelines the K/V tile DMAs, while the softmax running state (acc, m, l)
+lives in VMEM scratch that persists across grid steps on the same core.
+
+Causal masking is end-aligned (a query attends to the last ``Sq`` positions
+of ``Sk``), matching :func:`mha_reference` for cross-length decode shapes.
+
+Layout: [B, S, H, D] (the model's native layout; [B*H, S, D] internally).
+Backward is the standard two-kernel flash backward (dq sweep and dk/dv
+sweep) off saved (O, logsumexp).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .utils import interpret_mode, use_pallas
+
+NEG_INF = float("-inf")
+
+
+# ------------------------------------------------------------------ reference
+
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+    """Dense softmax attention; ground truth for the kernel. [B,S,H,D]."""
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def _causal_mask(s, qi, ki, block_q, block_k, offset):
+    """End-aligned causal mask on a (block_q, block_k) score tile."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(k_pos <= q_pos + offset, s, NEG_INF)
+
+
+def _block_visible(qi, ki, block_q, block_k, offset):
+    """Whether any (q, k) pair in this tile survives the causal mask."""
+    return ki * block_k <= qi * block_q + block_q - 1 + offset
+
+
+# ------------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale, causal, block_q, block_k, offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
+        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
+        vs = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, vs, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    offset = Sk - Sq
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k, offset=offset)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, Sq // block_q, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ------------------------------------------------------------------ backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, sm_scale, causal, block_q, block_k, offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
+        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
+        vs = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]                    # (BQ, 1)
+        delta = delta_ref[0, 0, :][:, None]
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse)                               # (BQ, BK)
+        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jnp.dot(ds, ks, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                    block_q, block_k, offset):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
+        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
+        vs = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse)                               # (BQ, BK)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k):
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    offset = Sk - Sq
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]                   # (BH, 1, Sq)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, block_q=block_q,
+                                  block_k=block_k, offset=offset)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, Sq // block_q, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret_mode(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k, offset=offset)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, Sk // block_k, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------- custom vjp
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    o, _ = _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
+    o, lse = _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pick_block(seq: int, want: int) -> Optional[int]:
+    """A block size dividing ``seq`` that satisfies Mosaic tiling: each of
+    the last two block dims must be divisible by (8, 128) or span the full
+    array dim.  Blocks land in both sublane (q tiles) and lane (lse)
+    position, so: multiple of 128, or the whole (8-aligned, small) sequence.
+    """
+    for b in (want, 256, 128):
+        if b <= want and seq % b == 0:
+            return b
+    if seq % 8 == 0 and seq <= 2048:
+        return seq  # single whole-sequence block
+    return None
+
+
+# -------------------------------------------------------------------- public
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256):
+    """Memory-linear attention. q,k,v: [B, S, H, D] → [B, S, H, D].
+
+    Falls back to the dense reference when the backend has no Pallas path or
+    the sequence doesn't tile (tiny/odd test shapes, Sq > Sk causal).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
+    if (not use_pallas() or bq is None or bk is None
+            or (causal and Sq > Sk)):
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    def to3(x):  # [B,S,H,D] → [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    o3 = _flash(to3(q), to3(k), to3(v), causal, scale, bq, bk)
+    return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)

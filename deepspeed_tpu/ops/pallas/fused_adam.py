@@ -1,0 +1,125 @@
+"""Fused Adam over a flat parameter buffer as one Pallas kernel.
+
+Counterpart of the reference's multi-tensor-apply Adam
+(``csrc/adam/multi_tensor_adam.cu`` + ``multi_tensor_apply.cuh``): there,
+chunking amortizes kernel-launch cost; here, one pallas_call tiled over the
+flattened buffer keeps params/moments streaming HBM→VMEM→HBM in a single
+pass with the update math on the VPU.  Scalars (lr, betas, step, ...) ride
+in SMEM so LR schedules never recompile.
+
+Used by the ZeRO flat-partition update path; the pytree ``tree_map`` path in
+``ops/adam/fused_adam.py`` remains the general case (XLA fuses it well).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .utils import cdiv, interpret_mode, use_pallas
+
+_LANES = 128
+_BLOCK_ROWS = 512  # 512×128 f32 tiles ≈ 256KB/operand in VMEM
+
+
+def _adam_kernel(hyper_ref, p_ref, g_ref, m_ref, v_ref,
+                 p_out, m_out, v_out, *, adam_w_mode):
+    lr = hyper_ref[0]
+    beta1 = hyper_ref[1]
+    beta2 = hyper_ref[2]
+    eps = hyper_ref[3]
+    wd = hyper_ref[4]
+    bc1 = hyper_ref[5]
+    bc2 = hyper_ref[6]
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p
+    m = beta1 * m_ref[...] + (1.0 - beta1) * g
+    v = beta2 * v_ref[...] + (1.0 - beta2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:
+        update = update + wd * p
+    p_out[...] = (p - lr * update).astype(p_out.dtype)
+    m_out[...] = m
+    v_out[...] = v
+
+
+def _flat_adam(params, grads, exp_avg, exp_avg_sq, hyper, adam_w_mode):
+    n = params.shape[0]
+    rows = cdiv(n, _LANES)
+    pad = rows * _LANES - n
+
+    def shape2d(x, dtype=None):
+        x = jnp.pad(x, (0, pad)) if pad else x
+        x = x.reshape(rows, _LANES)
+        return x.astype(dtype) if dtype is not None else x
+
+    p2 = shape2d(params)
+    g2 = shape2d(grads)
+    m2 = shape2d(exp_avg, jnp.float32)
+    v2 = shape2d(exp_avg_sq, jnp.float32)
+    block_rows = min(_BLOCK_ROWS, rows)
+    grid = (cdiv(rows, block_rows),)
+    blk = lambda dtype=None: pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    kernel = functools.partial(_adam_kernel, adam_w_mode=adam_w_mode)
+    p_new, m_new, v_new = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            blk(), blk(), blk(), blk(),
+        ],
+        out_specs=[blk(), blk(), blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+            jax.ShapeDtypeStruct(m2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v2.shape, jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(hyper, p2, g2, m2, v2)
+
+    def unshape(x):
+        x = x.reshape(-1)
+        return x[:n] if pad else x
+
+    return unshape(p_new), unshape(m_new), unshape(v_new)
+
+
+def fused_adam_step(params, grads, exp_avg, exp_avg_sq, step,
+                    lr, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
+                    adam_w_mode: bool = True, bias_correction: bool = True):
+    """One Adam step on flat 1-D buffers.
+
+    ``params``/``grads`` any float dtype; moments fp32.  Returns
+    (new_params, new_exp_avg, new_exp_avg_sq).  ``step`` is the post-increment
+    step count (1 on the first call), traced.
+    """
+    stepf = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(jnp.float32(beta1), stepf)
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2), stepf)
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+    hyper = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(beta1), jnp.float32(beta2),
+        jnp.float32(eps), jnp.asarray(weight_decay, jnp.float32), bc1, bc2])
+
+    if not use_pallas():
+        # reference path: identical math, plain XLA
+        p = params.astype(jnp.float32)
+        g = grads.astype(jnp.float32)
+        if not adam_w_mode:
+            g = g + hyper[4] * p
+        m = beta1 * exp_avg + (1.0 - beta1) * g
+        v = beta2 * exp_avg_sq + (1.0 - beta2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        if adam_w_mode:
+            update = update + hyper[4] * p
+        return (p - hyper[0] * update).astype(params.dtype), m, v
+
+    return _flat_adam(params, grads, exp_avg, exp_avg_sq, hyper, adam_w_mode)

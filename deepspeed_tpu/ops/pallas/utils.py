@@ -1,0 +1,32 @@
+"""Shared helpers for the Pallas kernel suite."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    """Whether to lower through Pallas at all.
+
+    TPU: always. Elsewhere: only when ``DS_TPU_PALLAS_INTERPRET=1`` — the
+    interpreter is slow but exact, which is what the kernel unit tests use
+    to validate logic on CPU CI.
+    """
+    if jax.default_backend() == "tpu":
+        return True
+    return os.environ.get("DS_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def interpret_mode() -> bool:
+    """Pass ``interpret=True`` to pallas_call on non-TPU backends."""
+    return jax.default_backend() != "tpu"
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
