@@ -1,0 +1,72 @@
+/* Host-side SIMD Adagrad for offloaded optimizer state.
+ *
+ * Counterpart of the reference's csrc/adagrad/cpu_adagrad.cpp
+ * (adagrad_update/adagrad_update_copy bindings at cpu_adagrad.cpp:221-226).
+ * Same structure as cpu_adam.cpp: C ABI, AVX2 + scalar tail, threaded,
+ * fused bf16 copy-out for device upload.
+ */
+
+#include "../includes/ds_cpu_math.h"
+
+#include <cmath>
+#include <cstdint>
+
+using ds_tpu::float_to_bf16;
+using ds_tpu::parallel_for;
+
+namespace {
+
+inline void adagrad_span(float* p, const float* g, float* h, uint16_t* p_bf16,
+                         size_t begin, size_t end, float lr, float eps,
+                         float wd) {
+    size_t i = begin;
+#if defined(__AVX2__) && defined(__FMA__)
+    const __m256 vlr = _mm256_set1_ps(lr);
+    const __m256 veps = _mm256_set1_ps(eps);
+    const __m256 vwd = _mm256_set1_ps(wd);
+    for (; i + 8 <= end; i += 8) {
+        __m256 gp = _mm256_loadu_ps(g + i);
+        __m256 pp = _mm256_loadu_ps(p + i);
+        gp = _mm256_fmadd_ps(vwd, pp, gp);
+        __m256 hp = _mm256_fmadd_ps(gp, gp, _mm256_loadu_ps(h + i));
+        _mm256_storeu_ps(h + i, hp);
+        __m256 upd = _mm256_div_ps(gp, _mm256_add_ps(_mm256_sqrt_ps(hp), veps));
+        pp = _mm256_fnmadd_ps(vlr, upd, pp);
+        _mm256_storeu_ps(p + i, pp);
+        if (p_bf16) {
+            alignas(32) float tmp[8];
+            _mm256_store_ps(tmp, pp);
+            for (int k = 0; k < 8; ++k) p_bf16[i + k] = float_to_bf16(tmp[k]);
+        }
+    }
+#endif
+    for (; i < end; ++i) {
+        float gp = g[i] + wd * p[i];
+        float hp = h[i] + gp * gp;
+        h[i] = hp;
+        float pp = p[i] - lr * gp / (std::sqrt(hp) + eps);
+        p[i] = pp;
+        if (p_bf16) p_bf16[i] = float_to_bf16(pp);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void ds_adagrad_step(float* p, const float* g, float* h, int64_t n, float lr,
+                     float eps, float wd, int nthreads) {
+    parallel_for((size_t)n, nthreads, [&](size_t b, size_t e) {
+        adagrad_span(p, g, h, nullptr, b, e, lr, eps, wd);
+    });
+}
+
+void ds_adagrad_step_copy(float* p, const float* g, float* h,
+                          uint16_t* p_bf16, int64_t n, float lr, float eps,
+                          float wd, int nthreads) {
+    parallel_for((size_t)n, nthreads, [&](size_t b, size_t e) {
+        adagrad_span(p, g, h, p_bf16, b, e, lr, eps, wd);
+    });
+}
+
+}  // extern "C"

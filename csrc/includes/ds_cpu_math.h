@@ -1,0 +1,53 @@
+/* Shared helpers for the host-side SIMD optimizer kernels.
+ *
+ * TPU-native counterpart of the reference's csrc/includes/cpu_adam.h /
+ * cpu_adagrad.h (AVX256/AVX512 tiled Adam for ZeRO-Offload).  On TPU VMs the
+ * host is an x86 (or ARM) machine holding offloaded fp32 optimizer state;
+ * the device uploads bf16 params, so the copy-out path converts to bf16
+ * with round-to-nearest-even instead of the reference's fp16.
+ */
+#pragma once
+
+#include <cstdint>
+#include <cstddef>
+#include <thread>
+#include <vector>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace ds_tpu {
+
+// float32 -> bfloat16 with round-to-nearest-even (matches XLA/jnp casts)
+inline uint16_t float_to_bf16(float f) {
+    uint32_t bits;
+    __builtin_memcpy(&bits, &f, sizeof(bits));
+    // NaN: keep a quiet NaN payload
+    if ((bits & 0x7fffffffu) > 0x7f800000u) return (uint16_t)((bits >> 16) | 0x0040u);
+    uint32_t lsb = (bits >> 16) & 1u;
+    bits += 0x7fffu + lsb;
+    return (uint16_t)(bits >> 16);
+}
+
+// Run fn(begin, end) over [0, n) split across up to max_threads workers.
+template <typename F>
+inline void parallel_for(size_t n, int max_threads, F&& fn) {
+    unsigned hw = std::thread::hardware_concurrency();
+    int nt = max_threads > 0 ? max_threads : (hw ? (int)hw : 1);
+    if (nt <= 1 || n < (size_t)(1 << 16)) {
+        fn((size_t)0, n);
+        return;
+    }
+    // chunks aligned to 16 floats so SIMD lanes in different threads never
+    // share a cache line
+    size_t chunk = ((n + nt - 1) / nt + 15) & ~(size_t)15;
+    std::vector<std::thread> workers;
+    for (size_t begin = 0; begin < n; begin += chunk) {
+        size_t end = begin + chunk < n ? begin + chunk : n;
+        workers.emplace_back([=, &fn] { fn(begin, end); });
+    }
+    for (auto& w : workers) w.join();
+}
+
+}  // namespace ds_tpu

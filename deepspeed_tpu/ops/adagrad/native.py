@@ -1,0 +1,62 @@
+"""Host-offloaded Adagrad over the native SIMD extension
+(reference ``ops/adagrad/cpu_adagrad.py`` ``DeepSpeedCPUAdagrad``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..op_builder.cpu_adagrad import CPUAdagradBuilder
+
+
+class DeepSpeedCPUAdagradNative:
+    """Stateful fp32 Adagrad over flat numpy buffers on the host."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, num_threads: int = 0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.num_threads = num_threads
+        self._lib = CPUAdagradBuilder().load()
+        self._h: Dict[int, np.ndarray] = {}
+
+    def _state_for(self, group_id: int, n: int) -> np.ndarray:
+        if group_id not in self._h:
+            self._h[group_id] = np.zeros(n, dtype=np.float32)
+        if self._h[group_id].size != n:
+            raise ValueError(
+                f"param group {group_id} was registered with "
+                f"{self._h[group_id].size} elements, got {n}")
+        return self._h[group_id]
+
+    def step(self, group_id: int, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        import ctypes
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        h = self._state_for(group_id, params.size)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        self._lib.ds_adagrad_step(
+            params.ctypes.data_as(f32p), grads.ctypes.data_as(f32p),
+            h.ctypes.data_as(f32p), params.size,
+            lr if lr is not None else self.lr, self.eps, self.weight_decay,
+            self.num_threads)
+
+    def step_with_copy(self, group_id: int, params: np.ndarray,
+                       grads: np.ndarray, lr: Optional[float] = None
+                       ) -> np.ndarray:
+        import ctypes
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        h = self._state_for(group_id, params.size)
+        out_bf16 = np.empty(params.size, dtype=np.uint16)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        self._lib.ds_adagrad_step_copy(
+            params.ctypes.data_as(f32p), grads.ctypes.data_as(f32p),
+            h.ctypes.data_as(f32p), out_bf16.ctypes.data_as(u16p),
+            params.size, lr if lr is not None else self.lr, self.eps,
+            self.weight_decay, self.num_threads)
+        return out_bf16

@@ -1,0 +1,110 @@
+"""Host-offloaded Adam over the native SIMD extension.
+
+Counterpart of the reference's ``ops/adam/cpu_adam.py`` ``DeepSpeedCPUAdam``
+(backed by ``csrc/adam/cpu_adam.cpp``): ZeRO-Offload keeps fp32 params +
+moments in host RAM and steps them on the CPU while the device runs the next
+micro-batch.  State is numpy (host) rather than torch CPU tensors; the fused
+``step_with_copy`` returns a bf16 view ready for ``jax.device_put`` upload —
+the reference's ``adam_update_copy`` overlap, with bf16 instead of fp16
+because TPU's 16-bit format is bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..op_builder.cpu_adam import CPUAdamBuilder
+
+
+def _as_c(arr: np.ndarray, ctype):
+    import ctypes
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class DeepSpeedCPUAdam:
+    """Stateful fp32 Adam over flat numpy buffers on the host."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True, num_threads: int = 0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.num_threads = num_threads
+        self._lib = CPUAdamBuilder().load()
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+        self._steps: Dict[int, int] = {}
+
+    @property
+    def simd_width(self) -> int:
+        return int(self._lib.ds_adam_simd_width())
+
+    def _state_for(self, group_id: int, n: int):
+        if group_id not in self._m:
+            self._m[group_id] = np.zeros(n, dtype=np.float32)
+            self._v[group_id] = np.zeros(n, dtype=np.float32)
+            self._steps[group_id] = 0
+        if self._m[group_id].size != n:
+            # the C kernel writes n elements into these buffers — a size
+            # mismatch would corrupt the heap, so fail loudly instead
+            raise ValueError(
+                f"param group {group_id} was registered with "
+                f"{self._m[group_id].size} elements, got {n}")
+        return self._m[group_id], self._v[group_id]
+
+    def _bias_corrections(self, step: int):
+        if not self.bias_correction:
+            return 1.0, 1.0
+        return (1.0 - self.beta1 ** step, 1.0 - self.beta2 ** step)
+
+    def step(self, group_id: int, params: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        """In-place Adam on flat fp32 ``params`` given fp32 ``grads``."""
+        import ctypes
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        m, v = self._state_for(group_id, params.size)
+        self._steps[group_id] += 1
+        bc1, bc2 = self._bias_corrections(self._steps[group_id])
+        self._lib.ds_adam_step(
+            _as_c(params, ctypes.c_float), _as_c(grads, ctypes.c_float),
+            _as_c(m, ctypes.c_float), _as_c(v, ctypes.c_float),
+            params.size, lr if lr is not None else self.lr,
+            self.beta1, self.beta2, self.eps, self.weight_decay,
+            int(self.adamw_mode), bc1, bc2, self.num_threads)
+
+    def step_with_copy(self, group_id: int, params: np.ndarray,
+                       grads: np.ndarray, lr: Optional[float] = None
+                       ) -> np.ndarray:
+        """Step + fused bf16 precast of the updated params (uint16 view of
+        the bf16 bits, reinterpretable via ``.view(ml_dtypes.bfloat16)``)."""
+        import ctypes
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        m, v = self._state_for(group_id, params.size)
+        self._steps[group_id] += 1
+        bc1, bc2 = self._bias_corrections(self._steps[group_id])
+        out_bf16 = np.empty(params.size, dtype=np.uint16)
+        self._lib.ds_adam_step_copy(
+            _as_c(params, ctypes.c_float), _as_c(grads, ctypes.c_float),
+            _as_c(m, ctypes.c_float), _as_c(v, ctypes.c_float),
+            _as_c(out_bf16, ctypes.c_uint16),
+            params.size, lr if lr is not None else self.lr,
+            self.beta1, self.beta2, self.eps, self.weight_decay,
+            int(self.adamw_mode), bc1, bc2, self.num_threads)
+        return out_bf16
+
+    def state_dict(self) -> Dict:
+        return {"m": self._m, "v": self._v, "steps": self._steps,
+                "lr": self.lr}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self._m = {k: np.asarray(x, np.float32) for k, x in sd["m"].items()}
+        self._v = {k: np.asarray(x, np.float32) for k, x in sd["v"].items()}
+        self._steps = dict(sd["steps"])

@@ -1,0 +1,19 @@
+"""Native op builder registry (reference ``op_builder/all_ops.py`` +
+``builder_names.py``)."""
+
+from .builder import (OpBuilder, all_builders, builder_report, cpu_arch,
+                      get_builder, register_builder, simd_width)
+from .cpu_adam import CPUAdamBuilder
+from .cpu_adagrad import CPUAdagradBuilder
+
+__all__ = [
+    "OpBuilder",
+    "CPUAdamBuilder",
+    "CPUAdagradBuilder",
+    "all_builders",
+    "builder_report",
+    "get_builder",
+    "register_builder",
+    "cpu_arch",
+    "simd_width",
+]

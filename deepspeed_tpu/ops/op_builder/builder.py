@@ -1,0 +1,214 @@
+"""JIT build system for native host extensions.
+
+Counterpart of the reference's ``op_builder/builder.py`` (``OpBuilder``:106,
+``load``:449 → try pre-installed else ``jit_load``:461 via torch
+cpp_extension; ``cpu_arch``:336, ``simd_width``:385;
+``TORCH_EXTENSIONS_DIR`` caching).  The TPU build has no nvcc and no torch
+extension machinery: device kernels are Pallas (``deepspeed_tpu/ops/pallas``),
+and *host* extensions (SIMD CPU optimizers for ZeRO-Offload, the aio NVMe
+engine) are plain C++ shared libraries compiled with the system ``g++`` and
+loaded through ctypes.
+
+Cache: ``$DS_TPU_EXTENSIONS_DIR`` (default ``~/.cache/deepspeed_tpu/ops``),
+keyed by a hash of sources + flags, so rebuilds only happen when the
+source or toolchain flags change — same contract as TORCH_EXTENSIONS_DIR.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ...utils.logging import logger
+
+# repo root (three levels up from this file's package)
+_REPO_ROOT = Path(__file__).resolve().parents[3]
+
+_BUILDER_REGISTRY: Dict[str, type] = {}
+
+
+def register_builder(cls):
+    _BUILDER_REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def get_builder(name: str) -> "OpBuilder":
+    if name not in _BUILDER_REGISTRY:
+        raise ValueError(f"Unknown op builder {name!r}; known: "
+                         f"{sorted(_BUILDER_REGISTRY)}")
+    return _BUILDER_REGISTRY[name]()
+
+
+def all_builders() -> List[str]:
+    return sorted(_BUILDER_REGISTRY)
+
+
+def _cache_dir() -> Path:
+    d = os.environ.get("DS_TPU_EXTENSIONS_DIR")
+    if d:
+        return Path(d)
+    return Path.home() / ".cache" / "deepspeed_tpu" / "ops"
+
+
+def cpu_arch() -> str:
+    """Host ISA family (reference cpu_arch :336)."""
+    import platform
+    m = platform.machine().lower()
+    if m in ("x86_64", "amd64"):
+        return "x86_64"
+    if m in ("aarch64", "arm64"):
+        return "aarch64"
+    return m
+
+
+def simd_width() -> int:
+    """Float lanes of the widest SIMD the host advertises (reference :385)."""
+    if cpu_arch() != "x86_64":
+        return 4 if cpu_arch() == "aarch64" else 1  # NEON
+    try:
+        flags = Path("/proc/cpuinfo").read_text()
+    except OSError:
+        return 1
+    if "avx512f" in flags:
+        return 16
+    if "avx2" in flags:
+        return 8
+    if "avx" in flags:
+        return 8
+    return 4
+
+
+class OpBuilder:
+    """One native op: declares sources/flags, compiles + loads on demand."""
+
+    NAME = "base"
+
+    def sources(self) -> List[str]:
+        """Paths relative to the repo's ``csrc/``."""
+        raise NotImplementedError
+
+    def include_dirs(self) -> List[str]:
+        return ["includes"]
+
+    def cxx_args(self) -> List[str]:
+        args = ["-O3", "-std=c++17", "-shared", "-fPIC", "-g"]
+        if cpu_arch() == "x86_64":
+            args += ["-march=native", "-mfma"]
+        return args
+
+    def libraries(self) -> List[str]:
+        return ["-lpthread"]
+
+    # ------------------------------------------------------------- probing
+
+    def compiler(self) -> Optional[str]:
+        for cc in (os.environ.get("CXX"), "g++", "clang++"):
+            if cc and shutil.which(cc):
+                return cc
+        return None
+
+    def is_compatible(self, verbose: bool = False) -> bool:
+        if self.compiler() is None:
+            if verbose:
+                logger.warning(f"op {self.NAME}: no C++ compiler found")
+            return False
+        for s in self.sources():
+            if not (_REPO_ROOT / "csrc" / s).exists():
+                if verbose:
+                    logger.warning(f"op {self.NAME}: missing source csrc/{s}")
+                return False
+        return True
+
+    # ------------------------------------------------------------ building
+
+    def _build_key(self) -> str:
+        h = hashlib.sha256()
+        for s in self.sources():
+            h.update((_REPO_ROOT / "csrc" / s).read_bytes())
+        for inc_dir in self.include_dirs():
+            d = _REPO_ROOT / "csrc" / inc_dir
+            if d.is_dir():
+                for f in sorted(d.glob("*.h")):
+                    h.update(f.read_bytes())
+        h.update(" ".join(self.cxx_args()).encode())
+        # -march=native resolves differently per host: key on the actual ISA
+        # so a cache dir shared across heterogeneous hosts (NFS home) never
+        # serves a binary built for the wrong microarchitecture
+        h.update(f"{cpu_arch()}:simd{simd_width()}".encode())
+        return h.hexdigest()[:16]
+
+    def lib_path(self) -> Path:
+        return _cache_dir() / f"lib_{self.NAME}_{self._build_key()}.so"
+
+    def build(self, verbose: bool = False) -> Path:
+        out = self.lib_path()
+        if out.exists():
+            return out
+        cc = self.compiler()
+        if cc is None:
+            raise RuntimeError(f"op {self.NAME}: no C++ compiler available")
+        out.parent.mkdir(parents=True, exist_ok=True)
+        srcs = [str(_REPO_ROOT / "csrc" / s) for s in self.sources()]
+        incs = [f"-I{_REPO_ROOT / 'csrc' / d}" for d in self.include_dirs()]
+        # unique temp per builder process: concurrent builds (xdist workers,
+        # multi-host shared cache) must not write through the same path
+        tmp = out.with_suffix(f".building.{os.getpid()}.so")
+
+        def compile_with(extra_args: List[str]) -> subprocess.CompletedProcess:
+            cmd = [cc, *extra_args, *incs, *srcs, "-o", str(tmp),
+                   *self.libraries()]
+            if verbose:
+                logger.info(f"building {self.NAME}: {' '.join(cmd)}")
+            return subprocess.run(cmd, check=True, capture_output=True,
+                                  text=True)
+
+        args = self.cxx_args()
+        try:
+            compile_with(args)
+        except subprocess.CalledProcessError as e:
+            # -march=native can fail on exotic hosts; retry portable
+            portable = [a for a in args if a not in ("-march=native", "-mfma")]
+            if portable == args:
+                raise RuntimeError(
+                    f"building op {self.NAME} failed:\n{e.stderr}") from e
+            try:
+                compile_with(portable)
+            except subprocess.CalledProcessError as e2:
+                raise RuntimeError(
+                    f"building op {self.NAME} failed:\n{e2.stderr}") from e2
+        os.replace(tmp, out)  # atomic: concurrent builders race safely
+        return out
+
+    _loaded: Dict[str, ctypes.CDLL] = {}
+
+    def load(self, verbose: bool = False) -> ctypes.CDLL:
+        """Compile if needed and dlopen; cached per-process per-op."""
+        if self.NAME in OpBuilder._loaded:
+            return OpBuilder._loaded[self.NAME]
+        lib = ctypes.CDLL(str(self.build(verbose=verbose)))
+        self._bind(lib)
+        OpBuilder._loaded[self.NAME] = lib
+        return lib
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        """Attach argtypes/restype to the lib's symbols."""
+
+
+def builder_report() -> List[Dict[str, object]]:
+    """Per-op compatibility summary (feeds ds_report)."""
+    rows = []
+    for name in all_builders():
+        b = get_builder(name)
+        compatible = b.is_compatible()
+        rows.append({
+            "op": name,
+            "compatible": compatible,
+            "built": compatible and b.lib_path().exists(),
+        })
+    return rows

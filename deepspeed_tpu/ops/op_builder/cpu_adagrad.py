@@ -1,0 +1,28 @@
+"""Builder for the host SIMD Adagrad (reference ``op_builder/cpu_adagrad.py``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .builder import OpBuilder, register_builder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+@register_builder
+class CPUAdagradBuilder(OpBuilder):
+    NAME = "cpu_adagrad"
+
+    def sources(self):
+        return ["adagrad/cpu_adagrad.cpp"]
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        lib.ds_adagrad_step.argtypes = [
+            _f32p, _f32p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_adagrad_step.restype = None
+        lib.ds_adagrad_step_copy.argtypes = [
+            _f32p, _f32p, _f32p, _u16p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_adagrad_step_copy.restype = None

@@ -1,0 +1,34 @@
+"""Builder for the host SIMD Adam (reference ``op_builder/cpu_adam.py``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .builder import OpBuilder, register_builder
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+
+@register_builder
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["adam/cpu_adam.cpp"]
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        lib.ds_adam_step.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int]
+        lib.ds_adam_step.restype = None
+        lib.ds_adam_step_copy.argtypes = [
+            _f32p, _f32p, _f32p, _f32p, _u16p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int]
+        lib.ds_adam_step_copy.restype = None
+        lib.ds_adam_simd_width.argtypes = []
+        lib.ds_adam_simd_width.restype = ctypes.c_int

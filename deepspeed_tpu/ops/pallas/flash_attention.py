@@ -49,7 +49,15 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
         Sq, Sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
         s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+        # Sq > Sk leaves leading queries with zero visible keys: give them
+        # zero output instead of softmax-over-(-inf) NaNs
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - jnp.where(jnp.isfinite(m), m, 0.0))
+        e = jnp.where(mask[None, None], e, 0.0)
+        denom = jnp.sum(e, axis=-1, keepdims=True)
+        p = e / jnp.maximum(denom, 1e-30)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
@@ -305,7 +313,7 @@ def _pick_block(seq: int, want: int) -> Optional[int]:
     position, so: multiple of 128, or the whole (8-aligned, small) sequence.
     """
     for b in (want, 256, 128):
-        if b <= want and seq % b == 0:
+        if b % 128 == 0 and b <= want and seq % b == 0:
             return b
     if seq % 8 == 0 and seq <= 2048:
         return seq  # single whole-sequence block

@@ -153,3 +153,25 @@ def test_fake_quantize_straight_through():
     y, vjp = jax.vjp(lambda x: fake_quantize(x, groups=4), x)
     (gx,) = vjp(jnp.ones_like(y))
     np.testing.assert_allclose(np.asarray(gx), 1.0)
+
+
+def test_mha_reference_sq_gt_sk_no_nan():
+    """Causal with more queries than keys: fully-masked rows give zeros."""
+    from deepspeed_tpu.ops.pallas import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(10), 3)
+    q = jax.random.normal(ks[0], (1, 128, 2, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 64, 2, 16), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out[0, :64]), 0.0)
+
+
+def test_pick_block_respects_lane_rule():
+    from deepspeed_tpu.ops.pallas.flash_attention import _pick_block
+    # requested 64 divides 256 but violates the 128-lane rule → larger pick
+    assert _pick_block(256, 64) in (256,)
+    assert _pick_block(1024, 256) == 256
+    assert _pick_block(64, 256) == 64      # whole-sequence block
+    assert _pick_block(1000, 256) == 1000  # 8-aligned odd seq, single block
+    assert _pick_block(37, 256) is None
