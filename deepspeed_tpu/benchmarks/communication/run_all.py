@@ -1,0 +1,143 @@
+"""ICI/DCN collective bandwidth probe (``ds_bench``).
+
+Counterpart of the reference's ``benchmarks/communication/`` suite
+(all_reduce/all_gather/all_to_all/broadcast/pt2pt + run_all, exposed as
+``bin/ds_bench``): sweep message sizes through each collective and report
+latency + algorithmic/bus bandwidth via the same ``get_bw`` accounting
+(utils/comms_logging.py).  Collectives run inside ``shard_map`` over the
+global mesh's flattened axis — on hardware they lower to ICI
+all-reduce/all-gather/collective-permute, exactly the ops training issues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...utils.comms_logging import get_bw
+from ...utils.logging import logger
+
+AXIS = "bench"
+
+
+def _mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()), (AXIS,))
+
+
+def _timed(fn: Callable, x, iters: int, warmup: int) -> float:
+    for _ in range(warmup):
+        out = fn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _build(op: str, mesh: Mesh) -> Callable:
+    n = mesh.devices.size
+
+    if op == "all_reduce":
+        body = lambda x: lax.psum(x, AXIS)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    elif op == "all_gather":
+        body = lambda x: lax.all_gather(x, AXIS, tiled=True)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    elif op == "reduce_scatter":
+        body = lambda x: lax.psum_scatter(x, AXIS, tiled=True)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    elif op == "all_to_all":
+        def body(x):
+            s = x.reshape(n, -1)
+            return lax.all_to_all(s, AXIS, 0, 0, tiled=False).reshape(-1)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    elif op == "broadcast":
+        def body(x):
+            # root's data to everyone: psum of masked input
+            idx = lax.axis_index(AXIS)
+            return lax.psum(jnp.where(idx == 0, x, jnp.zeros_like(x)), AXIS)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    elif op == "pt2pt":
+        def body(x):
+            # neighbor exchange ring: the ICI point-to-point path
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return lax.ppermute(x, AXIS, perm)
+        in_spec, out_spec = P(AXIS), P(AXIS)
+    else:
+        raise ValueError(f"unknown op {op}")
+
+    f = shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                  check_rep=False)
+    return jax.jit(f)
+
+
+def run_op(op: str, sizes_bytes: List[int], dtype=jnp.bfloat16,
+           iters: int = 20, warmup: int = 5) -> List[Dict]:
+    mesh = _mesh()
+    n = mesh.devices.size
+    fn = _build(op, mesh)
+    itemsize = jnp.zeros((), dtype).dtype.itemsize
+    results = []
+    for size in sizes_bytes:
+        elems = max(n, size // itemsize)
+        elems = (elems // n) * n  # divisible for sharding
+        x = jnp.ones((elems,), dtype)
+        dt = _timed(fn, x, iters, warmup)
+        msg_bytes = elems * itemsize
+        algbw, busbw = get_bw(op, msg_bytes, dt, n)
+        results.append({"op": op, "bytes": msg_bytes, "latency_us": dt * 1e6,
+                        "algbw_gbps": algbw, "busbw_gbps": busbw})
+    return results
+
+
+DEFAULT_OPS = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+               "broadcast", "pt2pt"]
+
+
+def print_table(results: List[Dict]) -> None:
+    print(f"{'op':16} {'size':>12} {'latency(us)':>12} "
+          f"{'algbw(Gbps)':>12} {'busbw(Gbps)':>12}")
+    for r in results:
+        print(f"{r['op']:16} {r['bytes']:>12,} {r['latency_us']:>12.1f} "
+              f"{r['algbw_gbps']:>12.2f} {r['busbw_gbps']:>12.2f}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="deepspeed_tpu comm bench")
+    parser.add_argument("--ops", nargs="*", default=DEFAULT_OPS,
+                        choices=DEFAULT_OPS)
+    parser.add_argument("--minsize", type=int, default=1 << 16)
+    parser.add_argument("--maxsize", type=int, default=1 << 26)
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    args = parser.parse_args(argv)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    sizes = []
+    s = args.minsize
+    while s <= args.maxsize:
+        sizes.append(s)
+        s *= 4
+    logger.info(f"devices: {len(jax.devices())} ({jax.default_backend()})")
+    all_results = []
+    for op in args.ops:
+        all_results += run_op(op, sizes, dtype, args.iters, args.warmup)
+    print_table(all_results)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Environment / compatibility report (reference ``deepspeed/env_report.py``,
+exposed as ``ds_report``): platform, JAX/device discovery, native-op
+build status."""
+
+from __future__ import annotations
+
+import importlib
+import platform
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def _version(mod_name: str) -> str:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except ImportError:
+        return ""
+
+
+def op_report() -> list:
+    from .ops.op_builder import builder_report, cpu_arch, simd_width
+    rows = builder_report()
+    print("-" * 60)
+    print("DeepSpeed-TPU C++ op report")
+    print("-" * 60)
+    print(f"host arch: {cpu_arch()}, SIMD width: {simd_width()} fp32 lanes")
+    print(f"{'op name':20} {'compatible':12} {'built':8}")
+    for r in rows:
+        compat = OKAY if r["compatible"] else NO
+        built = OKAY if r["built"] else WARNING
+        print(f"{r['op']:20} {compat:20} {built}")
+    return rows
+
+
+def accelerator_report() -> None:
+    print("-" * 60)
+    print("Accelerator report")
+    print("-" * 60)
+    try:
+        import jax
+        print(f"jax version ............. {jax.__version__}")
+        print(f"default backend ......... {jax.default_backend()}")
+        devices = jax.devices()
+        print(f"device count ............ {len(devices)}")
+        for d in devices[:8]:
+            print(f"  {d.id}: {d.device_kind} ({d.platform})")
+        if len(devices) > 8:
+            print(f"  ... and {len(devices) - 8} more")
+        print(f"process index ........... {jax.process_index()}"
+              f" / {jax.process_count()}")
+    except Exception as e:
+        print(f"jax unavailable: {e}")
+
+
+def general_report() -> None:
+    import deepspeed_tpu
+    print("-" * 60)
+    print("General environment")
+    print("-" * 60)
+    print(f"deepspeed_tpu ........... {deepspeed_tpu.__version__}")
+    print(f"python .................. {sys.version.split()[0]}")
+    print(f"platform ................ {platform.platform()}")
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        v = _version(mod)
+        state = v if v else "not installed"
+        print(f"{mod:24}{'.' * 1} {state}")
+
+
+def cli_main() -> int:
+    general_report()
+    accelerator_report()
+    op_report()
+    return 0
+
+
+def main() -> int:  # reference entry name
+    return cli_main()
+
+
+if __name__ == "__main__":
+    sys.exit(cli_main())

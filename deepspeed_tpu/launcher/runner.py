@@ -1,0 +1,187 @@
+"""``deepspeed`` CLI — multi-host TPU launcher.
+
+Counterpart of the reference's ``launcher/runner.py`` (``main``:353,
+``fetch_hostfile``:177, include/exclude filters :218, world-info encoding
+:318).  The reference spawns one process per GPU via pdsh/mpirun; a TPU pod
+runs one process per *host*, each seeing that host's chips, with rendezvous
+through ``jax.distributed.initialize`` (coordinator host:port) instead of
+NCCL env rendezvous.  Hostfile syntax is unchanged
+(``hostname slots=N`` — slots meaning TPU processes per host, normally 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_", "XLA_",
+               "TPU_", "DS_TPU_", "LIBTPU_"]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu distributed launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="e.g. 'host1,host2' or 'host1:0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="e.g. 'host1' or 'host1:1'")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_accelerators", type=int,
+                        default=-1, dest="num_gpus",
+                        help="processes per node (TPU: usually 1)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"],
+                        help="multi-node transport")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"])
+    parser.add_argument("user_script", type=str,
+                        help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional["OrderedDict[str, int]"]:
+    """Parse ``host slots=N`` lines (reference fetch_hostfile :177)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError
+                resource_pool[hostname] = int(slot_count)
+            except ValueError:
+                raise ValueError(f"hostfile line malformed: {line!r}") from None
+    return resource_pool
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'h1:0,1@h2' style include/exclude parsing (reference :218)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def filter_resource_pool(pool: "OrderedDict[str, int]", include: str,
+                         exclude: str) -> "OrderedDict[str, int]":
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if include:
+        inc = _parse_filter(include)
+        filtered = OrderedDict()
+        for host, slots in inc.items():
+            if host not in pool:
+                raise ValueError(f"include host {host} not in hostfile")
+            filtered[host] = len(slots) if slots else pool[host]
+        return filtered
+    if exclude:
+        exc = _parse_filter(exclude)
+        filtered = OrderedDict()
+        for host, n in pool.items():
+            if host in exc:
+                if exc[host] is None:
+                    continue
+                remaining = n - len(exc[host])
+                if remaining > 0:
+                    filtered[host] = remaining
+            else:
+                filtered[host] = n
+        return filtered
+    return OrderedDict(pool)
+
+
+def encode_world_info(pool: "OrderedDict[str, int]") -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(dict(pool)).encode()).decode()
+
+
+def _export_env() -> Dict[str, str]:
+    env = {}
+    for k, v in os.environ.items():
+        if any(k == p or (p.endswith("_") and k.startswith(p))
+               for p in EXPORT_ENVS):
+            env[k] = v
+    return env
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    pool = fetch_hostfile(args.hostfile)
+
+    if pool is None:
+        # single-node: local launch only
+        pool = OrderedDict([("localhost", max(args.num_gpus, 1))])
+    pool = filter_resource_pool(pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        pool = OrderedDict(list(pool.items())[:args.num_nodes])
+
+    hosts = list(pool)
+    num_nodes = len(hosts)
+    master_addr = args.master_addr or hosts[0]
+    world_info = encode_world_info(pool)
+
+    launch_cmd = [
+        sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+        f"--world_info={world_info}",
+        f"--master_addr={master_addr}",
+        f"--master_port={args.master_port}",
+    ]
+
+    if num_nodes == 1 and hosts[0] in ("localhost", "127.0.0.1"):
+        cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args
+        logger.info(f"launch: {' '.join(map(shlex.quote, cmd))}")
+        return subprocess.call(cmd)
+
+    # multi-node over ssh/pdsh: one launch.py per host
+    procs = []
+    env_exports = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in _export_env().items())
+    for rank, host in enumerate(hosts):
+        node_cmd = launch_cmd + [f"--node_rank={rank}",
+                                 args.user_script] + args.user_args
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
+            " ".join(map(shlex.quote, node_cmd))
+        if args.launcher == "pdsh":
+            ssh_cmd = ["pdsh", "-w", host, *shlex.split(args.launcher_args),
+                       remote]
+        else:
+            ssh_cmd = ["ssh", *shlex.split(args.launcher_args), host, remote]
+        logger.info(f"[{host}] {' '.join(map(shlex.quote, ssh_cmd))}")
+        procs.append(subprocess.Popen(ssh_cmd))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Training telemetry fan-out (reference ``deepspeed/monitor/``)."""
+
+from .config import get_monitor_config
+from .monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
+
+__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "csvMonitor", "get_monitor_config"]

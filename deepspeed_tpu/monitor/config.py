@@ -1,0 +1,51 @@
+"""Monitor config (reference ``monitor/config.py`` pydantic models)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+@dataclasses.dataclass
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclasses.dataclass
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: str = ""
+    team: str = ""
+    project: str = "deepspeed"
+
+
+@dataclasses.dataclass
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclasses.dataclass
+class DeepSpeedMonitorConfig:
+    tensorboard: TensorBoardConfig = dataclasses.field(
+        default_factory=TensorBoardConfig)
+    wandb: WandbConfig = dataclasses.field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = dataclasses.field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled)
+
+
+def get_monitor_config(monitor_dicts: Dict[str, Dict]) -> DeepSpeedMonitorConfig:
+    return DeepSpeedMonitorConfig(
+        tensorboard=TensorBoardConfig.from_dict(
+            monitor_dicts.get("tensorboard", {})),
+        wandb=WandbConfig.from_dict(monitor_dicts.get("wandb", {})),
+        csv_monitor=CSVConfig.from_dict(monitor_dicts.get("csv_monitor", {})))

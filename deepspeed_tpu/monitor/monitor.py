@@ -1,0 +1,113 @@
+"""Monitor backends + master fan-out.
+
+Counterpart of the reference's ``monitor/monitor.py`` (``Monitor`` ABC,
+``MonitorMaster``:24 routing to ``TensorBoardMonitor``/``WandbMonitor``/
+``csvMonitor``).  Events are ``(tag, value, step)`` triples written from the
+engine at the same points as the reference (train loss engine.py:1840,
+lr/loss-scale :2069).  Only rank 0 writes (log_dist semantics); missing
+backend packages degrade to warnings, never failures.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+from .config import DeepSpeedMonitorConfig
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            path = os.path.join(config.output_path or "./runs/",
+                                config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+        except Exception as e:  # tensorboard backend genuinely optional
+            logger.warning(f"TensorBoard monitor disabled: {e}")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        try:
+            import wandb
+            wandb.init(project=config.project,
+                       group=config.group or None,
+                       entity=config.team or None)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"W&B monitor disabled: {e}")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=step)
+
+
+class csvMonitor(Monitor):  # noqa: N801 (reference class name)
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = os.path.join(config.output_path or "./csv/",
+                                        config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for tag, value, step in event_list:
+            fname = os.path.join(self.output_path,
+                                 tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Fans events out to every enabled backend; rank-0 only."""
+
+    def __init__(self, config: DeepSpeedMonitorConfig, rank: int = 0):
+        super().__init__(config)
+        self.rank = rank
+        self.backends: List[Monitor] = []
+        if rank == 0:
+            if config.tensorboard.enabled:
+                self.backends.append(TensorBoardMonitor(config.tensorboard))
+            if config.wandb.enabled:
+                self.backends.append(WandbMonitor(config.wandb))
+            if config.csv_monitor.enabled:
+                self.backends.append(csvMonitor(config.csv_monitor))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.backends)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for b in self.backends:
+            b.write_events(event_list)

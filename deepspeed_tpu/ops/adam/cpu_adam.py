@@ -23,6 +23,34 @@ def _as_c(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def cpu_adam_step(lib, p: np.ndarray, g: np.ndarray, m: np.ndarray,
+                  v: np.ndarray, step: int, lr: float, beta1: float,
+                  beta2: float, eps: float, weight_decay: float,
+                  adamw_mode: bool = True, bias_correction: bool = True,
+                  bf16_out: Optional[np.ndarray] = None,
+                  num_threads: int = 0) -> None:
+    """Raw-buffer Adam step for callers owning their own state (the NVMe
+    optimizer swapper streams m/v through here). All buffers flat fp32
+    except ``bf16_out`` (uint16 bf16 bits), all updated in place."""
+    import ctypes
+    assert p.size == g.size == m.size == v.size
+    bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+    bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+    if bf16_out is None:
+        lib.ds_adam_step(
+            _as_c(p, ctypes.c_float), _as_c(g, ctypes.c_float),
+            _as_c(m, ctypes.c_float), _as_c(v, ctypes.c_float),
+            p.size, lr, beta1, beta2, eps, weight_decay, int(adamw_mode),
+            bc1, bc2, num_threads)
+    else:
+        lib.ds_adam_step_copy(
+            _as_c(p, ctypes.c_float), _as_c(g, ctypes.c_float),
+            _as_c(m, ctypes.c_float), _as_c(v, ctypes.c_float),
+            _as_c(bf16_out, ctypes.c_uint16),
+            p.size, lr, beta1, beta2, eps, weight_decay, int(adamw_mode),
+            bc1, bc2, num_threads)
+
+
 class DeepSpeedCPUAdam:
     """Stateful fp32 Adam over flat numpy buffers on the host."""
 
@@ -108,3 +136,5 @@ class DeepSpeedCPUAdam:
         self._m = {k: np.asarray(x, np.float32) for k, x in sd["m"].items()}
         self._v = {k: np.asarray(x, np.float32) for k, x in sd["v"].items()}
         self._steps = dict(sd["steps"])
+        if "lr" in sd:
+            self.lr = float(sd["lr"])

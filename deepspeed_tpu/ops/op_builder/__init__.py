@@ -3,11 +3,13 @@
 
 from .builder import (OpBuilder, all_builders, builder_report, cpu_arch,
                       get_builder, register_builder, simd_width)
+from .async_io import AsyncIOBuilder
 from .cpu_adam import CPUAdamBuilder
 from .cpu_adagrad import CPUAdagradBuilder
 
 __all__ = [
     "OpBuilder",
+    "AsyncIOBuilder",
     "CPUAdamBuilder",
     "CPUAdagradBuilder",
     "all_builders",

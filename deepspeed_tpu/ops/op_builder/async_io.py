@@ -1,0 +1,39 @@
+"""Builder for the native async I/O engine (reference ``op_builder/async_io.py``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .builder import OpBuilder, register_builder
+
+
+@register_builder
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+
+    def sources(self):
+        return ["aio/ds_aio.cpp"]
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        i64, i32 = ctypes.c_int64, ctypes.c_int
+        vp = ctypes.c_void_p
+        lib.ds_aio_create.argtypes = [i32, i64]
+        lib.ds_aio_create.restype = i64
+        lib.ds_aio_destroy.argtypes = [i64]
+        lib.ds_aio_destroy.restype = None
+        lib.ds_aio_open.argtypes = [ctypes.c_char_p, i32, i32]
+        lib.ds_aio_open.restype = i32
+        lib.ds_aio_close.argtypes = [i32]
+        lib.ds_aio_close.restype = i32
+        lib.ds_aio_submit_read.argtypes = [i64, i32, vp, i64, i64]
+        lib.ds_aio_submit_read.restype = i64
+        lib.ds_aio_submit_write.argtypes = [i64, i32, vp, i64, i64]
+        lib.ds_aio_submit_write.restype = i64
+        lib.ds_aio_wait.argtypes = [i64, i64]
+        lib.ds_aio_wait.restype = i64
+        lib.ds_aio_pending.argtypes = [i64]
+        lib.ds_aio_pending.restype = i32
+        lib.ds_aio_pread.argtypes = [i32, vp, i64, i64]
+        lib.ds_aio_pread.restype = i64
+        lib.ds_aio_pwrite.argtypes = [i32, vp, i64, i64]
+        lib.ds_aio_pwrite.restype = i64

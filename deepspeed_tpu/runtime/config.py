@@ -179,6 +179,10 @@ class DeepSpeedConfig:
         act_dict = pd.get(C.ACTIVATION_CHECKPOINTING, {})
         self.activation_checkpointing_config = act_dict
 
+        # async I/O engine tuning for NVMe offload (reference aio_config.py)
+        from .swap_tensor.aio_config import AioConfig
+        self.aio_config = AioConfig.from_dict(pd.get("aio", {}))
+
         # monitor backends (full configs parsed in deepspeed_tpu.monitor)
         self.monitor_config_dict = {
             k: pd.get(k, {}) for k in (C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV)

@@ -109,11 +109,24 @@ class DeepSpeedEngine:
         self.scaler_config = ls.LossScalerConfig.from_ds_config(self._config)
         self.loss_scaler = ls.LossScaler(self.scaler_config)
 
+        # ZeRO-Offload / Infinity: optimizer states on host (cpu) or swap
+        # files (nvme); device handles fwd/bwd + grad prep, host steps Adam
+        # (reference stage_1_and_2.py cpu_offload / stage3 NVMe swapping)
+        _ocfg = self._config.zero_config.offload_optimizer_config
+        self._offload_device = _ocfg.device if _ocfg.device != "none" else None
+        self._offload_cfg = _ocfg
+
         self._configure_sharding()
         self._configure_optimizer(optimizer, model_parameters)
         self._configure_lr_scheduler(lr_scheduler)
         self._init_state(rng)
         self._build_steps()
+
+        # telemetry fan-out (reference MonitorMaster, engine.py:1840/2069)
+        from ..monitor import MonitorMaster, get_monitor_config
+        self.monitor = MonitorMaster(
+            get_monitor_config(self._config.monitor_config_dict),
+            rank=self.global_rank)
 
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
 
@@ -242,6 +255,10 @@ class DeepSpeedEngine:
         stage = self._config.zero_optimization_stage
         self._separate_master = mixed or stage >= 1
 
+        if self._offload_device is not None:
+            self._init_state_offload(rng)
+            return
+
         separate = self._separate_master
 
         def init_all(rng):
@@ -288,6 +305,80 @@ class DeepSpeedEngine:
         }
         self._last_global_norm: Optional[float] = None
 
+    def _init_state_offload(self, rng: jax.Array) -> None:
+        """Device holds compute-dtype params + grad accumulators; fp32
+        master and Adam moments live with the host offload runner."""
+        from .zero.offload_engine import HostOffloadOptimizer
+        sh = self.shardings
+        self._separate_master = True
+
+        def init_all(rng):
+            if self.module.params is not None:
+                master = self.module.params
+            else:
+                master = self.module.init_fn(rng)
+            master = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), master)
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype), master)
+            grad_acc = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), master)
+            return params, master, grad_acc
+
+        out_sh = (sh.params, sh.master, sh.grads)
+        params, master_dev, grad_acc = jax.jit(
+            init_all, out_shardings=out_sh)(rng)
+        # precision-exact fp32 master moves to the host; the device copy is
+        # dropped immediately (transient 4N bytes at init only)
+        master_leaves = [np.asarray(jax.device_get(l), np.float32)
+                         for l in jax.tree_util.tree_leaves(master_dev)]
+        del master_dev
+        self._params_treedef = jax.tree_util.tree_structure(params)
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_optimizer currently requires a single controller "
+                "process (the host runner fetches global grads); multi-host "
+                "offload needs per-shard masters")
+        opt = self.optimizer
+        if getattr(opt, "param_groups", None) and len(opt.param_groups) > 1:
+            logger.warning(
+                "offload_optimizer applies param_groups[0]'s hyperparams to "
+                "every parameter; per-group weight decay is not honoured "
+                "under offload")
+        self._offload_opt = HostOffloadOptimizer(
+            master_leaves,
+            device=self._offload_device,
+            nvme_path=self._offload_cfg.nvme_path,
+            aio_config=self._config.aio_config,
+            pipeline_read=self._offload_cfg.pipeline_read,
+            pipeline_write=self._offload_cfg.pipeline_write,
+            betas=getattr(opt, "betas", (0.9, 0.999)),
+            eps=getattr(opt, "eps", 1e-8),
+            weight_decay=float(opt.param_groups[0].get("weight_decay", 0.0))
+            if getattr(opt, "param_groups", None) else 0.0,
+            adamw_mode=getattr(opt, "adam_w_mode", True),
+            bias_correction=getattr(opt, "bias_correction", True))
+
+        scale_state = jax.device_put(
+            ls.init_state(self.scaler_config), NamedSharding(self.mesh, P()))
+        self.state: Dict[str, Any] = {
+            "params": params,
+            "master": params,      # host runner owns the real fp32 master
+            "opt_state": {},
+            "grad_acc": grad_acc,
+            "scale": scale_state,
+        }
+        self._out_shardings = {
+            "params": sh.params, "master": sh.params, "opt_state": {},
+            "grads": sh.grads,
+            "scale": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P()), self.state["scale"]),
+        }
+        self._last_global_norm: Optional[float] = None
+        log_dist(f"[offload] optimizer states on {self._offload_device} "
+                 f"({len(master_leaves)} groups)", ranks=[0])
+
     # ------------------------------------------------------------------ jitted programs
     def _build_steps(self) -> None:
         loss_fn = self.module.loss_fn
@@ -320,6 +411,26 @@ class DeepSpeedEngine:
             new_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
             new_acc = constrain(new_acc, grad_specs)
             return new_acc, loss
+
+        if self._offload_device is not None:
+            # device side of the offloaded step: unscale, overflow check,
+            # clip — gradients then cross to the host for the Adam step
+            def grad_prep(grad_acc, scale_state):
+                scale = scale_state["loss_scale"]
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grad_acc)
+                overflow = (has_overflow(grads) if scaler_config.enabled
+                            else jnp.zeros((), bool))
+                if clip > 0:
+                    grads, norm = clip_grads_by_global_norm(grads, clip)
+                else:
+                    norm = global_grad_norm(grads)
+                new_scale = ls.update_state(scale_state, overflow, scaler_config)
+                zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
+                return grads, zero_acc, new_scale, norm, overflow
+
+            self._micro_jit = jax.jit(micro, donate_argnums=(1,))
+            self._grad_prep_jit = jax.jit(grad_prep)
+            return
 
         def apply_core(params, master, opt_state, grad_acc, scale_state, hyper):
             """Gas-boundary update: unscale, overflow check, clip, step, scale.
@@ -428,6 +539,10 @@ class DeepSpeedEngine:
             self.timers(BACKWARD_MICRO_TIMER).stop()
         loss = self._pending
         self._pending = None
+        if self.monitor.enabled and self.is_gradient_accumulation_boundary():
+            self.monitor.write_events([
+                ("Train/Samples/train_loss", float(jax.device_get(loss)),
+                 self.global_samples)])
         return loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -452,7 +567,59 @@ class DeepSpeedEngine:
         return {k: jnp.asarray(v, jnp.float32)
                 for k, v in self.optimizer.current_hyperparams().items()}
 
+    def _reseed_offload_master(self) -> None:
+        """Rebuild the host fp32 master from the current device params
+        (used when a checkpoint has no host optimizer state)."""
+        leaves = [np.asarray(jax.device_get(l), np.float32)
+                  for l in jax.tree_util.tree_leaves(self.state["params"])]
+        self._offload_opt.load_state_dict({
+            "step": 0,
+            "master": [l.ravel() for l in leaves],
+            "m": [np.zeros(l.size, np.float32) for l in leaves],
+            "v": [np.zeros(l.size, np.float32) for l in leaves],
+        })
+
+    def _apply_offload_step(self) -> bool:
+        """Gas-boundary step with host-resident optimizer states: device
+        preps grads, host Adam steps the fp32 master (native SIMD kernel),
+        bf16 params upload back (fused precast in the C++ kernel).
+        Returns whether the step overflowed (and was skipped)."""
+        s = self.state
+        grads, zero_acc, new_scale, norm, overflow = self._grad_prep_jit(
+            s["grad_acc"], s["scale"])
+        overflow_host = bool(overflow)
+        if not overflow_host:
+            host_grads = [np.asarray(jax.device_get(g), np.float32)
+                          for g in jax.tree_util.tree_leaves(grads)]
+            hyper = self.optimizer.current_hyperparams()
+            outs = self._offload_opt.step(
+                host_grads, float(hyper["lr"]),
+                weight_decay=float(hyper["weight_decay"])
+                if "weight_decay" in hyper else None,
+                bf16_out=self.compute_dtype == jnp.bfloat16)
+            param_leaves = jax.tree_util.tree_leaves(s["params"])
+            new_leaves = []
+            for out, leaf in zip(outs, param_leaves):
+                if self.compute_dtype == jnp.bfloat16:
+                    arr = out.view(jnp.bfloat16).reshape(leaf.shape)
+                else:
+                    arr = np.asarray(out, leaf.dtype).reshape(leaf.shape)
+                new_leaves.append(arr)
+            new_params_host = jax.tree_util.tree_unflatten(
+                self._params_treedef, new_leaves)
+            s["params"] = jax.device_put(
+                new_params_host, self._out_shardings["params"])
+            s["master"] = s["params"]
+        s["grad_acc"] = zero_acc
+        s["scale"] = new_scale
+        self._last_global_norm = norm
+        return overflow_host
+
     def _take_model_step(self, lr_kwargs=None) -> None:
+        if self._offload_device is not None:
+            overflow_host = self._apply_offload_step()
+            self._finish_model_step(overflow_host, lr_kwargs)
+            return
         s = self.state
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm,
@@ -469,8 +636,12 @@ class DeepSpeedEngine:
         s["grad_acc"] = zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm  # device scalar; float() lazily
+        self._finish_model_step(bool(overflow), lr_kwargs)
+
+    def _finish_model_step(self, overflow_host: bool, lr_kwargs=None) -> None:
+        """Post-step bookkeeping shared by the device and offload paths:
+        counters, scheduler, periodic log, monitor events."""
         self.global_steps += 1
-        overflow_host = bool(overflow)
         if overflow_host:
             self.skipped_steps += 1
             log_dist(f"[deepspeed_tpu] OVERFLOW! skipping step, "
@@ -480,10 +651,30 @@ class DeepSpeedEngine:
         if self.global_steps % self.steps_per_print() == 0:
             log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
                      f"lr={self.get_lr()}, loss_scale={self.cur_scale}", ranks=[0])
+        if self.monitor.enabled:
+            events = [("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
+            if self.fp16_enabled():
+                events.append(("Train/Samples/loss_scale", self.cur_scale,
+                               self.global_samples))
+            self.monitor.write_events(events)
 
     # fused whole-batch path -------------------------------------------------
     def train_batch_fused(self, batches):
         """Run a full train batch (gas stacked on dim 0) in one jit call."""
+        if self._offload_device is not None:
+            # host step can't live inside jit: run the micro loop on device,
+            # then the boundary step through the offload path
+            gas = self.gradient_accumulation_steps()
+            chunks = jax.tree_util.tree_map(
+                lambda x: np.reshape(np.asarray(x),
+                                     (gas, -1) + np.shape(x)[1:]), batches)
+            losses = []
+            for i in range(gas):
+                chunk = jax.tree_util.tree_map(lambda x: x[i], chunks)
+                losses.append(self.forward(chunk))
+                self.backward()
+                self.step()
+            return jnp.mean(jnp.stack(losses))
         s = self.state
         batches = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x).reshape(
@@ -507,15 +698,9 @@ class DeepSpeedEngine:
         s["grad_acc"] = zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm
-        self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
-        if bool(overflow):
-            self.skipped_steps += 1
-            log_dist(f"[deepspeed_tpu] OVERFLOW! skipping step, "
-                     f"reducing loss scale to {self.cur_scale}", ranks=[0])
-        elif self._lr_scheduler is not None:
-            self._lr_scheduler.step()
+        self._finish_model_step(bool(overflow))
         return mean_loss
 
     # ------------------------------------------------------------------ eval
@@ -540,23 +725,53 @@ class DeepSpeedEngine:
         if self._lr_scheduler is not None:
             client_state["lr_scheduler"] = self._lr_scheduler.state_dict()
         client_state["optimizer_param_groups"] = self.optimizer.param_groups
+        offload = self._offload_device is not None
         save_engine_checkpoint(save_dir, tag, self.state, client_state,
-                               separate_master=self._separate_master,
+                               separate_master=self._separate_master and not offload,
                                save_latest=save_latest)
+        if offload:
+            # host-side fp32 master + moments (zero_pp_rank_* analogue)
+            path = os.path.join(save_dir, tag,
+                                f"offload_optimizer_rank{self.global_rank}.npz")
+            self._offload_opt.save(path)
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpoint_engine.native_checkpoint_engine import load_engine_checkpoint
+        offload = self._offload_device is not None
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state,
             shardings=self._out_shardings,
             load_optimizer_states=load_optimizer_states and not load_module_only,
-            separate_master=self._separate_master)
+            separate_master=self._separate_master and not offload)
         if state is None:
             return None, {}
         self.state = state
+        if offload:
+            loaded = False
+            if load_optimizer_states and not load_module_only:
+                resolved_tag = tag
+                if resolved_tag is None:
+                    latest_path = os.path.join(load_dir, "latest")
+                    if os.path.exists(latest_path):
+                        with open(latest_path) as f:
+                            resolved_tag = f.read().strip()
+                path = os.path.join(
+                    load_dir, resolved_tag or "",
+                    f"offload_optimizer_rank{self.global_rank}.npz")
+                if os.path.exists(path):
+                    self._offload_opt.load(path)
+                    loaded = True
+                else:
+                    logger.warning(
+                        f"no offload optimizer state at {path}; re-seeding "
+                        "host master from loaded params, moments reset")
+            if not loaded:
+                # the host master must always track the loaded params or the
+                # first step would overwrite them with the init-time master
+                self._reseed_offload_master()
         self.micro_steps = client_state.get("micro_steps", 0)
         self.global_steps = client_state.get("global_steps", 0)
         self.global_samples = client_state.get("global_samples", 0)

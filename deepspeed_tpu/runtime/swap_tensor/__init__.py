@@ -1,0 +1,20 @@
+"""NVMe tensor swapping for ZeRO-Infinity.
+
+Counterpart of the reference's ``deepspeed/runtime/swap_tensor/`` package
+(``AsyncPartitionedParameterSwapper`` partitioned_param_swapper.py:35,
+``PartitionedOptimizerSwapper`` partitioned_optimizer_swapper.py:27,
+``AsyncTensorSwapper`` async_swapper.py:17, ``aio_config.py``) over the
+native aio engine in ``csrc/aio/ds_aio.cpp``.
+"""
+
+from .aio_config import AioConfig
+from .aio_handle import AsyncIOHandle
+from .async_swapper import AsyncTensorSwapper
+from .optimizer_swapper import OptimizerStateSwapper
+
+__all__ = [
+    "AioConfig",
+    "AsyncIOHandle",
+    "AsyncTensorSwapper",
+    "OptimizerStateSwapper",
+]

@@ -1,0 +1,20 @@
+"""aio section of the config (reference ``runtime/swap_tensor/aio_config.py``:
+block_size, queue_depth, thread_count, single_submit, overlap_events)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..config_utils import DeepSpeedConfigModel
+
+AIO = "aio"
+
+
+@dataclasses.dataclass
+class AioConfig(DeepSpeedConfigModel):
+    block_size: int = 1 << 20
+    queue_depth: int = 8          # accepted for parity; pool depth == threads
+    thread_count: int = 4
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_o_direct: bool = False

@@ -1,0 +1,108 @@
+"""Python wrapper over the native aio engine.
+
+Counterpart of the reference's ``deepspeed_aio_handle_t`` bindings
+(``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp``): async read/write of host
+numpy buffers against files with submit/wait semantics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...ops.op_builder.async_io import AsyncIOBuilder
+from .aio_config import AioConfig
+
+
+class AsyncIOHandle:
+    """Thread-pooled async file I/O over flat numpy buffers."""
+
+    def __init__(self, config: Optional[AioConfig] = None):
+        self.config = config or AioConfig()
+        self._lib = AsyncIOBuilder().load()
+        self._engine = self._lib.ds_aio_create(self.config.thread_count,
+                                               self.config.block_size)
+        self._fds: Dict[str, int] = {}
+        # requests hold a reference to their buffer until waited on, so the
+        # engine never writes through a garbage-collected pointer
+        self._inflight: Dict[int, np.ndarray] = {}
+
+    # ------------------------------------------------------------------ files
+
+    def _fd(self, path: str, for_write: bool) -> int:
+        key = f"{'w' if for_write else 'r'}:{path}"
+        if key not in self._fds:
+            fd = self._lib.ds_aio_open(
+                path.encode(), int(for_write), int(self.config.use_o_direct))
+            if fd < 0:
+                raise OSError(-fd, os.strerror(-fd), path)
+            self._fds[key] = fd
+        return self._fds[key]
+
+    # ------------------------------------------------------------------- ops
+
+    def submit_write(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        assert buf.flags.c_contiguous
+        rid = self._lib.ds_aio_submit_write(
+            self._engine, self._fd(path, True),
+            buf.ctypes.data, buf.nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, os.strerror(-rid))
+        self._inflight[rid] = buf
+        return rid
+
+    def submit_read(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        assert buf.flags.c_contiguous and buf.flags.writeable
+        rid = self._lib.ds_aio_submit_read(
+            self._engine, self._fd(path, False),
+            buf.ctypes.data, buf.nbytes, offset)
+        if rid < 0:
+            raise OSError(-rid, os.strerror(-rid))
+        self._inflight[rid] = buf
+        return rid
+
+    def wait(self, request_id: int) -> int:
+        nbytes = self._lib.ds_aio_wait(self._engine, request_id)
+        self._inflight.pop(request_id, None)
+        if nbytes < 0:
+            raise OSError(-nbytes, os.strerror(-nbytes))
+        return nbytes
+
+    def pending(self) -> int:
+        return self._lib.ds_aio_pending(self._engine)
+
+    # sync convenience (reference deepspeed_py_aio.cpp sync paths)
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        n = self._lib.ds_aio_pwrite(self._fd(path, True), buf.ctypes.data,
+                                    buf.nbytes, offset)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return n
+
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        n = self._lib.ds_aio_pread(self._fd(path, False), buf.ctypes.data,
+                                   buf.nbytes, offset)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return n
+
+    def close(self) -> None:
+        for rid in list(self._inflight):
+            try:
+                self.wait(rid)
+            except OSError:
+                pass
+        for fd in self._fds.values():
+            self._lib.ds_aio_close(fd)
+        self._fds.clear()
+        if self._engine:
+            self._lib.ds_aio_destroy(self._engine)
+            self._engine = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,74 @@
+"""Generic async tensor swapper (reference ``async_swapper.py:17``
+``AsyncTensorSwapper``): move host arrays to/from swap files while compute
+continues, waiting only when the data is needed back."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .aio_config import AioConfig
+from .aio_handle import AsyncIOHandle
+
+
+class AsyncTensorSwapper:
+    """Keyed swap store: ``swap_out(key, arr)`` starts an async write;
+    ``swap_in(key)`` waits for any pending write and reads the array back."""
+
+    def __init__(self, swap_dir: str, aio_config: Optional[AioConfig] = None):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.handle = AsyncIOHandle(aio_config)
+        # key -> (path, shape, dtype, pending write request or None)
+        self._meta: Dict[str, Tuple[str, tuple, np.dtype, Optional[int]]] = {}
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.swap_dir, f"{key}.swp")
+
+    def swap_out(self, key: str, arr: np.ndarray, blocking: bool = False) -> None:
+        arr = np.ascontiguousarray(arr)
+        path = self._path(key)
+        rid = self.handle.submit_write(path, arr)
+        self._meta[key] = (path, arr.shape, arr.dtype, rid)
+        if blocking:
+            self._drain(key)
+
+    def _drain(self, key: str) -> None:
+        path, shape, dtype, rid = self._meta[key]
+        if rid is not None:
+            self.handle.wait(rid)
+            self._meta[key] = (path, shape, dtype, None)
+
+    def swap_in(self, key: str, out: Optional[np.ndarray] = None) -> np.ndarray:
+        if key not in self._meta:
+            raise KeyError(f"no swapped tensor under key {key!r}")
+        self._drain(key)  # a write still in flight must land first
+        path, shape, dtype, _ = self._meta[key]
+        if out is None:
+            out = np.empty(shape, dtype=dtype)
+        rid = self.handle.submit_read(path, out.reshape(-1).view(np.uint8))
+        self.handle.wait(rid)
+        return out.reshape(shape)
+
+    def contains(self, key: str) -> bool:
+        return key in self._meta
+
+    def swapped_bytes(self) -> int:
+        return sum(np.dtype(d).itemsize * int(np.prod(s))
+                   for _, s, d, _ in self._meta.values())
+
+    def release(self, key: str) -> None:
+        if key in self._meta:
+            self._drain(key)
+            path = self._meta.pop(key)[0]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        for key in list(self._meta):
+            self.release(key)
+        self.handle.close()

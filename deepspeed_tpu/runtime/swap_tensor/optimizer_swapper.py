@@ -1,0 +1,111 @@
+"""Optimizer-state NVMe swapper.
+
+Counterpart of the reference's ``PartitionedOptimizerSwapper``
+(partitioned_optimizer_swapper.py:27) and ``PipelinedOptimizerSwapper``
+(pipelined_optimizer_swapper.py): fp32 master weights + Adam moments live in
+swap files; ``step`` streams one parameter group through host RAM at a time,
+prefetching the next group's read behind the current group's compute
+(pipeline_read) and letting write-back complete behind subsequent groups
+(pipeline_write).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .aio_config import AioConfig
+from .aio_handle import AsyncIOHandle
+
+
+class OptimizerStateSwapper:
+    """Per-group dict-of-flat-arrays store on NVMe with read prefetch."""
+
+    def __init__(self, swap_dir: str, aio_config: Optional[AioConfig] = None,
+                 pipeline_read: bool = True, pipeline_write: bool = True):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.handle = AsyncIOHandle(aio_config)
+        self.pipeline_read = pipeline_read
+        self.pipeline_write = pipeline_write
+        # key -> field -> (path, shape, dtype)
+        self._meta: Dict[str, Dict[str, tuple]] = {}
+        self._read_ahead: Dict[str, Dict[str, tuple]] = {}  # key->field->(rid, buf)
+        self._writes: List[int] = []
+
+    def _path(self, key: str, field: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.swap_dir, f"{safe}.{field}.swp")
+
+    # ---------------------------------------------------------------- write
+
+    def put(self, key: str, arrays: Dict[str, np.ndarray],
+            blocking: bool = False) -> None:
+        """(Over)write a group's state; async unless ``blocking``."""
+        meta = {}
+        for field, arr in arrays.items():
+            arr = np.ascontiguousarray(arr)
+            path = self._path(key, field)
+            rid = self.handle.submit_write(path, arr)
+            self._writes.append(rid)
+            meta[field] = (path, arr.shape, arr.dtype)
+        self._meta[key] = meta
+        if blocking or not self.pipeline_write:
+            self.flush_writes()
+
+    def flush_writes(self) -> None:
+        for rid in self._writes:
+            self.handle.wait(rid)
+        self._writes.clear()
+
+    # ----------------------------------------------------------------- read
+
+    def prefetch(self, key: str) -> None:
+        """Start async reads for ``key`` (no-op if already in flight)."""
+        if key in self._read_ahead or key not in self._meta:
+            return
+        self.flush_writes()  # never read a file with its write still queued
+        fetch = {}
+        for field, (path, shape, dtype) in self._meta[key].items():
+            buf = np.empty(int(np.prod(shape)), dtype=dtype)
+            rid = self.handle.submit_read(path, buf)
+            fetch[field] = (rid, buf, shape)
+        self._read_ahead[key] = fetch
+
+    def get(self, key: str, prefetch_next: Optional[str] = None
+            ) -> Dict[str, np.ndarray]:
+        """Blocking fetch of a group (uses the prefetched read when armed);
+        optionally arms the next group's prefetch before waiting."""
+        if key not in self._meta:
+            raise KeyError(f"no optimizer state under key {key!r}")
+        if key not in self._read_ahead:
+            self.prefetch(key)
+        if prefetch_next is not None and self.pipeline_read:
+            self.prefetch(prefetch_next)
+        out = {}
+        for field, (rid, buf, shape) in self._read_ahead.pop(key).items():
+            self.handle.wait(rid)
+            out[field] = buf.reshape(shape)
+        return out
+
+    def keys(self) -> Iterable[str]:
+        return self._meta.keys()
+
+    def close(self) -> None:
+        self.flush_writes()
+        for key in list(self._read_ahead):
+            for rid, _, _ in self._read_ahead.pop(key).values():
+                try:
+                    self.handle.wait(rid)
+                except OSError:
+                    pass
+        for meta in self._meta.values():
+            for path, _, _ in meta.values():
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self._meta.clear()
+        self.handle.close()

@@ -1,0 +1,181 @@
+"""ZeRO-Offload / ZeRO-Infinity host optimizer runner.
+
+Counterpart of the reference's cpu_offload path in
+``runtime/zero/stage_1_and_2.py`` (``cpu_offload`` + ``DeepSpeedCPUAdam``
+per-partition step) and the stage-3 NVMe swap of optimizer state
+(``_configure_tensor_swapping`` stage3.py:466 → PartitionedOptimizerSwapper).
+
+Division of labour on TPU:
+  - device (jit): forward/backward, grad accumulation, unscale/clip/overflow,
+    all ZeRO sharding collectives;
+  - host (this class): fp32 master weights + Adam moments, stepped by the
+    native SIMD kernel (csrc/adam/cpu_adam.cpp), with states resident in RAM
+    (device="cpu") or streamed from swap files through a read-prefetch
+    pipeline (device="nvme", csrc/aio/ds_aio.cpp).
+
+The updated master is precast to bf16 inside the C++ kernel (the fused
+copy-out), so the upload to HBM ships half the bytes and no device-side cast
+is needed — the reference's adam_update_copy overlap, adapted to bf16.
+
+Multi-host note: each process steps the shard(s) its devices own; here the
+runner consumes whatever host arrays the engine hands it (the engine fetches
+its addressable shards).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...ops.adam.cpu_adam import cpu_adam_step
+from ...ops.op_builder.cpu_adam import CPUAdamBuilder
+from ...utils.logging import logger
+from ..swap_tensor import AioConfig, OptimizerStateSwapper
+
+
+class HostOffloadOptimizer:
+    """Adam over host-resident (cpu) or swap-file (nvme) fp32 state."""
+
+    def __init__(self, master_leaves: Sequence[np.ndarray], device: str = "cpu",
+                 nvme_path: Optional[str] = None,
+                 aio_config: Optional[AioConfig] = None,
+                 pipeline_read: bool = True, pipeline_write: bool = True,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True, num_threads: int = 0):
+        assert device in ("cpu", "nvme"), device
+        self.device = device
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.num_threads = num_threads
+        self.step_count = 0
+        self._lib = CPUAdamBuilder().load()
+        self._shapes = [l.shape for l in master_leaves]
+        flats = [np.ascontiguousarray(l, np.float32).ravel()
+                 for l in master_leaves]
+        if device == "cpu":
+            self._master = flats
+            self._m = [np.zeros(f.size, np.float32) for f in flats]
+            self._v = [np.zeros(f.size, np.float32) for f in flats]
+            self._swapper = None
+        else:
+            if not nvme_path:
+                raise ValueError("offload device 'nvme' requires nvme_path")
+            self._swapper = OptimizerStateSwapper(
+                nvme_path, aio_config, pipeline_read=pipeline_read,
+                pipeline_write=pipeline_write)
+            for i, f in enumerate(flats):
+                self._swapper.put(self._key(i), {
+                    "master": f,
+                    "m": np.zeros(f.size, np.float32),
+                    "v": np.zeros(f.size, np.float32),
+                }, blocking=False)
+            self._swapper.flush_writes()
+            self._master = None
+            logger.info(f"[offload] {len(flats)} groups "
+                        f"({sum(f.size for f in flats)/1e6:.1f}M fp32 params) "
+                        f"swapped to {nvme_path}")
+
+    @staticmethod
+    def _key(i: int) -> str:
+        return f"group{i}"
+
+    @property
+    def num_groups(self) -> int:
+        return len(self._shapes)
+
+    def step(self, host_grads: List[np.ndarray], lr: float,
+             weight_decay: Optional[float] = None,
+             bf16_out: bool = True) -> List[np.ndarray]:
+        """One Adam step over every group; returns per-group updated params
+        as bf16 bit arrays (uint16) when ``bf16_out`` else fp32, each in the
+        group's original shape (bf16 arrays are flat bit views to reshape
+        after ``.view(bfloat16)``).  ``weight_decay`` overrides the
+        construction-time value so host steps track a scheduled wd."""
+        assert len(host_grads) == self.num_groups
+        if weight_decay is not None:
+            self.weight_decay = weight_decay
+        self.step_count += 1
+        outs: List[np.ndarray] = []
+        for i, g in enumerate(host_grads):
+            g = np.ascontiguousarray(g, np.float32).ravel()
+            if self._swapper is None:
+                p, m, v = self._master[i], self._m[i], self._v[i]
+            else:
+                nxt = self._key(i + 1) if i + 1 < self.num_groups else None
+                state = self._swapper.get(self._key(i), prefetch_next=nxt)
+                p, m, v = state["master"], state["m"], state["v"]
+            out16 = np.empty(p.size, np.uint16) if bf16_out else None
+            cpu_adam_step(self._lib, p, g, m, v, self.step_count, lr,
+                          self.beta1, self.beta2, self.eps, self.weight_decay,
+                          self.adamw_mode, self.bias_correction,
+                          bf16_out=out16, num_threads=self.num_threads)
+            if self._swapper is not None:
+                self._swapper.put(self._key(i), {"master": p, "m": m, "v": v})
+            outs.append(out16 if bf16_out else p.reshape(self._shapes[i]))
+        if self._swapper is not None:
+            self._swapper.flush_writes()
+        return outs
+
+    # ------------------------------------------------------------ checkpoint
+
+    def state_dict(self) -> Dict:
+        if self._swapper is None:
+            masters, ms, vs = self._master, self._m, self._v
+        else:
+            groups = [self._swapper.get(self._key(i))
+                      for i in range(self.num_groups)]
+            masters = [g["master"] for g in groups]
+            ms = [g["m"] for g in groups]
+            vs = [g["v"] for g in groups]
+        return {"step": self.step_count,
+                "master": list(masters), "m": list(ms), "v": list(vs)}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.step_count = int(sd["step"])
+        masters = [np.asarray(x, np.float32).ravel() for x in sd["master"]]
+        ms = [np.asarray(x, np.float32).ravel() for x in sd["m"]]
+        vs = [np.asarray(x, np.float32).ravel() for x in sd["v"]]
+        assert len(masters) == self.num_groups
+        if self._swapper is None:
+            self._master, self._m, self._v = masters, ms, vs
+        else:
+            for i in range(self.num_groups):
+                self._swapper.put(self._key(i), {
+                    "master": masters[i], "m": ms[i], "v": vs[i]})
+            self._swapper.flush_writes()
+
+    def save(self, path: str) -> None:
+        """Persist step count + master/m/v as one npz (checkpoint dir)."""
+        sd = self.state_dict()
+        arrays = {"step": np.asarray(sd["step"])}
+        for i in range(self.num_groups):
+            arrays[f"master_{i}"] = sd["master"][i]
+            arrays[f"m_{i}"] = sd["m"][i]
+            arrays[f"v_{i}"] = sd["v"][i]
+        np.savez(path, **arrays)
+
+    def load(self, path: str) -> None:
+        with np.load(path) as z:
+            n = self.num_groups
+            self.load_state_dict({
+                "step": int(z["step"]),
+                "master": [z[f"master_{i}"] for i in range(n)],
+                "m": [z[f"m_{i}"] for i in range(n)],
+                "v": [z[f"v_{i}"] for i in range(n)],
+            })
+
+    def masters(self) -> List[np.ndarray]:
+        """Current fp32 master leaves (reshaped); NVMe mode reads them in."""
+        if self._swapper is None:
+            return [m.reshape(s) for m, s in zip(self._master, self._shapes)]
+        return [self._swapper.get(self._key(i))["master"].reshape(s)
+                for i, s in enumerate(self._shapes)]
+
+    def close(self) -> None:
+        if self._swapper is not None:
+            self._swapper.close()

@@ -1,0 +1,69 @@
+"""Monitor backends + flops profiler (reference tests/unit/monitor/,
+tests/unit/profiling/)."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    from deepspeed_tpu.monitor import MonitorMaster, get_monitor_config
+    cfg = get_monitor_config({
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"}})
+    m = MonitorMaster(cfg, rank=0)
+    assert m.enabled
+    m.write_events([("Train/Samples/train_loss", 1.5, 10),
+                    ("Train/Samples/train_loss", 1.2, 20)])
+    fname = tmp_path / "job" / "Train_Samples_train_loss.csv"
+    rows = list(csv.reader(open(fname)))
+    assert rows[0] == ["step", "Train/Samples/train_loss"]
+    assert rows[1] == ["10", "1.5"] and rows[2] == ["20", "1.2"]
+
+
+def test_monitor_rank_nonzero_disabled(tmp_path):
+    from deepspeed_tpu.monitor import MonitorMaster, get_monitor_config
+    cfg = get_monitor_config({
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path)}})
+    m = MonitorMaster(cfg, rank=1)
+    assert not m.enabled
+
+
+def test_monitor_disabled_by_default():
+    from deepspeed_tpu.monitor import MonitorMaster, get_monitor_config
+    m = MonitorMaster(get_monitor_config({}), rank=0)
+    assert not m.enabled
+
+
+def test_flops_profiler_matmul_costs():
+    from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+    M = N = K = 256
+
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((M, K), jnp.float32)
+    b = jnp.ones((K, N), jnp.float32)
+    prof = FlopsProfiler()
+    stats = prof.profile_fn(fn, a, b)
+    # XLA cost model: 2*M*N*K flops for the matmul
+    assert stats["flops"] == pytest.approx(2 * M * N * K, rel=0.01)
+    assert stats["duration"] > 0
+    assert prof.get_flops_per_second() > 0
+
+
+def test_get_model_profile_strings():
+    from deepspeed_tpu.profiling.flops_profiler import get_model_profile
+
+    def fn(x):
+        return jnp.sum(x @ x)
+
+    flops, macs, params = get_model_profile(
+        fn, args=(jnp.ones((128, 128)),), print_profile=False)
+    assert "FLOPs" in flops and "MACs" in macs
