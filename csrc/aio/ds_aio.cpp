@@ -40,7 +40,8 @@ struct Request {
     int64_t offset = 0;
     bool write = false;
     std::atomic<int64_t> remaining{0};   // sub-chunks outstanding
-    std::atomic<int64_t> result{0};      // bytes moved, or -errno
+    std::atomic<int64_t> moved{0};       // bytes successfully moved
+    std::atomic<int> error{0};           // first errno seen (sticky)
     bool done = false;
 };
 
@@ -112,7 +113,8 @@ class AioEngine {
         }
         std::lock_guard<std::mutex> g(mu_);
         inflight_.erase(id);
-        return req->result.load();
+        int err = req->error.load();
+        return err ? -(int64_t)err : req->moved.load();
     }
 
     int pending() {
@@ -141,7 +143,10 @@ class AioEngine {
                                     : pread(r.fd, p, left, off);
                 if (n < 0) {
                     if (errno == EINTR) continue;
-                    r.result.store(-errno);
+                    // sticky first error; bytes accumulate separately so a
+                    // racing successful chunk can never mask the failure
+                    int expected = 0;
+                    r.error.compare_exchange_strong(expected, errno);
                     break;
                 }
                 if (n == 0) break;  // EOF on read
@@ -150,8 +155,7 @@ class AioEngine {
                 left -= n;
                 moved += n;
             }
-            if (r.result.load() >= 0)
-                r.result.fetch_add(moved);
+            r.moved.fetch_add(moved);
             if (r.remaining.fetch_sub(1) == 1) {
                 std::lock_guard<std::mutex> lk(done_mu_);
                 r.done = true;

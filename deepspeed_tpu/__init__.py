@@ -113,6 +113,40 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
+def init_inference(model=None, config=None, **kwargs):
+    """Build an InferenceEngine (reference deepspeed/__init__.py:233).
+
+    ``model`` may be:
+      - a live HF torch module (GPT-2 family) — converted through the
+        injection policies (module_inject/replace_policy.py);
+      - a ``(GPTConfig, params)`` tuple of this framework's native GPT;
+      - a ``ModelSpec`` with materialized ``params``.
+    ``config`` is a DeepSpeedInferenceConfig dict; remaining kwargs merge
+    into it (the reference's kwargs-into-config behaviour).
+    """
+    from .inference.config import DeepSpeedInferenceConfig
+    from .inference.engine import InferenceEngine
+
+    cfg_dict = dict(config or {})
+    cfg_dict.update(kwargs)
+    inf_config = DeepSpeedInferenceConfig.from_dict(cfg_dict)
+
+    from .models import gpt as gpt_mod
+    if isinstance(model, tuple) and len(model) == 2 \
+            and isinstance(model[0], gpt_mod.GPTConfig):
+        model_config, params = model
+    elif isinstance(model, ModelSpec):
+        assert model.params is not None, \
+            "init_inference(ModelSpec) needs materialized params"
+        model_config, params = model.meta["config"], model.params
+    else:
+        from .module_inject import convert_hf_model
+        model_config, params = convert_hf_model(
+            model, dtype=inf_config.jnp_dtype)
+    return InferenceEngine(model_config, params, inf_config,
+                           mesh_manager=get_mesh_manager(optional=True))
+
+
 def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     """Add --deepspeed / --deepspeed_config args (reference :210)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

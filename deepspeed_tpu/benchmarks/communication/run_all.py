@@ -35,7 +35,7 @@ def _mesh() -> Mesh:
 
 
 def _timed(fn: Callable, x, iters: int, warmup: int) -> float:
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # at least once: compile outside timing
         out = fn(x)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
@@ -95,7 +95,8 @@ def run_op(op: str, sizes_bytes: List[int], dtype=jnp.bfloat16,
         x = jnp.ones((elems,), dtype)
         dt = _timed(fn, x, iters, warmup)
         msg_bytes = elems * itemsize
-        algbw, busbw = get_bw(op, msg_bytes, dt, n)
+        algbw, busbw = get_bw("ppermute" if op == "pt2pt" else op,
+                              msg_bytes, dt, n)
         results.append({"op": op, "bytes": msg_bytes, "latency_us": dt * 1e6,
                         "algbw_gbps": algbw, "busbw_gbps": busbw})
     return results

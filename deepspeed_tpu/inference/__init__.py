@@ -1,0 +1,4 @@
+from .config import DeepSpeedInferenceConfig
+from .engine import InferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine"]

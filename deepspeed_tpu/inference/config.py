@@ -1,0 +1,61 @@
+"""Inference config (reference ``inference/config.py``
+``DeepSpeedInferenceConfig``): dtype, tensor_parallel, max_out_tokens,
+kernel injection, quantization."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+@dataclasses.dataclass
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+
+
+@dataclasses.dataclass
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference field names preserved; ``replace_with_kernel_inject`` keeps
+    its meaning — run through the fused Pallas decode path rather than the
+    layer-by-layer reference path."""
+
+    dtype: str = "bfloat16"
+    tensor_parallel: Dict = dataclasses.field(default_factory=dict)
+    moe: Dict = dataclasses.field(default_factory=dict)
+    quant: Dict = dataclasses.field(default_factory=dict)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = True
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False     # accepted; jit IS the graph capture
+    max_batch_size: int = 1
+
+    DEPRECATED_FIELDS = {"mp_size": "tensor_parallel"}
+
+    def __post_init__(self):
+        if isinstance(self.tensor_parallel, int):
+            self.tensor_parallel = {"tp_size": self.tensor_parallel}
+        self.tp = DeepSpeedTPConfig.from_dict(self.tensor_parallel or {})
+        self.quantization = QuantizationConfig.from_dict(self.quant or {})
+
+    @property
+    def tp_size(self) -> int:
+        return self.tp.tp_size
+
+    @property
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "fp32": jnp.float32,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "int8": jnp.int8}[str(self.dtype).replace("torch.", "")]

@@ -1,0 +1,146 @@
+"""Inference engine: jitted prefill + decode over a TP-sharded GPT.
+
+Counterpart of the reference's ``InferenceEngine`` (``inference/engine.py:32``):
+dtype conversion (:447), tensor-parallel weight sharding (kernel-injection
+slicing, ``module_inject/replace_module.py:18``), CUDA-graph capture (:464)
+→ here, jit compilation of whole prefill/decode programs; ``forward`` (:505)
+and a ``generate`` loop.
+
+TP on TPU is declarative: qkv/mlp weights carry head/ffn-dim shardings over
+the 'model' mesh axis and XLA inserts the per-layer all-reduce the
+reference's ``LinearAllreduce`` issues by hand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import gpt, gpt_inference
+from ..parallel.mesh import MODEL_AXIS, MeshManager, get_mesh_manager
+from ..utils.logging import logger
+from .config import DeepSpeedInferenceConfig
+
+PyTree = Any
+
+
+class InferenceEngine:
+    """Wraps (config, params) with jitted prefill/decode/generate."""
+
+    def __init__(self, model_config: gpt.GPTConfig, params: PyTree,
+                 config: DeepSpeedInferenceConfig,
+                 mesh_manager: Optional[MeshManager] = None):
+        self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
+        self._config = config
+        dtype = config.jnp_dtype
+        self.model_config = dataclasses.replace(model_config, dtype=dtype)
+        self.params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
+            else p, params)
+        if self.mesh_manager is not None and \
+                self.mesh_manager.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            self._shard_params_tp()
+        cfg = self.model_config
+        self._forward_jit = jax.jit(lambda p, t: gpt.apply(p, t, cfg))
+        self._generate_cache: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------------- tp
+
+    def _shard_params_tp(self) -> None:
+        """Head/ffn-dim sharding over the 'model' axis (the reference's
+        ReplaceWithTensorSlicing, done declaratively)."""
+        from ..models.partitioning import TP_RULES, tree_shardings
+        mesh = self.mesh_manager.mesh
+        axes = gpt.logical_axes(self.model_config)
+        shardings = tree_shardings(axes, mesh, TP_RULES)
+        self.params = jax.tree_util.tree_map(
+            jax.device_put, self.params, shardings)
+        logger.info(f"[inference] TP sharding over model axis "
+                    f"({mesh.shape[MODEL_AXIS]} ways)")
+
+    # -------------------------------------------------------------- forward
+
+    def forward(self, tokens) -> jnp.ndarray:
+        """Full-sequence logits (HF-style __call__). tokens [B, S] int32."""
+        return self._forward_jit(self.params, jnp.asarray(tokens, jnp.int32))
+
+    __call__ = forward
+
+    # ------------------------------------------------------------- generate
+
+    def _build_generate(self, max_len: int, max_new: int, greedy: bool):
+        cfg = self.model_config
+
+        def run(params, tokens, prompt_len, key, temperature):
+            B, S = tokens.shape
+            cache = gpt_inference.init_cache(cfg, B, max_len)
+            logits, cache = gpt_inference.prefill(params, tokens, cfg, cache)
+            # logits at the last *prompt* token predict the first new token
+            last = logits[jnp.arange(B), prompt_len - 1]
+            out = jnp.zeros((B, max_new), jnp.int32)
+
+            def pick(lg, key):
+                lg = lg[:, :cfg.vocab_size]
+                if greedy:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return jax.random.categorical(
+                    key, lg / jnp.maximum(temperature, 1e-6)).astype(jnp.int32)
+
+            def body(i, st):
+                out, last, cache, key = st
+                key, sub = jax.random.split(key)
+                nxt = pick(last, sub)
+                out = out.at[:, i].set(nxt)
+                logits, cache = gpt_inference.decode_step(params, nxt, cfg,
+                                                          cache)
+                return out, logits, cache, key
+
+            out, _, cache, _ = lax.fori_loop(0, max_new, body,
+                                             (out, last, cache, key))
+            return out
+
+        return jax.jit(run)
+
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 key: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Autoregressive generation; the whole loop is one XLA program.
+
+        tokens: [B, S] prompt (right-aligned padding NOT supported — pass
+        equal-length prompts or left-pad).  Returns [B, max_new_tokens].
+        """
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, S = tokens.shape
+        if S + max_new_tokens > self.model_config.max_seq_len:
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({self.model_config.max_seq_len}); decoding "
+                "past it would silently overwrite the last cache slot")
+        max_len = S + max_new_tokens
+        # round the cache up so the decode kernel tiles (and recompiles
+        # amortize across nearby lengths)
+        max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
+        max_len = min(max_len, self.model_config.max_seq_len)
+        sig = (max_len, max_new_tokens, not do_sample)
+        if sig not in self._generate_cache:
+            self._generate_cache[sig] = self._build_generate(
+                max_len, max_new_tokens, greedy=not do_sample)
+        key = key if key is not None else jax.random.PRNGKey(0)
+        return self._generate_cache[sig](
+            self.params, tokens, jnp.full((tokens.shape[0],), S, jnp.int32),
+            key, jnp.asarray(temperature, jnp.float32))
+
+    # ----------------------------------------------------------- checkpoint
+
+    def save_16bit_model(self, path: str) -> None:
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
+        arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
+        np.savez(path, **arrays)

@@ -1,0 +1,149 @@
+"""KV-cached inference applys for the GPT family.
+
+Counterpart of the reference's ``DeepSpeedTransformerInference``
+(``model_implementations/transformers/ds_transformer.py:17``) and its
+``softmax_context`` KV-cache attention
+(``csrc/transformer/inference/csrc/pt_binding.cpp``): prefill runs the
+training forward while recording K/V; decode advances one token against the
+cache.  Both are pure functions over (params, cache) so the whole generate
+loop jits into a single XLA program — the role CUDA-graph capture plays in
+the reference (``inference/engine.py:464``), played instead by jit tracing.
+
+Cache layout [L, B, S_max, H, D]: static shapes (XLA requirement), masked by
+the current length; decode attention reads the cache tiled over S_max with
+positions beyond ``pos`` masked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import gpt
+
+PyTree = Any
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    k: jnp.ndarray        # [L, B, S_max, H, D]
+    v: jnp.ndarray        # [L, B, S_max, H, D]
+    length: jnp.ndarray   # [] int32 — tokens already cached
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(config: gpt.GPTConfig, batch: int, max_len: int) -> KVCache:
+    shape = (config.n_layer, batch, max_len, config.n_head, config.head_dim)
+    return KVCache(k=jnp.zeros(shape, config.dtype),
+                   v=jnp.zeros(shape, config.dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _qkv(x, p, config: gpt.GPTConfig):
+    cdt = config.dtype
+    h = gpt._layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) \
+        + p["bqkv"].astype(cdt)
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _proj_mlp(x, attn, p, config: gpt.GPTConfig):
+    cdt = config.dtype
+    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
+        + p["bo"].astype(cdt)
+    x = x + attn_out
+    h2 = gpt._layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
+    ff = jax.nn.gelu(ff, approximate=True)
+    ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) \
+        + p["bo_mlp"].astype(cdt)
+    return x + ff_out
+
+
+def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig):
+    """q: [B, S_q, H, D] attending to cache[:, :pos+S_q].
+
+    ``pos`` is the number of tokens already in the cache before this call;
+    query i sits at absolute position pos+i and sees cache slots ≤ pos+i.
+    """
+    from ..ops.pallas.decode_attention import cached_attention
+    return cached_attention(q, cache_k, cache_v, pos,
+                            sm_scale=1.0 / math.sqrt(config.head_dim))
+
+
+def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
+            cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the model, filling cache[0:S].
+
+    Returns (logits [B, S, padded_vocab] fp32, cache).  Assumes an empty
+    cache (length 0) — chunked prefill composes by calling with growing
+    ``cache.length`` via :func:`extend`.
+    """
+    cdt = config.dtype
+    B, S = tokens.shape
+    pos_ids = jnp.arange(S)
+    x = params["wte"].astype(cdt)[tokens] + \
+        params["wpe"].astype(cdt)[pos_ids][None]
+
+    def layer(x, xs):
+        p, ck, cv = xs
+        q, k, v = _qkv(x, p, config)
+        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        # prefill attention runs on the unpadded k/v (training flash path);
+        # only decode reads back through the padded cache
+        attn = gpt._attention(q, k, v, config)
+        return _proj_mlp(x, attn, p, config), (new_ck, new_cv)
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    x = gpt._layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits, KVCache(k=new_k, v=new_v,
+                           length=jnp.asarray(S, jnp.int32))
+
+
+def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
+                cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+    """One-token decode: token [B] int32 at position cache.length.
+
+    Returns (logits [B, padded_vocab] fp32, cache advanced by one).
+    """
+    cdt = config.dtype
+    B = token.shape[0]
+    pos = cache.length
+    x = params["wte"].astype(cdt)[token][:, None] + \
+        params["wpe"].astype(cdt)[pos][None, None]
+
+    def layer(x, xs):
+        p, ck, cv = xs
+        q, k, v = _qkv(x, p, config)
+        new_ck = lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        new_cv = lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        attn = _cached_attention(q, new_ck, new_cv, pos, config)
+        return _proj_mlp(x, attn, p, config), (new_ck, new_cv)
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    x = gpt._layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits, KVCache(k=new_k, v=new_v, length=pos + 1)

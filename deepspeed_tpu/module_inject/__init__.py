@@ -1,0 +1,5 @@
+from .replace_policy import (HFGPT2LayerPolicy, convert_hf_model,
+                             replace_transformer_layer)
+
+__all__ = ["HFGPT2LayerPolicy", "convert_hf_model",
+           "replace_transformer_layer"]

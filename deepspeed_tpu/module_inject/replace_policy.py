@@ -1,0 +1,133 @@
+"""Weight-injection policies: HF checkpoints → TPU-native GPT params.
+
+Counterpart of the reference's ``module_inject/replace_policy.py`` (per-arch
+weight extraction: ``HFGPT2LayerPolicy``:423 etc.) and ``replace_module.py``
+``replace_transformer_layer``:289.  The reference swaps nn.Modules in place
+and slices weights across mp ranks; here a policy maps an HF state dict into
+the stacked-[L,...] param tree of ``models/gpt.py``, and TP slicing happens
+declaratively when the InferenceEngine device_puts with NamedShardings.
+
+Policies convert from *state dicts* (torch tensors or numpy), so they work
+on live HF modules, ``from_pretrained`` checkpoints, or raw ``torch.load``
+dicts identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..models import gpt
+from ..utils.logging import logger
+
+PyTree = Any
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+class HFGPT2LayerPolicy:
+    """transformers GPT-2 (``GPT2LMHeadModel``); Conv1D weights are stored
+    [in, out] so no transposes are needed against our einsum layouts."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any(k.endswith("attn.c_attn.weight") for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.n_positions,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            d_model=hf_config.n_embd,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh, f = config.n_head, config.head_dim, config.ffn_dim
+        prefix = "transformer." if any(k.startswith("transformer.")
+                                       for k in sd) else ""
+
+        def get(name):
+            return _np(sd[prefix + name])
+
+        wte = get("wte.weight")
+        pad = config.padded_vocab - wte.shape[0]
+        if pad:
+            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+
+        def layer(i, name):
+            return get(f"h.{i}.{name}")
+
+        block = {
+            "ln1_scale": np.stack([layer(i, "ln_1.weight") for i in range(L)]),
+            "ln1_bias": np.stack([layer(i, "ln_1.bias") for i in range(L)]),
+            "wqkv": np.stack([
+                layer(i, "attn.c_attn.weight").reshape(d, 3, H, Dh)
+                for i in range(L)]),
+            "bqkv": np.stack([
+                layer(i, "attn.c_attn.bias").reshape(3, H, Dh)
+                for i in range(L)]),
+            "wo": np.stack([
+                layer(i, "attn.c_proj.weight").reshape(H, Dh, d)
+                for i in range(L)]),
+            "bo": np.stack([layer(i, "attn.c_proj.bias") for i in range(L)]),
+            "ln2_scale": np.stack([layer(i, "ln_2.weight") for i in range(L)]),
+            "ln2_bias": np.stack([layer(i, "ln_2.bias") for i in range(L)]),
+            "wi": np.stack([layer(i, "mlp.c_fc.weight") for i in range(L)]),
+            "bi": np.stack([layer(i, "mlp.c_fc.bias") for i in range(L)]),
+            "wo_mlp": np.stack([layer(i, "mlp.c_proj.weight")
+                                for i in range(L)]),
+            "bo_mlp": np.stack([layer(i, "mlp.c_proj.bias")
+                                for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "wpe": get("wpe.weight"),
+            "blocks": block,
+            "lnf_scale": get("ln_f.weight"),
+            "lnf_bias": get("ln_f.bias"),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+def _tree_to_jnp(tree, dtype):
+    import jax
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), tree)
+
+
+POLICIES = [HFGPT2LayerPolicy]
+
+
+def convert_hf_model(hf_model, dtype=jnp.float32
+                     ) -> Tuple[gpt.GPTConfig, PyTree]:
+    """Live HF module (or anything with .config/.state_dict()) → (GPTConfig,
+    params).  The reference's auto policy match (replace_method='auto')."""
+    sd = hf_model.state_dict()
+    for policy in POLICIES:
+        if policy.match(sd):
+            config = policy.model_config(hf_model.config, dtype=dtype)
+            params = policy.convert(sd, config)
+            logger.info(f"[module_inject] converted via {policy.__name__}: "
+                        f"{config.n_layer}L/{config.d_model}d/"
+                        f"{config.n_head}h")
+            return config, params
+    raise ValueError(
+        f"no injection policy matches this model; known: "
+        f"{[p.__name__ for p in POLICIES]}")
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, config=None,
+                              **kwargs):
+    """Reference-name shim: returns (GPTConfig, params) for ``model``."""
+    return convert_hf_model(model, **{k: v for k, v in kwargs.items()
+                                      if k == "dtype"})

@@ -75,7 +75,6 @@ class csvMonitor(Monitor):  # noqa: N801 (reference class name)
         self.output_path = os.path.join(config.output_path or "./csv/",
                                         config.job_name)
         os.makedirs(self.output_path, exist_ok=True)
-        self._files = {}
 
     def write_events(self, event_list: List[Event]) -> None:
         for tag, value, step in event_list:

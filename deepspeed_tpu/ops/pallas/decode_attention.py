@@ -1,0 +1,123 @@
+"""KV-cache decode attention kernel.
+
+Counterpart of the reference's ``softmax_context`` inference kernel
+(``csrc/transformer/inference/csrc/pt_binding.cpp`` — fused attention over
+the KV cache with the current sequence length masked): one query token per
+(batch, head) attends to cache slots ``0..pos`` of a statically-shaped
+cache.  The Pallas kernel streams cache blocks through VMEM with the
+online-softmax recurrence and skips blocks entirely beyond ``pos`` — the
+decode step's HBM traffic is the live cache prefix, not S_max.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .utils import interpret_mode, use_pallas
+
+NEG_INF = float("-inf")
+
+
+def cached_attention_reference(q, cache_k, cache_v, pos,
+                               sm_scale: Optional[float] = None):
+    """Ground truth: q [B,Sq,H,D] over cache [B,Smax,H,D]; query i (at
+    absolute position pos+i) sees cache slots ≤ pos+i."""
+    B, Sq, H, D = q.shape
+    Smax = cache_k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32) * scale
+    q_abs = pos + jnp.arange(Sq)
+    k_pos = jnp.arange(Smax)
+    mask = k_pos[None, :] <= q_abs[:, None]            # [Sq, Smax]
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cache_v)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, sm_scale, block_k):
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+    pos = pos_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(ki * block_k <= pos)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale    # (1, D)
+        ks = k_ref[0].astype(jnp.float32)              # (BK, D)
+        vs = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (1, BK)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, vs, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _decode(q3, k3, v3, pos, sm_scale, block_k):
+    BH, _, D = q3.shape
+    Smax = k3.shape[1]
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                               block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, Smax // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, 1, D), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(pos_arr, q3, k3, v3)
+
+
+def cached_attention(q, cache_k, cache_v, pos,
+                     sm_scale: Optional[float] = None):
+    """q [B,Sq,H,D] over a padded cache [B,Smax,H,D], visibility ≤ pos+i.
+
+    Single-token decode (Sq=1) takes the Pallas streaming kernel; other
+    shapes (chunked prefill) use the dense reference.
+    """
+    B, Sq, H, D = q.shape
+    Smax = cache_k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    block_k = next((b for b in (256, 128) if Smax % b == 0), None)
+    if Sq != 1 or not use_pallas() or block_k is None:
+        return cached_attention_reference(q, cache_k, cache_v, pos, scale)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k)
+    return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)

@@ -75,7 +75,7 @@ class FlopsProfiler:
         costs = _cost_analysis(compiled)
         self._flops = costs.get("flops", 0.0)
         self._bytes = costs.get("bytes accessed", 0.0)
-        for _ in range(warmup):
+        for _ in range(max(warmup, 1)):  # at least one call: compile outside timing
             out = jitted(*args)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

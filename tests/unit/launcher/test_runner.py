@@ -11,6 +11,9 @@ import os
 import subprocess
 import sys
 from collections import OrderedDict
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parents[3])
 
 import pytest
 
@@ -84,7 +87,7 @@ def test_local_launch_end_to_end(tmp_path):
          f"--world_info={world}", "--node_rank=0",
          "--master_addr=127.0.0.1", "--master_port=29777", str(script)],
         capture_output=True, text=True, timeout=120,
-        cwd="/root/repo", env={**os.environ, "PYTHONPATH": "/root/repo"})
+        cwd=REPO_ROOT, env={**os.environ, "PYTHONPATH": REPO_ROOT})
     assert out.returncode == 0, out.stderr
     envs = [json.loads(l) for l in out.stdout.splitlines()
             if l.startswith("{")]
@@ -102,9 +105,9 @@ def test_ds_report_runs():
         [sys.executable, "-c",
          "from deepspeed_tpu.env_report import cli_main; cli_main()"],
         capture_output=True, text=True, timeout=300,
-        cwd="/root/repo", env={**os.environ, "PYTHONPATH": "/root/repo",
-                               "JAX_PLATFORMS": "cpu",
-                               "PALLAS_AXON_POOL_IPS": ""})
+        cwd=REPO_ROOT, env={**os.environ, "PYTHONPATH": REPO_ROOT,
+                             "JAX_PLATFORMS": "cpu",
+                             "PALLAS_AXON_POOL_IPS": ""})
     assert out.returncode == 0, out.stderr
     assert "C++ op report" in out.stdout
     assert "cpu_adam" in out.stdout
