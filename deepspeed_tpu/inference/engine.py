@@ -45,8 +45,23 @@ class InferenceEngine:
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
             else p, params)
-        if self.mesh_manager is not None and \
-                self.mesh_manager.mesh.shape.get(MODEL_AXIS, 1) > 1:
+        mesh_tp = (self.mesh_manager.mesh.shape.get(MODEL_AXIS, 1)
+                   if self.mesh_manager is not None else 1)
+        want_tp = config.tp.enabled and config.tp_size > 1
+        if want_tp and mesh_tp <= 1:
+            raise ValueError(
+                f"tensor_parallel.tp_size={config.tp_size} requested but the "
+                f"mesh has no model axis (model={mesh_tp}); initialize a "
+                "mesh with tp first (ParallelDims(tp=...))")
+        if want_tp and mesh_tp != config.tp_size:
+            raise ValueError(
+                f"tensor_parallel.tp_size={config.tp_size} does not match "
+                f"the mesh's model axis ({mesh_tp})")
+        if mesh_tp > 1 and not want_tp:
+            logger.warning(
+                f"mesh has model={mesh_tp} but tensor_parallel disabled in "
+                "the inference config; serving replicated (unsharded)")
+        if want_tp:
             self._shard_params_tp()
         cfg = self.model_config
         self._forward_jit = jax.jit(lambda p, t: gpt.apply(p, t, cfg))

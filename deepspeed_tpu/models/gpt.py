@@ -176,27 +176,36 @@ def _attention(q, k, v, config: GPTConfig):
     return mha_reference(q, k, v, causal=True)
 
 
-def _attn_residual(x, p, config: GPTConfig):
-    """LN1 + causal MHA + output projection, added residually. [B,S,d]."""
+def qkv_proj(x, p, config: GPTConfig):
+    """LN1 + qkv projection: [B,S,d] → (q, k, v) each [B,S,H,Dh].
+
+    Shared by training (_block) and inference (gpt_inference prefill/decode)
+    so the block math has one source of truth.
+    """
     cdt = config.dtype
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) + p["bqkv"].astype(cdt)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = _attention(q, k, v, config)
-    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
-    return x + attn_out
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
 
-def _block(x, layer_params, config: GPTConfig):
-    """One transformer block on [B, S, d]."""
+def block_tail(x, attn, p, config: GPTConfig):
+    """Attention output projection + residual + LN2 + MLP + residual."""
     cdt = config.dtype
-    p = layer_params
-    x = _attn_residual(x, p, config)
+    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
+    x = x + attn_out
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
     ff = jax.nn.gelu(ff, approximate=True)
     ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
     return x + ff_out
+
+
+def _block(x, layer_params, config: GPTConfig):
+    """One transformer block on [B, S, d]."""
+    p = layer_params
+    q, k, v = qkv_proj(x, p, config)
+    attn = _attention(q, k, v, config)
+    return block_tail(x, attn, p, config)
 
 
 def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray:

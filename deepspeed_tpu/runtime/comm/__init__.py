@@ -1,0 +1,8 @@
+"""Specialized communication backends (reference ``runtime/comm/``:
+compressed 1-bit collectives + coalesced helpers)."""
+
+from .compressed import (compressed_allreduce, compressed_allreduce_tree,
+                         pack_signs, unpack_signs)
+
+__all__ = ["compressed_allreduce", "compressed_allreduce_tree",
+           "pack_signs", "unpack_signs"]

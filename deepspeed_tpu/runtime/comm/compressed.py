@@ -1,0 +1,150 @@
+"""Error-feedback 1-bit compressed allreduce over an XLA mesh axis.
+
+Counterpart of the reference's ``NcclBackend.compressed_allreduce``
+(``runtime/comm/nccl.py:51``) and the MPI variant (``runtime/comm/mpi.py``):
+the two-stage 1-bit algorithm —
+
+  stage 1: each worker adds its error feedback, compresses to
+           sign bits + one fp32 scale, and all-to-alls chunk j to worker j;
+  stage 2: worker j decompresses and averages its chunk (the "server" role),
+           compresses the result with *server* error feedback, and
+           all-gathers the compressed chunks back.
+
+Signs travel truly bit-packed (8 signs/byte, uint8) so the wire volume is
+1/32 of fp32 + two scales per worker — the same 32× compression the CUDA
+backend gets, here lowered to XLA ``all_to_all``/``all_gather`` on ICI/DCN.
+Both error-feedback tensors live in caller state (functional, so they shard
+and checkpoint like any optimizer state).
+
+Citations: quantization + error reset (nccl.py:60-83), the all-to-all /
+allgather exchange (nccl.py:85-135), server-side recompression (:100-120).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+def pack_signs(signs: jnp.ndarray) -> jnp.ndarray:
+    """bool [N] (N % 8 == 0) → uint8 [N/8]; bit i of byte j = signs[8j+i]."""
+    bits = signs.reshape(-1, 8).astype(jnp.uint8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(bits * weights, axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [M] → bool [8M]."""
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    bits = (packed[:, None] & weights[None, :]) > 0
+    return bits.reshape(-1)
+
+
+def _compress(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """x [N] → (packed signs uint8 [N/8], scale f32 [], reconstruction)."""
+    n = x.shape[0]
+    scale = jnp.linalg.norm(x) / jnp.sqrt(jnp.float32(n))
+    signs = x >= 0
+    recon = scale * jnp.where(signs, 1.0, -1.0)
+    return pack_signs(signs), scale, recon
+
+
+def _compressed_allreduce_local(x, worker_err, server_err, axis: str):
+    """Body run per-worker inside shard_map.  x [N] with N % (8*n) == 0;
+    server_err is this worker's [N/n] chunk."""
+    n = lax.axis_size(axis)
+    N = x.shape[0]
+    chunk = N // n
+
+    # stage 1 compress (reference nccl.py:60-83)
+    corrected = x + worker_err
+    packed, scale, recon = _compress(corrected)
+    new_worker_err = corrected - recon
+
+    # chunk j of my signs → worker j; gather everyone's scale
+    packed_chunks = packed.reshape(n, chunk // 8)
+    recv = lax.all_to_all(packed_chunks, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                      # [n, chunk/8]
+    scales = lax.all_gather(scale, axis)                    # [n]
+
+    # server stage: decompress peers' chunks, average, recompress (:100-120)
+    sign_vals = jnp.where(unpack_signs(recv.reshape(-1)), 1.0, -1.0)
+    contrib = sign_vals.reshape(n, chunk) * scales[:, None]
+    server_avg = jnp.mean(contrib, axis=0) + server_err
+    s_packed, s_scale, s_recon = _compress(server_avg)
+    new_server_err = server_avg - s_recon
+
+    # stage 2: compressed server chunks back to everyone (:121-135)
+    all_packed = lax.all_gather(s_packed, axis)             # [n, chunk/8]
+    all_scales = lax.all_gather(s_scale, axis)              # [n]
+    out_signs = jnp.where(unpack_signs(all_packed.reshape(-1)), 1.0, -1.0)
+    out = out_signs.reshape(n, chunk) * all_scales[:, None]
+    return out.reshape(N), new_worker_err, new_server_err
+
+
+def compressed_allreduce(x: jnp.ndarray, worker_err: jnp.ndarray,
+                         server_err: jnp.ndarray, axis: str):
+    """In-shard_map entry: average ``x`` over ``axis`` with 1-bit wire
+    traffic.  Caller threads (worker_err, server_err) through steps."""
+    return _compressed_allreduce_local(x, worker_err, server_err, axis)
+
+
+def compressed_allreduce_tree(mesh: Mesh, axis: str):
+    """Build a pytree-level compressed allreduce over ``axis``.
+
+    Returns ``fn(tree, worker_err, server_err) ->
+    (avg_tree, new_worker_err, new_server_err)``.  Both error buffers are
+    flat ``[flat_size(tree)]`` arrays: ``worker_err`` replicated,
+    ``server_err`` laid out so each worker owns its ``N/n`` server chunk
+    (sharded over ``axis``).  With replicated inputs (grads already
+    dp-reduced — the optimizer-numerics path) every worker compresses
+    identically; the wire savings materialize when the body is invoked on
+    per-worker grads inside a wider shard_map.
+    """
+    n = int(np.prod([mesh.shape[a] for a in ((axis,) if isinstance(axis, str)
+                                             else axis)]))
+    align = 8 * n
+
+    def flat_size(tree) -> int:
+        total = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(tree))
+        return -(-total // align) * align
+
+    @jax.jit
+    def run(tree, worker_err, server_err):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        sizes = [int(np.prod(l.shape)) for l in leaves]
+        flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32)
+                                for l in leaves])
+        pad = worker_err.shape[0] - flat.shape[0]
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+
+        body = partial(_compressed_allreduce_local, axis=axis)
+        out, new_we, new_se = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(axis)),
+            out_specs=(P(), P(), P(axis)),
+            check_vma=False)(flat, worker_err, server_err)
+
+        outs = []
+        offset = 0
+        for leaf, size in zip(leaves, sizes):
+            outs.append(out[offset:offset + size].reshape(leaf.shape)
+                        .astype(leaf.dtype))
+            offset += size
+        return jax.tree_util.tree_unflatten(treedef, outs), new_we, new_se
+
+    run.flat_size = flat_size
+    run.world = n
+    return run

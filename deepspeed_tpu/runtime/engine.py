@@ -218,6 +218,7 @@ class DeepSpeedEngine:
         self._param_shapes = shapes
 
     def _configure_optimizer(self, client_optimizer, model_parameters) -> None:
+        from .fp16 import onebit  # noqa: F401 — registers 1-bit optimizers
         if client_optimizer is not None:
             self.optimizer = client_optimizer
             self.client_optimizer = client_optimizer

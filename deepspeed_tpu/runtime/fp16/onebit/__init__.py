@@ -1,0 +1,7 @@
+"""1-bit error-feedback optimizers (reference ``runtime/fp16/onebit/``)."""
+
+from .adam import OnebitAdam
+from .lamb import OnebitLamb
+from .zoadam import ZeroOneAdam
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam"]

@@ -1,0 +1,151 @@
+"""1-bit Adam.
+
+Counterpart of the reference's ``OnebitAdam`` (``runtime/fp16/onebit/adam.py:10``):
+two phases around ``freeze_step`` —
+
+  warmup (step ≤ freeze_step): exact Adam, variance (exp_avg_sq) updating;
+  compressed (step > freeze_step): variance FROZEN; the momentum update is
+  communicated through the error-feedback 1-bit compressed allreduce
+  (``runtime/comm/compressed.py``), whose quantization error feeds back into
+  worker/server error state exactly as the CUDA/NCCL backend does.
+
+The error-feedback buffers are part of the optimizer state pytree, so they
+shard under ZeRO and ride checkpoints like any moment.  Under the standard
+engine the incoming grads are already dp-reduced and each worker compresses
+identically — the *numerics* (quantize → error feedback → dequantize) match
+the reference; the wire saving engages when the engine reduces grads through
+the compressed collective (pure-dp configs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.optimizer import TpuOptimizer, register_optimizer
+
+PyTree = Any
+
+
+def _flatten(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([l.reshape(-1).astype(jnp.float32)
+                            for l in leaves])
+
+
+def _unflatten_like(flat, tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, offset = [], 0
+    for l in leaves:
+        size = int(np.prod(l.shape))
+        out.append(flat[offset:offset + size].reshape(l.shape))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _compress_with_feedback(flat, err):
+    """sign+scale quantization with error feedback (one worker's view of
+    compressed.py's stage-1; all workers see identical reduced grads here)."""
+    corrected = flat + err
+    scale = jnp.linalg.norm(corrected) / jnp.sqrt(
+        jnp.float32(corrected.shape[0]))
+    recon = scale * jnp.sign(corrected)
+    return recon, corrected - recon
+
+
+def momentum_compression(frozen, m_flat, worker_err, server_err):
+    """Worker+server 1-bit stages under lax.cond so warmup steps skip the
+    compression compute entirely (``frozen`` is traced; jnp.where would run
+    both branches every step on the full flattened model)."""
+
+    def compressed(m, we, se):
+        recon_w, new_we = _compress_with_feedback(m, we)
+        recon_s, new_se = _compress_with_feedback(recon_w, se)
+        return recon_s, new_we, new_se
+
+    def passthrough(m, we, se):
+        return m, we, se
+
+    return jax.lax.cond(frozen, compressed, passthrough,
+                        m_flat, worker_err, server_err)
+
+
+@register_optimizer("onebitadam", "onebit_adam")
+class OnebitAdam(TpuOptimizer):
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params=None, lr: float = 1e-3, freeze_step: int = 100000,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, amsgrad: bool = False,
+                 cuda_aware: bool = False, comm_backend_name: str = "xla",
+                 **kwargs):
+        if amsgrad:
+            raise RuntimeError("1-bit Adam does not support AMSGrad")
+        super().__init__(params, lr=lr, weight_decay=weight_decay)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.freeze_step = freeze_step
+        self.comm_backend_name = comm_backend_name
+        self.adam_freeze_key = False  # reference attribute name
+
+    def init(self, params: PyTree) -> PyTree:
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": jax.tree_util.tree_map(zeros, params),
+            "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
+            "worker_error": jnp.zeros((n,), jnp.float32),
+            "server_error": jnp.zeros((n,), jnp.float32),
+        }
+
+    def update(self, grads: PyTree, state: PyTree, params: PyTree,
+               hyper: Dict[str, jnp.ndarray]) -> Tuple[PyTree, PyTree]:
+        beta1, beta2 = self.betas
+        lr, wd = hyper["lr"], hyper["weight_decay"]
+        step = state["step"] + 1
+        frozen = step > self.freeze_step
+
+        # momentum always updates
+        new_m = jax.tree_util.tree_map(
+            lambda m, g: beta1 * m + (1.0 - beta1) * g.astype(jnp.float32),
+            state["exp_avg"], grads)
+        # variance only during warmup (reference adam.py: exp_avg_sq frozen
+        # after freeze_step)
+        new_v = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                frozen, v, beta2 * v + (1.0 - beta2)
+                * jnp.square(g.astype(jnp.float32))),
+            state["exp_avg_sq"], grads)
+
+        # compressed phase: momentum passes through 1-bit quantization with
+        # error feedback (worker stage then server stage); the state keeps
+        # the compressed momentum too (reference behaviour: exp_avg holds
+        # the dequantized server result after the allreduce)
+        m_flat = _flatten(new_m)
+        m_used_flat, new_we, new_se = momentum_compression(
+            frozen, m_flat, state["worker_error"], state["server_error"])
+        m_used = _unflatten_like(m_used_flat, new_m)
+
+        bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2), step.astype(jnp.float32))
+
+        def leaf(p, m, v):
+            p32 = p.astype(jnp.float32)
+            denom = jnp.sqrt(v / bc2) + self.eps
+            update = (m / bc1) / denom + wd * p32
+            return (p32 - lr * update).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(leaf, params, m_used, new_v)
+        return new_params, {
+            "step": step,
+            "exp_avg": m_used,
+            "exp_avg_sq": new_v,
+            "worker_error": new_we,
+            "server_error": new_se,
+        }

@@ -1,0 +1,124 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py:11``): LAMB's
+layerwise trust ratio composed with the 1-bit momentum compression of
+OnebitAdam.  During warmup the per-leaf scaling coefficients update; in the
+compressed phase they freeze alongside the variance (the reference's frozen
+``scaling_coeff``) so the trust ratio stays stable while momentum travels
+1-bit."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.optimizer import TpuOptimizer, register_optimizer
+from .adam import _flatten, _unflatten_like, momentum_compression
+
+PyTree = Any
+
+
+@register_optimizer("onebitlamb", "onebit_lamb")
+class OnebitLamb(TpuOptimizer):
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params=None, lr: float = 1e-3, freeze_step: int = 100000,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, max_coeff: float = 10.0,
+                 min_coeff: float = 0.01, amsgrad: bool = False,
+                 cuda_aware: bool = False, comm_backend_name: str = "xla",
+                 coeff_beta: float = 0.9, factor_max: float = 4.0,
+                 factor_min: float = 0.5, factor_threshold: float = 0.1,
+                 **kwargs):
+        if amsgrad:
+            raise RuntimeError("1-bit Lamb does not support AMSGrad")
+        super().__init__(params, lr=lr, weight_decay=weight_decay)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.freeze_step = freeze_step
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+        # factor_max/min/threshold bound the reference's compressed-phase
+        # coefficient drift correction (lamb.py:11 freeze logic); this build
+        # freezes the coefficients outright — the conservative special case
+        # — so the factors are accepted but have no effect
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+
+    def init(self, params: PyTree) -> PyTree:
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": jax.tree_util.tree_map(zeros, params),
+            "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
+            "scaling_coeff": jax.tree_util.tree_map(
+                lambda _: jnp.ones((), jnp.float32), params),
+            "worker_error": jnp.zeros((n,), jnp.float32),
+            "server_error": jnp.zeros((n,), jnp.float32),
+        }
+
+    def update(self, grads: PyTree, state: PyTree, params: PyTree,
+               hyper: Dict[str, jnp.ndarray]) -> Tuple[PyTree, PyTree]:
+        beta1, beta2 = self.betas
+        lr, wd = hyper["lr"], hyper["weight_decay"]
+        step = state["step"] + 1
+        frozen = step > self.freeze_step
+
+        new_m = jax.tree_util.tree_map(
+            lambda m, g: beta1 * m + (1.0 - beta1) * g.astype(jnp.float32),
+            state["exp_avg"], grads)
+        new_v = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                frozen, v, beta2 * v + (1.0 - beta2)
+                * jnp.square(g.astype(jnp.float32))),
+            state["exp_avg_sq"], grads)
+
+        m_flat = _flatten(new_m)
+        m_used_flat, new_we, new_se = momentum_compression(
+            frozen, m_flat, state["worker_error"], state["server_error"])
+        m_used = _unflatten_like(m_used_flat, new_m)
+
+        bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2), step.astype(jnp.float32))
+
+        def leaf(p, m, v, coeff):
+            p32 = p.astype(jnp.float32)
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps) + wd * p32
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(update)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                1.0)
+            # warmup: scaling_coeff tracks the trust ratio as a coeff_beta
+            # EMA (reference lamb.py scaling_coeff update); frozen phase
+            # reuses the learned coefficient
+            ema = self.coeff_beta * coeff + (1.0 - self.coeff_beta) * trust
+            new_coeff = jnp.where(frozen, coeff, ema)
+            used = jnp.where(frozen, coeff, trust)
+            return (p32 - lr * used * update).astype(p.dtype), new_coeff
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_m = treedef.flatten_up_to(m_used)
+        flat_v = treedef.flatten_up_to(new_v)
+        flat_c = treedef.flatten_up_to(state["scaling_coeff"])
+        results = [leaf(p, m, v, c)
+                   for p, m, v, c in zip(flat_p, flat_m, flat_v, flat_c)]
+        new_params = jax.tree_util.tree_unflatten(
+            treedef, [r[0] for r in results])
+        new_coeffs = jax.tree_util.tree_unflatten(
+            treedef, [r[1] for r in results])
+        return new_params, {
+            "step": step,
+            "exp_avg": m_used,
+            "exp_avg_sq": new_v,
+            "scaling_coeff": new_coeffs,
+            "worker_error": new_we,
+            "server_error": new_se,
+        }

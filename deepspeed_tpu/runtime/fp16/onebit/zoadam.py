@@ -1,0 +1,105 @@
+"""0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py:10`` ``ZeroOneAdam``):
+generalizes 1-bit Adam with *variance freezing intervals* — after a seeding
+window, variance updates happen only at var_update_scaler boundaries until
+var_freeze_step, then never, trading variance freshness for communication.
+Momentum flows through the 1-bit error-feedback compression once the
+variance is seeded.  The reference's adaptive interval doubling and
+learning-rate freezing (local_step_scaler/local_step_clipper) are accepted
+as config for compatibility but simplified to the fixed-interval core."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.optimizer import TpuOptimizer, register_optimizer
+from .adam import _flatten, _unflatten_like, momentum_compression
+
+PyTree = Any
+
+
+@register_optimizer("zerooneadam", "zero_one_adam")
+class ZeroOneAdam(TpuOptimizer):
+    TRACED_HYPERPARAMS = ("lr", "weight_decay")
+
+    def __init__(self, params=None, lr: float = 1e-3,
+                 var_freeze_step: int = 100000, var_update_scaler: int = 16,
+                 local_step_scaler: int = 32678, local_step_clipper: int = 16,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, amsgrad: bool = False,
+                 cuda_aware: bool = False, comm_backend_name: str = "xla",
+                 **kwargs):
+        if amsgrad:
+            raise RuntimeError("0/1 Adam does not support AMSGrad")
+        super().__init__(params, lr=lr, weight_decay=weight_decay)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+
+    def init(self, params: PyTree) -> PyTree:
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": jax.tree_util.tree_map(zeros, params),
+            "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
+            "worker_error": jnp.zeros((n,), jnp.float32),
+            "server_error": jnp.zeros((n,), jnp.float32),
+        }
+
+    def update(self, grads: PyTree, state: PyTree, params: PyTree,
+               hyper: Dict[str, jnp.ndarray]) -> Tuple[PyTree, PyTree]:
+        beta1, beta2 = self.betas
+        lr, wd = hyper["lr"], hyper["weight_decay"]
+        step = state["step"] + 1
+
+        # variance updates every step through the first interval (seeding —
+        # stepping on an all-zero variance would explode), then only at
+        # var_update_scaler boundaries until the freeze point, then never
+        # (the 0/1 interval policy, simplified to its fixed-interval core)
+        seeding = step <= self.var_update_scaler
+        at_interval = (step % self.var_update_scaler) == 0
+        before_freeze = step <= self.var_freeze_step
+        update_var = seeding | (at_interval & before_freeze)
+
+        new_m = jax.tree_util.tree_map(
+            lambda m, g: beta1 * m + (1.0 - beta1) * g.astype(jnp.float32),
+            state["exp_avg"], grads)
+        new_v = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(
+                update_var,
+                beta2 * v + (1.0 - beta2) * jnp.square(g.astype(jnp.float32)),
+                v),
+            state["exp_avg_sq"], grads)
+
+        # momentum compressed once the variance is seeded (0/1 Adam
+        # communicates 1-bit almost from the start)
+        m_flat = _flatten(new_m)
+        m_used_flat, we, se = momentum_compression(
+            ~seeding, m_flat, state["worker_error"], state["server_error"])
+        m_used = _unflatten_like(m_used_flat, new_m)
+
+        bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2), step.astype(jnp.float32))
+
+        def leaf(p, m, v):
+            p32 = p.astype(jnp.float32)
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps) + wd * p32
+            return (p32 - lr * update).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(leaf, params, m_used, new_v)
+        return new_params, {
+            "step": step,
+            "exp_avg": m_used,
+            "exp_avg_sq": new_v,
+            "worker_error": we,
+            "server_error": se,
+        }

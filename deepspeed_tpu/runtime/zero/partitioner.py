@@ -144,7 +144,14 @@ class ZeroPartitioner:
                 try:
                     sub_def = jax.tree_util.tree_structure(sub)
                     if sub_def == params_treedef:
-                        return master_sh
+                        # structure match isn't enough: a tree of per-param
+                        # *scalars* (e.g. LAMB scaling coefficients) shares
+                        # the treedef but can't take the tensor shardings
+                        return jax.tree_util.tree_map(
+                            lambda leaf, sh: sh
+                            if getattr(leaf, "ndim", 0) >= len(sh.spec)
+                            else NamedSharding(self.mesh, P()),
+                            sub, master_sh)
                 except Exception:
                     pass
                 return jax.tree_util.tree_map(
