@@ -188,16 +188,38 @@ def qkv_proj(x, p, config: GPTConfig):
     return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
 
-def block_tail(x, attn, p, config: GPTConfig):
-    """Attention output projection + residual + LN2 + MLP + residual."""
+def attn_out_residual(x, attn, p, config: GPTConfig):
+    """Attention output projection + residual: x + W_o·attn."""
     cdt = config.dtype
     attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
-    x = x + attn_out
+    return x + attn_out
+
+
+def mlp_residual(x, p, config: GPTConfig):
+    """LN2 + MLP + residual (the dense FFN half-block)."""
+    cdt = config.dtype
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
     ff = jax.nn.gelu(ff, approximate=True)
     ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
     return x + ff_out
+
+
+def block_tail(x, attn, p, config: GPTConfig):
+    """Attention output projection + residual + LN2 + MLP + residual."""
+    return mlp_residual(attn_out_residual(x, attn, p, config), p, config)
+
+
+def _attn_residual(x, layer_params, config: GPTConfig):
+    """Full attention sublayer with residual: x + W_o·attn(qkv(LN1(x))).
+
+    Used by the MoE model (gpt_moe._moe_half_block), whose FFN half is an
+    expert layer instead of mlp_residual.
+    """
+    p = layer_params
+    q, k, v = qkv_proj(x, p, config)
+    attn = _attention(q, k, v, config)
+    return attn_out_residual(x, attn, p, config)
 
 
 def _block(x, layer_params, config: GPTConfig):
