@@ -75,10 +75,11 @@ def _init_devices(attempts: int = 3, probe_timeout_s: float = 120.0,
     # Last resort: a CPU measurement (disclosed via detail.platform/tpu_error)
     # beats an rc=1 with no number at all.
     sys.stderr.write(f"bench: TPU unreachable, falling back to CPU: {last}\n")
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from deepspeed_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(n_devices=1)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     return jax.devices(), str(last)
 
 

@@ -224,10 +224,8 @@ def _attn_residual(x, layer_params, config: GPTConfig):
 
 def _block(x, layer_params, config: GPTConfig):
     """One transformer block on [B, S, d]."""
-    p = layer_params
-    q, k, v = qkv_proj(x, p, config)
-    attn = _attention(q, k, v, config)
-    return block_tail(x, attn, p, config)
+    return mlp_residual(_attn_residual(x, layer_params, config),
+                        layer_params, config)
 
 
 def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray:

@@ -1,0 +1,44 @@
+"""Force-CPU bootstrap for tests/driver entry points.
+
+The container's sitecustomize imports jax early, latches JAX_PLATFORMS while
+an 'axon' TPU plugin is registered, and backend init then hangs even with
+``JAX_PLATFORMS=cpu`` in the environment.  The live ``jax.config.update`` is
+the only reliable escape hatch, and it must run BEFORE the first backend
+instantiation.  One copy of that dance lives here; tests/conftest.py,
+bench.py's fallback, and __graft_entry__ all call it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Pin jax to the CPU platform with ``n_devices`` virtual devices.
+
+    Must be called before the jax backend is initialized; raises if it's
+    too late (a silent no-op here historically cost a driver gate — the
+    flags are latched at first backend touch).
+    """
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        # replace a stale/smaller count rather than trusting it
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge._backends:
+        backends = list(xla_bridge._backends)
+        if backends != ["cpu"]:
+            raise RuntimeError(
+                f"force_cpu_platform called after jax backend init "
+                f"(initialized: {backends}); call it before any jax "
+                f"device/array operation, or run in a fresh process")
+    jax.config.update("jax_platforms", "cpu")

@@ -8,18 +8,13 @@ Env must be set before jax initializes its backends, hence this conftest.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The container's sitecustomize may have imported jax already (TPU plugin
-# registration), in which case the env var was latched at import; override
-# through the live config before any backend is instantiated.
-import jax  # noqa: E402
+from deepspeed_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(n_devices=8)
 
 import pytest  # noqa: E402
 
