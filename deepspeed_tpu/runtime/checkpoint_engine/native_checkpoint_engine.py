@@ -47,16 +47,27 @@ def flatten_tree(tree: PyTree, prefix: str = "") -> Dict[str, Any]:
     return out
 
 
-def unflatten_into(template: PyTree, flat: Dict[str, np.ndarray], prefix: str = "") -> PyTree:
-    """Rebuild arrays following ``template``'s structure from flat storage."""
+def unflatten_into(template: PyTree, flat: Dict[str, np.ndarray], prefix: str = "",
+                   missing: Optional[list] = None) -> PyTree:
+    """Rebuild arrays following ``template``'s structure from flat storage.
+
+    With a ``missing`` list supplied, a key absent from storage keeps the
+    template's (live, initialized) value and is recorded instead of raising
+    — forward-compatible resume when an optimizer gains a new state field
+    between checkpoint and load.  Callers decide how much missing-ness is
+    tolerable (a couple of new fields: fine; half the tree: corrupt file).
+    """
     if isinstance(template, dict):
-        return {k: unflatten_into(template[k], flat, f"{prefix}{k}{SEP}")
+        return {k: unflatten_into(template[k], flat, f"{prefix}{k}{SEP}", missing)
                 for k in template}
     if isinstance(template, (list, tuple)):
-        return type(template)(unflatten_into(v, flat, f"{prefix}{i}{SEP}")
+        return type(template)(unflatten_into(v, flat, f"{prefix}{i}{SEP}", missing)
                               for i, v in enumerate(template))
     key = prefix[:-1]
     if key not in flat:
+        if missing is not None:
+            missing.append(key)
+            return template
         raise KeyError(f"checkpoint missing tensor {key!r}")
     return flat[key]
 
@@ -144,7 +155,20 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
 
     if load_optimizer_states:
         optim_flat = eng.load(os.path.join(ckpt_dir, "optim_states.npz"))
-        opt = unflatten_into(state["opt_state"], optim_flat, "opt_state" + SEP)
+        missing: list = []
+        opt = unflatten_into(state["opt_state"], optim_flat, "opt_state" + SEP,
+                             missing=missing)
+        n_leaves = len(jax.tree_util.tree_leaves(state["opt_state"]))
+        if missing:
+            if len(missing) > max(2, n_leaves // 4):
+                raise KeyError(
+                    f"optim_states.npz is missing {len(missing)}/{n_leaves} "
+                    f"tensors (e.g. {missing[:3]}) — corrupt or truncated "
+                    f"checkpoint, refusing to resume from it")
+            logger.warning(
+                f"checkpoint missing {len(missing)} optimizer tensors "
+                f"({missing[:5]}...); keeping initialized values (new "
+                f"optimizer state fields?)")
         new_state["opt_state"] = _put_like(state["opt_state"], opt, sh.get("opt_state"))
         if any(k.startswith("grad_acc" + SEP) for k in optim_flat):
             acc = unflatten_into(state["grad_acc"], optim_flat, "grad_acc" + SEP)

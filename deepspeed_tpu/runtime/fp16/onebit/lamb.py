@@ -1,21 +1,32 @@
 """1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py:11``): LAMB's
 layerwise trust ratio composed with the 1-bit momentum compression of
-OnebitAdam.  During warmup the per-leaf scaling coefficients update; in the
-compressed phase they freeze alongside the variance (the reference's frozen
-``scaling_coeff``) so the trust ratio stays stable while momentum travels
-1-bit."""
+OnebitAdam.
+
+Two phases around ``freeze_step``:
+
+  warmup: exact LAMB; per-leaf ``scaling_coeff`` tracks the trust ratio as
+  a ``coeff_beta`` EMA.
+  compressed: variance AND its bias correction freeze together (a frozen
+  ``v`` with a still-growing ``1-beta2^t`` correction would silently
+  inflate update magnitudes every step), momentum travels 1-bit, and the
+  applied coefficient is the frozen ``scaling_coeff`` times a *drift
+  factor*: the live trust ratio — exactly computable here because the
+  decompressed server momentum is in-graph — relative to the frozen
+  coefficient, clamped to [factor_min, factor_max] and rate-limited to
+  ±factor_threshold per step.  This is the role of the reference's
+  compressed-phase coefficient drift correction (its factor_max/min/
+  threshold knobs), realized on the actual update instead of a
+  reconstructed one."""
 
 from __future__ import annotations
 
 from typing import Any, Dict, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from ....ops.optimizer import TpuOptimizer, register_optimizer
-from .adam import _flatten, _unflatten_like, momentum_compression
+from .adam import frozen_bc2, momentum_compression
 
 PyTree = Any
 
@@ -41,17 +52,11 @@ class OnebitLamb(TpuOptimizer):
         self.max_coeff = max_coeff
         self.min_coeff = min_coeff
         self.coeff_beta = coeff_beta
-        # factor_max/min/threshold bound the reference's compressed-phase
-        # coefficient drift correction (lamb.py:11 freeze logic); this build
-        # freezes the coefficients outright — the conservative special case
-        # — so the factors are accepted but have no effect
         self.factor_max = factor_max
         self.factor_min = factor_min
         self.factor_threshold = factor_threshold
 
     def init(self, params: PyTree) -> PyTree:
-        n = sum(int(np.prod(l.shape))
-                for l in jax.tree_util.tree_leaves(params))
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
         return {
             "step": jnp.zeros((), jnp.int32),
@@ -59,8 +64,10 @@ class OnebitLamb(TpuOptimizer):
             "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
             "scaling_coeff": jax.tree_util.tree_map(
                 lambda _: jnp.ones((), jnp.float32), params),
-            "worker_error": jnp.zeros((n,), jnp.float32),
-            "server_error": jnp.zeros((n,), jnp.float32),
+            "last_factor": jax.tree_util.tree_map(
+                lambda _: jnp.ones((), jnp.float32), params),
+            "worker_error": jax.tree_util.tree_map(zeros, params),
+            "server_error": jax.tree_util.tree_map(zeros, params),
         }
 
     def update(self, grads: PyTree, state: PyTree, params: PyTree,
@@ -79,15 +86,13 @@ class OnebitLamb(TpuOptimizer):
                 * jnp.square(g.astype(jnp.float32))),
             state["exp_avg_sq"], grads)
 
-        m_flat = _flatten(new_m)
-        m_used_flat, new_we, new_se = momentum_compression(
-            frozen, m_flat, state["worker_error"], state["server_error"])
-        m_used = _unflatten_like(m_used_flat, new_m)
+        m_used, new_we, new_se = momentum_compression(
+            frozen, new_m, state["worker_error"], state["server_error"])
 
         bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
-        bc2 = 1.0 - jnp.power(jnp.float32(beta2), step.astype(jnp.float32))
+        bc2 = frozen_bc2(step, beta2, self.freeze_step)
 
-        def leaf(p, m, v, coeff):
+        def leaf(p, m, v, coeff, last_factor):
             p32 = p.astype(jnp.float32)
             update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps) + wd * p32
             w_norm = jnp.linalg.norm(p32)
@@ -97,28 +102,41 @@ class OnebitLamb(TpuOptimizer):
                 jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
                 1.0)
             # warmup: scaling_coeff tracks the trust ratio as a coeff_beta
-            # EMA (reference lamb.py scaling_coeff update); frozen phase
-            # reuses the learned coefficient
+            # EMA (reference lamb.py scaling_coeff update)
             ema = self.coeff_beta * coeff + (1.0 - self.coeff_beta) * trust
             new_coeff = jnp.where(frozen, coeff, ema)
-            used = jnp.where(frozen, coeff, trust)
-            return (p32 - lr * used * update).astype(p.dtype), new_coeff
+            # compressed phase: frozen coeff × drift factor.  The live trust
+            # ratio is exact (decompressed momentum in-graph); the factor it
+            # implies is clamped to [factor_min, factor_max] and rate-limited
+            # to ±factor_threshold per step so 1-bit noise can't whip it
+            raw_factor = trust / jnp.maximum(coeff, 1e-12)
+            factor = jnp.clip(raw_factor, self.factor_min, self.factor_max)
+            factor = jnp.clip(factor,
+                              last_factor * (1.0 - self.factor_threshold),
+                              last_factor * (1.0 + self.factor_threshold))
+            new_factor = jnp.where(frozen, factor, 1.0)
+            used = jnp.where(frozen, coeff * factor, trust)
+            return (p32 - lr * used * update).astype(p.dtype), new_coeff, new_factor
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_m = treedef.flatten_up_to(m_used)
         flat_v = treedef.flatten_up_to(new_v)
         flat_c = treedef.flatten_up_to(state["scaling_coeff"])
-        results = [leaf(p, m, v, c)
-                   for p, m, v, c in zip(flat_p, flat_m, flat_v, flat_c)]
+        flat_f = treedef.flatten_up_to(state["last_factor"])
+        results = [leaf(p, m, v, c, f)
+                   for p, m, v, c, f in zip(flat_p, flat_m, flat_v, flat_c, flat_f)]
         new_params = jax.tree_util.tree_unflatten(
             treedef, [r[0] for r in results])
         new_coeffs = jax.tree_util.tree_unflatten(
             treedef, [r[1] for r in results])
+        new_factors = jax.tree_util.tree_unflatten(
+            treedef, [r[2] for r in results])
         return new_params, {
             "step": step,
             "exp_avg": m_used,
             "exp_avg_sq": new_v,
             "scaling_coeff": new_coeffs,
+            "last_factor": new_factors,
             "worker_error": new_we,
             "server_error": new_se,
         }

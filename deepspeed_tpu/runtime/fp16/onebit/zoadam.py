@@ -11,13 +11,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from ....ops.optimizer import TpuOptimizer, register_optimizer
-from .adam import _flatten, _unflatten_like, momentum_compression
+from .adam import momentum_compression
 
 PyTree = Any
 
@@ -44,15 +42,14 @@ class ZeroOneAdam(TpuOptimizer):
         self.local_step_clipper = local_step_clipper
 
     def init(self, params: PyTree) -> PyTree:
-        n = sum(int(np.prod(l.shape))
-                for l in jax.tree_util.tree_leaves(params))
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
         return {
             "step": jnp.zeros((), jnp.int32),
             "exp_avg": jax.tree_util.tree_map(zeros, params),
             "exp_avg_sq": jax.tree_util.tree_map(zeros, params),
-            "worker_error": jnp.zeros((n,), jnp.float32),
-            "server_error": jnp.zeros((n,), jnp.float32),
+            "worker_error": jax.tree_util.tree_map(zeros, params),
+            "server_error": jax.tree_util.tree_map(zeros, params),
+            "var_steps": jnp.zeros((), jnp.int32),
         }
 
     def update(self, grads: PyTree, state: PyTree, params: PyTree,
@@ -79,16 +76,26 @@ class ZeroOneAdam(TpuOptimizer):
                 beta2 * v + (1.0 - beta2) * jnp.square(g.astype(jnp.float32)),
                 v),
             state["exp_avg_sq"], grads)
+        # count of variance EMA updates — the matching bias correction power
+        # (a correction keyed to `step` over an interval-updated v would
+        # drift the effective denominator between updates)
+        new_var_steps = state["var_steps"] + update_var.astype(jnp.int32)
 
         # momentum compressed once the variance is seeded (0/1 Adam
         # communicates 1-bit almost from the start)
-        m_flat = _flatten(new_m)
-        m_used_flat, we, se = momentum_compression(
-            ~seeding, m_flat, state["worker_error"], state["server_error"])
-        m_used = _unflatten_like(m_used_flat, new_m)
+        m_used, we, se = momentum_compression(
+            ~seeding, new_m, state["worker_error"], state["server_error"])
 
         bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
-        bc2 = 1.0 - jnp.power(jnp.float32(beta2), step.astype(jnp.float32))
+        # var_steps==0 with step>0 happens on resume from a checkpoint
+        # predating this field (fill_missing keeps the init zero); estimate
+        # it as min(step, freeze) — slightly-large bc2 means slightly-small
+        # updates, vs bc2=0 which is inf/NaN
+        eff_var_steps = jnp.where(
+            new_var_steps > 0, new_var_steps,
+            jnp.minimum(step, jnp.int32(self.var_freeze_step)))
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2),
+                              jnp.maximum(eff_var_steps, 1).astype(jnp.float32))
 
         def leaf(p, m, v):
             p32 = p.astype(jnp.float32)
@@ -102,4 +109,5 @@ class ZeroOneAdam(TpuOptimizer):
             "exp_avg_sq": new_v,
             "worker_error": we,
             "server_error": se,
+            "var_steps": new_var_steps,
         }

@@ -41,4 +41,10 @@ def force_cpu_platform(n_devices: int = 8) -> None:
                 f"force_cpu_platform called after jax backend init "
                 f"(initialized: {backends}); call it before any jax "
                 f"device/array operation, or run in a fresh process")
+        if len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                f"cpu backend already initialized with "
+                f"{len(jax.devices())} devices < requested {n_devices}; "
+                f"the device-count flag is latched at first backend touch "
+                f"— run in a fresh process")
     jax.config.update("jax_platforms", "cpu")

@@ -25,23 +25,35 @@ import jax
 import deepspeed_tpu
 from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
 
-_COLLECTIVE = re.compile(
-    r"=\s+(?P<shape>\(?[a-z0-9]+\[[0-9,]*\])[^ ]*\s+"
+_INSTR = re.compile(
+    r"=\s+(?P<ret>[^=]+?)\s+"
     r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
     r"(?:-start)?\(")
+_SHAPE = re.compile(r"[a-z0-9]+\[[0-9,]*\]")
 
 
 def _collectives(hlo_text):
-    """[(op, result_shape_str), ...] for real collective instructions."""
-    return [(m.group("op"), m.group("shape").lstrip("("))
-            for m in _COLLECTIVE.finditer(hlo_text)]
+    """[(op, result_shape_str), ...] for real collective instructions.
+
+    Handles tuple-shaped results from XLA's collective combiner, e.g.
+    ``(f32[1024]{0}, f32[512]{0}) all-reduce(...)`` — each tuple element
+    counts as one result shape (a merged all-gather of N tensors is still N
+    gathers for the per-leaf accounting).
+    """
+    out = []
+    for m in _INSTR.finditer(hlo_text):
+        for shape in _SHAPE.findall(m.group("ret")):
+            out.append((m.group("op"), shape))
+    return out
 
 
-def _apply_hlo(stage, tp=1):
+def _apply_hlo(stage, tp=1, optimizer=None):
     mm = make_mesh(dp=-1, tp=tp)
     cfg = base_config(micro_batch=1, gas=1, stage=stage)
     if tp > 1:
         cfg["tensor_parallel"] = {"enabled": True, "size": tp}
+    if optimizer:
+        cfg["optimizer"] = optimizer
     engine, *_ = deepspeed_tpu.initialize(
         model=tiny_model(), config=cfg, mesh_manager=mm,
         rng=jax.random.PRNGKey(42))
@@ -85,6 +97,23 @@ def test_apply_step_has_no_resharding_cliff(stage, tp):
     assert n_gathers <= n_leaves, (
         f"{n_gathers} all-gathers for {n_leaves} params — something is "
         f"gathered more than once (stage={stage}, tp={tp})")
+
+
+def test_onebit_lamb_apply_step_no_resharding_cliff():
+    """The round-1 cliff's actual trigger: the onebit optimizers' flat
+    compression buffer derived shardings that conflicted with the master
+    specs.  Per-leaf compression (onebit/adam.py momentum_compression) must
+    keep the update step free of tensor all-reduces and double gathers."""
+    hlo, n_leaves = _apply_hlo(
+        1, optimizer={"type": "OnebitLamb",
+                      "params": {"lr": 1e-3, "freeze_step": 2}})
+    ops = _collectives(hlo)
+    tensor_allreduce = [
+        s for op, s in ops if op == "all-reduce" and not _is_scalar(s)]
+    assert not tensor_allreduce, tensor_allreduce
+    assert not [o for o in ops if o[0] == "all-to-all"]
+    n_gathers = sum(1 for op, _ in ops if op == "all-gather")
+    assert n_gathers <= n_leaves
 
 
 def test_stage3_keeps_params_sharded():
