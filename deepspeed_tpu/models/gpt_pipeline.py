@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import PIPE_AXIS
-from ..runtime.pipe.spmd import pipeline_loss
+from ..runtime.pipe.spmd import pipeline_grads, pipeline_loss
 from .gpt import GPTConfig, _block, _layer_norm, init as gpt_init, logical_axes as gpt_axes
 from .partitioning import LAYERS
 
@@ -93,11 +93,7 @@ def _loss_head_fn(shared, x, micro_batch, config: GPTPipeConfig):
 def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTPipeConfig,
             mesh: Mesh) -> jnp.ndarray:
     """batch['tokens']: [M*mb, S+1] → mean loss over all microbatches."""
-    M = config.num_micro_batches
-    tokens = batch["tokens"]
-    assert tokens.shape[0] % M == 0, \
-        f"batch {tokens.shape[0]} not divisible by num_micro_batches {M}"
-    micro = {"tokens": tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])}
+    micro = _split_micro(config, batch)
     stage_params, shared = split_params(config, params)
     return pipeline_loss(
         stage_fn=partial(_stage_fn, config=config),
@@ -107,10 +103,40 @@ def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTPipeConfig
         shared_params=shared,
         micro_inputs=micro,
         mesh=mesh,
-        num_micro=M,
+        num_micro=config.num_micro_batches,
         stage_spec_tree=stage_specs(config),
         remat_stage=config.remat,
     )
+
+
+def _split_micro(config: GPTPipeConfig, batch: Dict[str, jnp.ndarray]):
+    M = config.num_micro_batches
+    tokens = batch["tokens"]
+    assert tokens.shape[0] % M == 0, \
+        f"batch {tokens.shape[0]} not divisible by num_micro_batches {M}"
+    return {"tokens": tokens.reshape(M, tokens.shape[0] // M, tokens.shape[1])}
+
+
+def grad_fn(params: PyTree, batch: Dict[str, jnp.ndarray],
+            config: GPTPipeConfig, mesh: Mesh, loss_scale=1.0):
+    """1F1B training step: (mean loss, grads of loss_scale × loss)."""
+    micro = _split_micro(config, batch)
+    stage_params, shared = split_params(config, params)
+    loss, d_stage, d_shared = pipeline_grads(
+        loss_scale=loss_scale,
+        stage_fn=partial(_stage_fn, config=config),
+        embed_fn=partial(_embed_fn, config=config),
+        loss_head_fn=partial(_loss_head_fn, config=config),
+        stage_params=stage_params,
+        shared_params=shared,
+        micro_inputs=micro,
+        mesh=mesh,
+        num_micro=config.num_micro_batches,
+        stage_spec_tree=stage_specs(config),
+    )
+    grads = dict(d_shared)
+    grads["blocks"] = d_stage["blocks"]
+    return loss, grads
 
 
 def model_spec(config: GPTPipeConfig, mesh: Mesh):
@@ -122,6 +148,8 @@ def model_spec(config: GPTPipeConfig, mesh: Mesh):
 
     return ModelSpec(
         loss_fn=lambda p, b: loss_fn(p, b, config, mesh),
+        grad_fn=lambda p, b, loss_scale=1.0: grad_fn(
+            p, b, config, mesh, loss_scale=loss_scale),
         init_fn=lambda rng: gpt_init(config, rng),
         logical_axes=gpt_axes(config),
         apply_fn=None,

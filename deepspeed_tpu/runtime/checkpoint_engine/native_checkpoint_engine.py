@@ -160,10 +160,23 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
                              missing=missing)
         n_leaves = len(jax.tree_util.tree_leaves(state["opt_state"]))
         if missing:
-            if len(missing) > max(2, n_leaves // 4):
+            # schema evolution vs corruption: a missing leaf whose parent
+            # subtree has NO stored tensors at all is a field that didn't
+            # exist when the checkpoint was written (e.g. onebit error
+            # feedback moving from one flat vector to a per-leaf tree) —
+            # keeping its initialized value is correct and shouldn't count
+            # toward the corruption threshold.  Scattered missing leaves
+            # inside an otherwise-present subtree do.
+            def _benign(key: str) -> bool:
+                parent = key.rsplit(SEP, 1)[0] + SEP if SEP in key else ""
+                return parent != "" and not any(
+                    s.startswith(parent) for s in optim_flat)
+
+            suspicious = [k for k in missing if not _benign(k)]
+            if len(suspicious) > max(2, n_leaves // 4):
                 raise KeyError(
-                    f"optim_states.npz is missing {len(missing)}/{n_leaves} "
-                    f"tensors (e.g. {missing[:3]}) — corrupt or truncated "
+                    f"optim_states.npz is missing {len(suspicious)}/{n_leaves} "
+                    f"tensors (e.g. {suspicious[:3]}) — corrupt or truncated "
                     f"checkpoint, refusing to resume from it")
             logger.warning(
                 f"checkpoint missing {len(missing)} optimizer tensors "

@@ -383,7 +383,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ jitted programs
     def _build_steps(self) -> None:
         loss_fn = self.module.loss_fn
+        model_grad_fn = self.module.grad_fn
         gas = self.gradient_accumulation_steps()
+        grad_div = 1 if self.module.meta.get("pipeline") else gas
         clip = self.gradient_clipping()
         scaler_config = self.scaler_config
         optimizer = self.optimizer
@@ -403,11 +405,23 @@ class DeepSpeedEngine:
             """One micro-batch: fused forward+backward+accumulate."""
             scale = scale_state["loss_scale"]
 
-            def scaled_loss(p):
-                loss = loss_fn(p, batch)
-                return loss * scale / gas, loss
+            if model_grad_fn is not None:
+                # custom in-graph schedule (1F1B pipeline): the loss scale
+                # seeds the backward (fp16 underflow protection happens
+                # inside the half-precision VJPs).  A pipelined model's
+                # grad_fn consumes ALL microbatches of the global batch in
+                # one call (gas lives inside the schedule), so no 1/gas;
+                # other grad_fn models accumulate per-micro like jax.grad.
+                loss, grads = model_grad_fn(params, batch, loss_scale=scale)
+                if grad_div != 1:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g / grad_div, grads)
+            else:
+                def scaled_loss(p):
+                    loss = loss_fn(p, batch)
+                    return loss * scale / gas, loss
 
-            grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
             new_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
             new_acc = constrain(new_acc, grad_specs)

@@ -78,8 +78,15 @@ class ZeroOneAdam(TpuOptimizer):
             state["exp_avg_sq"], grads)
         # count of variance EMA updates — the matching bias correction power
         # (a correction keyed to `step` over an interval-updated v would
-        # drift the effective denominator between updates)
-        new_var_steps = state["var_steps"] + update_var.astype(jnp.int32)
+        # drift the effective denominator between updates).  A zero counter
+        # with step>1 means a resume from a checkpoint predating the field:
+        # seed the counter ONCE with min(step-1, freeze) so later increments
+        # continue from the estimate instead of restarting bc2 at 1-beta2.
+        prior_var_steps = jnp.where(
+            (state["var_steps"] == 0) & (step > 1),
+            jnp.minimum(step - 1, jnp.int32(self.var_freeze_step)),
+            state["var_steps"])
+        new_var_steps = prior_var_steps + update_var.astype(jnp.int32)
 
         # momentum compressed once the variance is seeded (0/1 Adam
         # communicates 1-bit almost from the start)
@@ -87,15 +94,8 @@ class ZeroOneAdam(TpuOptimizer):
             ~seeding, new_m, state["worker_error"], state["server_error"])
 
         bc1 = 1.0 - jnp.power(jnp.float32(beta1), step.astype(jnp.float32))
-        # var_steps==0 with step>0 happens on resume from a checkpoint
-        # predating this field (fill_missing keeps the init zero); estimate
-        # it as min(step, freeze) — slightly-large bc2 means slightly-small
-        # updates, vs bc2=0 which is inf/NaN
-        eff_var_steps = jnp.where(
-            new_var_steps > 0, new_var_steps,
-            jnp.minimum(step, jnp.int32(self.var_freeze_step)))
         bc2 = 1.0 - jnp.power(jnp.float32(beta2),
-                              jnp.maximum(eff_var_steps, 1).astype(jnp.float32))
+                              jnp.maximum(new_var_steps, 1).astype(jnp.float32))
 
         def leaf(p, m, v):
             p32 = p.astype(jnp.float32)

@@ -31,6 +31,13 @@ class ModelSpec:
     logical_axes: Optional[PyTree] = None
     #: optional forward fn (params, inputs) -> outputs, for eval/inference
     apply_fn: Optional[Callable] = None
+    #: optional (params, batch, loss_scale=1.0) -> (loss, grads) computing
+    #: gradients with a custom in-graph schedule (e.g. the 1F1B pipeline
+    #: executor).  When set, the engine uses it instead of
+    #: ``jax.grad(loss_fn)``; ``loss_scale`` must seed the backward (so fp16
+    #: scaling protects the half-precision VJPs) and the returned grads are
+    #: of the SCALED loss; the engine divides by gas and later unscales.
+    grad_fn: Optional[Callable[..., Any]] = None
     name: str = "model"
     #: free-form extras (model config etc.)
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -100,6 +100,86 @@ def test_tied_layer_spec():
 
 # ------------------------------------------------------------- SPMD engine
 
+def test_schedule_tables_match_1f1b_invariants():
+    """The op tables compiled from TrainSchedule's stream must satisfy the
+    invariants the SPMD executor relies on (spmd.py module docstring)."""
+    from deepspeed_tpu.runtime.pipe.spmd import schedule_tables
+
+    for M, S in [(2, 2), (4, 2), (4, 3), (3, 4), (8, 4)]:
+        fwd, bwd = schedule_tables(M, S)
+        T = 2 * (M + S - 1)
+        assert fwd.shape == (T, S)
+        for s in range(S):
+            # each stage runs every microbatch exactly once each direction
+            assert sorted(m for m in fwd[:, s] if m >= 0) == list(range(M))
+            assert sorted(m for m in bwd[:, s] if m >= 0) == list(range(M))
+            for t in range(T):
+                # never two ops in one tick
+                assert not (fwd[t, s] >= 0 and bwd[t, s] >= 0)
+                # closed forms the executor's dataflow is built on
+                if fwd[t, s] >= 0:
+                    assert t == 2 * fwd[t, s] + s
+                if bwd[t, s] >= 0:
+                    assert t == 2 * bwd[t, s] + 2 * S - 1 - s
+        # activation produced at tick t is consumed at t+1 by s+1;
+        # gradient produced at tick t is consumed at t+1 by s-1
+        for s in range(1, S):
+            for t in range(T):
+                if fwd[t, s] >= 0:
+                    assert fwd[t - 1, s - 1] == fwd[t, s]
+        for s in range(S - 1):
+            for t in range(T):
+                if bwd[t, s] >= 0:
+                    assert bwd[t - 1, s + 1] == bwd[t, s]
+
+
+def test_1f1b_grads_match_dense_autodiff():
+    """pipeline_grads (manual 1F1B VJP) must equal jax.grad on the dense
+    model — per-parameter, not just the loss."""
+    mm = make_mesh(dp=4, pp=2)
+    cfg = dataclasses.replace(PIPE_CFG, num_micro_batches=4)
+    params = gpt.init(cfg, jax.random.PRNGKey(3))
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(8, SEQ, seed=1))
+
+    loss, grads = jax.jit(
+        lambda p, b: gpt_pipeline.grad_fn(p, b, cfg, mm.mesh))(params, batch)
+
+    dense_cfg = gpt.GPTConfig(**{f.name: getattr(cfg, f.name)
+                                 for f in dataclasses.fields(gpt.GPTConfig)})
+    dloss, dgrads = jax.jit(jax.value_and_grad(
+        lambda p: gpt.loss_fn(p, batch, dense_cfg)))(params)
+
+    np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-5)
+    flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+    ref = dict(jax.tree_util.tree_flatten_with_path(dgrads)[0])
+    for path, g in flat:
+        g1 = np.asarray(g, np.float64)
+        g2 = np.asarray(ref[path], np.float64)
+        denom = np.abs(g2).max() + 1e-8
+        assert np.abs(g1 - g2).max() / denom < 2e-4, jax.tree_util.keystr(path)
+
+
+def test_1f1b_activation_memory_is_o_p_not_o_m():
+    """Compiled temp memory must not grow with the microbatch count — the
+    1F1B property the GPipe transpose lacks (VERDICT weak #6)."""
+    from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
+
+    def temp_bytes(M):
+        cfg = dataclasses.replace(PIPE_CFG, num_micro_batches=M)
+        mm = initialize_mesh(ParallelDims(dp=4, pp=2))
+        params = jax.eval_shape(lambda r: gpt.init(cfg, r), jax.random.PRNGKey(0))
+        batch = {"tokens": jax.ShapeDtypeStruct((2 * M, SEQ + 1), jnp.int32)}
+        compiled = jax.jit(
+            lambda p, b: gpt_pipeline.grad_fn(p, b, cfg, mm.mesh)
+        ).lower(params, batch).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    small, large = temp_bytes(2), temp_bytes(8)
+    # 4x the microbatches may only grow transient memory marginally
+    # (the microbatch *inputs* still scale with M; activations must not)
+    assert large < small * 1.5, (small, large)
+
+
 def test_pipeline_vs_dense_parity():
     """Pipelined loss must equal the dense model's loss on the same weights."""
     mm = make_mesh(dp=4, pp=2)
@@ -136,6 +216,31 @@ def test_pipeline_trains_with_zero1():
     # block params must actually be sharded over the pipe axis
     wqkv = engine.state["params"]["blocks"]["wqkv"]
     assert "pipe" in str(wqkv.sharding.spec)
+
+
+def test_pipeline_gas_does_not_rescale_update():
+    """train_batch consumes ALL microbatches in one call, so the config's
+    gas value must not shrink the update (grad_fn path divides by 1, not
+    gas). Same global batch + same seed ⇒ identical params either way."""
+    batch = random_tokens(16, SEQ, seed=3)
+
+    def step_once(gas):
+        mm = make_mesh(dp=4, pp=2)
+        model = gpt_pipeline.model_spec(PIPE_CFG, mm.mesh)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config=base_config(micro_batch=16 // (4 * gas), gas=gas,
+                               extra={"pipeline": {"stages": 2}}),
+            mesh_manager=mm, rng=jax.random.PRNGKey(5))
+        engine.train_batch(batch=batch)
+        return jax.device_get(engine.state["params"])
+
+    p1, p4 = step_once(1), step_once(4)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(p1)[0],
+            jax.tree_util.tree_flatten_with_path(p4)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   err_msg=jax.tree_util.keystr(path))
 
 
 def test_pipeline_rejects_zero2():
