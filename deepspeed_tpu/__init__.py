@@ -24,6 +24,9 @@ from .runtime.engine import DeepSpeedEngine
 from .runtime.model import ModelSpec, from_gpt
 from .utils.logging import logger
 
+# guards Autotuner trial engines from re-entering the autotuner
+_autotuning_active = False
+
 
 def _load_raw_config(config: Union[str, Dict, None],
                      config_params: Union[str, Dict, None]) -> Dict:
@@ -82,6 +85,32 @@ def initialize(args=None,
         config = args.deepspeed_config
     raw = _load_raw_config(config, config_params)
     mm = _mesh_from_config(raw, mesh_manager)
+
+    # autotuning handoff (reference launcher/runner.py:324 run_autotuning →
+    # autotuner.tune): with {"autotuning": {"enabled": true}} (or the
+    # launcher's --autotuning flag latched in DS_AUTOTUNING), search the
+    # config space first.  Mode "run" (default) proceeds with the tuned
+    # config; mode "tune" records results and proceeds untouched.
+    # An explicit {"enabled": false} wins over the env latch, and the
+    # re-entrancy guard keeps the Autotuner's own trial engines (which call
+    # initialize() in this same process) from tuning recursively.
+    global _autotuning_active
+    at_enabled = raw.get("autotuning", {}).get("enabled")
+    at_env = os.environ.get("DS_AUTOTUNING", "").strip()
+    if at_env and at_env not in ("tune", "run"):
+        logger.warning(f"DS_AUTOTUNING={at_env!r} is not 'tune' or 'run'; "
+                       "treating it as 'run'")
+    at_mode = at_env if at_env in ("tune", "run") else "run"
+    should_tune = (at_enabled is True or (at_enabled is None and bool(at_env)))
+    if should_tune and not _autotuning_active:
+        from .autotuning import Autotuner
+        _autotuning_active = True
+        try:
+            tuned = Autotuner(model, raw, mesh_manager=mm, rng=rng).tune()
+        finally:
+            _autotuning_active = False
+        if tuned is not None and at_mode == "run":
+            raw = tuned
 
     # pipelined models get the PipelineEngine (reference __init__.py:124-148
     # routes PipelineModule to PipelineEngine the same way)

@@ -1,0 +1,4 @@
+from .autotuner import Autotuner
+from .config import DeepSpeedAutotuningConfig
+
+__all__ = ["Autotuner", "DeepSpeedAutotuningConfig"]

@@ -1,0 +1,102 @@
+"""Autotuning config.
+
+Counterpart of the reference's ``deepspeed/autotuning/config.py``
+(``DeepSpeedAutotuningConfig``) — same JSON section name and key vocabulary
+(``"autotuning": {"enabled": true, "metric": "throughput", ...}``) so
+reference configs load unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from ..runtime.config_utils import get_scalar_param
+
+AUTOTUNING = "autotuning"
+
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_ENABLED_DEFAULT = False
+
+# what to optimise
+AUTOTUNING_METRIC = "metric"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_FLOPS = "flops"
+AUTOTUNING_METRIC_DEFAULT = AUTOTUNING_METRIC_THROUGHPUT
+
+# search behaviour
+AUTOTUNING_TUNER_TYPE = "tuner_type"
+AUTOTUNING_TUNER_GRIDSEARCH = "gridsearch"
+AUTOTUNING_TUNER_RANDOM = "random"
+AUTOTUNING_TUNER_MODELBASED = "model_based"
+AUTOTUNING_TUNER_TYPE_DEFAULT = AUTOTUNING_TUNER_GRIDSEARCH
+
+AUTOTUNING_MAX_TRIALS = "max_trials"
+AUTOTUNING_MAX_TRIALS_DEFAULT = 50
+AUTOTUNING_TUNER_EARLY_STOPPING = "tuner_early_stopping"
+AUTOTUNING_TUNER_EARLY_STOPPING_DEFAULT = 5
+AUTOTUNING_NUM_TUNING_MICRO_BATCH_SIZES = "num_tuning_micro_batch_sizes"
+AUTOTUNING_NUM_TUNING_MICRO_BATCH_SIZES_DEFAULT = 3
+
+# search space
+AUTOTUNING_MICRO_BATCH_SIZES = "micro_batch_sizes"
+AUTOTUNING_MICRO_BATCH_SIZES_DEFAULT = None  # None -> powers of two sweep
+AUTOTUNING_MAX_MICRO_BATCH_SIZE = "max_micro_batch_size"
+AUTOTUNING_MAX_MICRO_BATCH_SIZE_DEFAULT = 64
+AUTOTUNING_MIN_MICRO_BATCH_SIZE = "min_micro_batch_size"
+AUTOTUNING_MIN_MICRO_BATCH_SIZE_DEFAULT = 1
+AUTOTUNING_ZERO_STAGES = "zero_stages"
+AUTOTUNING_ZERO_STAGES_DEFAULT = None  # None -> [0, 1, 2, 3]
+AUTOTUNING_TUNE_REMAT = "tune_remat"
+AUTOTUNING_TUNE_REMAT_DEFAULT = True
+AUTOTUNING_TUNE_OFFLOAD = "tune_offload"
+AUTOTUNING_TUNE_OFFLOAD_DEFAULT = False
+
+# trial execution
+AUTOTUNING_WARMUP_STEPS = "warmup_steps"
+AUTOTUNING_WARMUP_STEPS_DEFAULT = 2
+AUTOTUNING_TIMED_STEPS = "timed_steps"
+AUTOTUNING_TIMED_STEPS_DEFAULT = 5
+AUTOTUNING_RESULTS_DIR = "results_dir"
+AUTOTUNING_RESULTS_DIR_DEFAULT = "autotuning_results"
+AUTOTUNING_OVERWRITE = "overwrite"
+AUTOTUNING_OVERWRITE_DEFAULT = True
+
+# memory model: fraction of device HBM trials may use (headroom for
+# fragmentation and the XLA workspace)
+AUTOTUNING_MEMORY_FRACTION = "memory_fraction"
+AUTOTUNING_MEMORY_FRACTION_DEFAULT = 0.92
+AUTOTUNING_DEVICE_MEMORY_BYTES = "device_memory_bytes"
+AUTOTUNING_DEVICE_MEMORY_BYTES_DEFAULT = None  # None -> probe the device
+
+
+class DeepSpeedAutotuningConfig:
+    """Typed view of the ``"autotuning"`` section."""
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]]):
+        d = (param_dict or {}).get(AUTOTUNING, {})
+        g = lambda k, dflt: get_scalar_param(d, k, dflt)
+        self.enabled: bool = g(AUTOTUNING_ENABLED, AUTOTUNING_ENABLED_DEFAULT)
+        self.metric: str = g(AUTOTUNING_METRIC, AUTOTUNING_METRIC_DEFAULT)
+        self.tuner_type: str = g(AUTOTUNING_TUNER_TYPE, AUTOTUNING_TUNER_TYPE_DEFAULT)
+        self.max_trials: int = g(AUTOTUNING_MAX_TRIALS, AUTOTUNING_MAX_TRIALS_DEFAULT)
+        self.tuner_early_stopping: int = g(
+            AUTOTUNING_TUNER_EARLY_STOPPING, AUTOTUNING_TUNER_EARLY_STOPPING_DEFAULT)
+        self.micro_batch_sizes: Optional[List[int]] = g(
+            AUTOTUNING_MICRO_BATCH_SIZES, AUTOTUNING_MICRO_BATCH_SIZES_DEFAULT)
+        self.max_micro_batch_size: int = g(
+            AUTOTUNING_MAX_MICRO_BATCH_SIZE, AUTOTUNING_MAX_MICRO_BATCH_SIZE_DEFAULT)
+        self.min_micro_batch_size: int = g(
+            AUTOTUNING_MIN_MICRO_BATCH_SIZE, AUTOTUNING_MIN_MICRO_BATCH_SIZE_DEFAULT)
+        self.zero_stages: Optional[List[int]] = g(
+            AUTOTUNING_ZERO_STAGES, AUTOTUNING_ZERO_STAGES_DEFAULT)
+        self.tune_remat: bool = g(AUTOTUNING_TUNE_REMAT, AUTOTUNING_TUNE_REMAT_DEFAULT)
+        self.tune_offload: bool = g(AUTOTUNING_TUNE_OFFLOAD, AUTOTUNING_TUNE_OFFLOAD_DEFAULT)
+        self.warmup_steps: int = g(AUTOTUNING_WARMUP_STEPS, AUTOTUNING_WARMUP_STEPS_DEFAULT)
+        self.timed_steps: int = g(AUTOTUNING_TIMED_STEPS, AUTOTUNING_TIMED_STEPS_DEFAULT)
+        self.results_dir: str = g(AUTOTUNING_RESULTS_DIR, AUTOTUNING_RESULTS_DIR_DEFAULT)
+        self.overwrite: bool = g(AUTOTUNING_OVERWRITE, AUTOTUNING_OVERWRITE_DEFAULT)
+        self.memory_fraction: float = g(
+            AUTOTUNING_MEMORY_FRACTION, AUTOTUNING_MEMORY_FRACTION_DEFAULT)
+        self.device_memory_bytes: Optional[int] = g(
+            AUTOTUNING_DEVICE_MEMORY_BYTES, AUTOTUNING_DEVICE_MEMORY_BYTES_DEFAULT)

@@ -1,0 +1,93 @@
+"""Experiment scheduler: run candidate configs, record results.
+
+Counterpart of the reference's ``deepspeed/autotuning/scheduler.py``
+(``ResourceManager`` launching experiment sub-jobs over the cluster).  On
+TPU a single-controller process owns every chip, so experiments run
+in-process: each trial builds a real engine on the live mesh, times a few
+steps, and tears down.  Results are journaled to ``results_dir`` as JSON so
+an interrupted tune resumes without re-measuring (the reference caches
+experiment dirs the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+Candidate = Dict[str, Any]
+
+
+def _exp_name(c: Candidate) -> str:
+    parts = [f"z{c.get('zero_stage', 0)}",
+             f"mbs{c.get('train_micro_batch_size_per_gpu', 1)}"]
+    if c.get("remat"):
+        parts.append("remat")
+    if c.get("offload"):
+        parts.append("offload")
+    return "_".join(parts)
+
+
+class ExperimentScheduler:
+    """Runs trials through ``measure_fn`` with journaling + early stop."""
+
+    def __init__(self,
+                 measure_fn: Callable[[Candidate], float],
+                 results_dir: str,
+                 early_stopping: int = 5,
+                 max_trials: int = 50,
+                 overwrite: bool = True):
+        self.measure_fn = measure_fn
+        self.results_dir = results_dir
+        self.early_stopping = early_stopping
+        self.max_trials = max_trials
+        self.overwrite = overwrite
+        os.makedirs(results_dir, exist_ok=True)
+
+    def _journal_path(self, c: Candidate) -> str:
+        return os.path.join(self.results_dir, f"exp_{_exp_name(c)}.json")
+
+    def run(self, tuner) -> List[Dict[str, Any]]:
+        """Drive the tuner until exhaustion, early stop, or trial budget."""
+        records: List[Dict[str, Any]] = []
+        best_value = float("-inf")
+        since_best = 0
+        trials = 0
+        while tuner.has_next() and trials < self.max_trials:
+            cand = tuner.next_candidate()
+            if cand is None:
+                break
+            path = self._journal_path(cand)
+            cached = None
+            if not self.overwrite and os.path.exists(path):
+                with open(path) as f:
+                    cached = json.load(f)
+            if cached is not None:
+                value = cached["value"]
+            else:
+                t0 = time.time()
+                try:
+                    value = float(self.measure_fn(cand))
+                except Exception as e:  # OOM / compile failure = -inf trial
+                    logger.warning(f"autotuning trial {_exp_name(cand)} failed: {e}")
+                    value = float("-inf")
+                rec = {"candidate": cand, "value": value,
+                       "wall_time": time.time() - t0}
+                with open(path, "w") as f:
+                    json.dump(rec, f, indent=2)
+            tuner.record(cand, value)
+            records.append({"candidate": cand, "value": value})
+            trials += 1
+            logger.info(f"[autotuning] trial {trials}: {_exp_name(cand)} -> {value:.3f}")
+            if value > best_value:
+                best_value, since_best = value, 0
+            else:
+                since_best += 1
+                if since_best >= self.early_stopping:
+                    logger.info(f"[autotuning] early stop after {trials} trials "
+                                f"({since_best} without improvement)")
+                    break
+        return records

@@ -1,0 +1,6 @@
+from .base import BaseTuner
+from .grid import GridSearchTuner
+from .random_tuner import RandomTuner
+from .model_based import ModelBasedTuner
+
+__all__ = ["BaseTuner", "GridSearchTuner", "RandomTuner", "ModelBasedTuner"]

@@ -1,0 +1,32 @@
+"""Tuner strategies: which candidate to try next.
+
+Counterpart of the reference's ``deepspeed/autotuning/tuner/base_tuner.py``
+— a tuner owns a list of candidate experiment configs and yields them in
+strategy order; the scheduler measures each and feeds the result back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+Candidate = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, candidates: List[Candidate]):
+        self.candidates = list(candidates)
+        self.results: List[Tuple[Candidate, float]] = []
+
+    def has_next(self) -> bool:
+        return len(self.results) < len(self.candidates)
+
+    def next_candidate(self) -> Optional[Candidate]:
+        raise NotImplementedError
+
+    def record(self, candidate: Candidate, metric_value: float) -> None:
+        self.results.append((candidate, metric_value))
+
+    def best(self) -> Optional[Tuple[Candidate, float]]:
+        if not self.results:
+            return None
+        return max(self.results, key=lambda cv: cv[1])

@@ -1,0 +1,13 @@
+"""Exhaustive in-order sweep (reference tuner/index_based_tuner.py GridSearchTuner)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import BaseTuner, Candidate
+
+
+class GridSearchTuner(BaseTuner):
+    def next_candidate(self) -> Optional[Candidate]:
+        i = len(self.results)
+        return self.candidates[i] if i < len(self.candidates) else None

@@ -1,0 +1,56 @@
+"""Cost-model-guided tuner.
+
+Counterpart of the reference's ``deepspeed/autotuning/tuner/model_based_tuner.py``
+(XGBoost cost model over experiment features).  XGBoost isn't in the image;
+the same explore-then-exploit loop runs over a ridge-regularised quadratic
+least-squares model (numpy) — features are (log2 mbs, zero stage, remat,
+offload), ample for the smooth mbs/stage throughput surfaces this tuner
+ranks.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from .base import BaseTuner, Candidate
+
+
+def _features(c: Candidate) -> List[float]:
+    mbs = float(c.get("train_micro_batch_size_per_gpu", 1))
+    stage = float(c.get("zero_stage", 0))
+    x = [math.log2(max(mbs, 1.0)), stage,
+         1.0 if c.get("remat", False) else 0.0,
+         1.0 if c.get("offload", False) else 0.0]
+    # quadratic expansion
+    quad = [a * b for i, a in enumerate(x) for b in x[i:]]
+    return [1.0] + x + quad
+
+
+class ModelBasedTuner(BaseTuner):
+    def __init__(self, candidates: List[Candidate], num_random: int = 3, seed: int = 0):
+        super().__init__(candidates)
+        self.num_random = min(num_random, len(candidates))
+        rng = np.random.default_rng(seed)
+        self._explore_order = rng.permutation(len(candidates)).tolist()
+
+    def _tried(self) -> set:
+        return {id(c) for c, _ in self.results}
+
+    def next_candidate(self) -> Optional[Candidate]:
+        untried = [c for c in self.candidates if id(c) not in self._tried()]
+        if not untried:
+            return None
+        if len(self.results) < self.num_random:
+            for i in self._explore_order:
+                if id(self.candidates[i]) not in self._tried():
+                    return self.candidates[i]
+        # fit the cost model on observations, pick the untried argmax
+        X = np.array([_features(c) for c, _ in self.results])
+        y = np.array([v for _, v in self.results])
+        reg = 1e-3 * np.eye(X.shape[1])
+        w = np.linalg.solve(X.T @ X + reg, X.T @ y)
+        preds = [float(np.dot(_features(c), w)) for c in untried]
+        return untried[int(np.argmax(preds))]

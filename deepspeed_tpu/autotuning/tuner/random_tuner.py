@@ -1,0 +1,21 @@
+"""Random-order sweep (reference tuner/index_based_tuner.py RandomTuner)."""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from .base import BaseTuner, Candidate
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, candidates: List[Candidate], seed: int = 0):
+        super().__init__(candidates)
+        self._order = list(range(len(candidates)))
+        random.Random(seed).shuffle(self._order)
+
+    def next_candidate(self) -> Optional[Candidate]:
+        i = len(self.results)
+        if i >= len(self._order):
+            return None
+        return self.candidates[self._order[i]]

@@ -1,0 +1,77 @@
+"""Parse the ``compression_training`` section into typed technique configs.
+
+Counterpart of the reference's ``deepspeed/compression/config.py``
+(``get_compression_config`` and the per-technique readers).  Each technique
+has ``shared_parameters`` (enabled flag, schedule offset, method knobs) and
+``different_groups`` ({name: {params: {...}, modules: [regex...]}}).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from . import constants as CC
+
+
+@dataclasses.dataclass
+class CompressionGroup:
+    name: str
+    modules: List[str]          # regex fragments matched against param paths
+    params: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TechniqueConfig:
+    enabled: bool = False
+    schedule_offset: int = 0
+    shared: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    groups: List[CompressionGroup] = dataclasses.field(default_factory=list)
+
+
+def _parse_technique(section: Optional[Dict[str, Any]]) -> TechniqueConfig:
+    if not section:
+        return TechniqueConfig()
+    shared = dict(section.get(CC.SHARED_PARAMETERS, {}))
+    tc = TechniqueConfig(
+        enabled=bool(shared.get(CC.TECHNIQUE_ENABLED, False)),
+        schedule_offset=int(shared.get(CC.SCHEDULE_OFFSET, 0)),
+        shared=shared)
+    for name, g in (section.get(CC.DIFFERENT_GROUPS, {}) or {}).items():
+        tc.groups.append(CompressionGroup(
+            name=name,
+            modules=list(g.get(CC.MODULES, ["*"])),
+            params=dict(g.get(CC.PARAMS, {}))))
+    return tc
+
+
+@dataclasses.dataclass
+class CompressionConfig:
+    weight_quantization: TechniqueConfig
+    activation_quantization: TechniqueConfig
+    sparse_pruning: TechniqueConfig
+    row_pruning: TechniqueConfig
+    head_pruning: TechniqueConfig
+    channel_pruning: TechniqueConfig
+    layer_reduction: Dict[str, Any]
+
+    @property
+    def any_enabled(self) -> bool:
+        return any(t.enabled for t in (
+            self.weight_quantization, self.activation_quantization,
+            self.sparse_pruning, self.row_pruning, self.head_pruning,
+            self.channel_pruning)) or bool(
+                self.layer_reduction.get(CC.TECHNIQUE_ENABLED, False))
+
+
+def get_compression_config(ds_config: Dict[str, Any]) -> CompressionConfig:
+    section = (ds_config or {}).get(CC.COMPRESSION_TRAINING, {}) or {}
+    return CompressionConfig(
+        weight_quantization=_parse_technique(section.get(CC.WEIGHT_QUANTIZATION)),
+        activation_quantization=_parse_technique(
+            section.get(CC.ACTIVATION_QUANTIZATION)),
+        sparse_pruning=_parse_technique(section.get(CC.SPARSE_PRUNING)),
+        row_pruning=_parse_technique(section.get(CC.ROW_PRUNING)),
+        head_pruning=_parse_technique(section.get(CC.HEAD_PRUNING)),
+        channel_pruning=_parse_technique(section.get(CC.CHANNEL_PRUNING)),
+        layer_reduction=dict(section.get(CC.LAYER_REDUCTION, {}) or {}))

@@ -1,0 +1,44 @@
+"""Compression config key vocabulary (reference deepspeed/compression/constants.py
+naming, so reference "compression_training" JSON sections load unchanged)."""
+
+COMPRESSION_TRAINING = "compression_training"
+
+SHARED_PARAMETERS = "shared_parameters"
+DIFFERENT_GROUPS = "different_groups"
+TECHNIQUE_ENABLED = "enabled"
+SCHEDULE_OFFSET = "schedule_offset"
+MODULES = "modules"
+PARAMS = "params"
+RELATED_MODULES = "related_modules"
+
+# ---- weight quantization
+WEIGHT_QUANTIZATION = "weight_quantization"
+WQ_QUANTIZE_VERBOSE = "quantize_verbose"
+WQ_QUANTIZATION_TYPE = "quantization_type"  # symmetric | asymmetric
+WQ_ROUNDING = "rounding"                    # nearest | stochastic
+WQ_QUANTIZE_WEIGHT_IN_FORWARD = "quantize_weight_in_forward"
+WQ_START_BITS = "start_bits"
+WQ_TARGET_BITS = "target_bits"
+WQ_PERIOD = "quantization_period"
+WQ_GROUPS = "quantize_groups"
+
+# ---- activation quantization
+ACTIVATION_QUANTIZATION = "activation_quantization"
+AQ_BITS = "bits"
+AQ_QUANTIZATION_TYPE = "quantization_type"
+AQ_RANGE_CALIBRATION = "range_calibration"  # dynamic | static (dynamic only)
+
+# ---- pruning
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+PRUNING_METHOD = "method"                   # l1 | topk
+PRUNING_DENSE_RATIO = "dense_ratio"
+HP_NUM_HEADS = "num_heads"
+
+# ---- layer reduction (distillation-style depth slimming)
+LAYER_REDUCTION = "layer_reduction"
+LR_KEEP_NUMBER_LAYER = "keep_number_layer"
+LR_TEACHER_LAYER = "teacher_layer"
+LR_MODULE_NAME_PREFIX = "module_name_prefix"

@@ -1,0 +1,69 @@
+"""Compression scheduler: host-side mirror of the in-graph schedule.
+
+Counterpart of the reference's ``compression_scheduler`` stepped from the
+engine at every optimizer step (``runtime/engine.py:2002``).  The actual
+gating/bit-lowering happens *in-graph* off the traced step scalar
+(transforms.py), so this object's job is bookkeeping: which techniques are
+live at the current step, current bit-widths per group, and verbose
+transition logging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..utils.logging import logger
+from . import constants as CC
+from .config import CompressionConfig, get_compression_config
+
+
+class CompressionScheduler:
+    def __init__(self, ds_config: Dict[str, Any]):
+        self.config: CompressionConfig = get_compression_config(ds_config)
+        self.training_steps = 0
+        self.verbose = bool(
+            (ds_config.get(CC.COMPRESSION_TRAINING, {})
+             .get(CC.WEIGHT_QUANTIZATION, {})
+             .get(CC.SHARED_PARAMETERS, {})
+             .get(CC.WQ_QUANTIZE_VERBOSE, False)))
+        self._announced = set()
+
+    def current_bits(self, group) -> float:
+        start = group.params.get(CC.WQ_START_BITS, 8)
+        target = group.params.get(CC.WQ_TARGET_BITS, 8)
+        period = group.params.get(CC.WQ_PERIOD, 0)
+        offset = self.config.weight_quantization.schedule_offset
+        if self.training_steps < offset:
+            return float(start)
+        if period <= 0:
+            return float(target)
+        drops = (self.training_steps - offset) // period + 1
+        return float(max(target, start / (2 ** drops)))
+
+    def state(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"step": self.training_steps}
+        wq = self.config.weight_quantization
+        if wq.enabled:
+            out["weight_quantization"] = {
+                g.name: {"bits": self.current_bits(g),
+                         "active": self.training_steps >= wq.schedule_offset}
+                for g in wq.groups}
+        for name, t in (("sparse_pruning", self.config.sparse_pruning),
+                        ("row_pruning", self.config.row_pruning),
+                        ("head_pruning", self.config.head_pruning),
+                        ("channel_pruning", self.config.channel_pruning)):
+            if t.enabled:
+                out[name] = {"active": self.training_steps >= t.schedule_offset}
+        return out
+
+    def step(self, step_zero_check: bool = False) -> None:
+        self.training_steps += 1
+        if not self.verbose:
+            return
+        for key, info in self.state().items():
+            if key == "step":
+                continue
+            token = f"{key}:{info}"
+            if isinstance(info, dict) and token not in self._announced:
+                self._announced.add(token)
+                logger.info(f"[compression] step {self.training_steps}: {key} -> {info}")

@@ -1,0 +1,147 @@
+"""In-graph compression transforms over parameter trees.
+
+Counterpart of the reference's compressed layer zoo
+(``deepspeed/compression/basic_layer.py`` — ``LinearLayer_Compress``:134
+with sparse/row/head pruning + weight/activation quantization,
+``Embedding_Compress``:61).  The reference swaps nn.Modules for compressed
+twins; a functional model has no modules to swap, so each technique is a
+pure transform ``params → params`` applied inside the jitted loss, gated on
+the (traced) global step.  That keeps one compiled program for the whole
+schedule — bits drop and masks engage via ``jnp.where`` on the step scalar,
+with zero recompiles (the reference pays a python-side module mutation at
+every schedule event instead).
+
+Gradients: quantization uses a straight-through estimator (identity VJP);
+pruning multiplies by the mask so masked weights also get masked gradients
+(standard magnitude-pruning QAT).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+# ------------------------------------------------------------- fake quant
+
+@jax.custom_vjp
+def _ste(w, w_q):
+    """Forward: quantized; backward: identity to the raw weights."""
+    return w_q
+
+
+def _ste_fwd(w, w_q):
+    return w_q, None
+
+
+def _ste_bwd(_, g):
+    return g, None
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def fake_quantize_ste(w: jnp.ndarray, bits, symmetric: bool = True,
+                      stochastic: bool = False,
+                      key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Quantize-dequantize with straight-through gradients.
+
+    ``bits`` may be a traced scalar (the schedule lowers it over steps
+    in-graph).  Per-tensor scaling; symmetric or asymmetric (zero-point).
+    """
+    w32 = w.astype(jnp.float32)
+    bits = jnp.asarray(bits, jnp.float32)
+    if symmetric:
+        levels = jnp.power(2.0, bits - 1.0) - 1.0
+        amax = jnp.maximum(jnp.max(jnp.abs(w32)), 1e-8)
+        scale = amax / levels
+        q = w32 / scale
+        q = q + jax.random.uniform(key, w32.shape, minval=-0.5, maxval=0.5) \
+            if stochastic and key is not None else q
+        q = jnp.clip(jnp.round(q), -levels, levels)
+        dq = q * scale
+    else:
+        levels = jnp.power(2.0, bits) - 1.0
+        lo, hi = jnp.min(w32), jnp.max(w32)
+        scale = jnp.maximum(hi - lo, 1e-8) / levels
+        q = (w32 - lo) / scale
+        q = q + jax.random.uniform(key, w32.shape, minval=-0.5, maxval=0.5) \
+            if stochastic and key is not None else q
+        q = jnp.clip(jnp.round(q), 0.0, levels)
+        dq = q * scale + lo
+    return _ste(w, dq.astype(w.dtype))
+
+
+def quantize_activation(x: jnp.ndarray, bits: int,
+                        symmetric: bool = True) -> jnp.ndarray:
+    """Dynamic-range activation fake-quant (reference basic_layer act paths);
+    per-tensor dynamic calibration, STE gradients."""
+    return fake_quantize_ste(x, bits, symmetric=symmetric)
+
+
+def bits_schedule(step, start_bits: int, target_bits: int,
+                  offset: int, period: int):
+    """Current bit-width: ``start_bits`` until ``offset``, then halving every
+    ``period`` steps down to ``target_bits`` (the reference's
+    quantization_period semantics)."""
+    step = jnp.asarray(step, jnp.int32)
+    if period <= 0:
+        return jnp.where(step >= offset, jnp.float32(target_bits),
+                         jnp.float32(start_bits))
+    drops = jnp.maximum((step - offset) // period + 1, 0)
+    bits = jnp.maximum(jnp.float32(start_bits) / jnp.power(2.0, drops.astype(jnp.float32)),
+                       jnp.float32(target_bits))
+    return jnp.where(step >= offset, bits, jnp.float32(start_bits))
+
+
+# ---------------------------------------------------------------- pruning
+
+def magnitude_mask(w: jnp.ndarray, dense_ratio: float,
+                   axis: Optional[Tuple[int, ...]] = None) -> jnp.ndarray:
+    """Keep the largest-|w| fraction ``dense_ratio``.
+
+    ``axis=None``: unstructured (per-element over the whole tensor).
+    With ``axis``: structured — score = L1 norm reduced over ``axis``; rows/
+    heads/channels below the quantile are zeroed whole.
+    """
+    if axis is None:
+        score = jnp.abs(w.astype(jnp.float32))
+    else:
+        score = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    thresh = jnp.quantile(score, 1.0 - dense_ratio)
+    return (score >= thresh).astype(w.dtype)
+
+
+def prune(w: jnp.ndarray, dense_ratio: float, step, offset: int,
+          axis: Optional[Tuple[int, ...]] = None) -> jnp.ndarray:
+    """Masked weights once the schedule engages; untouched before."""
+    mask = magnitude_mask(w, dense_ratio, axis=axis)
+    active = jnp.asarray(step, jnp.int32) >= offset
+    return jnp.where(active, w * mask, w)
+
+
+# ------------------------------------------------------------ path matching
+
+def path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def match_modules(path: str, patterns: List[str]) -> bool:
+    for pat in patterns:
+        if pat == "*" or re.search(pat, path):
+            return True
+    return False
+
+
+def map_matching(params: PyTree, patterns: List[str],
+                 fn: Callable[[str, jnp.ndarray], jnp.ndarray]) -> PyTree:
+    """tree_map over leaves whose path matches any pattern."""
+    def mapper(path, leaf):
+        p = path_str(path)
+        return fn(p, leaf) if match_modules(p, patterns) else leaf
+    return jax.tree_util.tree_map_with_path(mapper, params)

@@ -1,0 +1,45 @@
+"""``ds_elastic``: inspect the elastic schedule of a DeepSpeed config.
+
+Counterpart of the reference's ``bin/ds_elastic`` — prints the resolved
+global batch size and admissible world sizes, optionally the micro-batch
+for a concrete world size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .elasticity import compute_elastic_config
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed elasticity config calculator")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json with an elasticity section")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="validate/resolve for this chip count")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size > 0:
+        batch, valid, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size, return_microbatch=True)
+        print(json.dumps({"final_batch_size": batch,
+                          "valid_world_sizes": valid,
+                          "world_size": args.world_size,
+                          "micro_batch_per_rank": micro,
+                          "gradient_accumulation_steps":
+                              batch // (args.world_size * micro)}, indent=2))
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(json.dumps({"final_batch_size": batch,
+                          "valid_world_sizes": valid}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

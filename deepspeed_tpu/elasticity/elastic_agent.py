@@ -1,0 +1,118 @@
+"""Preemption-resume execution: the TPU analogue of torchelastic.
+
+The reference's ``DSElasticAgent`` (``elasticity/elastic_agent.py:23``)
+rides torchelastic: on worker failure the agent restarts the group from a
+rendezvous and training resumes from the last checkpoint.  TPU slices fail
+differently — the whole slice is preempted (maintenance, spot reclaim) and
+the job is re-launched, possibly on a different chip count.  So the agent
+here is a train-loop runner that
+
+- resumes from the newest checkpoint at startup (dp-resharding on resize is
+  native: checkpoints are global logical arrays),
+- checkpoints on SIGTERM/SIGINT (the preemption notice) before exiting,
+- checkpoints every ``save_interval`` steps as a bound on lost work,
+- validates the world size against the elastic admission algebra.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from ..utils.logging import log_dist, logger
+from .elasticity import compute_elastic_config, elasticity_enabled
+
+
+class ElasticTrainRunner:
+    """Drives engine.train_batch with checkpoint-based elasticity.
+
+    Args:
+      engine: a live DeepSpeedEngine (already initialized).
+      data_iter: iterator of batches (or pass batches to ``run``).
+      save_dir: checkpoint directory shared across restarts.
+      save_interval: steps between periodic checkpoints.
+      ds_config: when it carries an enabled "elasticity" section, the
+        current dp world size is validated against the admissible set.
+    """
+
+    def __init__(self, engine, save_dir: str, save_interval: int = 100,
+                 ds_config: Optional[Dict[str, Any]] = None,
+                 tag_prefix: str = "elastic"):
+        self.engine = engine
+        self.save_dir = save_dir
+        self.save_interval = max(1, save_interval)
+        self.tag_prefix = tag_prefix
+        self._preempted = False
+        self._prev_handlers = {}
+
+        if ds_config is not None and elasticity_enabled(ds_config):
+            # admission check (launcher does the same for node counts)
+            compute_elastic_config(
+                ds_config, world_size=engine.dp_world_size)
+
+    # -------------------------------------------------------------- signals
+    def _on_signal(self, signum, frame):
+        logger.warning(f"[elastic] received signal {signum}: will checkpoint "
+                       "and exit at the next step boundary")
+        self._preempted = True
+
+    def _install(self):
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:  # non-main thread (tests)
+                pass
+
+    def _restore(self):
+        for sig, h in self._prev_handlers.items():
+            signal.signal(sig, h)
+        self._prev_handlers.clear()
+
+    # ------------------------------------------------------------------ run
+    def resume(self) -> int:
+        """Load the newest checkpoint if present; returns the step resumed at."""
+        if os.path.isdir(self.save_dir) and \
+                os.path.exists(os.path.join(self.save_dir, "latest")):
+            self.engine.load_checkpoint(self.save_dir)
+            log_dist(f"[elastic] resumed from step {self.engine.global_steps}",
+                     ranks=[0])
+        return self.engine.global_steps
+
+    def _save(self):
+        tag = f"{self.tag_prefix}_step{self.engine.global_steps}"
+        self.engine.save_checkpoint(self.save_dir, tag=tag)
+
+    def run(self, batches: Iterable[Any], max_steps: Optional[int] = None,
+            resume: bool = True) -> Dict[str, Any]:
+        """Train until batches run out, ``max_steps``, or preemption.
+
+        Returns {"steps": n, "preempted": bool, "losses": [...]}.
+        """
+        if resume:
+            self.resume()
+        start_step = self.engine.global_steps
+        losses = []
+        self._install()
+        try:
+            for batch in batches:
+                if max_steps is not None and \
+                        self.engine.global_steps - start_step >= max_steps:
+                    break
+                if self._preempted:
+                    break
+                if hasattr(self.engine, "train_batch"):  # PipelineEngine
+                    loss = self.engine.train_batch(batch=batch)
+                else:
+                    loss = self.engine.train_batch_fused(batch)
+                losses.append(float(loss))
+                if self.engine.global_steps % self.save_interval == 0:
+                    self._save()
+            if self._preempted:
+                self._save()
+        finally:
+            self._restore()
+        return {"steps": self.engine.global_steps - start_step,
+                "preempted": self._preempted,
+                "losses": losses}

@@ -25,7 +25,7 @@ from ..utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
 EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_", "XLA_",
-               "TPU_", "DS_TPU_", "LIBTPU_"]
+               "TPU_", "DS_TPU_", "LIBTPU_", "DS_AUTOTUNING"]
 
 
 def parse_args(args=None):
@@ -133,6 +133,29 @@ def _export_env() -> Dict[str, str]:
     return env
 
 
+def _validate_elastic_admission(user_args, pool) -> None:
+    """If the user script's --deepspeed_config has elasticity enabled,
+    reject launch on an inadmissible world size (reference runner.py:338)."""
+    cfg_path = None
+    for i, a in enumerate(user_args):
+        if a in ("--deepspeed_config", "--deepscale_config"):
+            if i + 1 < len(user_args):
+                cfg_path = user_args[i + 1]
+        elif a.startswith(("--deepspeed_config=", "--deepscale_config=")):
+            cfg_path = a.split("=", 1)[1]
+    if cfg_path is None or not os.path.exists(cfg_path):
+        return
+    with open(cfg_path) as f:
+        ds_config = json.load(f)
+    from ..elasticity import compute_elastic_config, elasticity_enabled
+    if not elasticity_enabled(ds_config):
+        return
+    world_size = sum(pool.values())
+    # raises ElasticityIncompatibleWorldSize on a bad world size
+    compute_elastic_config(ds_config, world_size=world_size)
+    logger.info(f"[elastic] admission OK for world size {world_size}")
+
+
 def main(args=None) -> int:
     args = parse_args(args)
     pool = fetch_hostfile(args.hostfile)
@@ -148,6 +171,18 @@ def main(args=None) -> int:
     num_nodes = len(hosts)
     master_addr = args.master_addr or hosts[0]
     world_info = encode_world_info(pool)
+
+    # elastic admission (reference runner.py:338): a job whose config
+    # carries an enabled elasticity section may only launch on a world size
+    # the batch algebra admits
+    _validate_elastic_admission(args.user_args, pool)
+
+    # autotuning handoff (reference runner.py:324): latch the mode in env;
+    # deepspeed_tpu.initialize() runs the Autotuner in-process (it owns the
+    # model object the runner never sees); argparse already constrains the
+    # flag to {"", "tune", "run"}
+    if args.autotuning:
+        os.environ["DS_AUTOTUNING"] = args.autotuning
 
     launch_cmd = [
         sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",

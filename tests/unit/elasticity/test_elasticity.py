@@ -1,0 +1,201 @@
+"""Elasticity tests (mirror reference tests/unit/elasticity/).
+
+Covers the compatible-batch algebra (v0.1/v0.2), config validation, the
+immutable-config latch, launcher admission, and the preemption-resume
+loop: kill a training run mid-flight, restart, verify the loss curve
+continues from the checkpoint.
+"""
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      ElasticTrainRunner,
+                                      compute_elastic_config,
+                                      ensure_immutable_elastic_config,
+                                      get_compatible_gpus_v01,
+                                      get_compatible_gpus_v02)
+from deepspeed_tpu.elasticity import constants as EC
+from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
+
+ELASTIC = {
+    "enabled": True,
+    "max_train_batch_size": 64,
+    "micro_batch_sizes": [2, 4],
+    "min_gpus": 1,
+    "max_gpus": 8,
+    "version": 0.1,
+}
+
+
+def _ds(elastic=ELASTIC, **extra):
+    d = {"elasticity": dict(elastic)}
+    d.update(extra)
+    return d
+
+
+# ------------------------------------------------------------------ algebra
+
+def test_v01_algebra_maximizes_admissible_world_sizes():
+    batch, valid = get_compatible_gpus_v01([2, 4], 64, 1, 8)
+    # the optimum here is 48: admits {1,2,3,4,6,8}; covering 5 AND 7 too
+    # would need a batch ≥ 70 > 64
+    assert batch == 48
+    assert valid == [1, 2, 3, 4, 6, 8]
+    for w in valid:
+        per = batch // w
+        assert batch % w == 0 and (per % 2 == 0 or per % 4 == 0)
+
+
+def test_v01_prefer_larger_batch():
+    b_large, _ = get_compatible_gpus_v01([2], 64, 1, 4, prefer_larger=True)
+    b_small, _ = get_compatible_gpus_v01([2], 64, 1, 4, prefer_larger=False)
+    assert b_large >= b_small
+
+
+def test_v02_model_parallel_constrains_world_sizes():
+    batch, valid = get_compatible_gpus_v02(
+        [2, 4], 64, 1, 8, model_parallel_size=2)
+    assert all(w % 2 == 0 for w in valid)
+    for w in valid:
+        dp = w // 2
+        assert batch % dp == 0
+
+
+def test_compute_elastic_config_validates_world_size():
+    batch, valid = compute_elastic_config(_ds())
+    assert valid
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(_ds(), world_size=max(valid) + 13)
+
+
+def test_compute_elastic_config_returns_microbatch():
+    batch, valid, micro = compute_elastic_config(
+        _ds(), world_size=4, return_microbatch=True)
+    assert micro in (2, 4)
+    assert (batch // 4) % micro == 0
+
+
+def test_conflicting_batch_info_rejected():
+    with pytest.raises(ElasticityConfigError, match="conflict"):
+        compute_elastic_config(_ds(train_batch_size=32))
+    # ...unless explicitly ignored
+    e = dict(ELASTIC)
+    e["ignore_non_elastic_batch_info"] = True
+    compute_elastic_config(_ds(elastic=e, train_batch_size=32))
+
+
+def test_bad_micro_batches_rejected():
+    e = dict(ELASTIC)
+    e["micro_batch_sizes"] = [0, -2]
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(_ds(elastic=e))
+
+
+def test_immutable_config_latch(monkeypatch):
+    monkeypatch.delenv(EC.DEEPSPEED_ELASTICITY_CONFIG, raising=False)
+    ensure_immutable_elastic_config(ELASTIC)
+    ensure_immutable_elastic_config(ELASTIC)  # same config OK
+    changed = dict(ELASTIC, max_train_batch_size=128)
+    with pytest.raises(ElasticityConfigError, match="admission"):
+        ensure_immutable_elastic_config(changed)
+
+
+def test_launcher_admission(tmp_path, monkeypatch):
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.runner import _validate_elastic_admission
+
+    cfg = _ds()
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(cfg))
+    # admissible pool passes, inadmissible raises
+    _validate_elastic_admission(
+        ["--deepspeed_config", str(path)], OrderedDict([("h1", 4)]))
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        _validate_elastic_admission(
+            ["--deepspeed_config", str(path)], OrderedDict([("h1", 7), ("h2", 6)]))
+
+
+# -------------------------------------------------------- preemption-resume
+
+def _make_engine(mm):
+    return deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(micro_batch=2, stage=1),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))[0]
+
+
+def _batches(n, bs=16):
+    return [random_tokens(bs, 16, seed=i) for i in range(n)]
+
+
+def test_preemption_resume_continues_loss_curve(tmp_path):
+    """Kill mid-run (SIGTERM), restart, loss curve continues (VERDICT #7)."""
+    save = str(tmp_path / "elastic_ckpt")
+    mm = make_mesh(dp=8)
+
+    # uninterrupted reference run: 8 steps
+    eng_ref = _make_engine(mm)
+    ref_losses = []
+    for b in _batches(8):
+        ref_losses.append(float(eng_ref.train_batch_fused(b)))
+
+    # interrupted run: SIGTERM (the preemption notice) lands during step 4
+    eng1 = _make_engine(mm)
+    runner1 = ElasticTrainRunner(eng1, save, save_interval=2)
+    batches = _batches(8)
+    steps_seen = {"n": 0}
+    real_train = eng1.train_batch_fused
+
+    def counting_train(b):
+        out = real_train(b)
+        steps_seen["n"] += 1
+        if steps_seen["n"] == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    eng1.train_batch_fused = counting_train
+    res1 = runner1.run(batches)
+    assert res1["preempted"]
+    assert res1["steps"] == 4
+    np.testing.assert_allclose(res1["losses"], ref_losses[:4], rtol=1e-5)
+
+    # fresh process equivalent: new engine resumes from the kill checkpoint
+    eng2 = _make_engine(mm)
+    runner2 = ElasticTrainRunner(eng2, save, save_interval=100)
+    res2 = runner2.run(batches[4:])
+    assert eng2.global_steps == 8
+    # the continued curve matches the uninterrupted run exactly
+    np.testing.assert_allclose(res2["losses"], ref_losses[4:], rtol=1e-4)
+
+
+def test_runner_validates_elastic_world_size(tmp_path):
+    mm = make_mesh(dp=8)
+    eng = _make_engine(mm)
+    bad = dict(ELASTIC, min_gpus=1, max_gpus=8,
+               micro_batch_sizes=[3])  # batch of 3s never lands on dp=8...
+    # find a config that excludes 8: micro_batches [3], max 9 -> valid {1,3,9}∩[1..8]
+    bad["max_train_batch_size"] = 9
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        ElasticTrainRunner(eng, str(tmp_path), ds_config={"elasticity": bad})
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    from deepspeed_tpu.elasticity.cli import main
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(_ds()))
+    assert main(["-c", str(path), "-w", "4"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["world_size"] == 4
+    assert out["micro_batch_per_rank"] in (2, 4)
+    assert out["final_batch_size"] == out["micro_batch_per_rank"] * 4 * \
+        out["gradient_accumulation_steps"]

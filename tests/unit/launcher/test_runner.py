@@ -76,11 +76,14 @@ def test_world_info_roundtrip():
 def test_local_launch_end_to_end(tmp_path):
     """launch.py spawns ranks with the full rendezvous env set."""
     script = tmp_path / "probe.py"
+    # ranks write to per-rank files: concurrent stdout lines can interleave
     script.write_text(
         "import os, json\n"
-        "print(json.dumps({k: os.environ[k] for k in "
+        "d = {k: os.environ[k] for k in "
         "('RANK','LOCAL_RANK','WORLD_SIZE','DS_COORDINATOR',"
-        "'DS_PROCESS_ID','DS_NUM_PROCESSES')}))\n")
+        "'DS_PROCESS_ID','DS_NUM_PROCESSES')}\n"
+        f"open(r'{tmp_path}/rank' + os.environ['RANK'] + '.json', 'w')"
+        ".write(json.dumps(d))\n")
     world = encode_world_info(OrderedDict([("localhost", 2)]))
     out = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
@@ -89,8 +92,8 @@ def test_local_launch_end_to_end(tmp_path):
         capture_output=True, text=True, timeout=120,
         cwd=REPO_ROOT, env={**os.environ, "PYTHONPATH": REPO_ROOT})
     assert out.returncode == 0, out.stderr
-    envs = [json.loads(l) for l in out.stdout.splitlines()
-            if l.startswith("{")]
+    envs = [json.loads((tmp_path / f"rank{r}.json").read_text())
+            for r in (0, 1)]
     assert len(envs) == 2
     ranks = sorted(int(e["RANK"]) for e in envs)
     assert ranks == [0, 1]
