@@ -1,0 +1,6 @@
+from .compress import init_compression, redundancy_clean
+from .config import CompressionConfig, get_compression_config
+from .scheduler import CompressionScheduler
+
+__all__ = ["init_compression", "redundancy_clean", "CompressionConfig",
+           "get_compression_config", "CompressionScheduler"]

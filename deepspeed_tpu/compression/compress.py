@@ -1,0 +1,211 @@
+"""``init_compression`` / ``redundancy_clean``: compression-aware training
+over functional models.
+
+Counterpart of the reference's ``deepspeed/compression/compress.py``.  The
+reference walks the nn.Module tree and swaps matching layers for compressed
+twins; here the model is a pure loss over a param pytree, so
+``init_compression`` returns a new ``ModelSpec`` whose loss applies the
+in-graph transforms (transforms.py) to matching parameters, gated on the
+traced global step the engine threads through the batch
+(``_compression_step``).  ``redundancy_clean`` bakes the final masks and
+quantization grid into the parameters for deployment.
+
+Technique → axis conventions (weights are ``[..., in, out]`` in this
+framework; leading dims may be a layer-stack):
+
+- sparse_pruning: unstructured, per element.
+- row_pruning: structured over the OUTPUT axis (last dim) — reference
+  LinearLayer_Compress row pruning on [out, in] torch weights.
+- channel_pruning: structured over the INPUT axis (second-to-last dim).
+- head_pruning: structured over the axis whose extent == num_heads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.model import ModelSpec
+from ..utils.logging import logger
+from . import constants as CC
+from .config import CompressionConfig, get_compression_config
+from .transforms import (bits_schedule, fake_quantize_ste, magnitude_mask,
+                         map_matching)
+
+PyTree = Any
+
+STEP_KEY = "_compression_step"
+_ALWAYS_ON = 1 << 30
+
+
+def _head_axis(shape, num_heads: int) -> Optional[int]:
+    for i, s in enumerate(shape):
+        if s == num_heads:
+            return i
+    return None
+
+
+def _structured_axes(w: jnp.ndarray, keep_axis: int):
+    """Reduce over every axis except ``keep_axis`` and a leading layer
+    stack (axis 0 of ndim>=3 tensors)."""
+    keep = {keep_axis % w.ndim}
+    if w.ndim >= 3:
+        keep.add(0)
+    return tuple(i for i in range(w.ndim) if i not in keep)
+
+
+def compression_transform(params: PyTree, step,
+                          config: CompressionConfig) -> PyTree:
+    """Apply every enabled technique to matching weight leaves, step-gated."""
+    p = params
+
+    wq = config.weight_quantization
+    if wq.enabled:
+        sym = wq.shared.get(CC.WQ_QUANTIZATION_TYPE, "symmetric") == "symmetric"
+        for grp in wq.groups:
+            start = grp.params.get(CC.WQ_START_BITS, 8)
+            target = grp.params.get(CC.WQ_TARGET_BITS, start)
+            period = grp.params.get(CC.WQ_PERIOD, 0)
+
+            def q(path, w, start=start, target=target, period=period):
+                if w.ndim < 2:
+                    return w  # biases / norms stay full precision
+                bits = bits_schedule(step, start, target,
+                                     wq.schedule_offset, period)
+                wq_ = fake_quantize_ste(w, bits, symmetric=sym)
+                active = jnp.asarray(step, jnp.int32) >= wq.schedule_offset
+                return jnp.where(active, wq_, w)
+
+            p = map_matching(p, grp.modules, q)
+
+    def _prune_technique(p, tech, keep_axis):
+        if not tech.enabled:
+            return p
+        for grp in tech.groups:
+            ratio = float(grp.params.get(CC.PRUNING_DENSE_RATIO, 1.0))
+
+            def f(path, w, ratio=ratio):
+                if w.ndim < 2 or ratio >= 1.0:
+                    return w
+                if keep_axis == "head":
+                    nh = int(tech.shared.get(CC.HP_NUM_HEADS, 0))
+                    ax = _head_axis(w.shape, nh) if nh else None
+                    if ax is None:
+                        return w
+                    axes = _structured_axes(w, ax)
+                elif keep_axis is None:
+                    axes = None  # unstructured
+                else:
+                    axes = _structured_axes(w, keep_axis)
+                mask = magnitude_mask(w, ratio, axis=axes)
+                active = jnp.asarray(step, jnp.int32) >= tech.schedule_offset
+                return jnp.where(active, w * mask, w)
+
+            p = map_matching(p, grp.modules, f)
+        return p
+
+    p = _prune_technique(p, config.sparse_pruning, None)
+    p = _prune_technique(p, config.row_pruning, -1)
+    p = _prune_technique(p, config.channel_pruning, -2)
+    p = _prune_technique(p, config.head_pruning, "head")
+    return p
+
+
+def _rebuild_gpt_spec(model: ModelSpec, **config_updates) -> ModelSpec:
+    """Rebuild a GPT-family spec with updated model-config fields."""
+    from ..runtime.model import from_gpt
+    cfg = model.meta.get("config")
+    new_cfg = dataclasses.replace(cfg, **config_updates)
+    new = from_gpt(new_cfg)
+    new.params = model.params
+    return new
+
+
+def init_compression(model: ModelSpec, deepspeed_config: Dict[str, Any],
+                     teacher_params: Optional[PyTree] = None) -> ModelSpec:
+    """Wrap a ModelSpec for compression-aware training (reference
+    ``init_compression``).  Returns a new spec; the original is untouched.
+
+    ``teacher_params``: with layer_reduction enabled, initialize the slimmed
+    student from these params' selected layers (knowledge-distillation
+    init; reference layer_reduction + teacher_layer).
+    """
+    config = get_compression_config(deepspeed_config)
+    if not config.any_enabled:
+        return model
+    if model.grad_fn is not None:
+        raise ValueError(
+            "init_compression does not compose with custom-schedule models "
+            "(pipeline); compress the dense model instead")
+
+    # ---- layer reduction: structurally slim the layer stack
+    lr = config.layer_reduction
+    if lr.get(CC.TECHNIQUE_ENABLED, False):
+        keep = lr.get(CC.LR_KEEP_NUMBER_LAYER)
+        teacher_layers = lr.get(CC.LR_TEACHER_LAYER)
+        cfg = model.meta.get("config")
+        if cfg is None or not hasattr(cfg, "n_layer"):
+            raise ValueError("layer_reduction needs a GPT-family ModelSpec")
+        if teacher_layers is None:
+            # evenly-spaced teacher layers (reference default policy)
+            import numpy as np
+            teacher_layers = [int(i) for i in
+                              np.linspace(0, cfg.n_layer - 1, keep).round()]
+        keep = len(teacher_layers)
+        idx = jnp.asarray(teacher_layers, jnp.int32)
+        model = _rebuild_gpt_spec(model, n_layer=keep)
+        if teacher_params is not None:
+            sliced = dict(teacher_params)
+            sliced["blocks"] = jax.tree_util.tree_map(
+                lambda x: jnp.take(x, idx, axis=0), teacher_params["blocks"])
+            model = dataclasses.replace(model, params=sliced, init_fn=None)
+        logger.info(f"[compression] layer_reduction: keeping layers "
+                    f"{teacher_layers}")
+
+    # ---- activation quantization: a model-config hook (the functional
+    # analogue of swapping in an act-quantizing layer)
+    aq = config.activation_quantization
+    if aq.enabled:
+        bits = 8
+        for grp in aq.groups:
+            bits = int(grp.params.get(CC.AQ_BITS, bits))
+        sym = aq.shared.get(CC.AQ_QUANTIZATION_TYPE, "symmetric") == "symmetric"
+        cfg = model.meta.get("config")
+        if cfg is not None and hasattr(cfg, "act_quant_bits"):
+            model = _rebuild_gpt_spec(model, act_quant_bits=bits,
+                                      act_quant_symmetric=sym)
+        else:
+            logger.warning("[compression] activation_quantization: model "
+                           "config has no act_quant_bits hook; skipped")
+
+    base_loss = model.loss_fn
+    base_apply = model.apply_fn
+
+    def loss_fn(params, batch):
+        step = _ALWAYS_ON
+        if isinstance(batch, dict) and STEP_KEY in batch:
+            batch = dict(batch)
+            step = batch.pop(STEP_KEY)
+        return base_loss(compression_transform(params, step, config), batch)
+
+    apply_fn = None
+    if base_apply is not None:
+        def apply_fn(params, *a, **k):
+            return base_apply(
+                compression_transform(params, _ALWAYS_ON, config), *a, **k)
+
+    return dataclasses.replace(
+        model, loss_fn=loss_fn, apply_fn=apply_fn,
+        meta={**model.meta, "compression": config})
+
+
+def redundancy_clean(params: PyTree, deepspeed_config: Dict[str, Any]) -> PyTree:
+    """Bake masks + quantization grid into the parameters (reference
+    ``redundancy_clean``): the returned tree is what the compressed model
+    computes with, suitable for export/serving."""
+    config = get_compression_config(deepspeed_config)
+    return jax.jit(
+        lambda p: compression_transform(p, _ALWAYS_ON, config))(params)

@@ -28,12 +28,17 @@ def main(argv=None) -> int:
     if args.world_size > 0:
         batch, valid, micro = compute_elastic_config(
             ds_config, world_size=args.world_size, return_microbatch=True)
+        # the batch divides over dp = world/mp ranks, not all chips
+        el = ds_config.get("elasticity", {})
+        mp = int(el.get("model_parallel_size", 1)) if \
+            float(el.get("version", 0.2)) >= 0.2 else 1
+        dp = args.world_size // mp
         print(json.dumps({"final_batch_size": batch,
                           "valid_world_sizes": valid,
                           "world_size": args.world_size,
                           "micro_batch_per_rank": micro,
                           "gradient_accumulation_steps":
-                              batch // (args.world_size * micro)}, indent=2))
+                              (batch // dp) // micro}, indent=2))
     else:
         batch, valid = compute_elastic_config(ds_config)
         print(json.dumps({"final_batch_size": batch,

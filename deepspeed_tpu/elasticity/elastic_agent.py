@@ -48,9 +48,14 @@ class ElasticTrainRunner:
         self._prev_handlers = {}
 
         if ds_config is not None and elasticity_enabled(ds_config):
-            # admission check (launcher does the same for node counts)
+            # admission check (launcher does the same for node counts),
+            # then latch the config hash so a restarted worker with an
+            # edited elasticity section fails loudly instead of silently
+            # training on a different schedule (reference elasticity.py:254)
+            from .elasticity import ensure_immutable_elastic_config
             compute_elastic_config(
                 ds_config, world_size=engine.dp_world_size)
+            ensure_immutable_elastic_config(ds_config["elasticity"])
 
     # -------------------------------------------------------------- signals
     def _on_signal(self, signum, frame):

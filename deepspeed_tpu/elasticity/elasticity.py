@@ -29,23 +29,37 @@ from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
                      ElasticityIncompatibleWorldSize)
 
 
+def _divisors(n: int) -> List[int]:
+    out = []
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            out.append(d)
+            if d != n // d:
+                out.append(n // d)
+        d += 1
+    return sorted(out)
+
+
 def _admissible_world_sizes(batch: int, micro_batches: List[int],
                             min_gpus: int, max_gpus: int,
                             mp_size: int = 1,
                             gpus_per_node: int = 1) -> List[int]:
-    """World sizes in range that can run ``batch`` = mbs × gas × dp."""
+    """World sizes in range that can run ``batch`` = mbs × gas × dp.
+
+    dp must divide the batch, so only divisor dp values are enumerated
+    (keeps the search cheap even with the default max_gpus of 10000).
+    """
     out = []
     unit = mp_size * gpus_per_node
-    for w in range(min_gpus, max_gpus + 1):
-        if w % unit != 0:
-            continue
-        dp = w // mp_size
-        if dp == 0 or batch % dp != 0:
+    for dp in _divisors(batch):
+        w = dp * mp_size
+        if w < min_gpus or w > max_gpus or w % unit != 0:
             continue
         per_rank = batch // dp
         if any(per_rank % m == 0 for m in micro_batches):
             out.append(w)
-    return out
+    return sorted(out)
 
 
 def _candidate_batches(micro_batches: List[int], max_batch: int) -> List[int]:

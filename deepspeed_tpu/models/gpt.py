@@ -48,6 +48,13 @@ class GPTConfig:
     use_flash_attention: bool = True    # pallas kernel when available
     vocab_round_to: int = 128           # pad vocab to a lane multiple
     sequence_parallel: Optional[str] = None  # None | 'ring' | 'ulysses'
+    # activation fake-quant hook set by compression.init_compression
+    # (reference basic_layer.py activation quantization)
+    act_quant_bits: Optional[int] = None
+    act_quant_symmetric: bool = True
+    # a SparsityConfig instance routes attention through the block-sparse
+    # kernel (reference SparseSelfAttention in BERT-style models)
+    sparse_attention: Optional[Any] = None
 
     @property
     def ffn_dim(self) -> int:
@@ -168,6 +175,12 @@ def _attention(q, k, v, config: GPTConfig):
             from ..parallel.sequence import sp_attention
             return sp_attention(q, k, v, impl=config.sequence_parallel,
                                 causal=True, mesh=mm.mesh)
+    if config.sparse_attention is not None:
+        from ..ops.pallas.block_sparse_attention import block_sparse_attention
+        layout = config.sparse_attention.make_layout(q.shape[1])
+        return block_sparse_attention(q, k, v, layout,
+                                      block=config.sparse_attention.block,
+                                      causal=True)
     from ..ops.pallas import flash_attention, mha_reference
     if config.use_flash_attention:
         # pallas kernel on TPU; internally falls back to the dense
@@ -201,6 +214,10 @@ def mlp_residual(x, p, config: GPTConfig):
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
     ff = jax.nn.gelu(ff, approximate=True)
+    if config.act_quant_bits is not None:
+        from ..compression.transforms import quantize_activation
+        ff = quantize_activation(ff, config.act_quant_bits,
+                                 symmetric=config.act_quant_symmetric)
     ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
     return x + ff_out
 

@@ -1,0 +1,8 @@
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+
+__all__ = ["SparseSelfAttention", "SparsityConfig", "DenseSparsityConfig",
+           "FixedSparsityConfig", "VariableSparsityConfig",
+           "BigBirdSparsityConfig", "BSLongformerSparsityConfig"]

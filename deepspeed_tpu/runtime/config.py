@@ -190,6 +190,8 @@ class DeepSpeedConfig:
         self.flops_profiler_config_dict = pd.get(C.FLOPS_PROFILER, {})
         self.autotuning_config_dict = pd.get(C.AUTOTUNING, {})
         self.elasticity_config_dict = pd.get(C.ELASTICITY, {})
+        # raw "compression_training" section (typed parse in
+        # deepspeed_tpu.compression.config); engine steps its scheduler
         self.compression_config_dict = pd.get("compression_training", {})
         self.sparse_attention = pd.get(C.SPARSE_ATTENTION, None)
         self.data_efficiency_config_dict = pd.get("data_efficiency", {})

@@ -122,6 +122,15 @@ class DeepSpeedEngine:
         self._init_state(rng)
         self._build_steps()
 
+        # compression scheduler (reference engine.py:2002 steps it at every
+        # optimizer step); the in-graph gating reads the step scalar the
+        # engine threads through the batch
+        self._compression_scheduler = None
+        if self._config.compression_config_dict:
+            from ..compression import CompressionScheduler
+            self._compression_scheduler = CompressionScheduler(
+                {"compression_training": self._config.compression_config_dict})
+
         # telemetry fan-out (reference MonitorMaster, engine.py:1840/2069)
         from ..monitor import MonitorMaster, get_monitor_config
         self.monitor = MonitorMaster(
@@ -530,11 +539,20 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(put, batch)
 
     # ------------------------------------------------------------------ train protocol
+    def _inject_compression_step(self, batch):
+        """Thread the global step into the batch so the in-graph compression
+        schedule (compression/transforms.py) can gate on it."""
+        if self._compression_scheduler is None or not isinstance(batch, dict):
+            return batch
+        from ..compression.compress import STEP_KEY
+        return {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
+
     def forward(self, batch, **kwargs):
         """Compute loss (and, fused, the gradients) for one micro-batch."""
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         self.tput_timer.start()
+        batch = self._inject_compression_step(batch)
         batch = self._shard_batch(batch)
         new_acc, loss = self._micro_jit(
             self.state["params"], self.state["grad_acc"], self.state["scale"], batch)
@@ -672,6 +690,8 @@ class DeepSpeedEngine:
                 events.append(("Train/Samples/loss_scale", self.cur_scale,
                                self.global_samples))
             self.monitor.write_events(events)
+        if self._compression_scheduler is not None:
+            self._compression_scheduler.step()
 
     # fused whole-batch path -------------------------------------------------
     def train_batch_fused(self, batches):
@@ -697,6 +717,13 @@ class DeepSpeedEngine:
         batches = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, NamedSharding(
                 self.mesh, P(None, (DATA_AXIS, EXPERT_AXIS)))), batches)
+        if self._compression_scheduler is not None and isinstance(batches, dict):
+            from ..compression.compress import STEP_KEY
+            # one step scalar per gas micro-step (same global step for all)
+            batches = {**batches, STEP_KEY: jax.device_put(
+                jnp.full((self.gradient_accumulation_steps(),),
+                         self.global_steps, jnp.int32),
+                NamedSharding(self.mesh, P(None)))}
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm, overflow,
              mean_loss) = self._fused_jit(
@@ -720,6 +747,7 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ eval
     def eval_loss(self, batch):
+        batch = self._inject_compression_step(batch)
         batch = self._shard_batch(batch)
         if not hasattr(self, "_eval_jit"):
             self._eval_jit = jax.jit(self.module.loss_fn)

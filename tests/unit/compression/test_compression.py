@@ -1,0 +1,214 @@
+"""Compression tests (mirror reference tests/unit/compression/test_compression.py).
+
+Covers the in-graph transforms (fake-quant STE, bit schedule, structured/
+unstructured pruning), init_compression end-to-end training with schedule
+gating, layer reduction, activation quantization, and redundancy_clean.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionScheduler, init_compression,
+                                       redundancy_clean)
+from deepspeed_tpu.compression.transforms import (bits_schedule,
+                                                  fake_quantize_ste,
+                                                  magnitude_mask)
+from deepspeed_tpu.models import gpt
+from tests.unit.common import TINY_GPT, base_config, make_mesh, random_tokens
+from deepspeed_tpu.runtime.model import from_gpt
+
+
+# ------------------------------------------------------------- transforms
+
+def test_fake_quant_values_on_grid():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), jnp.float32)
+    q = fake_quantize_ste(w, 4, symmetric=True)
+    # 4-bit symmetric: at most 15 distinct levels
+    assert len(np.unique(np.asarray(q))) <= 15
+    # quantization error bounded by half a step
+    scale = float(jnp.max(jnp.abs(w))) / 7
+    assert float(jnp.max(jnp.abs(q - w))) <= scale / 2 + 1e-6
+
+
+def test_fake_quant_ste_gradient_is_identity():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(32, 32)), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(fake_quantize_ste(x, 4) ** 2))(w)
+    # STE: d/dw sum(q(w)^2) = 2*q(w) exactly (identity through the rounding)
+    np.testing.assert_allclose(np.asarray(g),
+                               2 * np.asarray(fake_quantize_ste(w, 4)),
+                               rtol=1e-6)
+
+
+def test_bits_schedule_halves_to_target():
+    steps = jnp.asarray([0, 99, 100, 199, 200, 1000])
+    bits = [float(bits_schedule(s, 8, 2, offset=100, period=100)) for s in steps]
+    assert bits == [8.0, 8.0, 4.0, 4.0, 2.0, 2.0]
+
+
+def test_magnitude_mask_ratio():
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(64, 64)), jnp.float32)
+    mask = magnitude_mask(w, 0.25)
+    assert abs(float(jnp.mean(mask)) - 0.25) < 0.02
+    # structured: whole output rows
+    mask_r = magnitude_mask(w, 0.5, axis=(0,))
+    assert mask_r.shape == (1, 64)
+
+
+# ------------------------------------------------------- init_compression
+
+WQ_CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                  "quantization_type": "symmetric"},
+            "different_groups": {
+                "wq_group": {"params": {"start_bits": 8, "target_bits": 8},
+                             "modules": ["blocks"]}},
+        },
+    },
+}
+
+
+def _model():
+    return from_gpt(TINY_GPT)
+
+
+def test_init_compression_gates_on_schedule_offset():
+    """Before schedule_offset the compressed loss equals the raw loss;
+    after, it differs (weights quantized)."""
+    from deepspeed_tpu.compression.compress import STEP_KEY
+    model = _model()
+    comp = init_compression(model, WQ_CONFIG)
+    params = gpt.init(TINY_GPT, jax.random.PRNGKey(0))
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(4, 16, seed=0))
+
+    raw = float(model.loss_fn(params, batch))
+    before = float(jax.jit(comp.loss_fn)(params, {**batch, STEP_KEY: jnp.int32(1)}))
+    after = float(jax.jit(comp.loss_fn)(params, {**batch, STEP_KEY: jnp.int32(2)}))
+    assert before == pytest.approx(raw, rel=1e-6)
+    assert after != pytest.approx(raw, rel=1e-7)
+
+
+def test_compressed_training_end_to_end():
+    """QAT through the engine: scheduler stepped, loss decreases."""
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=init_compression(_model(), WQ_CONFIG),
+        config={**base_config(micro_batch=2), **WQ_CONFIG},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    assert engine._compression_scheduler is not None
+    batch = random_tokens(16, 16, seed=0)
+    losses = [float(engine.train_batch_fused(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert engine._compression_scheduler.training_steps == 6
+
+
+def test_sparse_and_row_pruning():
+    cfg = {
+        "compression_training": {
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                      "method": "l1"},
+                "different_groups": {
+                    "sp": {"params": {"dense_ratio": 0.5},
+                           "modules": ["blocks/wi"]}}},
+            "row_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0},
+                "different_groups": {
+                    "rp": {"params": {"dense_ratio": 0.5},
+                           "modules": ["blocks/wo_mlp"]}}},
+        },
+    }
+    params = gpt.init(TINY_GPT, jax.random.PRNGKey(0))
+    cleaned = redundancy_clean(params, cfg)
+    wi = np.asarray(cleaned["blocks"]["wi"])
+    assert abs((wi != 0).mean() - 0.5) < 0.02            # unstructured
+    wo = np.asarray(cleaned["blocks"]["wo_mlp"])         # [L, f, d] rows=d
+    col_alive = (np.abs(wo).sum(axis=1) > 0)             # per (layer, row)
+    assert col_alive.mean() == pytest.approx(0.5, abs=0.05)  # whole rows died
+    # untouched tensors stay untouched
+    np.testing.assert_array_equal(np.asarray(cleaned["wte"]),
+                                  np.asarray(params["wte"]))
+
+
+def test_head_pruning_zeroes_whole_heads():
+    cfg = {
+        "compression_training": {
+            "head_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                      "num_heads": TINY_GPT.n_head},
+                "different_groups": {
+                    "hp": {"params": {"dense_ratio": 0.5},
+                           "modules": ["blocks/wo$"]}}},
+        },
+    }
+    params = gpt.init(TINY_GPT, jax.random.PRNGKey(0))
+    cleaned = redundancy_clean(params, cfg)
+    wo = np.asarray(cleaned["blocks"]["wo"])  # [L, h, hd, d]
+    head_alive = np.abs(wo).sum(axis=(2, 3)) > 0  # [L, h]
+    # per layer, ~half the heads survive, and dead heads are fully zero
+    assert head_alive.mean() == pytest.approx(0.5, abs=0.13)
+
+
+def test_layer_reduction_slices_teacher():
+    cfg = {
+        "compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 1,
+                                "teacher_layer": [1]},
+        },
+    }
+    teacher = gpt.init(TINY_GPT, jax.random.PRNGKey(0))
+    student_spec = init_compression(_model(), cfg, teacher_params=teacher)
+    assert student_spec.meta["config"].n_layer == 1
+    np.testing.assert_array_equal(
+        np.asarray(student_spec.params["blocks"]["wqkv"][0]),
+        np.asarray(teacher["blocks"]["wqkv"][1]))
+    # the slimmed spec trains
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(4, 16, seed=0))
+    loss = jax.jit(student_spec.loss_fn)(student_spec.params, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_activation_quantization_hook():
+    cfg = {
+        "compression_training": {
+            "activation_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "quantization_type": "symmetric"},
+                "different_groups": {"aq": {"params": {"bits": 8}}}},
+        },
+    }
+    comp = init_compression(_model(), cfg)
+    assert comp.meta["config"].act_quant_bits == 8
+    params = gpt.init(comp.meta["config"], jax.random.PRNGKey(0))
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(4, 16, seed=0))
+    raw = float(_model().loss_fn(params, batch))
+    quant = float(jax.jit(comp.loss_fn)(params, batch))
+    assert np.isfinite(quant) and quant != pytest.approx(raw, rel=1e-7)
+
+
+def test_scheduler_reports_bits():
+    sched = CompressionScheduler({**WQ_CONFIG})
+    g = sched.config.weight_quantization.groups[0]
+    assert sched.current_bits(g) == 8.0
+    for _ in range(3):
+        sched.step()
+    st = sched.state()
+    assert st["weight_quantization"]["wq_group"]["active"]
+
+
+def test_rejects_pipeline_models():
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt_pipeline
+    mm = make_mesh(dp=4, pp=2)
+    pcfg = gpt_pipeline.GPTPipeConfig(
+        vocab_size=256, max_seq_len=64, n_layer=2, n_head=4, d_model=64,
+        dtype=jnp.float32, num_stages=2, num_micro_batches=2, vocab_round_to=128)
+    with pytest.raises(ValueError, match="pipeline"):
+        init_compression(gpt_pipeline.model_spec(pcfg, mm.mesh), WQ_CONFIG)

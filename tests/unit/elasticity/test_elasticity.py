@@ -178,7 +178,20 @@ def test_preemption_resume_continues_loss_curve(tmp_path):
     np.testing.assert_allclose(res2["losses"], ref_losses[4:], rtol=1e-4)
 
 
-def test_runner_validates_elastic_world_size(tmp_path):
+def test_runner_latches_elastic_config(tmp_path, monkeypatch):
+    """A restarted runner with an edited elasticity section must fail."""
+    monkeypatch.delenv(EC.DEEPSPEED_ELASTICITY_CONFIG, raising=False)
+    mm = make_mesh(dp=8)
+    eng = _make_engine(mm)
+    good = dict(ELASTIC, max_gpus=8)
+    ElasticTrainRunner(eng, str(tmp_path), ds_config={"elasticity": good})
+    edited = dict(good, max_train_batch_size=48)
+    with pytest.raises(ElasticityConfigError):
+        ElasticTrainRunner(eng, str(tmp_path), ds_config={"elasticity": edited})
+
+
+def test_runner_validates_elastic_world_size(tmp_path, monkeypatch):
+    monkeypatch.delenv(EC.DEEPSPEED_ELASTICITY_CONFIG, raising=False)
     mm = make_mesh(dp=8)
     eng = _make_engine(mm)
     bad = dict(ELASTIC, min_gpus=1, max_gpus=8,
