@@ -19,6 +19,7 @@ from . import comm as _comm_pkg  # noqa: F401
 from .comm.comm import init_distributed
 from .parallel.mesh import (MeshManager, ParallelDims, get_mesh_manager,
                             initialize_mesh)
+from .runtime.activation_checkpointing import checkpointing
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine
 from .runtime.model import ModelSpec, from_gpt

@@ -1,0 +1,6 @@
+from .deepspeed_checkpoint import DeepSpeedCheckpoint
+from .universal_checkpoint import (ds_to_universal, load_universal,
+                                   load_universal_into_engine)
+
+__all__ = ["DeepSpeedCheckpoint", "ds_to_universal", "load_universal",
+           "load_universal_into_engine"]

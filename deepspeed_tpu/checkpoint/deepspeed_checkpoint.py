@@ -1,0 +1,118 @@
+"""Checkpoint inspection + reshape toolkit.
+
+Counterpart of the reference's ``deepspeed/checkpoint/deepspeed_checkpoint.py``
+(``DeepSpeedCheckpoint`` :37 with the 3D tp/pp/dp reshape machinery,
+``reshape_meg_2d.py:75``).  The reference's checkpoints are per-rank files
+whose reshaping needs merge/split index math; this framework's are global
+logical arrays, so "reshape" degenerates to loading under a different mesh
+— what this class provides instead is the inspection surface (tags,
+tensors, shapes, client state, param/layer census) and slicing previews
+(how a tensor would shard on a hypothetical mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..runtime.checkpoint_engine.native_checkpoint_engine import (
+    SEP, NativeCheckpointEngine)
+
+PyTree = Any
+
+
+class DeepSpeedCheckpoint:
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.dir = ckpt_dir
+        if tag is None:
+            latest = os.path.join(ckpt_dir, "latest")
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+            else:
+                tags = self.get_tags()
+                if not tags:
+                    raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+                tag = tags[-1]
+        self.tag = tag
+        self._eng = NativeCheckpointEngine()
+        self._model: Optional[Dict[str, np.ndarray]] = None
+        self._optim: Optional[Dict[str, np.ndarray]] = None
+
+    # ------------------------------------------------------------- contents
+    def get_tags(self) -> List[str]:
+        return sorted(d for d in os.listdir(self.dir)
+                      if os.path.isdir(os.path.join(self.dir, d)))
+
+    @property
+    def model(self) -> Dict[str, np.ndarray]:
+        if self._model is None:
+            self._model = self._eng.load(
+                os.path.join(self.dir, self.tag, "model_states.npz"))
+        return self._model
+
+    @property
+    def optim(self) -> Dict[str, np.ndarray]:
+        if self._optim is None:
+            path = os.path.join(self.dir, self.tag, "optim_states.npz")
+            self._optim = self._eng.load(path) if os.path.exists(path) else {}
+        return self._optim
+
+    def client_state(self) -> Dict[str, Any]:
+        path = os.path.join(self.dir, self.tag, "client_state.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
+    def parameter_names(self) -> List[str]:
+        return sorted(k[len("params" + SEP):] for k in self.model
+                      if k.startswith("params" + SEP))
+
+    def num_parameters(self) -> int:
+        return sum(v.size for k, v in self.model.items()
+                   if k.startswith("params" + SEP))
+
+    def num_layers(self) -> int:
+        """Depth of the scan-stacked block dim (0 when not layer-stacked)."""
+        for k, v in self.model.items():
+            if SEP + "blocks" + SEP in k or k.startswith("params/blocks/"):
+                return int(v.shape[0])
+        return 0
+
+    def show(self) -> str:
+        lines = [f"checkpoint {self.dir} @ {self.tag}",
+                 f"  params: {self.num_parameters():,} "
+                 f"({len(self.parameter_names())} tensors, "
+                 f"{self.num_layers()} stacked layers)"]
+        cs = self.client_state()
+        if cs:
+            lines.append(f"  step: {cs.get('global_steps')} "
+                         f"samples: {cs.get('global_samples')}")
+        for name in self.parameter_names():
+            arr = self.model["params" + SEP + name]
+            lines.append(f"  {name:40s} {str(arr.shape):20s} {arr.dtype}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------- reshape preview
+    def shard_preview(self, name: str, mesh_shape: Dict[str, int],
+                      spec: List[Optional[str]]) -> List[tuple]:
+        """Per-device shard shapes a tensor would take under a mesh/spec —
+        the planning view the reference's reshape tools provide."""
+        arr = self.model["params" + SEP + name]
+        shape = list(arr.shape)
+        for dim, ax in enumerate(spec):
+            if ax is None:
+                continue
+            n = mesh_shape.get(ax, 1)
+            if shape[dim] % n:
+                raise ValueError(
+                    f"dim {dim} of {name} ({shape[dim]}) not divisible by "
+                    f"mesh axis {ax}={n}")
+            shape[dim] //= n
+        n_shards = int(np.prod([mesh_shape.get(a, 1)
+                                for a in spec if a is not None]))
+        return [tuple(shape)] * max(n_shards, 1)

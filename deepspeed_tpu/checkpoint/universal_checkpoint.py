@@ -1,0 +1,141 @@
+"""Universal checkpoints: parallelism-independent per-parameter storage.
+
+Counterpart of the reference's ``deepspeed/checkpoint/universal_checkpoint.py``
+(:13) and the ``ds_to_universal`` conversion flow.  The reference must
+un-flatten ZeRO partitions and re-slice tp/pp fragments to build per-param
+fp32 files; this framework's native checkpoints already store *global
+logical arrays* (sharding is a load-time device_put), so the universal
+format here is an exploded directory of one ``.npy`` per tensor plus a
+metadata manifest:
+
+    universal_dir/
+      meta.json                  # names, shapes, dtypes, client state
+      model/<flat-name>.npy      # params (+ loss-scale state)
+      optim/<flat-name>.npy      # fp32 master + optimizer moments
+
+Any engine — different dp/tp/pp/ep degree, different offload mode — loads
+it with ``load_universal_into_engine``; elastic resharding is inherent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.checkpoint_engine.native_checkpoint_engine import (
+    SEP, NativeCheckpointEngine, _put_like, flatten_tree, unflatten_into)
+from ..utils.logging import logger
+
+PyTree = Any
+
+
+def _safe(name: str) -> str:
+    return name.replace(SEP, "__")
+
+
+def _unsafe(name: str) -> str:
+    return name.replace("__", SEP)
+
+
+def ds_to_universal(load_dir: str, out_dir: str,
+                    tag: Optional[str] = None) -> Dict[str, Any]:
+    """Convert a native engine checkpoint into the universal layout.
+
+    Returns the manifest.  (The reference's ``ds_to_universal.py`` offline
+    tool; here no merging is needed — tensors are already global.)
+    """
+    eng = NativeCheckpointEngine()
+    if tag is None:
+        with open(os.path.join(load_dir, "latest")) as f:
+            tag = f.read().strip()
+    ckpt = os.path.join(load_dir, tag)
+    manifest: Dict[str, Any] = {"tag": tag, "tensors": {}}
+    for group, fname in (("model", "model_states.npz"),
+                         ("optim", "optim_states.npz")):
+        flat = eng.load(os.path.join(ckpt, fname))
+        gdir = os.path.join(out_dir, group)
+        os.makedirs(gdir, exist_ok=True)
+        for key, arr in flat.items():
+            np.save(os.path.join(gdir, _safe(key) + ".npy"), arr)
+            manifest["tensors"][f"{group}{SEP}{key}"] = {
+                "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    client_path = os.path.join(ckpt, "client_state.json")
+    if os.path.exists(client_path):
+        with open(client_path) as f:
+            manifest["client_state"] = json.load(f)
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    logger.info(f"universal checkpoint written to {out_dir} "
+                f"({len(manifest['tensors'])} tensors)")
+    return manifest
+
+
+def load_universal(universal_dir: str) -> Tuple[Dict[str, np.ndarray],
+                                                Dict[str, np.ndarray],
+                                                Dict[str, Any]]:
+    """Read a universal dir → (model_flat, optim_flat, manifest)."""
+    with open(os.path.join(universal_dir, "meta.json")) as f:
+        manifest = json.load(f)
+    out = {"model": {}, "optim": {}}
+    for group in ("model", "optim"):
+        gdir = os.path.join(universal_dir, group)
+        if not os.path.isdir(gdir):
+            continue
+        for fn in os.listdir(gdir):
+            if fn.endswith(".npy"):
+                out[group][_unsafe(fn[:-4])] = np.load(os.path.join(gdir, fn))
+    return out["model"], out["optim"], manifest
+
+
+def load_universal_into_engine(engine, universal_dir: str,
+                               load_optimizer_states: bool = True) -> None:
+    """Resume any engine from a universal checkpoint (reference
+    ``load_universal_checkpoint``, engine.py:751) — the engine's own
+    sharding plan re-shards every tensor on device_put."""
+    model_flat, optim_flat, manifest = load_universal(universal_dir)
+    state = engine.state
+    sh = engine._out_shardings
+    new_state = dict(state)
+    new_state["params"] = _put_like(
+        state["params"], unflatten_into(state["params"], model_flat,
+                                        "params" + SEP), sh.get("params"))
+    if "scale" + SEP + "loss_scale" in model_flat or any(
+            k.startswith("scale" + SEP) for k in model_flat):
+        new_state["scale"] = _put_like(
+            state["scale"], unflatten_into(state["scale"], model_flat,
+                                           "scale" + SEP), sh.get("scale"))
+    if load_optimizer_states and optim_flat:
+        missing: list = []
+        opt = unflatten_into(state["opt_state"], optim_flat,
+                             "opt_state" + SEP, missing=missing)
+        new_state["opt_state"] = _put_like(state["opt_state"], opt,
+                                           sh.get("opt_state"))
+        if any(k.startswith("master" + SEP) for k in optim_flat):
+            new_state["master"] = _put_like(
+                state["master"], unflatten_into(state["master"], optim_flat,
+                                                "master" + SEP),
+                sh.get("master"))
+        else:
+            new_state["master"] = new_state["params"]
+        if any(k.startswith("grad_acc" + SEP) for k in optim_flat):
+            new_state["grad_acc"] = _put_like(
+                state["grad_acc"], unflatten_into(state["grad_acc"],
+                                                  optim_flat,
+                                                  "grad_acc" + SEP),
+                sh.get("grads"))
+        if missing:
+            logger.warning(f"universal load: {len(missing)} optimizer "
+                           f"tensors absent; keeping initialized values")
+    engine.state = new_state
+    cs = manifest.get("client_state", {})
+    engine.micro_steps = cs.get("micro_steps", engine.micro_steps)
+    engine.global_steps = cs.get("global_steps", engine.global_steps)
+    engine.global_samples = cs.get("global_samples", engine.global_samples)
+    logger.info(f"universal checkpoint {universal_dir} loaded "
+                f"(step {engine.global_steps})")

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models import gpt, gpt_inference
+from ..models import gpt
 from ..parallel.mesh import MODEL_AXIS, MeshManager, get_mesh_manager
 from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
@@ -61,10 +61,24 @@ class InferenceEngine:
             logger.warning(
                 f"mesh has model={mesh_tp} but tensor_parallel disabled in "
                 "the inference config; serving replicated (unsharded)")
+        # model-family dispatch: dense GPT vs MoE (reference MoE inference,
+        # ops/transformer/inference/moe_inference.py + engine.py:190 expert
+        # groups — here the expert mesh axis shards the expert stacks)
+        from ..models.gpt_moe import GPTMoEConfig
+        cfg = self.model_config
+        if isinstance(cfg, GPTMoEConfig):
+            from ..models import gpt_moe, gpt_moe_inference as fam
+            self._apply_fn = lambda p, t: gpt_moe.apply(p, t, cfg,
+                                                        train=False)[0]
+            self._logical_axes = gpt_moe.logical_axes(cfg)
+        else:
+            from ..models import gpt_inference as fam
+            self._apply_fn = lambda p, t: gpt.apply(p, t, cfg)
+            self._logical_axes = gpt.logical_axes(cfg)
+        self._family = fam
         if want_tp:
             self._shard_params_tp()
-        cfg = self.model_config
-        self._forward_jit = jax.jit(lambda p, t: gpt.apply(p, t, cfg))
+        self._forward_jit = jax.jit(self._apply_fn)
         self._generate_cache: Dict[Tuple, Any] = {}
 
     # ------------------------------------------------------------------- tp
@@ -74,8 +88,7 @@ class InferenceEngine:
         ReplaceWithTensorSlicing, done declaratively)."""
         from ..models.partitioning import TP_RULES, tree_shardings
         mesh = self.mesh_manager.mesh
-        axes = gpt.logical_axes(self.model_config)
-        shardings = tree_shardings(axes, mesh, TP_RULES)
+        shardings = tree_shardings(self._logical_axes, mesh, TP_RULES)
         self.params = jax.tree_util.tree_map(
             jax.device_put, self.params, shardings)
         logger.info(f"[inference] TP sharding over model axis "
@@ -91,46 +104,79 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- generate
 
-    def _build_generate(self, max_len: int, max_new: int, greedy: bool):
+    def _build_generate(self, max_len: int, max_new: int, greedy: bool,
+                        eos: Optional[int], top_k: int, top_p: float):
         cfg = self.model_config
+
+        fam = self._family
+
+        def pick(lg, key, temperature):
+            lg = lg[:, :cfg.vocab_size]
+            if greedy:
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            lg = lg / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            if top_p < 1.0:
+                # nucleus: mask tokens outside the smallest top-p mass set
+                sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_lg, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # keep everything strictly inside the nucleus plus the
+                # first token that crosses p
+                keep_sorted = cum - probs < top_p
+                cutoff = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # >= 1
+                kth = jnp.take_along_axis(sorted_lg, cutoff - 1, axis=-1)
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            return jax.random.categorical(key, lg).astype(jnp.int32)
 
         def run(params, tokens, prompt_len, key, temperature):
             B, S = tokens.shape
-            cache = gpt_inference.init_cache(cfg, B, max_len)
-            logits, cache = gpt_inference.prefill(params, tokens, cfg, cache)
+            cache = fam.init_cache(cfg, B, max_len)
+            logits, cache = fam.prefill(params, tokens, cfg, cache)
             # logits at the last *prompt* token predict the first new token
             last = logits[jnp.arange(B), prompt_len - 1]
-            out = jnp.zeros((B, max_new), jnp.int32)
+            out = jnp.full((B, max_new), eos if eos is not None else 0,
+                           jnp.int32)
+            done0 = jnp.zeros((B,), bool)
 
-            def pick(lg, key):
-                lg = lg[:, :cfg.vocab_size]
-                if greedy:
-                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                return jax.random.categorical(
-                    key, lg / jnp.maximum(temperature, 1e-6)).astype(jnp.int32)
+            def cond(st):
+                i, _, _, _, _, done = st
+                return jnp.logical_and(i < max_new, ~jnp.all(done))
 
-            def body(i, st):
-                out, last, cache, key = st
+            def body(st):
+                i, out, last, cache, key, done = st
                 key, sub = jax.random.split(key)
-                nxt = pick(last, sub)
+                nxt = pick(last, sub, temperature)
+                if eos is not None:
+                    # rows that already finished keep emitting eos
+                    nxt = jnp.where(done, jnp.int32(eos), nxt)
                 out = out.at[:, i].set(nxt)
-                logits, cache = gpt_inference.decode_step(params, nxt, cfg,
-                                                          cache)
-                return out, logits, cache, key
+                if eos is not None:
+                    done = jnp.logical_or(done, nxt == eos)
+                logits, cache = fam.decode_step(params, nxt, cfg, cache)
+                return i + 1, out, logits, cache, key, done
 
-            out, _, cache, _ = lax.fori_loop(0, max_new, body,
-                                             (out, last, cache, key))
+            _, out, _, cache, _, _ = lax.while_loop(
+                cond, body, (jnp.int32(0), out, last, cache, key, done0))
             return out
 
         return jax.jit(run)
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 top_k: int = 0, top_p: float = 1.0,
                  key: Optional[jax.Array] = None) -> jnp.ndarray:
         """Autoregressive generation; the whole loop is one XLA program.
 
         tokens: [B, S] prompt (right-aligned padding NOT supported — pass
-        equal-length prompts or left-pad).  Returns [B, max_new_tokens].
+        equal-length prompts; ragged prompts need pad-masked cache
+        attention, not yet implemented).  ``eos_token_id`` stops early once
+        every row has emitted it (finished rows keep emitting eos);
+        ``top_k``/``top_p`` shape the sampling distribution.
+        Returns [B, max_new_tokens].
         """
         tokens = jnp.asarray(tokens, jnp.int32)
         B, S = tokens.shape
@@ -144,10 +190,12 @@ class InferenceEngine:
         # amortize across nearby lengths)
         max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
         max_len = min(max_len, self.model_config.max_seq_len)
-        sig = (max_len, max_new_tokens, not do_sample)
+        sig = (max_len, max_new_tokens, not do_sample, eos_token_id,
+               top_k, top_p)
         if sig not in self._generate_cache:
             self._generate_cache[sig] = self._build_generate(
-                max_len, max_new_tokens, greedy=not do_sample)
+                max_len, max_new_tokens, greedy=not do_sample,
+                eos=eos_token_id, top_k=top_k, top_p=top_p)
         key = key if key is not None else jax.random.PRNGKey(0)
         return self._generate_cache[sig](
             self.params, tokens, jnp.full((tokens.shape[0],), S, jnp.int32),

@@ -26,7 +26,10 @@ from ..utils.logging import logger
 def parse_args(args=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--world_info", type=str, required=True)
-    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--node_rank", type=int, default=-1)
+    parser.add_argument("--node_rank_env", type=str, default="",
+                        help="env var carrying the node rank (MPI/SLURM "
+                             "launchers: OMPI_COMM_WORLD_RANK, SLURM_PROCID)")
     parser.add_argument("--master_addr", type=str, required=True)
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("user_script", type=str)
@@ -36,6 +39,12 @@ def parse_args(args=None):
 
 def main(args=None) -> int:
     args = parse_args(args)
+    if args.node_rank < 0:
+        if not args.node_rank_env or args.node_rank_env not in os.environ:
+            raise SystemExit(
+                "launch.py needs --node_rank or --node_rank_env naming a "
+                "set env var (MPI/SLURM rank variable)")
+        args.node_rank = int(os.environ[args.node_rank_env])
     world_info = OrderedDict(json.loads(
         base64.urlsafe_b64decode(args.world_info.encode())))
     hosts = list(world_info)

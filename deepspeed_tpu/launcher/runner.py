@@ -44,8 +44,10 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"],
-                        help="multi-node transport")
+                        choices=["ssh", "pdsh", "local", "openmpi", "slurm",
+                                 "mvapich"],
+                        help="multi-node transport (reference "
+                             "multinode_runner.py backends)")
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--autotuning", type=str, default="",
@@ -196,7 +198,22 @@ def main(args=None) -> int:
         logger.info(f"launch: {' '.join(map(shlex.quote, cmd))}")
         return subprocess.call(cmd)
 
-    # multi-node over ssh/pdsh: one launch.py per host
+    # scheduler-backed launchers (reference multinode_runner.py): one
+    # launch.py per host via the chosen backend
+    if args.launcher in ("pdsh", "openmpi", "slurm", "mvapich"):
+        from .multinode_runner import RUNNERS
+        runner = RUNNERS[args.launcher](args, world_info)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"launcher backend {args.launcher!r} not found on PATH")
+        for k, v in _export_env().items():
+            runner.add_export(k, v)
+        env = dict(os.environ)
+        cmd = runner.get_cmd(env, pool)  # runners may mutate env (pdsh rcmd)
+        logger.info(f"[{args.launcher}] {' '.join(map(shlex.quote, cmd))}")
+        return subprocess.call(cmd, env=env)
+
+    # plain ssh fallback: one launch.py per host
     procs = []
     env_exports = " ".join(f"{k}={shlex.quote(v)}"
                            for k, v in _export_env().items())
@@ -205,11 +222,7 @@ def main(args=None) -> int:
                                  args.user_script] + args.user_args
         remote = f"cd {shlex.quote(os.getcwd())} && {env_exports} " + \
             " ".join(map(shlex.quote, node_cmd))
-        if args.launcher == "pdsh":
-            ssh_cmd = ["pdsh", "-w", host, *shlex.split(args.launcher_args),
-                       remote]
-        else:
-            ssh_cmd = ["ssh", *shlex.split(args.launcher_args), host, remote]
+        ssh_cmd = ["ssh", *shlex.split(args.launcher_args), host, remote]
         logger.info(f"[{host}] {' '.join(map(shlex.quote, ssh_cmd))}")
         procs.append(subprocess.Popen(ssh_cmd))
     rc = 0

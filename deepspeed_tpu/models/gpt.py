@@ -55,6 +55,28 @@ class GPTConfig:
     # a SparsityConfig instance routes attention through the block-sparse
     # kernel (reference SparseSelfAttention in BERT-style models)
     sparse_attention: Optional[Any] = None
+    # ---- architecture variants (covering the reference's injection-policy
+    # breadth: GPT-2/OPT learned positions, BLOOM alibi, NeoX/GPT-J rotary)
+    pos_embed: str = "learned"          # learned | rotary | alibi | none
+    rotary_pct: float = 1.0             # NeoX rotates only a fraction
+    rotary_base: float = 10000.0
+    rotary_interleaved: bool = False    # GPT-J pairs dims; NeoX splits halves
+    activation: str = "gelu"            # gelu | relu
+    parallel_residual: bool = False     # NeoX: x + attn(ln1 x) + mlp(ln2 x)
+    tie_word_embeddings: bool = True    # False -> separate lm_head param
+    pos_offset: int = 0                 # OPT stores positions offset by 2
+    embed_layernorm: bool = False       # BLOOM's word_embeddings_layernorm
+
+    def __post_init__(self):
+        # alibi routes attention through its own biased-dense path; make the
+        # non-composition with SP/sparse kernels loud rather than silently
+        # ignoring the configured parallelism (same policy as the pipeline
+        # config's asserts)
+        if self.pos_embed == "alibi":
+            assert not self.sequence_parallel, \
+                "alibi attention does not compose with sequence_parallel yet"
+            assert self.sparse_attention is None, \
+                "alibi attention does not compose with sparse_attention yet"
 
     @property
     def ffn_dim(self) -> int:
@@ -123,20 +145,27 @@ def init(config: GPTConfig, rng: jax.Array) -> PyTree:
         "wo_mlp": _normal(keys[3], (L, f, d), resid_std, pdt),
         "bo_mlp": jnp.zeros((L, d), pdt),
     }
-    return {
+    params = {
         "wte": _normal(keys[4], (v, d), std, pdt),
-        "wpe": _normal(keys[5], (config.max_seq_len, d), std, pdt),
         "blocks": block,
         "lnf_scale": jnp.ones((d,), pdt),
         "lnf_bias": jnp.zeros((d,), pdt),
     }
+    if config.pos_embed == "learned":
+        params["wpe"] = _normal(
+            keys[5], (config.max_seq_len + config.pos_offset, d), std, pdt)
+    if not config.tie_word_embeddings:
+        params["lm_head"] = _normal(keys[6], (v, d), std, pdt)
+    if config.embed_layernorm:
+        params["emb_ln_scale"] = jnp.ones((d,), pdt)
+        params["emb_ln_bias"] = jnp.zeros((d,), pdt)
+    return params
 
 
 def logical_axes(config: GPTConfig) -> PyTree:
     """Per-dim logical axis names mirroring ``init``'s tree."""
-    return {
+    axes = {
         "wte": (VOCAB, EMBED),
-        "wpe": (SEQ, EMBED),
         "blocks": {
             "ln1_scale": (LAYERS, EMBED),
             "ln1_bias": (LAYERS, EMBED),
@@ -154,6 +183,14 @@ def logical_axes(config: GPTConfig) -> PyTree:
         "lnf_scale": (EMBED,),
         "lnf_bias": (EMBED,),
     }
+    if config.pos_embed == "learned":
+        axes["wpe"] = (SEQ, EMBED)
+    if not config.tie_word_embeddings:
+        axes["lm_head"] = (VOCAB, EMBED)
+    if config.embed_layernorm:
+        axes["emb_ln_scale"] = (EMBED,)
+        axes["emb_ln_bias"] = (EMBED,)
+    return axes
 
 
 # -------------------------------------------------------------------- apply
@@ -166,8 +203,87 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
+def _rotate(x, positions, config: GPTConfig):
+    """Rotary position embedding on [B, S, H, D].
+
+    ``rotary_pct`` < 1 rotates only the leading fraction of head dims
+    (NeoX); ``rotary_interleaved`` pairs (0,1),(2,3)… dims (GPT-J) instead
+    of the NeoX half-split (i, i+rot/2) convention.
+    """
+    D = x.shape[-1]
+    rot = int(D * config.rotary_pct) // 2 * 2
+    if rot == 0:
+        return x
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    inv = 1.0 / (config.rotary_base **
+                 (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]   # [S, rot/2]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    if config.rotary_interleaved:
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+        out = out.reshape(x_rot.shape)
+    else:
+        x1, x2 = x_rot[..., :rot // 2], x_rot[..., rot // 2:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+    return jnp.concatenate([out, x_pass], axis=-1)
+
+
+def alibi_slopes(n_head: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (Press et al.): geometric from 2^(-8/n); the
+    non-power-of-two tail interleaves slopes of the doubled ladder."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-8.0 / n)
+        return [start ** (i + 1) for i in range(n)]
+
+    floor = 1 << (n_head.bit_length() - 1)  # largest power of two <= n_head
+    if floor == n_head:
+        slopes = pow2_slopes(n_head)
+    else:
+        slopes = pow2_slopes(floor)
+        slopes += pow2_slopes(2 * floor)[0::2][:n_head - floor]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def _alibi_attention(q, k, v, config: GPTConfig, q_positions=None):
+    """Dense causal attention with the ALiBi bias (BLOOM family).
+    q: [B,Sq,H,D] at absolute positions q_positions (default end-aligned)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q_pos = (jnp.arange(Sq) + (Sk - Sq)) if q_positions is None else q_positions
+    k_pos = jnp.arange(Sk)
+    # bias = -slope * distance; 0 on the diagonal
+    dist = q_pos[:, None] - k_pos[None, :]                       # [Sq, Sk]
+    bias = -alibi_slopes(H)[:, None, None] * dist[None].astype(jnp.float32)
+    s = s + bias[None]
+    mask = dist >= 0
+    s = jnp.where(mask[None, None], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def _activation_fn(x, config: GPTConfig):
+    if config.activation == "relu":
+        return jax.nn.relu(x)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _dropout(x, rate: float, key):
+    if key is None or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
 def _attention(q, k, v, config: GPTConfig):
     """Causal MHA. q,k,v: [B, S, H, D]."""
+    if config.pos_embed == "alibi":
+        return _alibi_attention(q, k, v, config)
     if config.sequence_parallel:
         from ..parallel.mesh import SEQ_AXIS, get_mesh_manager
         mm = get_mesh_manager(optional=True)
@@ -189,37 +305,57 @@ def _attention(q, k, v, config: GPTConfig):
     return mha_reference(q, k, v, causal=True)
 
 
-def qkv_proj(x, p, config: GPTConfig):
+def qkv_proj(x, p, config: GPTConfig, positions=None):
     """LN1 + qkv projection: [B,S,d] → (q, k, v) each [B,S,H,Dh].
 
     Shared by training (_block) and inference (gpt_inference prefill/decode)
-    so the block math has one source of truth.
+    so the block math has one source of truth.  Rotary embedding (when
+    configured) rotates q/k at ``positions`` (default 0..S-1).
     """
     cdt = config.dtype
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) + p["bqkv"].astype(cdt)
-    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if config.pos_embed == "rotary":
+        if positions is None:
+            positions = jnp.arange(x.shape[1])
+        q = _rotate(q, positions, config)
+        k = _rotate(k, positions, config)
+    return q, k, v
 
 
-def attn_out_residual(x, attn, p, config: GPTConfig):
-    """Attention output projection + residual: x + W_o·attn."""
+def attn_project(attn, p, config: GPTConfig):
+    """Attention output projection W_o·attn + b_o (no residual) — the one
+    definition every train/inference/MoE path shares."""
     cdt = config.dtype
-    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) + p["bo"].astype(cdt)
-    return x + attn_out
+    return jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
+        + p["bo"].astype(cdt)
 
 
-def mlp_residual(x, p, config: GPTConfig):
-    """LN2 + MLP + residual (the dense FFN half-block)."""
+def attn_out_residual(x, attn, p, config: GPTConfig, dropout_key=None):
+    """Attention output projection + residual: x + W_o·attn."""
+    return x + _dropout(attn_project(attn, p, config), config.dropout,
+                        dropout_key)
+
+
+def mlp_out(x, p, config: GPTConfig, dropout_key=None):
+    """LN2 + MLP (no residual add — parallel-residual models sum it with
+    the attention branch instead of chaining)."""
     cdt = config.dtype
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
-    ff = jax.nn.gelu(ff, approximate=True)
+    ff = _activation_fn(ff, config)
     if config.act_quant_bits is not None:
         from ..compression.transforms import quantize_activation
         ff = quantize_activation(ff, config.act_quant_bits,
                                  symmetric=config.act_quant_symmetric)
     ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
-    return x + ff_out
+    return _dropout(ff_out, config.dropout, dropout_key)
+
+
+def mlp_residual(x, p, config: GPTConfig, dropout_key=None):
+    """LN2 + MLP + residual (the dense FFN half-block)."""
+    return x + mlp_out(x, p, config, dropout_key)
 
 
 def block_tail(x, attn, p, config: GPTConfig):
@@ -227,30 +363,81 @@ def block_tail(x, attn, p, config: GPTConfig):
     return mlp_residual(attn_out_residual(x, attn, p, config), p, config)
 
 
-def _attn_residual(x, layer_params, config: GPTConfig):
+def _attn_residual(x, layer_params, config: GPTConfig, positions=None,
+                   dropout_key=None):
     """Full attention sublayer with residual: x + W_o·attn(qkv(LN1(x))).
 
     Used by the MoE model (gpt_moe._moe_half_block), whose FFN half is an
     expert layer instead of mlp_residual.
     """
     p = layer_params
-    q, k, v = qkv_proj(x, p, config)
+    q, k, v = qkv_proj(x, p, config, positions=positions)
     attn = _attention(q, k, v, config)
-    return attn_out_residual(x, attn, p, config)
+    return attn_out_residual(x, attn, p, config, dropout_key)
 
 
-def _block(x, layer_params, config: GPTConfig):
+def _block(x, layer_params, config: GPTConfig, positions=None,
+           dropout_key=None):
     """One transformer block on [B, S, d]."""
-    return mlp_residual(_attn_residual(x, layer_params, config),
-                        layer_params, config)
+    k_attn = k_mlp = None
+    if dropout_key is not None:
+        k_attn, k_mlp = jax.random.split(dropout_key)
+    if config.parallel_residual:
+        # NeoX: both sublayers read the SAME input; residual sums them
+        p = layer_params
+        q, k, v = qkv_proj(x, p, config, positions=positions)
+        attn = _attention(q, k, v, config)
+        return x + _dropout(attn_project(attn, p, config),
+                            config.dropout, k_attn) \
+            + mlp_out(x, p, config, k_mlp)
+    h = _attn_residual(x, layer_params, config, positions=positions,
+                       dropout_key=k_attn)
+    return mlp_residual(h, layer_params, config, dropout_key=k_mlp)
 
 
-def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, padded_vocab] f32."""
+def embed(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
+          positions=None) -> jnp.ndarray:
+    """Token (+ learned position) embedding with the family's variants."""
     cdt = config.dtype
+    x = params["wte"].astype(cdt)[tokens]
+    if config.embed_layernorm:
+        x = _layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"])
+    if config.pos_embed == "learned":
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1])
+        x = x + params["wpe"].astype(cdt)[positions + config.pos_offset]
+    return x
+
+
+def lm_logits(params: PyTree, x, config: GPTConfig) -> jnp.ndarray:
+    """Final LN + (tied or separate) head.
+
+    Inputs stay in the compute dtype so the MXU runs at its bf16 rate; the
+    accumulator/output is fp32 (``preferred_element_type``) for a stable
+    softmax — an fp32×fp32 vocab matmul is ~30% of GPT-2's step FLOPs at
+    a fraction of the MXU rate.
+    """
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    head = params["wte"] if config.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum("...d,vd->...v", x.astype(config.dtype),
+                      head.astype(config.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
+          dropout_rng=None, pld_theta=None) -> jnp.ndarray:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, padded_vocab] f32.
+
+    ``pld_theta`` (engine-injected, train only) enables progressive layer
+    drop: layer l keeps with prob 1 - (l+1)/L · (1-θ) — deeper layers drop
+    more, the whole stack survives at θ=1 (reference PLD semantics,
+    runtime/progressive_layer_drop.py wired at engine.py:1698).
+    """
     B, S = tokens.shape
-    pos = jnp.arange(S)
-    x = params["wte"].astype(cdt)[tokens] + params["wpe"].astype(cdt)[pos][None]
+    x = embed(params, tokens, config)
+    if dropout_rng is not None and config.dropout > 0:
+        emb_key, dropout_rng = jax.random.split(dropout_rng)
+        x = _dropout(x, config.dropout, emb_key)
 
     if config.sequence_parallel:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -263,27 +450,51 @@ def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig) -> jnp.ndarray
 
     block_fn = partial(_block, config=config)
     if config.remat:
-        block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        from ..runtime.activation_checkpointing import checkpointing as ckpt
+        if ckpt.is_configured():
+            # policy-driven remat (partitioned/offloaded checkpoints)
+            block_fn = ckpt.wrap(block_fn)
+        else:
+            block_fn = jax.checkpoint(
+                block_fn, policy=jax.checkpoint_policies.nothing_saveable)
 
-    def scan_body(carry, layer_params):
-        return block_fn(carry, layer_params), None
+    use_dropout = dropout_rng is not None and config.dropout > 0
+    use_pld = pld_theta is not None and dropout_rng is not None
+    L = config.n_layer
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
-    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    # tied embedding head; logits in fp32 for a stable softmax/loss
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        params["wte"].astype(jnp.float32))
-    return logits
+    def scan_body(carry, xs):
+        layer_params, idx = xs
+        key = jax.random.fold_in(dropout_rng, idx) if use_dropout else None
+        out = block_fn(carry, layer_params, dropout_key=key)
+        if use_pld:
+            p_keep = 1.0 - (idx + 1.0) / L * (1.0 - pld_theta)
+            gate_key = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, idx), 7919)
+            keep = jax.random.bernoulli(gate_key, p_keep)
+            out = jnp.where(keep, out, carry)
+        return out, None
+
+    x, _ = lax.scan(scan_body, x,
+                    (params["blocks"], jnp.arange(config.n_layer)))
+    return lm_logits(params, x, config)
 
 
 def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTConfig) -> jnp.ndarray:
-    """Mean next-token cross-entropy. batch: {'tokens': [B,S+1]} or input/target."""
+    """Mean next-token cross-entropy. batch: {'tokens': [B,S+1]} or
+    input/target.  A ``_train_rng`` key in the batch (engine-injected)
+    enables dropout; its absence (eval) disables it."""
+    dropout_rng = pld_theta = None
+    if "_train_rng" in batch or "_pld_theta" in batch:
+        batch = dict(batch)
+        dropout_rng = batch.pop("_train_rng", None)
+        pld_theta = batch.pop("_pld_theta", None)
     if "input_ids" in batch:
         inputs, targets = batch["input_ids"], batch["labels"]
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = apply(params, inputs, config)
+    logits = apply(params, inputs, config, dropout_rng=dropout_rng,
+                   pld_theta=pld_theta)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold

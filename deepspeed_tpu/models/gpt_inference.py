@@ -9,6 +9,11 @@ cache.  Both are pure functions over (params, cache) so the whole generate
 loop jits into a single XLA program — the role CUDA-graph capture plays in
 the reference (``inference/engine.py:464``), played instead by jit tracing.
 
+Architecture variants ride the shared ``models/gpt.py`` helpers, so every
+injected family (GPT-2 learned positions, OPT relu+offset, BLOOM alibi,
+NeoX rotary + parallel residual, untied heads) decodes through this one
+implementation.
+
 Cache layout [L, B, S_max, H, D]: static shapes (XLA requirement), masked by
 the current length; decode attention reads the cache tiled over S_max with
 positions beyond ``pos`` masked.
@@ -56,36 +61,29 @@ def init_cache(config: gpt.GPTConfig, batch: int, max_len: int) -> KVCache:
                    length=jnp.zeros((), jnp.int32))
 
 
-def _qkv(x, p, config: gpt.GPTConfig):
-    cdt = config.dtype
-    h = gpt._layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-    qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) \
-        + p["bqkv"].astype(cdt)
-    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-
-
-def _proj_mlp(x, attn, p, config: gpt.GPTConfig):
-    cdt = config.dtype
-    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
-        + p["bo"].astype(cdt)
-    x = x + attn_out
-    h2 = gpt._layer_norm(x, p["ln2_scale"], p["ln2_bias"])
-    ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
-    ff = jax.nn.gelu(ff, approximate=True)
-    ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) \
-        + p["bo_mlp"].astype(cdt)
-    return x + ff_out
-
-
 def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig):
     """q: [B, S_q, H, D] attending to cache[:, :pos+S_q].
 
     ``pos`` is the number of tokens already in the cache before this call;
     query i sits at absolute position pos+i and sees cache slots ≤ pos+i.
     """
+    if config.pos_embed == "alibi":
+        # dense path with the alibi bias; cache slots beyond the query's
+        # position fall out of the dist >= 0 mask
+        q_positions = pos + jnp.arange(q.shape[1])
+        return gpt._alibi_attention(q, cache_k, cache_v, config,
+                                    q_positions=q_positions)
     from ..ops.pallas.decode_attention import cached_attention
     return cached_attention(q, cache_k, cache_v, pos,
                             sm_scale=1.0 / math.sqrt(config.head_dim))
+
+
+def _block_tail(x, attn, p, config: gpt.GPTConfig):
+    """Post-attention half of the block, honouring parallel_residual."""
+    attn_out = gpt.attn_project(attn, p, config)
+    if config.parallel_residual:
+        return x + attn_out + gpt.mlp_out(x, p, config)
+    return gpt.mlp_residual(x + attn_out, p, config)
 
 
 def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
@@ -96,26 +94,22 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
     cache (length 0) — chunked prefill composes by calling with growing
     ``cache.length`` via :func:`extend`.
     """
-    cdt = config.dtype
     B, S = tokens.shape
-    pos_ids = jnp.arange(S)
-    x = params["wte"].astype(cdt)[tokens] + \
-        params["wpe"].astype(cdt)[pos_ids][None]
+    positions = jnp.arange(S)
+    x = gpt.embed(params, tokens, config, positions=positions)
 
     def layer(x, xs):
         p, ck, cv = xs
-        q, k, v = _qkv(x, p, config)
+        q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
         new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
         new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         # prefill attention runs on the unpadded k/v (training flash path);
         # only decode reads back through the padded cache
         attn = gpt._attention(q, k, v, config)
-        return _proj_mlp(x, attn, p, config), (new_ck, new_cv)
+        return _block_tail(x, attn, p, config), (new_ck, new_cv)
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
-    x = gpt._layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        params["wte"].astype(jnp.float32))
+    logits = gpt.lm_logits(params, x, config)
     return logits, KVCache(k=new_k, v=new_v,
                            length=jnp.asarray(S, jnp.int32))
 
@@ -126,24 +120,21 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
 
     Returns (logits [B, padded_vocab] fp32, cache advanced by one).
     """
-    cdt = config.dtype
     B = token.shape[0]
     pos = cache.length
-    x = params["wte"].astype(cdt)[token][:, None] + \
-        params["wpe"].astype(cdt)[pos][None, None]
+    positions = pos[None]
+    x = gpt.embed(params, token[:, None], config, positions=positions)
 
     def layer(x, xs):
         p, ck, cv = xs
-        q, k, v = _qkv(x, p, config)
+        q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
         new_ck = lax.dynamic_update_slice(
             ck, k.astype(ck.dtype), (0, pos, 0, 0))
         new_cv = lax.dynamic_update_slice(
             cv, v.astype(cv.dtype), (0, pos, 0, 0))
         attn = _cached_attention(q, new_ck, new_cv, pos, config)
-        return _proj_mlp(x, attn, p, config), (new_ck, new_cv)
+        return _block_tail(x, attn, p, config), (new_ck, new_cv)
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
-    x = gpt._layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    logits = jnp.einsum("bd,vd->bv", x[:, 0].astype(jnp.float32),
-                        params["wte"].astype(jnp.float32))
+    logits = gpt.lm_logits(params, x[:, 0], config)
     return logits, KVCache(k=new_k, v=new_v, length=pos + 1)

@@ -41,6 +41,7 @@ class GPTMoEConfig(GPTConfig):
     ep_size: int = 1
 
     def __post_init__(self):
+        super().__post_init__()
         assert self.n_layer % 2 == 0, "GPT-MoE requires an even layer count"
 
     @property
@@ -173,8 +174,10 @@ def apply(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
         (params["dense_blocks"], params["moe_attn_blocks"], params["moe_blocks"]))
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        params["wte"].astype(jnp.float32))
+    # bf16 MXU inputs, fp32 accumulation (see gpt.lm_logits)
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(cdt),
+                        params["wte"].astype(cdt),
+                        preferred_element_type=jnp.float32)
     return logits, aux_total
 
 

@@ -1,0 +1,130 @@
+"""KV-cached inference for the GPT-MoE family.
+
+Counterpart of the reference's MoE inference stack
+(``ops/transformer/inference/moe_inference.py`` ``DeepSpeedMoEInference``
+and the expert-group creation in ``inference/engine.py:190``): prefill and
+single-token decode over the (dense, MoE) pair stack, with the gate running
+in eval mode (eval capacity factor, no RTS/aux loss) and experts sharded
+over the ``expert`` mesh axis declaratively — the all-to-all the reference
+issues by hand falls out of XLA's dispatch/combine einsums.
+
+Cache layout: two [n_pairs, B, S_max, H, D] banks (dense layers, MoE
+layers) scanned together with the parameter pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import gpt
+from .gpt_moe import GPTMoEConfig, _moe_obj
+
+PyTree = Any
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MoEKVCache:
+    dense_k: jnp.ndarray   # [P, B, S_max, H, D]
+    dense_v: jnp.ndarray
+    moe_k: jnp.ndarray
+    moe_v: jnp.ndarray
+    length: jnp.ndarray    # [] int32
+
+    def tree_flatten(self):
+        return (self.dense_k, self.dense_v, self.moe_k, self.moe_v,
+                self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_cache(config: GPTMoEConfig, batch: int, max_len: int) -> MoEKVCache:
+    shape = (config.n_pairs, batch, max_len, config.n_head, config.head_dim)
+    z = lambda: jnp.zeros(shape, config.dtype)
+    return MoEKVCache(dense_k=z(), dense_v=z(), moe_k=z(), moe_v=z(),
+                      length=jnp.zeros((), jnp.int32))
+
+
+def _moe_ffn(x, attn_p, moe_p, moe, config: GPTMoEConfig):
+    """Post-attention expert FFN half (eval gating)."""
+    h2 = gpt._layer_norm(x, attn_p["ln2_scale"], attn_p["ln2_bias"])
+    moe_out, _aux, _counts = moe.apply(moe_p, h2, train=False, constrain=None)
+    return x + moe_out
+
+
+def _attend_prefill(x, p, config, positions):
+    q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
+    attn = gpt._attention(q, k, v, config)
+    return x + gpt.attn_project(attn, p, config), k, v
+
+
+def _attend_decode(x, p, config, ck, cv, pos, positions):
+    from .gpt_inference import _cached_attention
+    q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    attn = _cached_attention(q, ck, cv, pos, config)
+    return x + gpt.attn_project(attn, p, config), ck, cv
+
+
+def prefill(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
+            cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+    """Prompt pass filling both cache banks; returns (logits, cache)."""
+    B, S = tokens.shape
+    positions = jnp.arange(S)
+    moe = _moe_obj(config)
+    x = gpt.embed(params, tokens, config, positions=positions)
+
+    def pair(x, xs):
+        dense_p, attn_p, moe_p, dck, dcv, mck, mcv = xs
+        x, k, v = _attend_prefill(x, dense_p, config, positions)
+        dck = lax.dynamic_update_slice(dck, k.astype(dck.dtype), (0, 0, 0, 0))
+        dcv = lax.dynamic_update_slice(dcv, v.astype(dcv.dtype), (0, 0, 0, 0))
+        x = gpt.mlp_residual(x, dense_p, config)
+        x, k, v = _attend_prefill(x, attn_p, config, positions)
+        mck = lax.dynamic_update_slice(mck, k.astype(mck.dtype), (0, 0, 0, 0))
+        mcv = lax.dynamic_update_slice(mcv, v.astype(mcv.dtype), (0, 0, 0, 0))
+        x = _moe_ffn(x, attn_p, moe_p, moe, config)
+        return x, (dck, dcv, mck, mcv)
+
+    x, (dk, dv, mk, mv) = lax.scan(
+        pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
+                  params["moe_blocks"], cache.dense_k, cache.dense_v,
+                  cache.moe_k, cache.moe_v))
+    logits = gpt.lm_logits(params, x, config)
+    return logits, MoEKVCache(dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
+                              length=jnp.asarray(S, jnp.int32))
+
+
+def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
+                cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+    """One-token decode through both banks; token [B] int32."""
+    pos = cache.length
+    positions = pos[None]
+    moe = _moe_obj(config)
+    x = gpt.embed(params, token[:, None], config, positions=positions)
+
+    def pair(x, xs):
+        dense_p, attn_p, moe_p, dck, dcv, mck, mcv = xs
+        x, dck, dcv = _attend_decode(x, dense_p, config, dck, dcv, pos,
+                                     positions)
+        x = gpt.mlp_residual(x, dense_p, config)
+        x, mck, mcv = _attend_decode(x, attn_p, config, mck, mcv, pos,
+                                     positions)
+        x = _moe_ffn(x, attn_p, moe_p, moe, config)
+        return x, (dck, dcv, mck, mcv)
+
+    x, (dk, dv, mk, mv) = lax.scan(
+        pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
+                  params["moe_blocks"], cache.dense_k, cache.dense_v,
+                  cache.moe_k, cache.moe_v))
+    logits = gpt.lm_logits(params, x[:, 0], config)
+    return logits, MoEKVCache(dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
+                              length=pos + 1)

@@ -38,12 +38,20 @@ class GPTPipeConfig(GPTConfig):
     num_micro_batches: int = 4
 
     def __post_init__(self):
+        super().__post_init__()
         assert self.n_layer % self.num_stages == 0, \
             f"n_layer {self.n_layer} must divide evenly into {self.num_stages} stages"
         # SP's shard_map cannot nest inside the pipe-manual region of the
         # SPMD 1F1B schedule; reject the combination up front.
         assert not self.sequence_parallel, \
             "sequence_parallel does not compose with the SPMD pipeline engine"
+        # the 1F1B backward recomputes the forward at backward ticks; until
+        # per-(microbatch, stage) dropout keys are threaded through the
+        # schedule, stochastic forwards would silently produce wrong grads
+        assert self.dropout == 0.0, \
+            "dropout is not yet supported by the pipelined model family"
+        assert self.pos_embed == "learned", \
+            "the pipelined embed/head split assumes learned positions (wpe)"
 
 
 def split_params(config: GPTPipeConfig, params: PyTree) -> Tuple[PyTree, PyTree]:
@@ -82,8 +90,10 @@ def _embed_fn(shared, micro_batch, config: GPTPipeConfig):
 def _loss_head_fn(shared, x, micro_batch, config: GPTPipeConfig):
     targets = micro_batch["tokens"][:, 1:]
     x = _layer_norm(x, shared["lnf_scale"], shared["lnf_bias"])
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        shared["wte"].astype(jnp.float32))
+    # bf16 MXU inputs, fp32 accumulation (see gpt.lm_logits)
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(config.dtype),
+                        shared["wte"].astype(config.dtype),
+                        preferred_element_type=jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     mask = (targets >= 0).astype(jnp.float32)

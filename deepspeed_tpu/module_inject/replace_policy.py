@@ -105,7 +105,274 @@ def _tree_to_jnp(tree, dtype):
     return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), tree)
 
 
-POLICIES = [HFGPT2LayerPolicy]
+def _linear_w(sd_get, name):
+    """torch Linear stores [out, in]; our einsums take [in, out]."""
+    return _np(sd_get(name)).T
+
+
+def _fused_qkv_per_head(w, b, H, Dh, d):
+    """BLOOM/NeoX fuse qkv as [(H, 3, Dh), d] — per-head interleaved.
+    Returns (wqkv [d, 3, H, Dh], bqkv [3, H, Dh])."""
+    wq = w.reshape(H, 3, Dh, d).transpose(3, 1, 0, 2)
+    bq = b.reshape(H, 3, Dh).transpose(1, 0, 2)
+    return wq, bq
+
+
+class HFOPTLayerPolicy:
+    """transformers OPT (``OPTForCausalLM``): separate q/k/v projections,
+    relu MLP, learned positions stored with a +2 offset (reference
+    replace_policy.py:559)."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("self_attn.q_proj.weight" in k and "decoder" in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        assert getattr(hf_config, "word_embed_proj_dim",
+                       hf_config.hidden_size) == hf_config.hidden_size, \
+            "OPT variants with embedding projections are not supported"
+        assert getattr(hf_config, "do_layer_norm_before", True), \
+            "post-LN OPT-350m layout is not supported"
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.ffn_dim,
+            activation="relu",
+            pos_offset=2,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "model.decoder." if any(k.startswith("model.") for k in sd) \
+            else "decoder."
+
+        def get(name):
+            return sd[pre + name]
+
+        wte = _np(get("embed_tokens.weight"))
+        pad = config.padded_vocab - wte.shape[0]
+        if pad:
+            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+
+        def lw(i, name):
+            return _linear_w(get, f"layers.{i}.{name}.weight")
+
+        def lb(i, name):
+            return _np(get(f"layers.{i}.{name}.bias"))
+
+        def qkv_w(i):
+            return np.stack([lw(i, f"self_attn.{n}_proj").reshape(d, H, Dh)
+                             for n in ("q", "k", "v")], axis=1)
+
+        def qkv_b(i):
+            return np.stack([lb(i, f"self_attn.{n}_proj").reshape(H, Dh)
+                             for n in ("q", "k", "v")], axis=0)
+
+        block = {
+            "ln1_scale": np.stack([_np(get(f"layers.{i}.self_attn_layer_norm.weight"))
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([_np(get(f"layers.{i}.self_attn_layer_norm.bias"))
+                                  for i in range(L)]),
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.stack([qkv_b(i) for i in range(L)]),
+            "wo": np.stack([lw(i, "self_attn.out_proj").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "self_attn.out_proj") for i in range(L)]),
+            "ln2_scale": np.stack([_np(get(f"layers.{i}.final_layer_norm.weight"))
+                                   for i in range(L)]),
+            "ln2_bias": np.stack([_np(get(f"layers.{i}.final_layer_norm.bias"))
+                                  for i in range(L)]),
+            "wi": np.stack([lw(i, "fc1") for i in range(L)]),
+            "bi": np.stack([lb(i, "fc1") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "fc2") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "fc2") for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "wpe": _np(get("embed_positions.weight")),
+            "blocks": block,
+            "lnf_scale": _np(get("final_layer_norm.weight")),
+            "lnf_bias": _np(get("final_layer_norm.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+class BLOOMLayerPolicy:
+    """transformers BLOOM (``BloomForCausalLM``): alibi positions, fused
+    per-head qkv, embedding layernorm (reference replace_policy.py:463)."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("self_attention.query_key_value" in k for k in sd) and \
+            any("word_embeddings_layernorm" in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        d = hf_config.hidden_size
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=getattr(hf_config, "seq_length", 2048),
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            d_model=d,
+            pos_embed="alibi",
+            embed_layernorm=True,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) \
+            else ""
+
+        def get(name):
+            return sd[pre + name]
+
+        wte = _np(get("word_embeddings.weight"))
+        pad = config.padded_vocab - wte.shape[0]
+        if pad:
+            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+
+        def fused(i):
+            w = _np(get(f"h.{i}.self_attention.query_key_value.weight"))
+            b = _np(get(f"h.{i}.self_attention.query_key_value.bias"))
+            return _fused_qkv_per_head(w, b, H, Dh, d)
+
+        qkvs = [fused(i) for i in range(L)]
+
+        def lw(i, name):
+            return _np(get(f"h.{i}.{name}.weight")).T
+
+        def lb(i, name):
+            return _np(get(f"h.{i}.{name}.bias"))
+
+        block = {
+            "ln1_scale": np.stack([_np(get(f"h.{i}.input_layernorm.weight"))
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([_np(get(f"h.{i}.input_layernorm.bias"))
+                                  for i in range(L)]),
+            "wqkv": np.stack([w for w, _ in qkvs]),
+            "bqkv": np.stack([b for _, b in qkvs]),
+            "wo": np.stack([lw(i, "self_attention.dense").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "self_attention.dense") for i in range(L)]),
+            "ln2_scale": np.stack([_np(get(f"h.{i}.post_attention_layernorm.weight"))
+                                   for i in range(L)]),
+            "ln2_bias": np.stack([_np(get(f"h.{i}.post_attention_layernorm.bias"))
+                                  for i in range(L)]),
+            "wi": np.stack([lw(i, "mlp.dense_h_to_4h") for i in range(L)]),
+            "bi": np.stack([lb(i, "mlp.dense_h_to_4h") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "mlp.dense_4h_to_h") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "mlp.dense_4h_to_h") for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "emb_ln_scale": _np(get("word_embeddings_layernorm.weight")),
+            "emb_ln_bias": _np(get("word_embeddings_layernorm.bias")),
+            "blocks": block,
+            "lnf_scale": _np(get("ln_f.weight")),
+            "lnf_bias": _np(get("ln_f.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+class GPTNEOXLayerPolicy:
+    """transformers GPT-NeoX (``GPTNeoXForCausalLM``): rotary (partial,
+    half-split convention), parallel residual, untied embed_out head
+    (reference replace_policy.py:505)."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("attention.query_key_value" in k and
+                   ("gpt_neox" in k or k.startswith("layers.")) for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size,
+            pos_embed="rotary",
+            rotary_pct=getattr(hf_config, "rotary_pct", 0.25),
+            rotary_base=getattr(hf_config, "rotary_emb_base", 10000),
+            parallel_residual=getattr(hf_config, "use_parallel_residual", True),
+            tie_word_embeddings=False,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+
+        def get(name):
+            return sd[pre + name]
+
+        def pad_vocab(w):
+            p = config.padded_vocab - w.shape[0]
+            return np.concatenate([w, np.zeros((p, d), np.float32)]) if p else w
+
+        wte = pad_vocab(_np(get("embed_in.weight")))
+        # the untied head lives OUTSIDE the gpt_neox. prefix on CausalLM
+        head = sd.get("embed_out.weight", sd.get(pre + "embed_out.weight"))
+        lm_head = pad_vocab(_np(head))
+
+        def fused(i):
+            w = _np(get(f"layers.{i}.attention.query_key_value.weight"))
+            b = _np(get(f"layers.{i}.attention.query_key_value.bias"))
+            return _fused_qkv_per_head(w, b, H, Dh, d)
+
+        qkvs = [fused(i) for i in range(L)]
+
+        def lw(i, name):
+            return _np(get(f"layers.{i}.{name}.weight")).T
+
+        def lb(i, name):
+            return _np(get(f"layers.{i}.{name}.bias"))
+
+        block = {
+            "ln1_scale": np.stack([_np(get(f"layers.{i}.input_layernorm.weight"))
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([_np(get(f"layers.{i}.input_layernorm.bias"))
+                                  for i in range(L)]),
+            "wqkv": np.stack([w for w, _ in qkvs]),
+            "bqkv": np.stack([b for _, b in qkvs]),
+            "wo": np.stack([lw(i, "attention.dense").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "attention.dense") for i in range(L)]),
+            "ln2_scale": np.stack(
+                [_np(get(f"layers.{i}.post_attention_layernorm.weight"))
+                 for i in range(L)]),
+            "ln2_bias": np.stack(
+                [_np(get(f"layers.{i}.post_attention_layernorm.bias"))
+                 for i in range(L)]),
+            "wi": np.stack([lw(i, "mlp.dense_h_to_4h") for i in range(L)]),
+            "bi": np.stack([lb(i, "mlp.dense_h_to_4h") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "mlp.dense_4h_to_h") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "mlp.dense_4h_to_h") for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "lm_head": lm_head,
+            "blocks": block,
+            "lnf_scale": _np(get("final_layer_norm.weight")),
+            "lnf_bias": _np(get("final_layer_norm.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+POLICIES = [HFGPT2LayerPolicy, HFOPTLayerPolicy, BLOOMLayerPolicy,
+            GPTNEOXLayerPolicy]
 
 
 def convert_hf_model(hf_model, dtype=jnp.float32

@@ -11,14 +11,19 @@ backends, (b) as the ground truth in unit tests (Pallas interpret mode vs
 reference), so the whole package is CI-testable on CPU.
 """
 
+from .block_sparse_attention import block_sparse_attention, sparse_mha_reference
 from .flash_attention import flash_attention, mha_reference
 from .fused_adam import fused_adam_step
+from .fused_lamb import fused_lamb_step
 from .quantizer import dequantize, quantize
 
 __all__ = [
     "flash_attention",
     "mha_reference",
+    "block_sparse_attention",
+    "sparse_mha_reference",
     "fused_adam_step",
+    "fused_lamb_step",
     "quantize",
     "dequantize",
 ]

@@ -1,0 +1,195 @@
+"""Fused LAMB over a flat multi-tensor buffer as Pallas kernels.
+
+Counterpart of the reference's CUDA LAMB
+(``csrc/lamb/fused_lamb_cuda_kernel.cu`` — fused update with two-pass
+per-tensor trust-ratio block reductions, frontend
+``fused_lamb_cuda.cpp:108``).  TPU formulation:
+
+- Tensors are packed row-aligned into one [rows, 128] buffer with a
+  per-row segment id, so one kernel streams every tensor.
+- Pass 1 (Pallas): moment update + unscaled LAMB update, emitting per-row
+  partial sums of ‖p‖² and ‖update‖² alongside.
+- Between passes (XLA, tiny): ``segment_sum`` of the row sums by tensor id
+  → per-tensor trust ratios, clamped to [min_coeff, max_coeff] — the
+  ``lamb_coeff`` of the CUDA kernel.
+- Pass 2 (Pallas): ``p -= lr · ratio[row] · update`` with the ratio
+  broadcast back per row.
+
+``pack_tree``/``unpack_tree`` round-trip a param pytree through the flat
+layout (each leaf padded to whole rows so segment ids are per-row exact).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .utils import cdiv, interpret_mode, use_pallas
+
+PyTree = Any
+
+_LANES = 128
+_BLOCK_ROWS = 512
+
+
+# ------------------------------------------------------------------ packing
+
+def pack_tree(tree: PyTree) -> Tuple[jnp.ndarray, jnp.ndarray, list]:
+    """Pack leaves into ([rows, 128] buffer, [rows] segment ids, layout).
+
+    Every leaf is padded to whole 128-lane rows, so a row belongs to
+    exactly one tensor and per-row sums segment cleanly.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    rows_per = [cdiv(int(np.prod(l.shape)), _LANES) for l in leaves]
+    seg = np.repeat(np.arange(len(leaves)), rows_per).astype(np.int32)
+    parts = []
+    for leaf, r in zip(leaves, rows_per):
+        flat = leaf.reshape(-1)
+        pad = r * _LANES - flat.shape[0]
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        parts.append(flat.reshape(r, _LANES))
+    buf = jnp.concatenate(parts, axis=0)
+    layout = [(l.shape, l.dtype, r) for l, r in zip(leaves, rows_per)]
+    return buf, jnp.asarray(seg), (treedef, layout)
+
+
+def unpack_tree(buf: jnp.ndarray, meta) -> PyTree:
+    treedef, layout = meta
+    leaves, row = [], 0
+    for shape, dtype, r in layout:
+        n = int(np.prod(shape))
+        leaves.append(buf[row:row + r].reshape(-1)[:n]
+                      .reshape(shape).astype(dtype))
+        row += r
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------------------ kernels
+
+def _lamb_phase1(hyper_ref, p_ref, g_ref, m_ref, v_ref,
+                 u_out, m_out, v_out, wsq_out, usq_out, *, eps_inside_sqrt):
+    beta1 = hyper_ref[0]
+    beta2 = hyper_ref[1]
+    eps = hyper_ref[2]
+    wd = hyper_ref[3]
+    bc1 = hyper_ref[4]
+    bc2 = hyper_ref[5]
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    m = beta1 * m_ref[...] + (1.0 - beta1) * g
+    v = beta2 * v_ref[...] + (1.0 - beta2) * g * g
+    if eps_inside_sqrt:
+        denom = jnp.sqrt(v / bc2 + eps)
+    else:
+        denom = jnp.sqrt(v / bc2) + eps
+    u = (m / bc1) / denom + wd * p
+    u_out[...] = u
+    m_out[...] = m
+    v_out[...] = v
+    wsq_out[...] = jnp.sum(p * p, axis=1, keepdims=True)
+    usq_out[...] = jnp.sum(u * u, axis=1, keepdims=True)
+
+
+def _lamb_phase2(hyper_ref, p_ref, u_ref, ratio_ref, p_out):
+    lr = hyper_ref[6]
+    p = p_ref[...].astype(jnp.float32)
+    p_out[...] = (p - lr * ratio_ref[...] * u_ref[...]).astype(p_out.dtype)
+
+
+def fused_lamb_step(params: PyTree, grads: PyTree, exp_avg: PyTree,
+                    exp_avg_sq: PyTree, step, lr,
+                    beta1: float = 0.9, beta2: float = 0.999,
+                    eps: float = 1e-8, weight_decay: float = 0.0,
+                    bias_correction: bool = True,
+                    eps_inside_sqrt: bool = False,
+                    max_coeff: float = 10.0,
+                    min_coeff: float = 0.01) -> Tuple[PyTree, PyTree, PyTree]:
+    """One LAMB step over a whole pytree through the flat kernels.
+
+    Returns (new_params, new_exp_avg, new_exp_avg_sq) with the input tree
+    structure.  Falls back to the identical-math XLA path off-TPU.
+    """
+    stepf = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(jnp.float32(beta1), stepf)
+        bc2 = 1.0 - jnp.power(jnp.float32(beta2), stepf)
+    else:
+        bc1 = bc2 = jnp.float32(1.0)
+    hyper = jnp.stack([jnp.float32(beta1), jnp.float32(beta2),
+                       jnp.float32(eps), jnp.asarray(weight_decay, jnp.float32),
+                       bc1, bc2, jnp.asarray(lr, jnp.float32)])
+
+    p_buf, seg, meta = pack_tree(params)
+    g_buf, _, _ = pack_tree(grads)
+    m_buf, _, _ = pack_tree(exp_avg)
+    v_buf, _, _ = pack_tree(exp_avg_sq)
+    n_tensors = len(meta[1])
+    rows = p_buf.shape[0]
+    block_rows = min(_BLOCK_ROWS, rows)
+    grid = (cdiv(rows, block_rows),)
+    blk = lambda: pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    col = lambda: pl.BlockSpec((block_rows, 1), lambda i: (i, 0))
+
+    if use_pallas():
+        u_buf, m_new, v_new, wsq, usq = pl.pallas_call(
+            functools.partial(_lamb_phase1, eps_inside_sqrt=eps_inside_sqrt),
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      blk(), blk(), blk(), blk()],
+            out_specs=[blk(), blk(), blk(), col(), col()],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            ],
+            interpret=interpret_mode(),
+        )(hyper, p_buf, g_buf, m_buf, v_buf)
+    else:
+        p32 = p_buf.astype(jnp.float32)
+        g32 = g_buf.astype(jnp.float32)
+        m_new = beta1 * m_buf + (1.0 - beta1) * g32
+        v_new = beta2 * v_buf + (1.0 - beta2) * g32 * g32
+        denom = jnp.sqrt(v_new / bc2 + eps) if eps_inside_sqrt \
+            else jnp.sqrt(v_new / bc2) + eps
+        u_buf = (m_new / bc1) / denom + hyper[3] * p32
+        wsq = jnp.sum(p32 * p32, axis=1, keepdims=True)
+        usq = jnp.sum(u_buf * u_buf, axis=1, keepdims=True)
+
+    # per-tensor trust ratios from the row partial sums (tiny XLA math —
+    # the CUDA kernel's second-pass block reduction)
+    w_norm = jnp.sqrt(jax.ops.segment_sum(wsq[:, 0], seg, n_tensors))
+    u_norm = jnp.sqrt(jax.ops.segment_sum(usq[:, 0], seg, n_tensors))
+    trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                      jnp.clip(w_norm / jnp.maximum(u_norm, 1e-30),
+                               min_coeff, max_coeff),
+                      jnp.float32(1.0))
+    ratio_rows = trust[seg][:, None]                      # [rows, 1]
+
+    if use_pallas():
+        p_new = pl.pallas_call(
+            _lamb_phase2,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      blk(), blk(), col()],
+            out_specs=blk(),
+            out_shape=jax.ShapeDtypeStruct(p_buf.shape, p_buf.dtype),
+            interpret=interpret_mode(),
+        )(hyper, p_buf, u_buf, ratio_rows)
+    else:
+        p_new = (p_buf.astype(jnp.float32)
+                 - hyper[6] * ratio_rows * u_buf).astype(p_buf.dtype)
+
+    return (unpack_tree(p_new, meta),
+            unpack_tree(m_new, meta),
+            unpack_tree(v_new, meta))

@@ -1,0 +1,208 @@
+"""Activation checkpointing: configurable rematerialization policies.
+
+Counterpart of the reference's Megatron-style subsystem
+(``runtime/activation_checkpointing/checkpointing.py`` —
+``CheckpointFunction`` :499, ``partition_activations`` :373,
+``gather_partitioned_activations`` :259, ``CudaRNGStatesTracker`` :122,
+``configure`` :831).  The mechanisms translate:
+
+- ``checkpoint(fn, *args)`` → ``jax.checkpoint`` with a policy chosen by
+  the configured flags.  Default recomputes everything
+  (``nothing_saveable``); ``deepspeed_config["activation_checkpointing"]``
+  selects richer policies.
+- ``partition_activations`` → the saved boundary activations carry a
+  sharding constraint over the TP ('model') mesh axis, so each rank stores
+  1/tp of every checkpoint — the declarative form of the reference's
+  explicit partition/all-gather pair (:373/:259); XLA inserts the gather
+  before the recompute.
+- ``cpu_checkpointing`` → boundary activations are tagged with
+  ``checkpoint_name`` and offloaded to host memory via
+  ``save_and_offload_only_these_names`` (TPU backends; other backends fall
+  back to recompute with a warning).
+- ``CudaRNGStatesTracker`` → functional PRNG makes replay determinism
+  structural (the same key reaches the recompute), so the tracker here is
+  a thin named-key registry kept for API parity.
+- ``contiguous_memory_optimization`` / ``number_checkpoints`` /
+  ``synchronize_checkpoint_boundary`` / ``profile`` are accepted and
+  recorded; buffer layout and stream synchronization are XLA's job on TPU,
+  so they do not change lowering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+
+from ...utils.logging import logger
+
+BOUNDARY = "ds_act_ckpt_boundary"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+_config = CheckpointConfig()
+_configured = False
+
+
+def configure(mpu_=None, deepspeed_config: Optional[Dict[str, Any]] = None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              num_checkpoints: Optional[int] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None) -> None:
+    """Reference ``configure`` (:831): json section and/or kwargs."""
+    global _config, _configured
+    section = {}
+    if deepspeed_config is not None:
+        section = (deepspeed_config or {}).get("activation_checkpointing", {})
+    pick = lambda kw, key, dflt: kw if kw is not None else section.get(key, dflt)
+    _config = CheckpointConfig(
+        partition_activations=pick(partition_activations,
+                                   "partition_activations", False),
+        cpu_checkpointing=pick(checkpoint_in_cpu, "cpu_checkpointing", False),
+        contiguous_memory_optimization=pick(
+            contiguous_checkpointing, "contiguous_memory_optimization", False),
+        number_checkpoints=pick(num_checkpoints, "number_checkpoints", None),
+        synchronize_checkpoint_boundary=pick(
+            synchronize, "synchronize_checkpoint_boundary", False),
+        profile=pick(profile, "profile", False),
+    )
+    _configured = True
+    logger.info(f"[activation_checkpointing] configured: {_config}")
+
+
+def is_configured() -> bool:
+    return _configured
+
+
+def get_config() -> CheckpointConfig:
+    return _config
+
+
+def reset() -> None:
+    global _config, _configured
+    _config = CheckpointConfig()
+    _configured = False
+
+
+def _policy():
+    if _config.cpu_checkpointing:
+        if jax.default_backend() in ("tpu", "gpu"):
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[BOUNDARY],
+                offload_src="device", offload_dst="pinned_host")
+        logger.warning("[activation_checkpointing] cpu_checkpointing needs "
+                       "an accelerator backend with pinned_host memory; "
+                       "falling back to full recompute")
+    if _config.partition_activations:
+        # save the named boundaries (sharded — see wrap()), recompute the rest
+        return jax.checkpoint_policies.save_only_these_names(BOUNDARY)
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def _tp_constrain(x):
+    """Shard a saved boundary activation over the TP axis (the partitioned
+    activation of reference :373); no-op off-mesh or without TP."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ...parallel.mesh import MODEL_AXIS, get_mesh_manager
+    mm = get_mesh_manager(optional=True)
+    if mm is None or mm.mesh.shape.get(MODEL_AXIS, 1) <= 1 or x.ndim < 1:
+        return x
+    # shard the last dim (d_model-like) over 'model'
+    if x.shape[-1] % mm.mesh.shape[MODEL_AXIS] != 0:
+        return x
+    spec = [None] * (x.ndim - 1) + [MODEL_AXIS]
+    return lax.with_sharding_constraint(x, NamedSharding(mm.mesh, P(*spec)))
+
+
+def wrap(function: Callable) -> Callable:
+    """Rematerialized version of ``function`` under the configured policy.
+
+    The function's array arguments are tagged as checkpoint boundaries (and
+    TP-sharded when partition_activations is on) so the offload/save
+    policies can address them by name.
+    """
+    policy = _policy()
+    tag = (_config.partition_activations or _config.cpu_checkpointing)
+
+    def tagged(*args, **kwargs):
+        if tag:
+            args = tuple(
+                checkpoint_name(_tp_constrain(a), BOUNDARY)
+                if isinstance(a, jax.Array) or hasattr(a, "dtype") else a
+                for a in args)
+        return function(*args, **kwargs)
+
+    return jax.checkpoint(tagged, policy=policy)
+
+
+def checkpoint(function: Callable, *args):
+    """Reference ``checkpoint(function, *args)`` API (:499)."""
+    return wrap(function)(*args)
+
+
+# --------------------------------------------------------------- RNG tracker
+
+class RngStatesTracker:
+    """Named PRNG key registry (reference ``CudaRNGStatesTracker`` :122).
+
+    Functional PRNG needs no state save/restore around recompute — the same
+    key object reaches the replay — so ``fork`` simply hands out the named
+    key; ``add`` registers one.
+    """
+
+    def __init__(self):
+        self._keys: Dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        self._keys.clear()
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self._keys)
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._keys:
+            raise RuntimeError(f"rng state {name} already exists")
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng") -> jax.Array:
+        if name not in self._keys:
+            raise RuntimeError(f"rng state {name} was not added")
+        # advance so successive forks differ (the tracker's state mutation)
+        key, sub = jax.random.split(self._keys[name])
+        self._keys[name] = key
+        return sub
+
+
+_RNG_TRACKER = RngStatesTracker()
+
+
+def get_rng_tracker() -> RngStatesTracker:
+    return _RNG_TRACKER
+
+
+get_cuda_rng_tracker = get_rng_tracker  # reference-name shim
+
+
+def model_parallel_rng_seed(seed: int, tp_rank: int = 0) -> None:
+    """Reference ``model_parallel_cuda_manual_seed``: one default stream +
+    one tp-offset stream."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("default", seed)
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718 + tp_rank)

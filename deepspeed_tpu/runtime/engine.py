@@ -122,6 +122,19 @@ class DeepSpeedEngine:
         self._init_state(rng)
         self._build_steps()
 
+        # progressive layer drop + curriculum (reference engine.py:1554/1559
+        # construction, :1698-1710 per-forward injection)
+        self._pld = None
+        if self._config.pld_enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            p = self._config.pld_params or {}
+            self._pld = ProgressiveLayerDrop(
+                theta=p.get("theta", 0.5), gamma=p.get("gamma", 0.001))
+        self._curriculum = None
+        if self._config.curriculum_enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self._curriculum = CurriculumScheduler(self._config.curriculum_params)
+
         # compression scheduler (reference engine.py:2002 steps it at every
         # optimizer step); the in-graph gating reads the step scalar the
         # engine threads through the batch
@@ -547,12 +560,52 @@ class DeepSpeedEngine:
         from ..compression.compress import STEP_KEY
         return {**batch, STEP_KEY: jnp.asarray(self.global_steps, jnp.int32)}
 
+    def _inject_train_rng(self, batch, n: Optional[int] = None):
+        """Thread per-micro-step PRNG keys into training batches for models
+        that declare ``needs_rng`` (dropout) or when PLD gates layers; eval
+        never injects, so stochasticity is train-only by construction."""
+        if not isinstance(batch, dict) or not (
+                self.module.meta.get("needs_rng") or self._pld is not None):
+            return batch
+        base = jax.random.fold_in(jax.random.PRNGKey(0), self.micro_steps)
+        if n is None:
+            return {**batch, "_train_rng": base}
+        return {**batch, "_train_rng": jax.device_put(
+            jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n)),
+            NamedSharding(self.mesh, P(None)))}
+
+    def _apply_curriculum(self, batch):
+        """Curriculum seqlen truncation (reference engine.py:1704)."""
+        if self._curriculum is None or not isinstance(batch, dict) \
+                or "tokens" not in batch:
+            return batch
+        seqlen = self._curriculum.update_difficulty(self.global_steps + 1)
+        toks = batch["tokens"]
+        if seqlen + 1 < np.shape(toks)[-1]:
+            batch = {**batch, "tokens": toks[..., :seqlen + 1]}
+        return batch
+
+    def _inject_pld(self, batch, n: Optional[int] = None):
+        """PLD theta injection (reference engine.py:1698); shape (n,) on the
+        fused path so the gas scan unstacks one scalar per micro-step."""
+        if self._pld is None or not isinstance(batch, dict):
+            return batch
+        self._pld.update_state(self.global_steps)
+        theta = jnp.asarray(self._pld.get_theta(), jnp.float32)
+        if n is not None:
+            theta = jax.device_put(jnp.full((n,), theta),
+                                   NamedSharding(self.mesh, P(None)))
+        return {**batch, "_pld_theta": theta}
+
     def forward(self, batch, **kwargs):
         """Compute loss (and, fused, the gradients) for one micro-batch."""
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         self.tput_timer.start()
+        batch = self._apply_curriculum(batch)
+        batch = self._inject_pld(batch)
         batch = self._inject_compression_step(batch)
+        batch = self._inject_train_rng(batch)
         batch = self._shard_batch(batch)
         new_acc, loss = self._micro_jit(
             self.state["params"], self.state["grad_acc"], self.state["scale"], batch)
@@ -711,6 +764,7 @@ class DeepSpeedEngine:
                 self.step()
             return jnp.mean(jnp.stack(losses))
         s = self.state
+        batches = self._apply_curriculum(batches)
         batches = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x).reshape(
                 (self.gradient_accumulation_steps(), -1) + np.shape(x)[1:]), batches)
@@ -724,6 +778,10 @@ class DeepSpeedEngine:
                 jnp.full((self.gradient_accumulation_steps(),),
                          self.global_steps, jnp.int32),
                 NamedSharding(self.mesh, P(None)))}
+        batches = self._inject_train_rng(
+            batches, n=self.gradient_accumulation_steps())
+        batches = self._inject_pld(
+            batches, n=self.gradient_accumulation_steps())
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm, overflow,
              mean_loss) = self._fused_jit(
@@ -777,7 +835,25 @@ class DeepSpeedEngine:
             path = os.path.join(save_dir, tag,
                                 f"offload_optimizer_rank{self.global_rank}.npz")
             self._offload_opt.save(path)
+        self._copy_recovery_script(save_dir)
         return True
+
+    @staticmethod
+    def _copy_recovery_script(save_dir: str) -> None:
+        """Drop a fp32-recovery shim next to the checkpoints (reference
+        engine.py:3249 copies utils/zero_to_fp32.py the same way)."""
+        path = os.path.join(save_dir, "zero_to_fp32.py")
+        if os.path.exists(path):
+            return
+        with open(path, "w") as f:
+            f.write(
+                "#!/usr/bin/env python3\n"
+                '"""Recover a consolidated fp32 state dict from this '
+                'checkpoint dir.\nUsage: python zero_to_fp32.py . out.npz '
+                '[tag]\n"""\n'
+                "import sys\n"
+                "from deepspeed_tpu.utils.zero_to_fp32 import main\n"
+                "sys.exit(main())\n")
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,

@@ -67,5 +67,7 @@ def from_gpt(config, dtype=None) -> ModelSpec:
         logical_axes=gpt.logical_axes(config),
         apply_fn=lambda params, tokens: gpt.apply(params, tokens, config),
         name="gpt",
-        meta={"config": config},
+        # needs_rng: the engine injects a per-micro-step "_train_rng" key
+        # into training batches (dropout); eval paths never inject
+        meta={"config": config, "needs_rng": config.dropout > 0},
     )

@@ -1,0 +1,168 @@
+"""State-dict factory: load mp-sharded (Megatron-style) checkpoints at a
+different tensor-parallel degree.
+
+Counterpart of the reference's ``runtime/state_dict_factory.py``
+(``SDLoaderFactory``/``MegatronSDLoader``, :474): a checkpoint written with
+tp=N is merged (N → 1, or N → M with M | N) or split (1 → M) at load.  In
+this framework the natural target is **tp=1 full arrays** — once tensors
+are global, serving/training at any degree is a declarative device_put —
+but partial merges and splits are provided for reference parity.
+
+Merge rules per tensor category (torch [out, in] Linear layout):
+- fused qkv (``query_key_value``): every shard carries its heads' (q, k, v)
+  stacked on dim 0 — split each shard in 3, concat per component, restack.
+- column-parallel (``dense_h_to_4h``, attention output *input* side …):
+  concat dim 0; row-parallel (``dense_4h_to_h``, ``attention.dense``,
+  ``out_proj``): concat dim 1.
+- embeddings (``word_embeddings``, ``position_embeddings``): concat dim 0.
+- replicated (layernorms, biases of row-parallel layers): take shard 0
+  (asserting shards agree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Sequence, Union
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _load_file(path: str) -> Dict[str, Any]:
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    # Megatron checkpoints nest the weights under 'model' / 'module'
+    for key in ("model", "module", "state_dict"):
+        if isinstance(sd, dict) and key in sd and isinstance(sd[key], dict):
+            sd = sd[key]
+    return sd
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader_json(json_path: Union[str, Dict]) -> "MegatronSDLoader":
+        """The reference's checkpoint-description json: {"type": ...,
+        "checkpoints": [paths...], "version": ...}."""
+        if isinstance(json_path, str):
+            with open(json_path) as f:
+                data = json.load(f)
+        else:
+            data = json_path
+        return SDLoaderFactory.get_sd_loader(
+            data["checkpoints"], sd_type=data.get("type", "Megatron"),
+            version=data.get("version"))
+
+    @staticmethod
+    def get_sd_loader(ckpt_list: Sequence[str], sd_type: str = "Megatron",
+                      version=None) -> "MegatronSDLoader":
+        return MegatronSDLoader(list(ckpt_list), version=version)
+
+
+class MegatronSDLoader:
+    def __init__(self, ckpt_list: List[str], version=None):
+        self.ckpt_list = ckpt_list
+        self.version = version
+
+    # ------------------------------------------------------------ category
+    @staticmethod
+    def _category(key: str) -> str:
+        if "query_key_value" in key or "c_attn" in key:
+            return "qkv"
+        if any(t in key for t in ("dense_h_to_4h", "fc1", "c_fc",
+                                  "q_proj", "k_proj", "v_proj")):
+            return "col"
+        if any(t in key for t in ("dense_4h_to_h", "attention.dense", "fc2",
+                                  "out_proj", "c_proj")):
+            return "row"
+        if "embedding" in key or key.endswith("word_embeddings.weight") or \
+                "embed" in key:
+            return "embed"
+        return "replicated"
+
+    @staticmethod
+    def merge_query_key_value(parts: List[np.ndarray]) -> np.ndarray:
+        """Each shard: [(3 × local), ...] — split thirds, concat per
+        component, restack (reference merge_query_key_value)."""
+        qs, ks, vs = [], [], []
+        for p in parts:
+            q, k, v = np.split(p, 3, axis=0)
+            qs.append(q); ks.append(k); vs.append(v)
+        return np.concatenate([np.concatenate(qs, axis=0),
+                               np.concatenate(ks, axis=0),
+                               np.concatenate(vs, axis=0)], axis=0)
+
+    @staticmethod
+    def split_query_key_value(full: np.ndarray, n: int, rank: int) -> np.ndarray:
+        q, k, v = np.split(full, 3, axis=0)
+        pick = lambda x: np.split(x, n, axis=0)[rank]
+        return np.concatenate([pick(q), pick(k), pick(v)], axis=0)
+
+    # --------------------------------------------------------------- merge
+    def _merge(self, sds: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for key in sds[0]:
+            parts = [_np(sd[key]) for sd in sds]
+            cat = self._category(key)
+            is_weight = key.endswith("weight") and parts[0].ndim >= 2
+            if cat == "qkv":
+                out[key] = self.merge_query_key_value(parts) \
+                    if parts[0].ndim >= 1 else parts[0]
+            elif cat in ("col", "embed"):
+                out[key] = np.concatenate(parts, axis=0)
+            elif cat == "row" and is_weight:
+                out[key] = np.concatenate(parts, axis=1)
+            else:  # replicated (incl. row-parallel biases, layernorms)
+                if not all(np.allclose(parts[0], p, atol=1e-6) for p in parts[1:]):
+                    logger.warning(f"replicated tensor {key} differs across "
+                                   "mp shards; taking shard 0")
+                out[key] = parts[0]
+        return out
+
+    def _split(self, sd: Dict[str, Any], n: int, rank: int) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for key, t in sd.items():
+            arr = _np(t)
+            cat = self._category(key)
+            is_weight = key.endswith("weight") and arr.ndim >= 2
+            if cat == "qkv" and arr.ndim >= 1:
+                out[key] = self.split_query_key_value(arr, n, rank)
+            elif cat in ("col", "embed"):
+                out[key] = np.split(arr, n, axis=0)[rank]
+            elif cat == "row" and is_weight:
+                out[key] = np.split(arr, n, axis=1)[rank]
+            else:
+                out[key] = arr
+        return out
+
+    # ---------------------------------------------------------------- load
+    def load(self, mp_world_size: int, mp_rank: int = 0,
+             quantize: bool = False) -> Dict[str, np.ndarray]:
+        """State dict for ``mp_rank`` of ``mp_world_size`` from a checkpoint
+        written at tp = len(ckpt_list)."""
+        src = len(self.ckpt_list)
+        if mp_world_size == src:
+            return {k: _np(v) for k, v in
+                    _load_file(self.ckpt_list[mp_rank]).items()}
+        if mp_world_size < src:
+            assert src % mp_world_size == 0, \
+                f"cannot merge tp={src} into tp={mp_world_size}"
+            factor = src // mp_world_size
+            group = [_load_file(p) for p in
+                     self.ckpt_list[mp_rank * factor:(mp_rank + 1) * factor]]
+            return self._merge(group)
+        assert mp_world_size % src == 0, \
+            f"cannot split tp={src} into tp={mp_world_size}"
+        factor = mp_world_size // src
+        sd = _load_file(self.ckpt_list[mp_rank // factor])
+        return self._split(sd, factor, mp_rank % factor)

@@ -1,0 +1,111 @@
+"""Contiguous host-buffer allocator for the swap/offload path.
+
+Counterpart of the reference's
+``deepspeed/runtime/zero/contiguous_memory_allocator.py`` (:285 file): a
+single large pinned buffer carved into tensor views, with release and
+defragmentation, so NVMe/CPU swapping reuses one allocation instead of
+churning the host allocator.  Device memory is XLA's job on TPU; this
+allocator backs the *host* side (aio staging buffers, offloaded optimizer
+partitions), where numpy views over one arena give aligned, zero-copy
+slices for ``csrc/aio`` O_DIRECT I/O.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size: int, dtype=np.float32, alignment: int = 128):
+        self.size = size
+        self.dtype = np.dtype(dtype)
+        self.alignment = alignment
+        self.buffer = np.zeros(size, self.dtype)
+        # free list: {offset: length}; allocations: {id: (offset, length)}
+        self._free: Dict[int, int] = {0: size}
+        self._alloc: Dict[int, tuple] = {}
+        self._next_id = 0
+        self.total_allocated = 0
+
+    # ------------------------------------------------------------ internal
+    def _round(self, n: int) -> int:
+        a = self.alignment
+        return -(-n // a) * a
+
+    def _merge_free(self) -> None:
+        merged: Dict[int, int] = {}
+        last_off: Optional[int] = None
+        for off in sorted(self._free):
+            if last_off is not None and last_off + merged[last_off] == off:
+                merged[last_off] += self._free[off]
+            else:
+                merged[off] = self._free[off]
+                last_off = off
+        self._free = merged
+
+    # ------------------------------------------------------------- public
+    def allocate_tensor(self, numel: int) -> tuple:
+        """Returns (tensor_id, view). Defragments when fragmented-but-able."""
+        need = self._round(numel)
+        if need > self.size - self.total_allocated:
+            raise MemoryError(
+                f"allocator exhausted: need {need}, "
+                f"free {self.size - self.total_allocated}")
+        off = self._find(need)
+        if off is None:
+            self.defragment()
+            off = self._find(need)
+            assert off is not None, "defragment failed to produce a hole"
+        length = self._free.pop(off)
+        if length > need:
+            self._free[off + need] = length - need
+        tid = self._next_id
+        self._next_id += 1
+        self._alloc[tid] = (off, need)
+        self.total_allocated += need
+        return tid, self.buffer[off:off + numel]
+
+    def _find(self, need: int) -> Optional[int]:
+        for off in sorted(self._free):
+            if self._free[off] >= need:
+                return off
+        return None
+
+    def release_tensor(self, tid: int) -> None:
+        off, length = self._alloc.pop(tid)
+        self._free[off] = length
+        self.total_allocated -= length
+        self._merge_free()
+
+    def get_tensor(self, tid: int, numel: Optional[int] = None) -> np.ndarray:
+        off, length = self._alloc[tid]
+        return self.buffer[off:off + (numel or length)]
+
+    def defragment(self) -> None:
+        """Compact live allocations to the front (the reference's
+        contiguous-buffer re-pack); existing views are invalidated, callers
+        re-fetch via get_tensor."""
+        cursor = 0
+        moved = 0
+        for tid in sorted(self._alloc, key=lambda t: self._alloc[t][0]):
+            off, length = self._alloc[tid]
+            if off != cursor:
+                self.buffer[cursor:cursor + length] = \
+                    self.buffer[off:off + length]
+                self._alloc[tid] = (cursor, length)
+                moved += 1
+            cursor += length
+        self._free = {cursor: self.size - cursor} if cursor < self.size else {}
+        if moved:
+            logger.debug(f"[allocator] defragmented {moved} tensors")
+
+    @property
+    def available(self) -> int:
+        return self.size - self.total_allocated
+
+    def largest_hole(self) -> int:
+        return max(self._free.values(), default=0)

@@ -1,0 +1,100 @@
+"""TiledLinear: split a huge linear into remat'd tiles.
+
+Counterpart of the reference's ``deepspeed/runtime/zero/tiling.py``
+(``TiledLinear``, :296 file): a linear too big to materialize activations
+(or, under ZeRO-3, to gather whole) is computed as a grid of
+(in_splits × out_splits) tile matmuls.  Functionally: the tile loop is a
+``lax.scan`` over output tiles with the input tiles' partial sums
+rematerialized (``jax.checkpoint``), so live memory is one tile's
+activations instead of the whole [B, out_features] (plus, under ZeRO-3,
+XLA gathers one weight tile at a time instead of the full matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def split_tensor_along_dim(x: jnp.ndarray, n: int, dim: int):
+    assert x.shape[dim] % n == 0, \
+        f"dim {dim} ({x.shape[dim]}) not divisible into {n} tiles"
+    return jnp.split(x, n, axis=dim)
+
+
+def tiled_linear(x: jnp.ndarray, w: jnp.ndarray,
+                 b: Optional[jnp.ndarray] = None,
+                 in_splits: int = 1, out_splits: int = 1,
+                 remat: bool = True) -> jnp.ndarray:
+    """``x @ w + b`` computed tile-by-tile.  x: [..., in], w: [in, out]."""
+    d_in, d_out = w.shape
+    assert x.shape[-1] == d_in
+    assert d_in % in_splits == 0 and d_out % out_splits == 0
+    ti = d_in // in_splits
+    to = d_out // out_splits
+
+    # [out_splits, in_splits, ti, to] tile grid of the weight
+    w_tiles = w.reshape(in_splits, ti, out_splits, to).transpose(2, 0, 1, 3)
+    x_tiles = x.reshape(x.shape[:-1] + (in_splits, ti))
+
+    def one_out_tile(w_col):
+        # sum over input tiles for one output tile: [..., to]
+        def body(acc, pair):
+            wt, xt = pair
+            return acc + jnp.einsum("...i,io->...o", xt, wt), None
+
+        acc0 = jnp.zeros(x.shape[:-1] + (to,), x.dtype)
+        acc, _ = lax.scan(body, acc0,
+                          (w_col, jnp.moveaxis(x_tiles, -2, 0)))
+        return acc
+
+    fn = jax.checkpoint(one_out_tile) if remat else one_out_tile
+    _, out = lax.scan(lambda carry, w_col: (carry, fn(w_col)), 0, w_tiles)
+    # out: [out_splits, ..., to] → [..., out]
+    out = jnp.moveaxis(out, 0, -2).reshape(x.shape[:-1] + (d_out,))
+    if b is not None:
+        out = out + b
+    return out
+
+
+class TiledLinear:
+    """Module-shaped wrapper mirroring the reference constructor surface."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 in_splits: int = 1, out_splits: int = 1,
+                 input_is_already_split: bool = False,
+                 combine_out_splits: bool = True):
+        assert in_features % in_splits == 0
+        assert out_features % out_splits == 0
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.input_is_already_split = input_is_already_split
+        self.combine_out_splits = combine_out_splits
+
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> PyTree:
+        std = (2.0 / (self.in_features + self.out_features)) ** 0.5
+        p = {"w": jax.random.normal(
+            rng, (self.in_features, self.out_features)) * std}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.out_features,))
+        return jax.tree_util.tree_map(lambda t: t.astype(dtype), p)
+
+    def apply(self, params: PyTree, x) -> jnp.ndarray:
+        if self.input_is_already_split:
+            x = jnp.concatenate(x, axis=-1)
+        out = tiled_linear(x, params["w"], params.get("b"),
+                           in_splits=self.in_splits,
+                           out_splits=self.out_splits)
+        if not self.combine_out_splits:
+            return split_tensor_along_dim(out, self.out_splits, -1)
+        return out
+
+    __call__ = apply

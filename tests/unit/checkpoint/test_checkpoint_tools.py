@@ -1,0 +1,191 @@
+"""Checkpoint interop tools (reference tests/unit/checkpoint/ coverage for
+universal checkpoints, zero_to_fp32 recovery, the state-dict factory, and
+the inspection toolkit)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (DeepSpeedCheckpoint, ds_to_universal,
+                                      load_universal_into_engine)
+from deepspeed_tpu.runtime.state_dict_factory import (MegatronSDLoader,
+                                                      SDLoaderFactory)
+from deepspeed_tpu.utils.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint)
+from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
+
+
+def _train_and_save(tmp_path, steps=3, stage=1, **precision):
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(dtype=jnp.bfloat16 if precision else jnp.float32),
+        config=base_config(micro_batch=2, stage=stage, **precision),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    for i in range(steps):
+        b = random_tokens(16, 16, seed=i)
+        engine.backward(engine.forward(b)); engine.step()
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    return engine
+
+
+def test_zero_to_fp32_recovery(tmp_path):
+    engine = _train_and_save(tmp_path, bf16={"enabled": True})
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path / "ckpt"))
+    assert all(v.dtype == np.float32 for v in sd.values())
+    # the fp32 master is exact (not a bf16 round-trip): compare to live
+    live = jax.device_get(engine.state["master"])
+    flat = {}
+    from deepspeed_tpu.runtime.checkpoint_engine.native_checkpoint_engine import flatten_tree
+    for k, v in flatten_tree(live).items():
+        flat[k] = np.asarray(v, np.float32)
+    for k, v in sd.items():
+        np.testing.assert_allclose(v, flat[k], atol=0, rtol=0, err_msg=k)
+    # CLI writes an npz
+    out = tmp_path / "fp32.npz"
+    convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path / "ckpt"), str(out))
+    assert out.exists()
+    # the recovery shim was dropped next to the checkpoints
+    assert (tmp_path / "ckpt" / "zero_to_fp32.py").exists()
+
+
+def test_universal_checkpoint_roundtrip_across_topologies(tmp_path):
+    """Save under dp=8/stage1, convert to universal, resume under a dp=4/tp=2
+    stage-3 engine — loss trajectory must continue identically."""
+    from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
+    engine = _train_and_save(tmp_path, steps=2)
+    ref_losses = []
+    for i in range(2):
+        b = random_tokens(16, 16, seed=10 + i)
+        l = engine.forward(b); engine.backward(l); engine.step()
+        ref_losses.append(float(l))
+
+    uni = str(tmp_path / "universal")
+    manifest = ds_to_universal(str(tmp_path / "ckpt"), uni)
+    assert (tmp_path / "universal" / "meta.json").exists()
+    assert manifest["tensors"]
+
+    mm2 = initialize_mesh(ParallelDims(dp=4, tp=2))
+    engine2, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(
+            micro_batch=4, stage=3, extra={"tensor_parallel": {"size": 2}}),
+        mesh_manager=mm2, rng=jax.random.PRNGKey(99))
+    load_universal_into_engine(engine2, uni)
+    assert engine2.global_steps == 2
+    got = []
+    for i in range(2):
+        b = random_tokens(16, 16, seed=10 + i)
+        l = engine2.forward(b); engine2.backward(l); engine2.step()
+        got.append(float(l))
+    np.testing.assert_allclose(got, ref_losses, rtol=3e-4)
+
+
+def test_deepspeed_checkpoint_inspection(tmp_path):
+    _train_and_save(tmp_path)
+    ck = DeepSpeedCheckpoint(str(tmp_path / "ckpt"))
+    assert ck.num_parameters() > 0
+    assert "blocks/wqkv" in ck.parameter_names()
+    assert ck.num_layers() == 2
+    assert ck.client_state()["global_steps"] == 3
+    txt = ck.show()
+    assert "blocks/wqkv" in txt
+    # shard preview: head dim over a hypothetical model axis
+    shards = ck.shard_preview("blocks/wqkv", {"model": 2},
+                              [None, None, None, "model", None])
+    full = ck.model["params/blocks/wqkv"].shape
+    assert shards[0][3] == full[3] // 2
+
+
+# ---------------------------------------------------------- sd factory
+
+def _fake_megatron_shards(tp=4, d=8, f=16, heads=4, seed=0):
+    """Column/row/qkv-sharded state dicts whose merge is known exactly."""
+    rng = np.random.default_rng(seed)
+    full = {
+        "attention.query_key_value.weight": rng.normal(size=(3 * d, d)),
+        "attention.dense.weight": rng.normal(size=(d, d)),
+        "attention.dense.bias": rng.normal(size=(d,)),
+        "mlp.dense_h_to_4h.weight": rng.normal(size=(f, d)),
+        "mlp.dense_4h_to_h.weight": rng.normal(size=(d, f)),
+        "input_layernorm.weight": rng.normal(size=(d,)),
+        "word_embeddings.weight": rng.normal(size=(32, d)),
+    }
+    shards = []
+    for r in range(tp):
+        sd = {}
+        sd["attention.query_key_value.weight"] = \
+            MegatronSDLoader.split_query_key_value(
+                full["attention.query_key_value.weight"], tp, r)
+        sd["attention.dense.weight"] = np.split(
+            full["attention.dense.weight"], tp, axis=1)[r]
+        sd["attention.dense.bias"] = full["attention.dense.bias"]
+        sd["mlp.dense_h_to_4h.weight"] = np.split(
+            full["mlp.dense_h_to_4h.weight"], tp, axis=0)[r]
+        sd["mlp.dense_4h_to_h.weight"] = np.split(
+            full["mlp.dense_4h_to_h.weight"], tp, axis=1)[r]
+        sd["input_layernorm.weight"] = full["input_layernorm.weight"]
+        sd["word_embeddings.weight"] = np.split(
+            full["word_embeddings.weight"], tp, axis=0)[r]
+        shards.append(sd)
+    return full, shards
+
+
+def test_sd_factory_merges_tp_shards(tmp_path):
+    full, shards = _fake_megatron_shards(tp=4)
+    paths = []
+    for i, sd in enumerate(shards):
+        p = tmp_path / f"mp_rank_{i:02d}.npz"
+        np.savez(p, **sd)
+        paths.append(str(p))
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    merged = loader.load(mp_world_size=1)
+    for k, v in full.items():
+        np.testing.assert_allclose(merged[k], v, atol=1e-6, err_msg=k)
+
+
+def test_sd_factory_partial_merge_and_split(tmp_path):
+    full, shards = _fake_megatron_shards(tp=4)
+    paths = []
+    for i, sd in enumerate(shards):
+        p = tmp_path / f"mp_rank_{i:02d}.npz"
+        np.savez(p, **sd)
+        paths.append(str(p))
+    loader = SDLoaderFactory.get_sd_loader(paths)
+    # 4 -> 2: each new rank merges two shards
+    half0 = loader.load(mp_world_size=2, mp_rank=0)
+    q_full = full["attention.query_key_value.weight"]
+    q, k, v = np.split(q_full, 3, axis=0)
+    expect_q = np.concatenate([q[:q.shape[0] // 2],
+                               k[:k.shape[0] // 2],
+                               v[:v.shape[0] // 2]], axis=0)
+    np.testing.assert_allclose(
+        half0["attention.query_key_value.weight"], expect_q, atol=1e-6)
+    # 1 -> 2 split of the merged full roundtrips against the 4->2 merge
+    np.savez(tmp_path / "full.npz", **{k: np.asarray(v) for k, v in
+                                       loader.load(mp_world_size=1).items()})
+    loader1 = SDLoaderFactory.get_sd_loader([str(tmp_path / "full.npz")])
+    split0 = loader1.load(mp_world_size=2, mp_rank=0)
+    np.testing.assert_allclose(
+        split0["attention.query_key_value.weight"], expect_q, atol=1e-6)
+
+
+def test_sd_factory_json_descriptor(tmp_path):
+    _, shards = _fake_megatron_shards(tp=2)
+    paths = []
+    for i, sd in enumerate(shards):
+        p = tmp_path / f"mp_rank_{i:02d}.npz"
+        np.savez(p, **sd)
+        paths.append(str(p))
+    desc = {"type": "Megatron", "version": 0,
+            "checkpoints": paths}
+    jpath = tmp_path / "ckpt.json"
+    jpath.write_text(json.dumps(desc))
+    loader = SDLoaderFactory.get_sd_loader_json(str(jpath))
+    merged = loader.load(mp_world_size=1)
+    assert merged["attention.query_key_value.weight"].shape == (24, 8)

@@ -121,6 +121,55 @@ def test_fused_adam_bf16_params(pallas_interpret):
                            np.asarray(p, np.float32))
 
 
+def test_fused_lamb_matches_optimizer(pallas_interpret):
+    """Flat two-pass pallas LAMB == the per-leaf FusedLamb optimizer
+    (per-TENSOR trust ratios must survive the flat packing)."""
+    from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+    from deepspeed_tpu.ops.pallas import fused_lamb_step
+    key = jax.random.PRNGKey(7)
+    # multiple tensors with very different norms -> distinct trust ratios
+    params = {"a": jax.random.normal(key, (300,)) * 5.0,
+              "b": {"w": jax.random.normal(jax.random.fold_in(key, 1),
+                                           (64, 17)) * 0.1,
+                    "bias": jnp.zeros((5,))}}
+    grads = jax.tree_util.tree_map(
+        lambda p: jax.random.normal(jax.random.fold_in(key, 2), p.shape),
+        params)
+    zeros = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                   params)
+
+    got_p, got_m, got_v = fused_lamb_step(
+        params, grads, zeros, zeros, step=1, lr=1e-2, weight_decay=0.01)
+
+    opt = FusedLamb(lr=1e-2, weight_decay=0.01)
+    state = opt.init(params)
+    ref_p, ref_state = opt.update(
+        grads, state, params,
+        {"lr": jnp.float32(1e-2), "weight_decay": jnp.float32(0.01)})
+    for path, a in jax.tree_util.tree_flatten_with_path(got_p)[0]:
+        b = dict(jax.tree_util.tree_flatten_with_path(ref_p)[0])[path]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                                   rtol=1e-6, err_msg=jax.tree_util.keystr(path))
+    for path, a in jax.tree_util.tree_flatten_with_path(got_m)[0]:
+        b = dict(jax.tree_util.tree_flatten_with_path(
+            ref_state["exp_avg"])[0])[path]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_lamb_pack_roundtrip():
+    from deepspeed_tpu.ops.pallas.fused_lamb import pack_tree, unpack_tree
+    tree = {"x": jnp.arange(5, dtype=jnp.bfloat16),
+            "y": jnp.ones((3, 130), jnp.float32)}
+    buf, seg, meta = pack_tree(tree)
+    assert buf.shape[1] == 128 and seg.shape[0] == buf.shape[0]
+    assert int(seg[0]) == 0 and int(seg[-1]) == 1
+    back = unpack_tree(buf, meta)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(back[k], np.float32),
+                                      np.asarray(tree[k], np.float32))
+        assert back[k].dtype == tree[k].dtype
+
+
 @pytest.mark.parametrize("symmetric", [True, False])
 def test_quantize_roundtrip(symmetric):
     from deepspeed_tpu.ops.pallas import dequantize, quantize

@@ -1,0 +1,119 @@
+"""Activation checkpointing subsystem (mirror reference
+tests/unit/runtime/activation_checkpointing/): configure() surface,
+gradient parity under every policy, TP-partitioned saved activations, and
+the RNG tracker shims."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    ck.reset()
+
+
+def _mlp(w1, w2, x):
+    return jnp.tanh(jnp.tanh(x @ w1) @ w2)
+
+
+def _setup(seed=0, d=32):
+    r = np.random.default_rng(seed)
+    w1 = jnp.asarray(r.normal(size=(d, 4 * d)), jnp.float32)
+    w2 = jnp.asarray(r.normal(size=(4 * d, d)), jnp.float32)
+    x = jnp.asarray(r.normal(size=(8, d)), jnp.float32)
+    return w1, w2, x
+
+
+def test_configure_from_ds_config():
+    ck.configure(deepspeed_config={
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "cpu_checkpointing": False,
+            "number_checkpoints": 4,
+            "contiguous_memory_optimization": True,
+        }})
+    assert ck.is_configured()
+    cfg = ck.get_config()
+    assert cfg.partition_activations and cfg.number_checkpoints == 4
+    # kwargs override the json section (reference precedence)
+    ck.configure(deepspeed_config={
+        "activation_checkpointing": {"partition_activations": True}},
+        partition_activations=False)
+    assert not ck.get_config().partition_activations
+
+
+@pytest.mark.parametrize("flags", [
+    {},  # default: nothing_saveable
+    {"partition_activations": True},
+    {"cpu_checkpointing": True},  # CPU backend -> warned fallback
+])
+def test_checkpoint_grad_parity(flags):
+    ck.configure(deepspeed_config={"activation_checkpointing": flags})
+    w1, w2, x = _setup()
+
+    def loss_plain(w1, w2):
+        return jnp.sum(_mlp(w1, w2, x) ** 2)
+
+    def loss_ckpt(w1, w2):
+        return jnp.sum(ck.checkpoint(lambda a: _mlp(w1, w2, a), x) ** 2)
+
+    g_ref = jax.jit(jax.grad(loss_plain, argnums=(0, 1)))(w1, w2)
+    g_ck = jax.jit(jax.grad(loss_ckpt, argnums=(0, 1)))(w1, w2)
+    for a, b in zip(g_ck, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_partitioned_activations_run_under_tp_mesh():
+    """partition_activations shards the saved boundary over 'model'."""
+    from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
+    initialize_mesh(ParallelDims(dp=4, tp=2))
+    ck.configure(partition_activations=True)
+    w1, w2, x = _setup()
+
+    @jax.jit
+    def loss(w1, w2):
+        return jnp.sum(ck.checkpoint(lambda a: _mlp(w1, w2, a), x) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)))(w1, w2)
+    ref = jax.grad(lambda a, b: jnp.sum(_mlp(a, b, x) ** 2), argnums=(0, 1))(w1, w2)
+    for a, b in zip(g, ref):
+        # sharded reductions reorder float sums — tolerance, not bit-parity
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_gpt_remat_uses_configured_policy():
+    """config.remat + configured subsystem: model still trains/evals right."""
+    import dataclasses
+
+    from deepspeed_tpu.models import gpt
+    from tests.unit.common import TINY_GPT, random_tokens
+    ck.configure(partition_activations=True)
+    cfg = dataclasses.replace(TINY_GPT, remat=True)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(4, 16, seed=0))
+    l_remat = float(jax.jit(lambda p: gpt.loss_fn(p, batch, cfg))(params))
+    l_plain = float(jax.jit(lambda p: gpt.loss_fn(
+        p, batch, dataclasses.replace(cfg, remat=False)))(params))
+    np.testing.assert_allclose(l_remat, l_plain, rtol=1e-6)
+
+
+def test_rng_tracker():
+    ck.model_parallel_rng_seed(1234, tp_rank=1)
+    tr = ck.get_rng_tracker()
+    assert set(tr.get_states()) == {"default", "model-parallel-rng"}
+    k1 = tr.fork()
+    k2 = tr.fork()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    with pytest.raises(RuntimeError):
+        tr.add("default", 0)
+    with pytest.raises(RuntimeError):
+        tr.fork("missing")
+    # reference-name shim resolves
+    assert ck.get_cuda_rng_tracker() is tr

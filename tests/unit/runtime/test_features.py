@@ -1,0 +1,231 @@
+"""Small engine features (VERDICT missing #10): eigenvalue, sparse tensors,
+TiledLinear, contiguous allocator, PLD + curriculum engine wiring, and the
+scheduler-backed multinode runners."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
+
+SEQ = 16
+
+
+# ------------------------------------------------------------- eigenvalue
+
+def test_eigenvalue_exact_on_quadratic():
+    """loss = Σ_l c_l ‖w_l‖² has per-layer Hessian 2·c_l·I — the power
+    iteration must recover exactly [2c_0, 2c_1, ...]."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    c = jnp.asarray([0.5, 2.0, 4.0])
+    params = {"blocks": {"w": jnp.ones((3, 8))},
+              "other": jnp.ones((4,))}
+
+    def loss(p):
+        per_layer = jnp.sum(jnp.square(p["blocks"]["w"]), axis=1)
+        return jnp.sum(c * per_layer) + jnp.sum(p["other"])
+
+    ev = Eigenvalue(max_iter=50, tol=1e-4)
+    eigs = ev.compute_eigenvalue(loss, params, rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(eigs, [1.0, 4.0, 8.0], rtol=1e-3)
+
+
+def test_eigenvalue_on_gpt():
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    from tests.unit.common import TINY_GPT
+    params = gpt.init(TINY_GPT, jax.random.PRNGKey(0))
+    batch = jax.tree_util.tree_map(jnp.asarray, random_tokens(4, SEQ, seed=0))
+    ev = Eigenvalue(max_iter=8, tol=1e-2)
+    eigs = ev.compute_eigenvalue(
+        lambda p: gpt.loss_fn(p, batch, TINY_GPT), params)
+    assert len(eigs) == TINY_GPT.n_layer
+    assert all(np.isfinite(e) and e > 0 for e in eigs)
+
+
+# ----------------------------------------------------------- sparse tensor
+
+def test_sparse_tensor_roundtrip_and_reduce():
+    from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                     sparse_all_reduce)
+    rng = np.random.default_rng(0)
+    dense = np.zeros((32, 8), np.float32)
+    rows = [3, 7, 21]
+    dense[rows] = rng.normal(size=(3, 8))
+    st = SparseTensor.from_dense(jnp.asarray(dense))
+    assert st.nnz == 3
+    assert st.sparse_size() < st.dense_size()
+    np.testing.assert_allclose(np.asarray(st.to_dense()), dense)
+
+    dense2 = np.zeros((32, 8), np.float32)
+    dense2[[7, 9]] = rng.normal(size=(2, 8))
+    st2 = SparseTensor.from_dense(jnp.asarray(dense2))
+    red = sparse_all_reduce([st, st2])
+    np.testing.assert_allclose(np.asarray(red.to_dense()), dense + dense2,
+                               rtol=1e-6)
+    assert red.nnz == 4  # union of {3,7,21} and {7,9}
+
+
+def test_sparse_tensor_jit_static_bound():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+    @jax.jit
+    def f(d):
+        st = SparseTensor.from_dense(d, max_rows=4)
+        return st.to_dense()
+
+    dense = jnp.zeros((16, 4)).at[jnp.asarray([1, 5])].set(1.0)
+    np.testing.assert_allclose(np.asarray(f(dense)), np.asarray(dense))
+
+
+# ------------------------------------------------------------ tiled linear
+
+def test_tiled_linear_matches_dense():
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear, tiled_linear
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 6, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(96,)), jnp.float32)
+    ref = x @ w + b
+    for ins, outs in [(1, 1), (2, 3), (4, 4)]:
+        got = tiled_linear(x, w, b, in_splits=ins, out_splits=outs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-4, err_msg=f"{ins}x{outs}")
+    # module surface + gradients flow through the tile scan
+    tl = TiledLinear(64, 96, in_splits=2, out_splits=2)
+    p = tl.init(jax.random.PRNGKey(0))
+    g = jax.grad(lambda p: jnp.sum(tl.apply(p, x) ** 2))(p)
+    assert g["w"].shape == (64, 96) and bool(jnp.all(jnp.isfinite(g["w"])))
+
+
+# --------------------------------------------------------------- allocator
+
+def test_contiguous_memory_allocator():
+    from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import (
+        ContiguousMemoryAllocator)
+    al = ContiguousMemoryAllocator(1024, alignment=128)
+    t1, v1 = al.allocate_tensor(100)
+    t2, v2 = al.allocate_tensor(200)
+    t3, v3 = al.allocate_tensor(100)
+    v1[:] = 1.0
+    v3[:] = 3.0
+    assert al.total_allocated == 128 + 256 + 128
+    al.release_tensor(t2)  # hole in the middle
+    # too big for any hole but fits after defrag
+    t4, v4 = al.allocate_tensor(600)
+    v4[:] = 4.0
+    # data moved but preserved
+    np.testing.assert_array_equal(al.get_tensor(t1, 100), 1.0)
+    np.testing.assert_array_equal(al.get_tensor(t3, 100), 3.0)
+    np.testing.assert_array_equal(al.get_tensor(t4, 600), 4.0)
+    with pytest.raises(MemoryError):
+        al.allocate_tensor(10_000)
+    al.release_tensor(t1)
+    al.release_tensor(t3)
+    al.release_tensor(t4)
+    assert al.available == 1024 and al.largest_hole() == 1024
+
+
+# ------------------------------------------------------- PLD + curriculum
+
+def test_pld_theta_one_is_identity_and_decays():
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+    mm = make_mesh(dp=8)
+    batch = random_tokens(16, SEQ, seed=0)
+
+    def run(extra):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=tiny_model(), config=base_config(micro_batch=2, extra=extra),
+            mesh_manager=mm, rng=jax.random.PRNGKey(0))
+        l = engine.forward(batch); engine.backward(l); engine.step()
+        return float(l), engine
+
+    base_loss, _ = run(None)
+    pld_loss, eng = run({"progressive_layer_drop":
+                         {"enabled": True, "theta": 1.0, "gamma": 0.0}})
+    # theta=1: every layer keeps; must equal the vanilla forward
+    np.testing.assert_allclose(pld_loss, base_loss, rtol=1e-6)
+    # theta decays toward the floor over steps
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    pld.update_state(0)
+    t0 = pld.get_theta()
+    pld.update_state(500)
+    assert t0 == 1.0 and 0.5 < pld.get_theta() < 1.0
+
+
+def test_pld_trains():
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(
+            micro_batch=2,
+            extra={"progressive_layer_drop": {"enabled": True, "theta": 0.6,
+                                              "gamma": 0.001}}),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    losses = []
+    for i in range(6):
+        b = random_tokens(16, SEQ, seed=i)
+        l = engine.forward(b); engine.backward(l); engine.step()
+        losses.append(float(l))
+    assert losses[-1] < losses[0] + 0.2
+    # eval path is deterministic (no theta/rng injected)
+    e = random_tokens(8, SEQ, seed=99)
+    assert float(engine.eval_loss(e)) == float(engine.eval_loss(e))
+
+
+def test_curriculum_truncates_seqlen():
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(
+            micro_batch=2,
+            extra={"curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}}}),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    assert engine._curriculum is not None
+    losses = []
+    for i in range(5):
+        b = random_tokens(16, SEQ, seed=i)
+        l = engine.forward(b); engine.backward(l); engine.step()
+        losses.append(float(l))
+    # difficulty reached the max by the end of the curriculum window
+    assert engine._curriculum.get_current_difficulty() == 16
+    assert all(np.isfinite(l) for l in losses)
+
+
+# ------------------------------------------------------- multinode runners
+
+def test_multinode_runner_cmds():
+    import argparse
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.multinode_runner import (OpenMPIRunner,
+                                                         PDSHRunner,
+                                                         SlurmRunner)
+    args = argparse.Namespace(
+        master_addr="10.0.0.1", master_port=29500, launcher_args="",
+        user_script="train.py", user_args=["--foo", "1"], include="")
+    pool = OrderedDict([("host1", 1), ("host2", 1), ("host3", 1)])
+
+    slurm = SlurmRunner(args, world_info="abc")
+    slurm.add_export("JAX_PLATFORMS", "tpu")
+    cmd = slurm.get_cmd({}, pool)
+    assert cmd[:3] == ["srun", "-n", "3"]
+    assert "--node_rank_env=SLURM_PROCID" in cmd
+    assert any("JAX_PLATFORMS=tpu" in c for c in cmd)
+
+    ompi = OpenMPIRunner(args, world_info="abc")
+    cmd = ompi.get_cmd({}, pool)
+    assert cmd[:3] == ["mpirun", "-n", "3"]
+    assert "--node_rank_env=OMPI_COMM_WORLD_RANK" in cmd
+    assert "host1:1,host2:1,host3:1" in cmd
+
+    pdsh = PDSHRunner(args, world_info="abc")
+    cmd = pdsh.get_cmd({}, pool)
+    assert cmd[0] == "pdsh"
+    assert "--node_rank=%n" in cmd[-1]
