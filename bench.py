@@ -84,37 +84,54 @@ def _init_devices(attempts: int = 3, probe_timeout_s: float = 120.0,
 
 
 def main() -> None:
+    # `python bench.py bert` benches BERT-large seq-128 MLM pretraining (the
+    # reference's headline: 272 samples/s on one V100,
+    # docs/_tutorials/bert-pretraining.md:392); default is GPT-2 (the
+    # driver's metric).
+    bench_bert = len(sys.argv) > 1 and sys.argv[1] == "bert"
     devices, tpu_error = _init_devices()
 
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
-    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.models import bert, gpt
     from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
     from deepspeed_tpu.runtime.model import from_gpt
     n_chips = len(devices)
     platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
 
-    # model + batch sizing: CPU CI keeps it tiny; a real chip runs GPT-2 125M
-    if on_tpu:
-        import dataclasses
-        # no remat: 125M at this batch fits HBM comfortably, and recompute
-        # would burn ~33% extra FLOPs the MFU accounting doesn't credit
-        config = dataclasses.replace(gpt.GPT2_125M, max_seq_len=1024,
-                                     dtype=jnp.bfloat16, remat=False)
-        micro_batch = 16
-        gas = 1
-        steps = 10
-        warmup = 2
+    # model + batch sizing: CPU CI keeps it tiny; a real chip runs the full
+    # model (no remat: these fit HBM, and recompute would burn ~33% extra
+    # FLOPs the MFU accounting doesn't credit)
+    import dataclasses
+    if bench_bert:
+        if on_tpu:
+            config = dataclasses.replace(bert.BERT_LARGE, max_seq_len=128,
+                                         dtype=jnp.bfloat16)
+            micro_batch, gas, steps, warmup = 64, 1, 10, 2
+        else:
+            config = bert.BertConfig(vocab_size=512, max_seq_len=64, n_layer=2,
+                                     n_head=4, d_model=128, dtype=jnp.float32)
+            micro_batch, gas, steps, warmup = 4, 1, 4, 1
+        model_spec = bert.model_spec(config)
+        flops_per_tok = bert.flops_per_token(config)
+        metric = "bert_large_mlm_samples_per_sec_per_chip"
+        baseline = 272.0  # samples/s on 1x V100 (reference headline)
     else:
-        config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
-                               n_head=4, d_model=128, dtype=jnp.float32)
-        micro_batch = 4
-        gas = 1
-        steps = 4
-        warmup = 1
+        if on_tpu:
+            config = dataclasses.replace(gpt.GPT2_125M, max_seq_len=1024,
+                                         dtype=jnp.bfloat16, remat=False)
+            micro_batch, gas, steps, warmup = 16, 1, 10, 2
+        else:
+            config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
+                                   n_head=4, d_model=128, dtype=jnp.float32)
+            micro_batch, gas, steps, warmup = 4, 1, 4, 1
+        model_spec = from_gpt(config)
+        flops_per_tok = gpt.flops_per_token(config)
+        metric = "gpt2_train_samples_per_sec_per_chip"
+        baseline = None
 
     seq = config.max_seq_len
     mm = initialize_mesh(ParallelDims(dp=-1))
@@ -127,19 +144,28 @@ def main() -> None:
         "bf16": {"enabled": bool(on_tpu)},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=from_gpt(config), config=ds_config, mesh_manager=mm,
+        model=model_spec, config=ds_config, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))
 
     rng = np.random.default_rng(0)
     global_batch = micro_batch * mm.dp_world_size * gas
-    batch = {"tokens": rng.integers(
-        0, config.vocab_size, size=(global_batch, seq + 1)).astype(np.int32)}
+    if bench_bert:
+        tokens = rng.integers(0, config.vocab_size,
+                              size=(global_batch, seq)).astype(np.int32)
+        labels = np.where(rng.random((global_batch, seq)) < 0.15, tokens, -100)
+        batch = {"tokens": tokens, "mlm_labels": labels.astype(np.int32)}
+    else:
+        batch = {"tokens": rng.integers(
+            0, config.vocab_size, size=(global_batch, seq + 1)).astype(np.int32)}
 
     # warmup (compile).  The fence is a host transfer of a param leaf:
     # block_until_ready can return early on some experimental PJRT transports,
     # but device_get cannot lie — it needs the real bytes of the final state.
     def fence():
-        np.asarray(jax.device_get(engine.state["params"]["lnf_scale"]))
+        # host-transfer a CURRENT param leaf: device_get cannot return until
+        # the final state of the last step is materialized
+        leaf = jax.tree_util.tree_leaves(engine.state["params"])[0]
+        np.asarray(jax.device_get(leaf))
 
     for _ in range(warmup):
         loss = engine.train_batch_fused(batch)
@@ -153,7 +179,6 @@ def main() -> None:
 
     samples_per_sec = steps * global_batch / dt
     tokens_per_sec = samples_per_sec * seq
-    flops_per_tok = gpt.flops_per_token(config)
     achieved_flops = tokens_per_sec * flops_per_tok
 
     # peak bf16 flops per chip by device generation
@@ -170,13 +195,20 @@ def main() -> None:
             peak_per_chip = 197e12  # conservative default
     mfu = achieved_flops / (peak_per_chip * n_chips) if peak_per_chip else 0.0
 
+    # vs_baseline: BERT compares samples/s directly against the reference's
+    # published 272/V100; GPT (no published equivalent) reports MFU vs the
+    # 0.45 north star
+    if baseline is not None:
+        vs = round((samples_per_sec / n_chips) / baseline, 4) if on_tpu else 0.0
+    else:
+        vs = round(mfu / 0.45, 4) if mfu else 0.0
     result = {
-        "metric": "gpt2_train_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(samples_per_sec / n_chips, 3),
         "unit": "samples/s/chip",
-        "vs_baseline": round(mfu / 0.45, 4) if mfu else 0.0,
+        "vs_baseline": vs,
         "detail": {
-            "model": f"gpt2-{config.n_layer}L-{config.d_model}d",
+            "model": f"{config.n_layer}L-{config.d_model}d",
             "seq_len": seq,
             "global_batch": global_batch,
             "n_chips": n_chips,

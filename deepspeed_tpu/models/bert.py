@@ -1,0 +1,308 @@
+"""BERT-family encoder, TPU-first.
+
+The reference's headline pretraining benchmark is BERT-large
+(``docs/_tutorials/bert-pretraining.md`` — 272 samples/s/V100 at seq 128)
+and its fused-kernel training stack (``csrc/transformer/``) targets this
+encoder; ``HFBertLayerPolicy`` (module_inject/replace_policy.py:143) is its
+injection surface.  Same design as ``models/gpt.py``: layer-stacked params
+scanned with ``lax.scan``, logical-axis annotations for TP/FSDP, bf16
+matmuls with fp32 logits, flash attention (non-causal) on the Pallas path.
+
+Differences from the GPT family that matter here: bidirectional attention
+with a padding mask, token-type embeddings, post-layernorm residuals
+(original BERT ordering), an MLM head with its own transform + layernorm,
+and the NSP/classification pooler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .partitioning import EMBED, HEADS, KV, LAYERS, MLP, SEQ, VOCAB
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+    remat: bool = False
+    use_flash_attention: bool = True
+    vocab_round_to: int = 128
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        r = self.vocab_round_to
+        return ((self.vocab_size + r - 1) // r) * r
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(n_layer=24, n_head=16, d_model=1024)
+
+PRESETS = {"bert-base": BERT_BASE, "bert-large": BERT_LARGE}
+
+
+# --------------------------------------------------------------------- init
+
+def _normal(rng, shape, std, dtype):
+    return (jax.random.normal(rng, shape) * std).astype(dtype)
+
+
+def init(config: BertConfig, rng: jax.Array) -> PyTree:
+    d, v, L = config.d_model, config.padded_vocab, config.n_layer
+    h, hd, f = config.n_head, config.head_dim, config.ffn_dim
+    pdt = config.param_dtype
+    std = 0.02
+    keys = jax.random.split(rng, 10)
+    block = {
+        "wqkv": _normal(keys[0], (L, d, 3, h, hd), std, pdt),
+        "bqkv": jnp.zeros((L, 3, h, hd), pdt),
+        "wo": _normal(keys[1], (L, h, hd, d), std, pdt),
+        "bo": jnp.zeros((L, d), pdt),
+        "ln1_scale": jnp.ones((L, d), pdt),   # post-attention LN
+        "ln1_bias": jnp.zeros((L, d), pdt),
+        "wi": _normal(keys[2], (L, d, f), std, pdt),
+        "bi": jnp.zeros((L, f), pdt),
+        "wo_mlp": _normal(keys[3], (L, f, d), std, pdt),
+        "bo_mlp": jnp.zeros((L, d), pdt),
+        "ln2_scale": jnp.ones((L, d), pdt),   # post-MLP LN
+        "ln2_bias": jnp.zeros((L, d), pdt),
+    }
+    return {
+        "wte": _normal(keys[4], (v, d), std, pdt),
+        "wpe": _normal(keys[5], (config.max_seq_len, d), std, pdt),
+        "wtype": _normal(keys[6], (config.type_vocab_size, d), std, pdt),
+        "emb_ln_scale": jnp.ones((d,), pdt),
+        "emb_ln_bias": jnp.zeros((d,), pdt),
+        "blocks": block,
+        # MLM head: dense transform + LN + tied decoder with bias
+        "mlm_dense": _normal(keys[7], (d, d), std, pdt),
+        "mlm_dense_bias": jnp.zeros((d,), pdt),
+        "mlm_ln_scale": jnp.ones((d,), pdt),
+        "mlm_ln_bias": jnp.zeros((d,), pdt),
+        "mlm_bias": jnp.zeros((v,), pdt),
+        # pooler (NSP / classification)
+        "pool_w": _normal(keys[8], (d, d), std, pdt),
+        "pool_b": jnp.zeros((d,), pdt),
+    }
+
+
+def logical_axes(config: BertConfig) -> PyTree:
+    return {
+        "wte": (VOCAB, EMBED),
+        "wpe": (SEQ, EMBED),
+        "wtype": (None, EMBED),
+        "emb_ln_scale": (EMBED,),
+        "emb_ln_bias": (EMBED,),
+        "blocks": {
+            "wqkv": (LAYERS, EMBED, None, HEADS, KV),
+            "bqkv": (LAYERS, None, HEADS, KV),
+            "wo": (LAYERS, HEADS, KV, EMBED),
+            "bo": (LAYERS, EMBED),
+            "ln1_scale": (LAYERS, EMBED),
+            "ln1_bias": (LAYERS, EMBED),
+            "wi": (LAYERS, EMBED, MLP),
+            "bi": (LAYERS, MLP),
+            "wo_mlp": (LAYERS, MLP, EMBED),
+            "bo_mlp": (LAYERS, EMBED),
+            "ln2_scale": (LAYERS, EMBED),
+            "ln2_bias": (LAYERS, EMBED),
+        },
+        "mlm_dense": (EMBED, None),
+        "mlm_dense_bias": (EMBED,),
+        "mlm_ln_scale": (EMBED,),
+        "mlm_ln_bias": (EMBED,),
+        "mlm_bias": (VOCAB,),
+        "pool_w": (EMBED, None),
+        "pool_b": (EMBED,),
+    }
+
+
+# -------------------------------------------------------------------- apply
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, pad_mask, config: BertConfig):
+    """Bidirectional MHA with a padding mask. q,k,v: [B,S,H,D];
+    pad_mask: [B, S] bool (True = real token)."""
+    if pad_mask is None and config.use_flash_attention:
+        from ..ops.pallas import flash_attention
+        return flash_attention(q, k, v, causal=False)
+    scale = 1.0 / math.sqrt(config.head_dim)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if pad_mask is not None:
+        # large-finite rather than -inf: a fully padded row (dataset-tail
+        # batch padding) must yield garbage-but-finite outputs, not NaNs
+        # that survive the MLM label mask and poison the batch loss
+        s = jnp.where(pad_mask[:, None, None, :], s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def _dropout(x, rate: float, key):
+    if key is None or rate <= 0.0:
+        return x
+    mask = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(mask, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def _block(x, pad_mask, p, config: BertConfig, dropout_key=None):
+    """Post-LN transformer encoder block (original BERT ordering)."""
+    cdt = config.dtype
+    eps = config.layer_norm_eps
+    k_attn = k_mlp = None
+    if dropout_key is not None:
+        k_attn, k_mlp = jax.random.split(dropout_key)
+    qkv = jnp.einsum("bsd,dthe->bsthe", x, p["wqkv"].astype(cdt)) \
+        + p["bqkv"].astype(cdt)
+    attn = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask, config)
+    attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
+        + p["bo"].astype(cdt)
+    attn_out = _dropout(attn_out, config.dropout, k_attn)
+    x = _layer_norm(x + attn_out, p["ln1_scale"], p["ln1_bias"], eps)
+    ff = jnp.einsum("bsd,df->bsf", x, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
+    ff = jax.nn.gelu(ff, approximate=False)
+    ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) \
+        + p["bo_mlp"].astype(cdt)
+    ff_out = _dropout(ff_out, config.dropout, k_mlp)
+    return _layer_norm(x + ff_out, p["ln2_scale"], p["ln2_bias"], eps)
+
+
+def encode(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
+           token_type_ids: Optional[jnp.ndarray] = None,
+           attention_mask: Optional[jnp.ndarray] = None,
+           dropout_rng=None) -> jnp.ndarray:
+    """tokens [B,S] → hidden states [B,S,d] (compute dtype)."""
+    cdt = config.dtype
+    B, S = tokens.shape
+    pos = jnp.arange(S)
+    ttype = token_type_ids if token_type_ids is not None \
+        else jnp.zeros_like(tokens)
+    x = params["wte"].astype(cdt)[tokens] \
+        + params["wpe"].astype(cdt)[pos][None] \
+        + params["wtype"].astype(cdt)[ttype]
+    x = _layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                    config.layer_norm_eps)
+    use_dropout = dropout_rng is not None and config.dropout > 0
+    if use_dropout:
+        emb_key, dropout_rng = jax.random.split(dropout_rng)
+        x = _dropout(x, config.dropout, emb_key)
+    pad_mask = attention_mask.astype(bool) if attention_mask is not None else None
+
+    block_fn = partial(_block, config=config)
+    if config.remat:
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, xs):
+        layer_params, idx = xs
+        key = jax.random.fold_in(dropout_rng, idx) if use_dropout else None
+        return block_fn(carry, pad_mask, layer_params, dropout_key=key), None
+
+    x, _ = lax.scan(body, x, (params["blocks"], jnp.arange(config.n_layer)))
+    return x
+
+
+def mlm_logits(params: PyTree, hidden, config: BertConfig) -> jnp.ndarray:
+    """MLM head: transform + LN + tied decoder (+vocab bias), fp32 out."""
+    cdt = config.dtype
+    h = jnp.einsum("...d,de->...e", hidden, params["mlm_dense"].astype(cdt)) \
+        + params["mlm_dense_bias"].astype(cdt)
+    h = jax.nn.gelu(h, approximate=False)
+    h = _layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                    config.layer_norm_eps)
+    logits = jnp.einsum("...d,vd->...v", h.astype(cdt),
+                        params["wte"].astype(cdt),
+                        preferred_element_type=jnp.float32)
+    return logits + params["mlm_bias"].astype(jnp.float32)
+
+
+def pooled_output(params: PyTree, hidden, config: BertConfig) -> jnp.ndarray:
+    """[CLS] pooler (NSP/classification input)."""
+    cdt = config.dtype
+    cls = hidden[:, 0]
+    return jnp.tanh(jnp.einsum("bd,de->be", cls, params["pool_w"].astype(cdt))
+                    + params["pool_b"].astype(cdt))
+
+
+def apply(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
+          token_type_ids=None, attention_mask=None) -> jnp.ndarray:
+    """tokens → MLM logits [B, S, padded_vocab] fp32."""
+    return mlm_logits(params, encode(params, tokens, config, token_type_ids,
+                                     attention_mask), config)
+
+
+def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray],
+            config: BertConfig) -> jnp.ndarray:
+    """Masked-LM cross-entropy.
+
+    batch: {"tokens": [B,S] (input with [MASK]s applied),
+            "mlm_labels": [B,S] (-100 = not predicted),
+            optional "token_type_ids", "attention_mask"}.
+    """
+    dropout_rng = None
+    if "_train_rng" in batch:
+        batch = dict(batch)
+        dropout_rng = batch.pop("_train_rng")
+    tokens = batch["tokens"]
+    labels = batch["mlm_labels"]
+    logits = mlm_logits(params, encode(
+        params, tokens, config, batch.get("token_type_ids"),
+        batch.get("attention_mask"), dropout_rng=dropout_rng), config)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    safe = jnp.maximum(labels, 0)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def flops_per_token(config: BertConfig) -> float:
+    """6N + attention flops per token (MFU accounting, fwd+bwd)."""
+    d, L, S = config.d_model, config.n_layer, config.max_seq_len
+    n_params = (config.padded_vocab * d + S * d + config.type_vocab_size * d
+                + L * (12 * d * d + 13 * d) + 2 * d * d + 4 * d)
+    return 6.0 * n_params + 12.0 * L * d * S
+
+
+def model_spec(config: BertConfig):
+    from ..runtime.model import ModelSpec
+    return ModelSpec(
+        loss_fn=lambda p, b: loss_fn(p, b, config),
+        init_fn=lambda rng: init(config, rng),
+        logical_axes=logical_axes(config),
+        apply_fn=lambda p, t: apply(p, t, config),
+        name="bert",
+        meta={"config": config, "needs_rng": config.dropout > 0},
+    )

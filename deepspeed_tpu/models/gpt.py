@@ -64,6 +64,7 @@ class GPTConfig:
     activation: str = "gelu"            # gelu | relu
     parallel_residual: bool = False     # NeoX: x + attn(ln1 x) + mlp(ln2 x)
     tie_word_embeddings: bool = True    # False -> separate lm_head param
+    lm_head_bias: bool = False          # GPT-J: untied head carries a bias
     pos_offset: int = 0                 # OPT stores positions offset by 2
     embed_layernorm: bool = False       # BLOOM's word_embeddings_layernorm
 
@@ -156,6 +157,8 @@ def init(config: GPTConfig, rng: jax.Array) -> PyTree:
             keys[5], (config.max_seq_len + config.pos_offset, d), std, pdt)
     if not config.tie_word_embeddings:
         params["lm_head"] = _normal(keys[6], (v, d), std, pdt)
+        if config.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((v,), pdt)
     if config.embed_layernorm:
         params["emb_ln_scale"] = jnp.ones((d,), pdt)
         params["emb_ln_bias"] = jnp.zeros((d,), pdt)
@@ -187,6 +190,8 @@ def logical_axes(config: GPTConfig) -> PyTree:
         axes["wpe"] = (SEQ, EMBED)
     if not config.tie_word_embeddings:
         axes["lm_head"] = (VOCAB, EMBED)
+        if config.lm_head_bias:
+            axes["lm_head_bias"] = (VOCAB,)
     if config.embed_layernorm:
         axes["emb_ln_scale"] = (EMBED,)
         axes["emb_ln_bias"] = (EMBED,)
@@ -419,9 +424,12 @@ def lm_logits(params: PyTree, x, config: GPTConfig) -> jnp.ndarray:
     """
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     head = params["wte"] if config.tie_word_embeddings else params["lm_head"]
-    return jnp.einsum("...d,vd->...v", x.astype(config.dtype),
-                      head.astype(config.dtype),
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum("...d,vd->...v", x.astype(config.dtype),
+                        head.astype(config.dtype),
+                        preferred_element_type=jnp.float32)
+    if "lm_head_bias" in params:  # GPT-J's biased untied head
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits
 
 
 def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,

@@ -371,8 +371,297 @@ class GPTNEOXLayerPolicy:
         return _tree_to_jnp(params, config.param_dtype)
 
 
+class HFBertLayerPolicy:
+    """transformers BERT (``BertForMaskedLM``/``BertModel``) → the
+    ``models/bert.py`` encoder tree (reference replace_policy.py:143)."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("attention.self.query.weight" in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32):
+        from ..models import bert
+        return bert.BertConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            type_vocab_size=hf_config.type_vocab_size,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size,
+            layer_norm_eps=hf_config.layer_norm_eps,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config) -> PyTree:
+        from ..models import bert
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+        def get(name):
+            return sd[pre + name]
+
+        def pad_v(w):
+            p = config.padded_vocab - w.shape[0]
+            return np.concatenate(
+                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+
+        def lw(i, name):
+            return _linear_w(get, f"encoder.layer.{i}.{name}.weight")
+
+        def lb(i, name):
+            return _np(get(f"encoder.layer.{i}.{name}.bias"))
+
+        def lnp(i, name, field):
+            return _np(get(f"encoder.layer.{i}.{name}.LayerNorm.{field}"))
+
+        def qkv_w(i):
+            return np.stack([lw(i, f"attention.self.{n}").reshape(d, H, Dh)
+                             for n in ("query", "key", "value")], axis=1)
+
+        def qkv_b(i):
+            return np.stack([lb(i, f"attention.self.{n}").reshape(H, Dh)
+                             for n in ("query", "key", "value")], axis=0)
+
+        block = {
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.stack([qkv_b(i) for i in range(L)]),
+            "wo": np.stack([lw(i, "attention.output.dense").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "attention.output.dense") for i in range(L)]),
+            "ln1_scale": np.stack([lnp(i, "attention.output", "weight")
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([lnp(i, "attention.output", "bias")
+                                  for i in range(L)]),
+            "wi": np.stack([lw(i, "intermediate.dense") for i in range(L)]),
+            "bi": np.stack([lb(i, "intermediate.dense") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "output.dense") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "output.dense") for i in range(L)]),
+            "ln2_scale": np.stack([lnp(i, "output", "weight") for i in range(L)]),
+            "ln2_bias": np.stack([lnp(i, "output", "bias") for i in range(L)]),
+        }
+        params = {
+            "wte": pad_v(_np(get("embeddings.word_embeddings.weight"))),
+            "wpe": _np(get("embeddings.position_embeddings.weight")),
+            "wtype": _np(get("embeddings.token_type_embeddings.weight")),
+            "emb_ln_scale": _np(get("embeddings.LayerNorm.weight")),
+            "emb_ln_bias": _np(get("embeddings.LayerNorm.bias")),
+            "blocks": block,
+        }
+        # MLM head (BertForMaskedLM); absent on a bare BertModel
+        if "cls.predictions.transform.dense.weight" in sd:
+            params["mlm_dense"] = _np(
+                sd["cls.predictions.transform.dense.weight"]).T
+            params["mlm_dense_bias"] = _np(
+                sd["cls.predictions.transform.dense.bias"])
+            params["mlm_ln_scale"] = _np(
+                sd["cls.predictions.transform.LayerNorm.weight"])
+            params["mlm_ln_bias"] = _np(
+                sd["cls.predictions.transform.LayerNorm.bias"])
+            params["mlm_bias"] = pad_v(_np(sd["cls.predictions.bias"]))
+        else:
+            params["mlm_dense"] = np.eye(d, dtype=np.float32)
+            params["mlm_dense_bias"] = np.zeros((d,), np.float32)
+            params["mlm_ln_scale"] = np.ones((d,), np.float32)
+            params["mlm_ln_bias"] = np.zeros((d,), np.float32)
+            params["mlm_bias"] = np.zeros((config.padded_vocab,), np.float32)
+        if pre + "pooler.dense.weight" in sd:
+            params["pool_w"] = _np(get("pooler.dense.weight")).T
+            params["pool_b"] = _np(get("pooler.dense.bias"))
+        else:
+            params["pool_w"] = np.eye(d, dtype=np.float32)
+            params["pool_b"] = np.zeros((d,), np.float32)
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+class HFGPTJLayerPolicy:
+    """transformers GPT-J (``GPTJForCausalLM``): interleaved rotary over
+    ``rotary_dim`` dims, parallel residual with ONE shared layernorm
+    (mapped by aliasing ln2 := ln1), bias-free attention, biased untied
+    head (reference replace_policy.py:298)."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("attn.q_proj.weight" in k and "h." in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        hd = hf_config.n_embd // hf_config.n_head
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.n_positions,
+            n_layer=hf_config.n_layer,
+            n_head=hf_config.n_head,
+            d_model=hf_config.n_embd,
+            d_ff=getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd,
+            pos_embed="rotary",
+            rotary_pct=(hf_config.rotary_dim or hd) / hd,
+            rotary_interleaved=True,
+            parallel_residual=True,
+            tie_word_embeddings=False,
+            lm_head_bias=True,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "transformer." if any(k.startswith("transformer.") for k in sd) \
+            else ""
+
+        def get(name):
+            return sd[pre + name]
+
+        def pad_v(w):
+            p = config.padded_vocab - w.shape[0]
+            return np.concatenate(
+                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+
+        def lw(i, name):
+            return _np(get(f"h.{i}.{name}.weight")).T
+
+        def qkv_w(i):
+            return np.stack([lw(i, f"attn.{n}_proj").reshape(d, H, Dh)
+                             for n in ("q", "k", "v")], axis=1)
+
+        ln1_scale = np.stack([_np(get(f"h.{i}.ln_1.weight")) for i in range(L)])
+        ln1_bias = np.stack([_np(get(f"h.{i}.ln_1.bias")) for i in range(L)])
+        block = {
+            "ln1_scale": ln1_scale,
+            "ln1_bias": ln1_bias,
+            # GPT-J has ONE layernorm feeding both parallel branches; our
+            # parallel-residual block applies ln1 to attn and ln2 to mlp,
+            # so aliasing ln2 = ln1 reproduces the shared-LN dataflow
+            "ln2_scale": ln1_scale.copy(),
+            "ln2_bias": ln1_bias.copy(),
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.zeros((L, 3, H, Dh), np.float32),
+            "wo": np.stack([lw(i, "attn.out_proj").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.zeros((L, d), np.float32),
+            "wi": np.stack([lw(i, "mlp.fc_in") for i in range(L)]),
+            "bi": np.stack([_np(get(f"h.{i}.mlp.fc_in.bias"))
+                            for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "mlp.fc_out") for i in range(L)]),
+            "bo_mlp": np.stack([_np(get(f"h.{i}.mlp.fc_out.bias"))
+                                for i in range(L)]),
+        }
+        params = {
+            "wte": pad_v(_np(get("wte.weight"))),
+            "lm_head": pad_v(_np(sd["lm_head.weight"])),
+            "lm_head_bias": pad_v(_np(sd["lm_head.bias"])),
+            "blocks": block,
+            "lnf_scale": _np(get("ln_f.weight")),
+            "lnf_bias": _np(get("ln_f.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+class MegatronLayerPolicy:
+    """Megatron-LM GPT checkpoints (the reference's MegatronLayerPolicy,
+    replace_policy.py:343) — consumed after any tp-shard merging by
+    ``runtime/state_dict_factory.py``.  ``megatron_v2`` selects the fused
+    qkv row layout: v2+ interleaves per head ``(H, 3, Dh)``; v0/v1 stacks
+    components ``(3, H, Dh)``."""
+
+    version_aware = True  # not part of auto-match (needs megatron_v2 info)
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("attention.query_key_value.weight" in k and
+                   "layers." in k and "gpt_neox" not in k for k in sd)
+
+    @staticmethod
+    def model_config(n_layer: int, n_head: int, d_model: int,
+                     vocab_size: int, max_seq_len: int,
+                     dtype=jnp.float32) -> gpt.GPTConfig:
+        return gpt.GPTConfig(vocab_size=vocab_size, max_seq_len=max_seq_len,
+                             n_layer=n_layer, n_head=n_head, d_model=d_model,
+                             dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig,
+                megatron_v2: bool = True) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        keys = list(sd)
+
+        def find(suffix):
+            for k in keys:
+                if k.endswith(suffix):
+                    return sd[k]
+            raise KeyError(suffix)
+
+        def layer(i, suffix):
+            for k in keys:
+                if f"layers.{i}.{suffix}" in k:
+                    return sd[k]
+            raise KeyError(f"layers.{i}.{suffix}")
+
+        def pad_v(w):
+            p = config.padded_vocab - w.shape[0]
+            return np.concatenate(
+                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+
+        def qkv(i):
+            w = _np(layer(i, "attention.query_key_value.weight"))  # [3d, d]
+            b = _np(layer(i, "attention.query_key_value.bias"))
+            if megatron_v2:
+                wq, bq = _fused_qkv_per_head(w, b, H, Dh, d)
+            else:
+                wq = w.reshape(3, H, Dh, d).transpose(3, 0, 1, 2)
+                bq = b.reshape(3, H, Dh)
+            return wq, bq
+
+        qkvs = [qkv(i) for i in range(L)]
+        block = {
+            "ln1_scale": np.stack([_np(layer(i, "input_layernorm.weight"))
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([_np(layer(i, "input_layernorm.bias"))
+                                  for i in range(L)]),
+            "wqkv": np.stack([w for w, _ in qkvs]),
+            "bqkv": np.stack([b for _, b in qkvs]),
+            "wo": np.stack([_np(layer(i, "attention.dense.weight")).T
+                            .reshape(H, Dh, d) for i in range(L)]),
+            "bo": np.stack([_np(layer(i, "attention.dense.bias"))
+                            for i in range(L)]),
+            "ln2_scale": np.stack(
+                [_np(layer(i, "post_attention_layernorm.weight"))
+                 for i in range(L)]),
+            "ln2_bias": np.stack(
+                [_np(layer(i, "post_attention_layernorm.bias"))
+                 for i in range(L)]),
+            "wi": np.stack([_np(layer(i, "mlp.dense_h_to_4h.weight")).T
+                            for i in range(L)]),
+            "bi": np.stack([_np(layer(i, "mlp.dense_h_to_4h.bias"))
+                            for i in range(L)]),
+            "wo_mlp": np.stack([_np(layer(i, "mlp.dense_4h_to_h.weight")).T
+                                for i in range(L)]),
+            "bo_mlp": np.stack([_np(layer(i, "mlp.dense_4h_to_h.bias"))
+                                for i in range(L)]),
+        }
+        params = {
+            "wte": pad_v(_np(find("word_embeddings.weight"))),
+            "wpe": _np(find("position_embeddings.weight")),
+            "blocks": block,
+            "lnf_scale": _np(find("final_layernorm.weight")),
+            "lnf_bias": _np(find("final_layernorm.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+def convert_hf_bert(hf_model, dtype=jnp.float32):
+    """Live HF BERT module → (BertConfig, params)."""
+    sd = hf_model.state_dict()
+    assert HFBertLayerPolicy.match(sd), "not a BERT-family state dict"
+    config = HFBertLayerPolicy.model_config(hf_model.config, dtype=dtype)
+    return config, HFBertLayerPolicy.convert(sd, config)
+
+
 POLICIES = [HFGPT2LayerPolicy, HFOPTLayerPolicy, BLOOMLayerPolicy,
-            GPTNEOXLayerPolicy]
+            GPTNEOXLayerPolicy, HFGPTJLayerPolicy]
 
 
 def convert_hf_model(hf_model, dtype=jnp.float32

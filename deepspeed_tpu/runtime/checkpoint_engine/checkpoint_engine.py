@@ -3,9 +3,9 @@
 Counterpart of the reference's
 ``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py`` — the interface
 behind which Torch (sync) and Nebula (async) engines sit.  The TPU build's
-implementations: ``NativeCheckpointEngine`` (sync, numpy-based) and an
-orbax-backed async engine (``orbax_checkpoint_engine.py``) filling Nebula's
-role.
+implementations: ``NativeCheckpointEngine`` (sync, numpy-based) and
+``AsyncCheckpointEngine`` (background writer threads + atomic commit),
+filling Nebula's role; selected via ``{"checkpoint": {"async_save": true}}``.
 """
 
 from __future__ import annotations

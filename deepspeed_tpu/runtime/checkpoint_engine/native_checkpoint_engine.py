@@ -72,18 +72,22 @@ def unflatten_into(template: PyTree, flat: Dict[str, np.ndarray], prefix: str = 
     return flat[key]
 
 
+def snapshot_host(state_dict: PyTree) -> Dict[str, np.ndarray]:
+    """Flatten + device_get with npz-portable dtype widening (bf16/fp8 →
+    fp32; the load template's dtype restores the narrow type)."""
+    arrays = {}
+    for k, v in flatten_tree(state_dict).items():
+        a = np.asarray(jax.device_get(v))
+        if a.dtype.kind == "V" or a.dtype.name in ("bfloat16", "float8_e4m3fn",
+                                                   "float8_e5m2"):
+            a = a.astype(np.float32)
+        arrays[k] = a
+    return arrays
+
+
 class NativeCheckpointEngine(CheckpointEngine):
     def save(self, state_dict: PyTree, path: str) -> None:
-        flat = flatten_tree(state_dict)
-        arrays = {}
-        for k, v in flat.items():
-            a = np.asarray(jax.device_get(v))
-            if a.dtype.kind == "V" or a.dtype.name in ("bfloat16", "float8_e4m3fn",
-                                                       "float8_e5m2"):
-                # not portable through npz; widen losslessly (template dtype
-                # restores the narrow type on load)
-                a = a.astype(np.float32)
-            arrays[k] = a
+        arrays = snapshot_host(state_dict)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         np.savez(path, **arrays)
 
@@ -96,8 +100,9 @@ class NativeCheckpointEngine(CheckpointEngine):
 
 def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
                            client_state: Dict[str, Any], separate_master: bool,
-                           save_latest: bool = True) -> None:
-    eng = NativeCheckpointEngine()
+                           save_latest: bool = True,
+                           engine: Optional[CheckpointEngine] = None) -> None:
+    eng = engine or NativeCheckpointEngine()
     ckpt_dir = os.path.join(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
     model_state = {"params": state["params"], "scale": state["scale"]}
@@ -110,10 +115,22 @@ def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
     eng.save(optim_state, os.path.join(ckpt_dir, "optim_states.npz"))
     with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
         json.dump(client_state, f, default=str)
-    if save_latest:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
-    logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
+
+    def publish():
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+        logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
+
+    # the latest marker publishes only after every write of the tag lands
+    # (nebula semantics).  An async engine chains publication behind its
+    # writers WITHOUT blocking the caller — that's the whole point of
+    # async_save; sync engines commit inline.
+    if hasattr(eng, "finalize_async"):
+        eng.finalize_async(tag, publish)
+    else:
+        eng.commit(tag)
+        publish()
 
 
 def _put_like(template: PyTree, loaded: PyTree, shardings: Optional[PyTree] = None) -> PyTree:

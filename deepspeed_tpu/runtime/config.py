@@ -190,6 +190,9 @@ class DeepSpeedConfig:
         self.flops_profiler_config_dict = pd.get(C.FLOPS_PROFILER, {})
         self.autotuning_config_dict = pd.get(C.AUTOTUNING, {})
         self.elasticity_config_dict = pd.get(C.ELASTICITY, {})
+        # checkpoint backend selection (reference "nebula"/engine choice;
+        # async_save -> AsyncCheckpointEngine)
+        self.checkpoint_config_dict = pd.get("checkpoint", {})
         # raw "compression_training" section (typed parse in
         # deepspeed_tpu.compression.config); engine steps its scheduler
         self.compression_config_dict = pd.get("compression_training", {})

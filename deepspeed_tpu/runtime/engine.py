@@ -135,6 +135,15 @@ class DeepSpeedEngine:
             from .data_pipeline.curriculum_scheduler import CurriculumScheduler
             self._curriculum = CurriculumScheduler(self._config.curriculum_params)
 
+        # checkpoint backend (reference _configure_checkpointing, torch vs
+        # nebula): async_save runs writers in the background, committing
+        # before the latest marker publishes
+        self._checkpoint_engine = None
+        if self._config.checkpoint_config_dict.get("async_save"):
+            from .checkpoint_engine.async_checkpoint_engine import (
+                AsyncCheckpointEngine)
+            self._checkpoint_engine = AsyncCheckpointEngine()
+
         # compression scheduler (reference engine.py:2002 steps it at every
         # optimizer step); the in-graph gating reads the step scalar the
         # engine threads through the batch
@@ -827,14 +836,18 @@ class DeepSpeedEngine:
             client_state["lr_scheduler"] = self._lr_scheduler.state_dict()
         client_state["optimizer_param_groups"] = self.optimizer.param_groups
         offload = self._offload_device is not None
-        save_engine_checkpoint(save_dir, tag, self.state, client_state,
-                               separate_master=self._separate_master and not offload,
-                               save_latest=save_latest)
         if offload:
-            # host-side fp32 master + moments (zero_pp_rank_* analogue)
+            # host-side fp32 master + moments (zero_pp_rank_* analogue) —
+            # written BEFORE save_engine_checkpoint so the latest marker
+            # never advertises a tag whose offload state is missing
             path = os.path.join(save_dir, tag,
                                 f"offload_optimizer_rank{self.global_rank}.npz")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
             self._offload_opt.save(path)
+        save_engine_checkpoint(save_dir, tag, self.state, client_state,
+                               separate_master=self._separate_master and not offload,
+                               save_latest=save_latest,
+                               engine=self._checkpoint_engine)
         self._copy_recovery_script(save_dir)
         return True
 

@@ -94,6 +94,69 @@ def test_variant_decode_matches_full_forward(variant):
                                    err_msg=f"{variant} step {i}")
 
 
+def test_gptj_injection_logit_parity():
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=2, n_positions=64,
+        rotary_dim=8, n_inner=None, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    torch.manual_seed(4)
+    _parity(transformers.GPTJForCausalLM(cfg), 128)
+
+
+def test_megatron_policy_roundtrip():
+    """Synthesize a Megatron-layout state dict from known params, convert,
+    and require exact tree equality — validates the qkv interleave both
+    ways and both checkpoint versions."""
+    from deepspeed_tpu.module_inject.replace_policy import MegatronLayerPolicy
+
+    cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=64, n_layer=2, n_head=2,
+                        d_model=32, dtype=jnp.float32, vocab_round_to=128)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    L, d, H, Dh = cfg.n_layer, cfg.d_model, cfg.n_head, cfg.head_dim
+
+    for v2 in (True, False):
+        sd = {
+            "model.language_model.embedding.word_embeddings.weight":
+                np.asarray(params["wte"])[:128],
+            "model.language_model.embedding.position_embeddings.weight":
+                np.asarray(params["wpe"]),
+            "model.language_model.transformer.final_layernorm.weight":
+                np.asarray(params["lnf_scale"]),
+            "model.language_model.transformer.final_layernorm.bias":
+                np.asarray(params["lnf_bias"]),
+        }
+        for i in range(L):
+            b = {k: np.asarray(v[i]) for k, v in params["blocks"].items()}
+            p = f"model.language_model.transformer.layers.{i}."
+            # our wqkv [d,3,H,Dh] -> megatron rows: v2 (H,3,Dh) / v0 (3,H,Dh)
+            if v2:
+                wq = b["wqkv"].transpose(2, 1, 3, 0).reshape(3 * d, d)
+                bq = b["bqkv"].transpose(1, 0, 2).reshape(3 * d)
+            else:
+                wq = b["wqkv"].transpose(1, 2, 3, 0).reshape(3 * d, d)
+                bq = b["bqkv"].reshape(3 * d)
+            sd[p + "attention.query_key_value.weight"] = wq
+            sd[p + "attention.query_key_value.bias"] = bq
+            sd[p + "attention.dense.weight"] = b["wo"].reshape(d, d).T
+            sd[p + "attention.dense.bias"] = b["bo"]
+            sd[p + "input_layernorm.weight"] = b["ln1_scale"]
+            sd[p + "input_layernorm.bias"] = b["ln1_bias"]
+            sd[p + "post_attention_layernorm.weight"] = b["ln2_scale"]
+            sd[p + "post_attention_layernorm.bias"] = b["ln2_bias"]
+            sd[p + "mlp.dense_h_to_4h.weight"] = b["wi"].T
+            sd[p + "mlp.dense_h_to_4h.bias"] = b["bi"]
+            sd[p + "mlp.dense_4h_to_h.weight"] = b["wo_mlp"].T
+            sd[p + "mlp.dense_4h_to_h.bias"] = b["bo_mlp"]
+
+        assert MegatronLayerPolicy.match(sd)
+        got = MegatronLayerPolicy.convert(sd, cfg, megatron_v2=v2)
+        for path, a in jax.tree_util.tree_flatten_with_path(got)[0]:
+            b_ = dict(jax.tree_util.tree_flatten_with_path(params)[0])[path]
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=1e-6,
+                err_msg=f"v2={v2} {jax.tree_util.keystr(path)}")
+
+
 def test_alibi_slopes_match_hf():
     from transformers.models.bloom.modeling_bloom import build_alibi_tensor
     for H in (2, 4, 6, 12):

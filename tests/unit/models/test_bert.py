@@ -1,0 +1,112 @@
+"""BERT encoder family (the reference's headline pretraining benchmark +
+HFBertLayerPolicy, replace_policy.py:143)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import bert
+from tests.unit.common import base_config, make_mesh
+
+TINY = bert.BertConfig(vocab_size=256, max_seq_len=64, type_vocab_size=2,
+                       n_layer=2, n_head=4, d_model=64, dtype=jnp.float32,
+                       vocab_round_to=128)
+
+
+def _mlm_batch(B, S, seed=0, mask_frac=0.15):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(3, 256, size=(B, S)).astype(np.int32)
+    labels = np.full((B, S), -100, np.int32)
+    n_mask = max(1, int(S * mask_frac))
+    for b in range(B):
+        pos = rng.choice(S, size=n_mask, replace=False)
+        labels[b, pos] = tokens[b, pos]
+        tokens[b, pos] = 1  # [MASK]
+    return {"tokens": tokens, "mlm_labels": labels}
+
+
+def test_bert_mlm_trains_with_zero2():
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=bert.model_spec(TINY), config=base_config(micro_batch=2, stage=2),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    # a FIXED batch: random tokens carry no mutual information, so fresh
+    # batches sit at the entropy floor — memorizing one batch is the signal
+    b = _mlm_batch(16, 32, seed=0)
+    losses = []
+    for _ in range(8):
+        l = engine.forward(b); engine.backward(l); engine.step()
+        losses.append(float(l))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_bert_padding_mask_isolates_pad_tokens():
+    """Real tokens' hidden states must not change when pad tokens vary."""
+    params = bert.init(TINY, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    real = rng.integers(3, 256, size=(1, 8)).astype(np.int32)
+    for pad_fill in (0, 7):
+        toks = np.concatenate(
+            [real, np.full((1, 4), pad_fill, np.int32)], axis=1)
+        mask = np.concatenate([np.ones((1, 8)), np.zeros((1, 4))], axis=1)
+        h = bert.encode(params, jnp.asarray(toks), TINY,
+                        attention_mask=jnp.asarray(mask))
+        if pad_fill == 0:
+            first = np.asarray(h[:, :8])
+        else:
+            np.testing.assert_allclose(np.asarray(h[:, :8]), first,
+                                       atol=1e-5, rtol=1e-5)
+
+
+def test_hf_bert_injection_logit_parity():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from deepspeed_tpu.module_inject.replace_policy import convert_hf_bert
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    cfg, params = convert_hf_bert(hf)
+
+    tokens = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    mask = np.ones_like(tokens)
+    mask[:, 12:] = 0
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens),
+                 attention_mask=torch.tensor(mask)).logits.numpy()
+    got = np.asarray(jax.jit(
+        lambda p, t: bert.apply(p, t, cfg,
+                                attention_mask=jnp.asarray(mask)))(
+        params, jnp.asarray(tokens, jnp.int32)))[:, :, :128]
+    # compare only non-pad positions (HF computes pads too, we mask keys)
+    np.testing.assert_allclose(got[:, :12], ref[:, :12], atol=3e-4, rtol=3e-4)
+
+
+def test_bert_tp_sharded_training_parity():
+    """TP=2: same losses as dp-only (the logical-axis annotations hold)."""
+    def run(mm, stage):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=bert.model_spec(TINY),
+            config=base_config(micro_batch=16 // mm.dp_world_size, stage=stage,
+                               extra={"tensor_parallel":
+                                      {"enabled": True, "size": 2}}
+                               if mm.tp_world_size > 1 else None),
+            mesh_manager=mm, rng=jax.random.PRNGKey(1))
+        out = []
+        for i in range(3):
+            b = _mlm_batch(16, 32, seed=i)
+            l = engine.forward(b); engine.backward(l); engine.step()
+            out.append(float(l))
+        return out
+
+    from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
+    ref = run(initialize_mesh(ParallelDims(dp=8)), 0)
+    got = run(initialize_mesh(ParallelDims(dp=4, tp=2)), 0)
+    np.testing.assert_allclose(got, ref, rtol=2e-4)
