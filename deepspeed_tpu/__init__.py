@@ -21,6 +21,9 @@ from .parallel.mesh import (MeshManager, ParallelDims, get_mesh_manager,
                             initialize_mesh)
 from .runtime.activation_checkpointing import checkpointing
 from .runtime.config import DeepSpeedConfig
+from .runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from .runtime import zero  # noqa: F401 — deepspeed.zero namespace parity
+from .module_inject.replace_policy import replace_transformer_layer
 from .runtime.engine import DeepSpeedEngine
 from .runtime.model import ModelSpec, from_gpt
 from .utils.logging import logger
@@ -175,6 +178,35 @@ def init_inference(model=None, config=None, **kwargs):
             model, dtype=inf_config.jnp_dtype)
     return InferenceEngine(model_config, params, inf_config,
                            mesh_manager=get_mesh_manager(optional=True))
+
+
+class OnDevice:
+    """Reference ``deepspeed.OnDevice`` parity: a context for constructing
+    params with a chosen dtype/placement.  On TPU the real mechanism is
+    abstract init (``ModelSpec.init_fn`` under ``jax.eval_shape`` +
+    jit-with-out-shardings — no unsharded materialization, see
+    ``runtime/engine.py:_init_state``); this context covers ad-hoc array
+    construction with ``jax.default_device``."""
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+        if not self.enabled:
+            return self
+        if self.device not in ("meta", None):
+            self._ctx = jax.default_device(jax.devices(self.device)[0])
+            self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            self._ctx.__exit__(*exc)
+        return False
 
 
 def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:

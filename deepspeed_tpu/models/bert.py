@@ -156,7 +156,17 @@ def _layer_norm(x, scale, bias, eps):
 
 def _attention(q, k, v, pad_mask, config: BertConfig):
     """Bidirectional MHA with a padding mask. q,k,v: [B,S,H,D];
-    pad_mask: [B, S] bool (True = real token)."""
+    pad_mask: [B, S] bool (True = real token).
+
+    The Pallas flash path serves the unmasked case (packed fixed-length
+    pretraining batches — the benchmark path); a padding mask falls back to
+    dense masked attention until the kernel grows per-row kv-length
+    masking.  Concrete all-ones masks are detected and treated as None.
+    """
+    if pad_mask is not None and not isinstance(pad_mask, jax.core.Tracer):
+        import numpy as _np
+        if _np.asarray(pad_mask).all():
+            pad_mask = None
     if pad_mask is None and config.use_flash_attention:
         from ..ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=False)

@@ -117,6 +117,14 @@ def _fused_qkv_per_head(w, b, H, Dh, d):
     bq = b.reshape(H, 3, Dh).transpose(1, 0, 2)
     return wq, bq
 
+def _pad_vocab(w, padded_vocab: int):
+    """Zero-pad vocab-leading tensors up to the lane-aligned padded vocab."""
+    pad = padded_vocab - w.shape[0]
+    if pad:
+        return np.concatenate([w, np.zeros((pad,) + w.shape[1:], np.float32)])
+    return w
+
+
 
 class HFOPTLayerPolicy:
     """transformers OPT (``OPTForCausalLM``): separate q/k/v projections,
@@ -319,8 +327,7 @@ class GPTNEOXLayerPolicy:
             return sd[pre + name]
 
         def pad_vocab(w):
-            p = config.padded_vocab - w.shape[0]
-            return np.concatenate([w, np.zeros((p, d), np.float32)]) if p else w
+            return _pad_vocab(w, config.padded_vocab)
 
         wte = pad_vocab(_np(get("embed_in.weight")))
         # the untied head lives OUTSIDE the gpt_neox. prefix on CausalLM
@@ -404,9 +411,7 @@ class HFBertLayerPolicy:
             return sd[pre + name]
 
         def pad_v(w):
-            p = config.padded_vocab - w.shape[0]
-            return np.concatenate(
-                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+            return _pad_vocab(w, config.padded_vocab)
 
         def lw(i, name):
             return _linear_w(get, f"encoder.layer.{i}.{name}.weight")
@@ -515,9 +520,7 @@ class HFGPTJLayerPolicy:
             return sd[pre + name]
 
         def pad_v(w):
-            p = config.padded_vocab - w.shape[0]
-            return np.concatenate(
-                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+            return _pad_vocab(w, config.padded_vocab)
 
         def lw(i, name):
             return _np(get(f"h.{i}.{name}.weight")).T
@@ -601,9 +604,7 @@ class MegatronLayerPolicy:
             raise KeyError(f"layers.{i}.{suffix}")
 
         def pad_v(w):
-            p = config.padded_vocab - w.shape[0]
-            return np.concatenate(
-                [w, np.zeros((p,) + w.shape[1:], np.float32)]) if p else w
+            return _pad_vocab(w, config.padded_vocab)
 
         def qkv(i):
             w = _np(layer(i, "attention.query_key_value.weight"))  # [3d, d]

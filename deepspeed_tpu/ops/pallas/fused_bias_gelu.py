@@ -78,7 +78,7 @@ def _fwd_kernel(seed_ref, x_ref, b_ref, o_ref, *, rate, block_rows):
 
 
 def _bwd_kernel(seed_ref, x_ref, b_ref, g_ref, dx_ref, db_ref, *, rate,
-                block_rows):
+                block_rows, total_rows):
     i = pl.program_id(0)
     x = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
@@ -87,7 +87,12 @@ def _bwd_kernel(seed_ref, x_ref, b_ref, g_ref, dx_ref, db_ref, *, rate,
             * (1.0 / (1.0 - rate))
     dx = g * _gelu_grad(x)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    db_ref[...] = jnp.sum(dx, axis=0, keepdims=True)
+    # mask the last block's padding rows out of the bias reduction: their
+    # dx writes are discarded, but a row-sum would carry undefined padding
+    # contents into db on hardware
+    row = i * block_rows + jax.lax.broadcasted_iota(jnp.int32, dx.shape, 0)
+    db_ref[...] = jnp.sum(jnp.where(row < total_rows, dx, 0.0),
+                          axis=0, keepdims=True)
 
 
 def _specs(rows, C):
@@ -121,7 +126,8 @@ def _bias_gelu_bwd(rate, res, g):
     rows, C = x2.shape
     grid, block, row_blk, bias_blk = _specs(rows, C)
     dx, db_part = pl.pallas_call(
-        functools.partial(_bwd_kernel, rate=rate, block_rows=block),
+        functools.partial(_bwd_kernel, rate=rate, block_rows=block,
+                          total_rows=rows),
         grid=grid,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), row_blk, bias_blk,
                   row_blk],

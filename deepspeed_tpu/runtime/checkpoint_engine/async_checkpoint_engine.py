@@ -35,6 +35,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._pending: List[Future] = []
         self._sync = NativeCheckpointEngine()
         self._lock = threading.Lock()
+        self._last_error: Optional[BaseException] = None
 
     # ----------------------------------------------------------------- save
     def save(self, state_dict: PyTree, path: str) -> None:
@@ -53,15 +54,26 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def finalize_async(self, tag: str, publish) -> None:
         """Run ``publish`` after every pending write lands — WITHOUT
         blocking the caller (training overlaps the serialization; the
-        latest marker still can't advertise unfinished files)."""
+        latest marker still can't advertise unfinished files).
+
+        A failed write logs loudly, skips publication, and is re-raised at
+        the next ``wait()``/``commit()``/``load()`` — a tag whose bytes
+        never landed must not look saved."""
         with self._lock:
-            pending = list(self._pending)
+            # the chain takes ownership of (joins) the current pending set,
+            # so _pending stays O(1) across a long run of periodic saves
+            pending, self._pending = self._pending, []
 
         def chain():
-            for f in pending:
-                f.result()
-            publish()
-            logger.info(f"[async-ckpt] tag {tag} committed")
+            try:
+                for f in pending:
+                    f.result()
+                publish()
+                logger.info(f"[async-ckpt] tag {tag} committed")
+            except BaseException as e:  # surfaced on the next wait()
+                self._last_error = e
+                logger.error(f"[async-ckpt] writing tag {tag} FAILED — the "
+                             f"latest marker was NOT published: {e!r}")
 
         with self._lock:
             self._pending.append(self._pool.submit(chain))
@@ -76,6 +88,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
             pending, self._pending = self._pending, []
         for f in pending:
             f.result()  # re-raise writer errors in the caller
+        if self._last_error is not None:
+            err, self._last_error = self._last_error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
     def commit(self, tag: str) -> bool:
         self.wait()

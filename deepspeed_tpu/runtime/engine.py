@@ -872,6 +872,10 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpoint_engine.native_checkpoint_engine import load_engine_checkpoint
+        if self._checkpoint_engine is not None:
+            # never read our own in-flight async writes (also re-raises a
+            # background write failure here instead of losing it)
+            self._checkpoint_engine.wait()
         offload = self._offload_device is not None
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state,
