@@ -189,6 +189,11 @@ class OnDevice:
     construction with ``jax.default_device``."""
 
     def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        if dtype is not None:
+            logger.warning(
+                "OnDevice(dtype=...) is not honored on TPU — construct "
+                "arrays in the target dtype (GPTConfig.param_dtype / "
+                "jnp.asarray(..., dtype)) instead")
         self.dtype = dtype
         self.device = device
         self.enabled = enabled

@@ -131,7 +131,7 @@ class InferenceEngine:
                 lg = jnp.where(lg < kth, -jnp.inf, lg)
             return jax.random.categorical(key, lg).astype(jnp.int32)
 
-        def run(params, tokens, prompt_len, key, temperature):
+        def run(params, tokens, prompt_len, key, temperature, is_ragged):
             B, S = tokens.shape
             cache = fam.init_cache(cfg, B, max_len)
             logits, cache = fam.prefill(params, tokens, cfg, cache)
@@ -142,11 +142,11 @@ class InferenceEngine:
             done0 = jnp.zeros((B,), bool)
 
             def cond(st):
-                i, _, _, _, _, done = st
+                i, _, _, _, _, _, done = st
                 return jnp.logical_and(i < max_new, ~jnp.all(done))
 
             def body(st):
-                i, out, last, cache, key, done = st
+                i, out, last, cache, lengths, key, done = st
                 key, sub = jax.random.split(key)
                 nxt = pick(last, sub, temperature)
                 if eos is not None:
@@ -155,31 +155,53 @@ class InferenceEngine:
                 out = out.at[:, i].set(nxt)
                 if eos is not None:
                     done = jnp.logical_or(done, nxt == eos)
-                logits, cache = fam.decode_step(params, nxt, cfg, cache)
-                return i + 1, out, logits, cache, key, done
+                if is_ragged:
+                    logits, cache = fam.decode_step(params, nxt, cfg, cache,
+                                                    lengths=lengths)
+                else:
+                    logits, cache = fam.decode_step(params, nxt, cfg, cache)
+                return i + 1, out, logits, cache, lengths + 1, key, done
 
-            _, out, _, cache, _, _ = lax.while_loop(
-                cond, body, (jnp.int32(0), out, last, cache, key, done0))
+            _, out, _, cache, _, _, _ = lax.while_loop(
+                cond, body,
+                (jnp.int32(0), out, last, cache, prompt_len, key, done0))
             return out
 
-        return jax.jit(run)
+        return jax.jit(run, static_argnums=(5,))
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  top_k: int = 0, top_p: float = 1.0,
+                 prompt_lens=None,
                  key: Optional[jax.Array] = None) -> jnp.ndarray:
         """Autoregressive generation; the whole loop is one XLA program.
 
-        tokens: [B, S] prompt (right-aligned padding NOT supported — pass
-        equal-length prompts; ragged prompts need pad-masked cache
-        attention, not yet implemented).  ``eos_token_id`` stops early once
-        every row has emitted it (finished rows keep emitting eos);
-        ``top_k``/``top_p`` shape the sampling distribution.
-        Returns [B, max_new_tokens].
+        tokens: [B, S] prompt.  Unequal-length prompts: RIGHT-pad to S and
+        pass the true lengths as ``prompt_lens`` [B] — each row continues
+        from its own last real token, with per-row visibility masking in
+        the decode kernel (GPT families; MoE serving is uniform-only).
+        ``eos_token_id`` stops early once every row has emitted it
+        (finished rows keep emitting eos); ``top_k``/``top_p`` shape the
+        sampling distribution.  Returns [B, max_new_tokens].
         """
         tokens = jnp.asarray(tokens, jnp.int32)
         B, S = tokens.shape
+        is_ragged = prompt_lens is not None
+        if is_ragged:
+            from ..models import gpt_inference
+            if self._family is not gpt_inference:
+                raise NotImplementedError(
+                    "ragged prompt_lens is supported for the dense GPT "
+                    "family only (MoE serving decodes uniform batches)")
+            lens_np = np.asarray(prompt_lens)
+            if lens_np.shape != (B,):
+                raise ValueError(f"prompt_lens shape {lens_np.shape} != ({B},)")
+            if (lens_np < 1).any() or (lens_np > S).any():
+                raise ValueError(
+                    f"prompt_lens must be in [1, {S}] (the padded width); "
+                    f"got {lens_np.tolist()} — out-of-range lengths would "
+                    "silently condition on the wrong tokens")
         if S + max_new_tokens > self.model_config.max_seq_len:
             raise ValueError(
                 f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
@@ -197,9 +219,11 @@ class InferenceEngine:
                 max_len, max_new_tokens, greedy=not do_sample,
                 eos=eos_token_id, top_k=top_k, top_p=top_p)
         key = key if key is not None else jax.random.PRNGKey(0)
+        lens = jnp.asarray(prompt_lens, jnp.int32) if is_ragged \
+            else jnp.full((B,), S, jnp.int32)
         return self._generate_cache[sig](
-            self.params, tokens, jnp.full((tokens.shape[0],), S, jnp.int32),
-            key, jnp.asarray(temperature, jnp.float32))
+            self.params, tokens, lens,
+            key, jnp.asarray(temperature, jnp.float32), is_ragged)
 
     # ----------------------------------------------------------- checkpoint
 

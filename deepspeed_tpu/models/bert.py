@@ -21,6 +21,8 @@ import math
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -161,12 +163,8 @@ def _attention(q, k, v, pad_mask, config: BertConfig):
     The Pallas flash path serves the unmasked case (packed fixed-length
     pretraining batches — the benchmark path); a padding mask falls back to
     dense masked attention until the kernel grows per-row kv-length
-    masking.  Concrete all-ones masks are detected and treated as None.
+    masking (``encode`` drops concrete all-ones masks before tracing).
     """
-    if pad_mask is not None and not isinstance(pad_mask, jax.core.Tracer):
-        import numpy as _np
-        if _np.asarray(pad_mask).all():
-            pad_mask = None
     if pad_mask is None and config.use_flash_attention:
         from ..ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=False)
@@ -229,6 +227,12 @@ def encode(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
     if use_dropout:
         emb_key, dropout_rng = jax.random.split(dropout_rng)
         x = _dropout(x, config.dropout, emb_key)
+    # one host check BEFORE tracing: a concrete all-ones mask is the
+    # unmasked case and keeps the flash-attention path
+    if attention_mask is not None and \
+            not isinstance(attention_mask, jax.core.Tracer) and \
+            np.asarray(attention_mask).all():
+        attention_mask = None
     pad_mask = attention_mask.astype(bool) if attention_mask is not None else None
 
     block_fn = partial(_block, config=config)

@@ -222,9 +222,12 @@ def _rotate(x, positions, config: GPTConfig):
     x_rot, x_pass = x[..., :rot], x[..., rot:]
     inv = 1.0 / (config.rotary_base **
                  (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
-    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]   # [S, rot/2]
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    # positions: [S] (shared) or [B, S] (per-row, ragged decode)
+    ang = positions.astype(jnp.float32)[..., None] * inv   # [..., S, rot/2]
+    if ang.ndim == 2:
+        ang = ang[None]                                    # [1, S, rot/2]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     if config.rotary_interleaved:
         x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
         out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -254,19 +257,22 @@ def alibi_slopes(n_head: int) -> jnp.ndarray:
 
 def _alibi_attention(q, k, v, config: GPTConfig, q_positions=None):
     """Dense causal attention with the ALiBi bias (BLOOM family).
-    q: [B,Sq,H,D] at absolute positions q_positions (default end-aligned)."""
+    q: [B,Sq,H,D] at absolute positions q_positions — [Sq] shared or
+    [B,Sq] per-row (ragged decode); default end-aligned."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     q_pos = (jnp.arange(Sq) + (Sk - Sq)) if q_positions is None else q_positions
+    q_pos = jnp.atleast_2d(q_pos)                                # [B or 1, Sq]
     k_pos = jnp.arange(Sk)
     # bias = -slope * distance; 0 on the diagonal
-    dist = q_pos[:, None] - k_pos[None, :]                       # [Sq, Sk]
-    bias = -alibi_slopes(H)[:, None, None] * dist[None].astype(jnp.float32)
-    s = s + bias[None]
+    dist = q_pos[:, :, None] - k_pos[None, None, :]              # [B?, Sq, Sk]
+    bias = -alibi_slopes(H)[None, :, None, None] * \
+        dist[:, None].astype(jnp.float32)
+    s = s + bias
     mask = dist >= 0
-    s = jnp.where(mask[None, None], s, float("-inf"))
+    s = jnp.where(mask[:, None], s, float("-inf"))
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
@@ -402,7 +408,8 @@ def _block(x, layer_params, config: GPTConfig, positions=None,
 
 def embed(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
           positions=None) -> jnp.ndarray:
-    """Token (+ learned position) embedding with the family's variants."""
+    """Token (+ learned position) embedding with the family's variants.
+    ``positions``: [S] shared or [B, S] per-row (ragged decode)."""
     cdt = config.dtype
     x = params["wte"].astype(cdt)[tokens]
     if config.embed_layernorm:
@@ -410,7 +417,8 @@ def embed(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
     if config.pos_embed == "learned":
         if positions is None:
             positions = jnp.arange(tokens.shape[-1])
-        x = x + params["wpe"].astype(cdt)[positions + config.pos_offset]
+        pe = params["wpe"].astype(cdt)[positions + config.pos_offset]
+        x = x + (pe if pe.ndim == x.ndim else pe[None])
     return x
 
 

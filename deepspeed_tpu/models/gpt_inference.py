@@ -69,8 +69,11 @@ def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig):
     """
     if config.pos_embed == "alibi":
         # dense path with the alibi bias; cache slots beyond the query's
-        # position fall out of the dist >= 0 mask
-        q_positions = pos + jnp.arange(q.shape[1])
+        # position fall out of the dist >= 0 mask.  pos: scalar or [B].
+        pos_arr = jnp.asarray(pos)
+        steps = jnp.arange(q.shape[1])
+        q_positions = pos_arr[:, None] + steps if pos_arr.ndim \
+            else pos_arr + steps
         return gpt._alibi_attention(q, cache_k, cache_v, config,
                                     q_positions=q_positions)
     from ..ops.pallas.decode_attention import cached_attention
@@ -115,26 +118,36 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
-                cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
-    """One-token decode: token [B] int32 at position cache.length.
+                cache: KVCache, lengths=None) -> Tuple[jnp.ndarray, KVCache]:
+    """One-token decode: token [B] int32 at position cache.length — or,
+    with ``lengths`` [B], at per-row positions (ragged right-padded
+    prompts: each row's token lands on ITS next slot and sees only ITS
+    live prefix; pad-slot K/V is overwritten as rows catch up).
 
     Returns (logits [B, padded_vocab] fp32, cache advanced by one).
     """
     B = token.shape[0]
-    pos = cache.length
-    positions = pos[None]
+    ragged = lengths is not None
+    pos = lengths if ragged else cache.length
+    positions = pos[:, None] if ragged else pos[None]
     x = gpt.embed(params, token[:, None], config, positions=positions)
 
     def layer(x, xs):
         p, ck, cv = xs
         q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-        new_ck = lax.dynamic_update_slice(
-            ck, k.astype(ck.dtype), (0, pos, 0, 0))
-        new_cv = lax.dynamic_update_slice(
-            cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        if ragged:
+            rows = jnp.arange(B)
+            new_ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
+            new_cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+        else:
+            new_ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, pos, 0, 0))
+            new_cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, pos, 0, 0))
         attn = _cached_attention(q, new_ck, new_cv, pos, config)
         return _block_tail(x, attn, p, config), (new_ck, new_cv)
 
     x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
     logits = gpt.lm_logits(params, x[:, 0], config)
-    return logits, KVCache(k=new_k, v=new_v, length=pos + 1)
+    new_len = (jnp.max(pos) + 1) if ragged else pos + 1
+    return logits, KVCache(k=new_k, v=new_v, length=new_len)

@@ -1,5 +1,12 @@
-from .replace_policy import (HFGPT2LayerPolicy, convert_hf_model,
-                             replace_transformer_layer)
+from .load_checkpoint import load_sharded_state_dict, module_quantize
+from .replace_policy import (BLOOMLayerPolicy, GPTNEOXLayerPolicy,
+                             HFBertLayerPolicy, HFGPT2LayerPolicy,
+                             HFGPTJLayerPolicy, HFOPTLayerPolicy,
+                             MegatronLayerPolicy, convert_hf_bert,
+                             convert_hf_model, replace_transformer_layer)
 
-__all__ = ["HFGPT2LayerPolicy", "convert_hf_model",
-           "replace_transformer_layer"]
+__all__ = ["HFGPT2LayerPolicy", "HFOPTLayerPolicy", "BLOOMLayerPolicy",
+           "GPTNEOXLayerPolicy", "HFGPTJLayerPolicy", "HFBertLayerPolicy",
+           "MegatronLayerPolicy", "convert_hf_model", "convert_hf_bert",
+           "replace_transformer_layer", "load_sharded_state_dict",
+           "module_quantize"]

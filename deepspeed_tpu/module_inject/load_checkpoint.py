@@ -1,0 +1,86 @@
+"""Sharded HF checkpoint loading + inference weight quantization.
+
+Counterparts of the reference's ``module_inject/load_checkpoint.py``
+(layer-wise sharded checkpoint loading during injection) and
+``module_inject/module_quantize.py`` (MoQ post-training quantization of
+injected weights).
+
+``load_sharded_state_dict`` reads a directory saved by
+``save_pretrained`` with sharding (``pytorch_model-00001-of-000NN.bin`` +
+index json, or ``.safetensors``, or ``.npz`` shards) into one state dict
+for the injection policies — shard at a time, so peak host memory is one
+shard, not the model.
+
+``module_quantize`` fake-quantizes the converted param tree's matmul
+weights (symmetric, groupwise) for serving — the numerics the reference's
+MoQ applies at injection time, backed by the Pallas quantizer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+PyTree = Any
+
+
+def load_sharded_state_dict(ckpt_dir: str) -> Dict[str, Any]:
+    """Merge a sharded checkpoint directory into one flat state dict."""
+    # deterministic index choice; prefer safetensors (no torch dependency)
+    index_files = sorted(
+        (f for f in os.listdir(ckpt_dir) if f.endswith(".index.json")),
+        key=lambda f: (0 if "safetensors" in f else 1, f))
+    shards = []
+    if index_files:
+        with open(os.path.join(ckpt_dir, index_files[0])) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+    else:
+        shards = sorted(f for f in os.listdir(ckpt_dir)
+                        if f.endswith((".bin", ".pt", ".npz", ".safetensors")))
+    if not shards:
+        raise FileNotFoundError(f"no checkpoint shards under {ckpt_dir}")
+    sd: Dict[str, Any] = {}
+    for shard in shards:
+        path = os.path.join(ckpt_dir, shard)
+        if shard.endswith(".npz"):
+            with np.load(path) as z:
+                part = {k: z[k] for k in z.files}
+        elif shard.endswith(".safetensors"):
+            from safetensors.numpy import load_file  # optional dep
+            part = load_file(path)
+        else:
+            import torch
+            part = torch.load(path, map_location="cpu", weights_only=False)
+        sd.update(part)
+        logger.info(f"[load_checkpoint] merged shard {shard} "
+                    f"({len(part)} tensors)")
+    return sd
+
+
+def module_quantize(params: PyTree, bits: int = 8, groups: int = 1,
+                    min_ndim: int = 2) -> PyTree:
+    """Groupwise symmetric fake-quantization of every weight leaf.
+
+    Serving-side MoQ (reference ``quantize_transformer_layer``): weights
+    land on the int grid so a later int8 path is a cast, while activations
+    and the compute dtype stay untouched.  Biases/norms (< min_ndim dims)
+    pass through.
+    """
+    from ..ops.pallas.quantizer import fake_quantize
+
+    def q(leaf):
+        if leaf.ndim < min_ndim or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        return fake_quantize(leaf, groups=groups, bits=bits,
+                             symmetric=True).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map(q, params)

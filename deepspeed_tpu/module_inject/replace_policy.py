@@ -60,10 +60,7 @@ class HFGPT2LayerPolicy:
         def get(name):
             return _np(sd[prefix + name])
 
-        wte = get("wte.weight")
-        pad = config.padded_vocab - wte.shape[0]
-        if pad:
-            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+        wte = _pad_vocab(get("wte.weight"), config.padded_vocab)
 
         def layer(i, name):
             return get(f"h.{i}.{name}")
@@ -163,10 +160,7 @@ class HFOPTLayerPolicy:
         def get(name):
             return sd[pre + name]
 
-        wte = _np(get("embed_tokens.weight"))
-        pad = config.padded_vocab - wte.shape[0]
-        if pad:
-            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+        wte = _pad_vocab(_np(get("embed_tokens.weight")), config.padded_vocab)
 
         def lw(i, name):
             return _linear_w(get, f"layers.{i}.{name}.weight")
@@ -243,10 +237,8 @@ class BLOOMLayerPolicy:
         def get(name):
             return sd[pre + name]
 
-        wte = _np(get("word_embeddings.weight"))
-        pad = config.padded_vocab - wte.shape[0]
-        if pad:
-            wte = np.concatenate([wte, np.zeros((pad, d), np.float32)])
+        wte = _pad_vocab(_np(get("word_embeddings.weight")),
+                         config.padded_vocab)
 
         def fused(i):
             w = _np(get(f"h.{i}.self_attention.query_key_value.weight"))

@@ -28,24 +28,28 @@ NEG_INF = float("-inf")
 def cached_attention_reference(q, cache_k, cache_v, pos,
                                sm_scale: Optional[float] = None):
     """Ground truth: q [B,Sq,H,D] over cache [B,Smax,H,D]; query i (at
-    absolute position pos+i) sees cache slots ≤ pos+i."""
+    absolute position pos+i) sees cache slots ≤ pos+i.  ``pos`` may be a
+    scalar or a per-row [B] vector (ragged decode)."""
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32) * scale
-    q_abs = pos + jnp.arange(Sq)
+    pos = jnp.asarray(pos)
+    q_abs = (pos.reshape(-1, 1) if pos.ndim else pos) + jnp.arange(Sq)
     k_pos = jnp.arange(Smax)
-    mask = k_pos[None, :] <= q_abs[:, None]            # [Sq, Smax]
-    s = jnp.where(mask[None, None], s, NEG_INF)
+    # [B or 1, Sq, Smax]
+    mask = k_pos[None, None, :] <= jnp.atleast_2d(q_abs)[:, :, None]
+    s = jnp.where(mask[:, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cache_v)
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                   *, sm_scale, block_k):
+                   *, sm_scale, block_k, H):
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
-    pos = pos_ref[0]
+    pos = pos_ref[bh // H]  # per-ROW visibility (ragged decode)
 
     @pl.when(ki == 0)
     def _init():
@@ -76,12 +80,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-def _decode(q3, k3, v3, pos, sm_scale, block_k):
+def _decode(q3, k3, v3, pos, sm_scale, block_k, H):
     BH, _, D = q3.shape
     Smax = k3.shape[1]
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    B = BH // H
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
-                               block_k=block_k)
+                               block_k=block_k, H=H)
     return pl.pallas_call(
         kernel,
         grid=(BH, Smax // block_k),
@@ -106,8 +111,10 @@ def cached_attention(q, cache_k, cache_v, pos,
                      sm_scale: Optional[float] = None):
     """q [B,Sq,H,D] over a padded cache [B,Smax,H,D], visibility ≤ pos+i.
 
-    Single-token decode (Sq=1) takes the Pallas streaming kernel; other
-    shapes (chunked prefill) use the dense reference.
+    ``pos``: scalar, or a per-row [B] vector for ragged decode (each row's
+    block sweep stops at ITS live prefix).  Single-token decode (Sq=1)
+    takes the Pallas streaming kernel; other shapes (chunked prefill) use
+    the dense reference.
     """
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
@@ -119,5 +126,5 @@ def cached_attention(q, cache_k, cache_v, pos,
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
-    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k)
+    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k, H)
     return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)

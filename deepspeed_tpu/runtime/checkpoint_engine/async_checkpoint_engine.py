@@ -59,12 +59,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         A failed write logs loudly, skips publication, and is re-raised at
         the next ``wait()``/``commit()``/``load()`` — a tag whose bytes
         never landed must not look saved."""
-        with self._lock:
-            # the chain takes ownership of (joins) the current pending set,
-            # so _pending stays O(1) across a long run of periodic saves
-            pending, self._pending = self._pending, []
-
-        def chain():
+        def chain(pending):
             try:
                 for f in pending:
                     f.result()
@@ -75,8 +70,13 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 logger.error(f"[async-ckpt] writing tag {tag} FAILED — the "
                              f"latest marker was NOT published: {e!r}")
 
+        # swap + submit under ONE lock hold: a concurrent wait() must never
+        # observe the window where the writes are in flight but _pending is
+        # empty.  The chain takes ownership of the current pending set, so
+        # _pending stays O(1) across a long run of periodic saves.
         with self._lock:
-            self._pending.append(self._pool.submit(chain))
+            pending, self._pending = self._pending, []
+            self._pending.append(self._pool.submit(chain, pending))
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
         self.wait()  # never read our own unfinished write
