@@ -156,20 +156,20 @@ def _layer_norm(x, scale, bias, eps):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _attention(q, k, v, pad_mask, config: BertConfig):
-    """Bidirectional MHA with a padding mask. q,k,v: [B,S,H,D];
-    pad_mask: [B, S] bool (True = real token).
+def _attention(q, k, v, pad_mask, seq_lens, config: BertConfig):
+    """Bidirectional MHA. q,k,v: [B,S,H,D].
 
-    The Pallas flash path serves the unmasked case (packed fixed-length
-    pretraining batches — the benchmark path); a padding mask falls back to
-    dense masked attention until the kernel grows per-row kv-length
-    masking (``encode`` drops concrete all-ones masks before tracing).
+    ``seq_lens`` [B] (right-padded batches — the standard MLM layout) keeps
+    the Pallas flash path with per-row kv-length masking; an arbitrary
+    ``pad_mask`` [B, S] (holes) falls back to dense masked attention.
     """
     if pad_mask is None and config.use_flash_attention:
         from ..ops.pallas import flash_attention
-        return flash_attention(q, k, v, causal=False)
+        return flash_attention(q, k, v, causal=False, kv_lens=seq_lens)
     scale = 1.0 / math.sqrt(config.head_dim)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if pad_mask is None and seq_lens is not None:
+        pad_mask = jnp.arange(q.shape[1])[None, :] < seq_lens[:, None]
     if pad_mask is not None:
         # large-finite rather than -inf: a fully padded row (dataset-tail
         # batch padding) must yield garbage-but-finite outputs, not NaNs
@@ -186,7 +186,7 @@ def _dropout(x, rate: float, key):
     return jnp.where(mask, x / (1.0 - rate), jnp.zeros_like(x))
 
 
-def _block(x, pad_mask, p, config: BertConfig, dropout_key=None):
+def _block(x, pad_mask, seq_lens, p, config: BertConfig, dropout_key=None):
     """Post-LN transformer encoder block (original BERT ordering)."""
     cdt = config.dtype
     eps = config.layer_norm_eps
@@ -195,7 +195,8 @@ def _block(x, pad_mask, p, config: BertConfig, dropout_key=None):
         k_attn, k_mlp = jax.random.split(dropout_key)
     qkv = jnp.einsum("bsd,dthe->bsthe", x, p["wqkv"].astype(cdt)) \
         + p["bqkv"].astype(cdt)
-    attn = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask, config)
+    attn = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask,
+                      seq_lens, config)
     attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
         + p["bo"].astype(cdt)
     attn_out = _dropout(attn_out, config.dropout, k_attn)
@@ -211,8 +212,12 @@ def _block(x, pad_mask, p, config: BertConfig, dropout_key=None):
 def encode(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
            token_type_ids: Optional[jnp.ndarray] = None,
            attention_mask: Optional[jnp.ndarray] = None,
-           dropout_rng=None) -> jnp.ndarray:
-    """tokens [B,S] → hidden states [B,S,d] (compute dtype)."""
+           dropout_rng=None, seq_lens=None) -> jnp.ndarray:
+    """tokens [B,S] → hidden states [B,S,d] (compute dtype).
+
+    Right-padded batches should pass ``seq_lens`` [B] (keeps the flash
+    kernel, per-row masked); ``attention_mask`` [B,S] covers arbitrary
+    masks via the dense path."""
     cdt = config.dtype
     B, S = tokens.shape
     pos = jnp.arange(S)
@@ -243,7 +248,8 @@ def encode(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
     def body(carry, xs):
         layer_params, idx = xs
         key = jax.random.fold_in(dropout_rng, idx) if use_dropout else None
-        return block_fn(carry, pad_mask, layer_params, dropout_key=key), None
+        return block_fn(carry, pad_mask, seq_lens, layer_params,
+                        dropout_key=key), None
 
     x, _ = lax.scan(body, x, (params["blocks"], jnp.arange(config.n_layer)))
     return x
@@ -272,10 +278,12 @@ def pooled_output(params: PyTree, hidden, config: BertConfig) -> jnp.ndarray:
 
 
 def apply(params: PyTree, tokens: jnp.ndarray, config: BertConfig,
-          token_type_ids=None, attention_mask=None) -> jnp.ndarray:
+          token_type_ids=None, attention_mask=None,
+          seq_lens=None) -> jnp.ndarray:
     """tokens → MLM logits [B, S, padded_vocab] fp32."""
     return mlm_logits(params, encode(params, tokens, config, token_type_ids,
-                                     attention_mask), config)
+                                     attention_mask, seq_lens=seq_lens),
+                      config)
 
 
 def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray],
@@ -294,7 +302,8 @@ def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray],
     labels = batch["mlm_labels"]
     logits = mlm_logits(params, encode(
         params, tokens, config, batch.get("token_type_ids"),
-        batch.get("attention_mask"), dropout_rng=dropout_rng), config)
+        batch.get("attention_mask"), dropout_rng=dropout_rng,
+        seq_lens=batch.get("seq_lens")), config)
     logz = jax.nn.logsumexp(logits, axis=-1)
     safe = jnp.maximum(labels, 0)
     gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]

@@ -44,8 +44,15 @@ def load_sharded_state_dict(ckpt_dir: str) -> Dict[str, Any]:
             index = json.load(f)
         shards = sorted(set(index["weight_map"].values()))
     else:
-        shards = sorted(f for f in os.listdir(ckpt_dir)
-                        if f.endswith((".bin", ".pt", ".npz", ".safetensors")))
+        # weight files only: HF Trainer dirs also hold optimizer.pt,
+        # training_args.bin, scheduler.pt — none of which are state dicts
+        def is_weight_file(f: str) -> bool:
+            if f.endswith(".npz"):
+                return True
+            return (f.startswith(("pytorch_model", "model", "tf_model")) and
+                    f.endswith((".bin", ".pt", ".safetensors")))
+
+        shards = sorted(f for f in os.listdir(ckpt_dir) if is_weight_file(f))
     if not shards:
         raise FileNotFoundError(f"no checkpoint shards under {ckpt_dir}")
     sd: Dict[str, Any] = {}
@@ -59,27 +66,37 @@ def load_sharded_state_dict(ckpt_dir: str) -> Dict[str, Any]:
             part = load_file(path)
         else:
             import torch
-            part = torch.load(path, map_location="cpu", weights_only=False)
+            # plain tensor state dicts only: never execute checkpoint pickle
+            part = torch.load(path, map_location="cpu", weights_only=True)
+        if not isinstance(part, dict):
+            raise ValueError(f"{shard} is not a state dict "
+                             f"({type(part).__name__})")
         sd.update(part)
         logger.info(f"[load_checkpoint] merged shard {shard} "
                     f"({len(part)} tensors)")
     return sd
 
 
-def module_quantize(params: PyTree, bits: int = 8, groups: int = 1,
+def module_quantize(params: PyTree, bits: int = 8,
+                    groups_per_layer: int = 1,
                     min_ndim: int = 2) -> PyTree:
     """Groupwise symmetric fake-quantization of every weight leaf.
 
     Serving-side MoQ (reference ``quantize_transformer_layer``): weights
     land on the int grid so a later int8 path is a cast, while activations
-    and the compute dtype stay untouched.  Biases/norms (< min_ndim dims)
-    pass through.
+    and the compute dtype stay untouched.  Layer-stacked leaves ([L, ...])
+    quantize with PER-LAYER scales (× groups_per_layer) — one outlier layer
+    must not set the step size for the whole stack.  Biases/norms
+    (< min_ndim dims) pass through.
     """
     from ..ops.pallas.quantizer import fake_quantize
 
     def q(leaf):
         if leaf.ndim < min_ndim or not jnp.issubdtype(leaf.dtype, jnp.floating):
             return leaf
+        groups = groups_per_layer
+        if leaf.ndim >= 3:  # leading dim is a layer stack
+            groups = leaf.shape[0] * groups_per_layer
         return fake_quantize(leaf, groups=groups, bits=bits,
                              symmetric=True).astype(leaf.dtype)
 

@@ -40,24 +40,35 @@ NEG_INF = float("-inf")
 
 # ------------------------------------------------------------------ reference
 
-def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
-    """Dense softmax attention; ground truth for the kernel. [B,S,H,D]."""
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                  kv_lens=None):
+    """Dense softmax attention; ground truth for the kernel. [B,S,H,D].
+    ``kv_lens`` [B]: keys at position ≥ kv_lens[b] are masked (right-padded
+    batches)."""
     D = q.shape[-1]
+    B, Sq = q.shape[:2]
+    Sk = k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
-        Sq, Sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
-        s = jnp.where(mask[None, None], s, NEG_INF)
-        # Sq > Sk leaves leading queries with zero visible keys: give them
-        # zero output instead of softmax-over-(-inf) NaNs
+        mask = jnp.broadcast_to(mask[None], (B, Sq, Sk))
+    else:
+        mask = jnp.ones((B, Sq, Sk), dtype=bool)
+    if kv_lens is not None:
+        mask = jnp.logical_and(
+            mask, (jnp.arange(Sk)[None, :] < kv_lens[:, None])[:, None])
+    if not causal and kv_lens is None:
+        p = jax.nn.softmax(s, axis=-1)
+    else:
+        # rows with zero visible keys (Sq > Sk causal heads, or kv_len 0)
+        # get zero output instead of softmax-over-(-inf) NaNs
+        s = jnp.where(mask[:, None], s, NEG_INF)
         m = jnp.max(s, axis=-1, keepdims=True)
         e = jnp.exp(s - jnp.where(jnp.isfinite(m), m, 0.0))
-        e = jnp.where(mask[None, None], e, 0.0)
+        e = jnp.where(mask[:, None], e, 0.0)
         denom = jnp.sum(e, axis=-1, keepdims=True)
         p = e / jnp.maximum(denom, 1e-30)
-    else:
-        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
@@ -70,6 +81,12 @@ def _causal_mask(s, qi, ki, block_q, block_k, offset):
     return jnp.where(k_pos <= q_pos + offset, s, NEG_INF)
 
 
+def _lens_mask(s, ki, block_k, kv_len):
+    """Mask key columns at global position ≥ kv_len (right-padded rows)."""
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_pos < kv_len, s, NEG_INF)
+
+
 def _block_visible(qi, ki, block_q, block_k, offset):
     """Whether any (q, k) pair in this tile survives the causal mask."""
     return ki * block_k <= qi * block_q + block_q - 1 + offset
@@ -77,11 +94,14 @@ def _block_visible(qi, ki, block_q, block_k, offset):
 
 # ------------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                sm_scale, causal, block_q, block_k, offset):
+def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *,
+                sm_scale, causal, block_q, block_k, offset, use_lens, H):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    kv_len = lens_ref[bh // H] if use_lens else 0
 
     @pl.when(ki == 0)
     def _init():
@@ -90,6 +110,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+    if use_lens:
+        run = jnp.logical_and(run, ki * block_k < kv_len)
 
     @pl.when(run)
     def _update():
@@ -100,6 +122,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                                 preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        if use_lens:
+            s = _lens_mask(s, ki, block_k, kv_len)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -112,21 +136,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_ref[...]
+        l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
         lse_ref[0, 0, :] = (m_ref[...] + jnp.log(l))[:, 0]
 
 
-def _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
+def _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     offset = Sk - Sq
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k, offset=offset)
+                               block_q=block_q, block_k=block_k, offset=offset,
+                               use_lens=lens is not None, H=H)
+    lens_arr = jnp.asarray(lens if lens is not None else [0], jnp.int32)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // block_q, Sk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
@@ -145,23 +172,28 @@ def _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q3, k3, v3)
+    )(lens_arr, q3, k3, v3)
     return o, lse
 
 
 # ------------------------------------------------------------------ backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, sm_scale, causal, block_q, block_k, offset):
+def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, sm_scale, causal, block_q, block_k,
+                   offset, use_lens, H):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    kv_len = lens_ref[bh // H] if use_lens else 0
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+    if use_lens:
+        run = jnp.logical_and(run, ki * block_k < kv_len)
 
     @pl.when(run)
     def _update():
@@ -175,6 +207,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        if use_lens:
+            s = _lens_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -186,12 +220,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                    block_q, block_k, offset):
+                    block_q, block_k, offset, use_lens, H):
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
+    kv_len = lens_ref[bh // H] if use_lens else 0
 
     @pl.when(qi == 0)
     def _init():
@@ -199,6 +235,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
+    if use_lens:
+        # the whole K block is beyond this row's live prefix: dk/dv stay 0
+        run = jnp.logical_and(run, ki * block_k < kv_len)
 
     @pl.when(run)
     def _update():
@@ -212,6 +251,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        if use_lens:
+            s = _lens_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -227,20 +268,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k):
+def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
+         H):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     offset = Sk - Sq
+    use_lens = lens is not None
+    lens_arr = jnp.asarray(lens if lens is not None else [0], jnp.int32)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]                   # (BH, 1, Sq)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
-                                  block_k=block_k, offset=offset)
+                                  block_k=block_k, offset=offset,
+                                  use_lens=use_lens, H=H)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, Sq // block_q, Sk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
@@ -252,15 +298,17 @@ def _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k):
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret_mode(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(lens_arr, q3, k3, v3, do3, lse, delta)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
-                                   block_k=block_k, offset=offset)
+                                   block_k=block_k, offset=offset,
+                                   use_lens=use_lens, H=H)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, Sk // block_k, Sq // block_q),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -281,26 +329,31 @@ def _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k):
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(lens_arr, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------------- custom vjp
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    o, _ = _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
+    o, _ = _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H)
     return o
 
 
-def _flash_fwd(q3, k3, v3, causal, sm_scale, block_q, block_k):
-    o, lse = _fwd(q3, k3, v3, causal, sm_scale, block_q, block_k)
-    return o, (q3, k3, v3, o, lse)
+def _flash_fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
+    o, lse = _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H)
+    return o, (q3, k3, v3, o, lse, lens)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do3):
-    q3, k3, v3, o3, lse = res
-    return _bwd(q3, k3, v3, o3, lse, do3, causal, sm_scale, block_q, block_k)
+def _flash_bwd(causal, sm_scale, block_q, block_k, H, res, do3):
+    import numpy as np
+    q3, k3, v3, o3, lse, lens = res
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale,
+                      block_q, block_k, H)
+    # int32 lens: float0 cotangent (non-differentiable input)
+    lens_ct = None if lens is None else np.zeros(lens.shape, jax.dtypes.float0)
+    return dq, dk, dv, lens_ct
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -324,8 +377,15 @@ def _pick_block(seq: int, want: int) -> Optional[int]:
 
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256):
+                    block_q: int = 256, block_k: int = 256,
+                    kv_lens=None):
     """Memory-linear attention. q,k,v: [B, S, H, D] → [B, S, H, D].
+
+    ``kv_lens`` [B] masks keys at positions ≥ kv_lens[b] — right-padded
+    batches (BERT MLM) keep the streaming kernel, and blocks entirely
+    beyond a row's live prefix are skipped in fwd AND both backward sweeps.
+    Lengths are clamped to ≥ 1 (a zero-length row has no defined
+    attention output; callers mask its loss anyway).
 
     Falls back to the dense reference when the backend has no Pallas path or
     the sequence doesn't tile (tiny/odd test shapes, Sq > Sk causal).
@@ -334,13 +394,16 @@ def flash_attention(q, k, v, causal: bool = True,
     Sk = k.shape[1]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
+    if kv_lens is not None:
+        kv_lens = jnp.maximum(jnp.asarray(kv_lens, jnp.int32), 1)
     if (not use_pallas() or bq is None or bk is None
             or (causal and Sq > Sk)):
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             kv_lens=kv_lens)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
     def to3(x):  # [B,S,H,D] → [B*H, S, D]
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
-    o3 = _flash(to3(q), to3(k), to3(v), causal, scale, bq, bk)
+    o3 = _flash(to3(q), to3(k), to3(v), kv_lens, causal, scale, bq, bk, H)
     return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)

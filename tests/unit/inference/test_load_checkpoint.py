@@ -61,9 +61,13 @@ def test_module_quantize_grids_weights():
                         d_model=32, dtype=jnp.float32, vocab_round_to=128)
     params = gpt.init(cfg, jax.random.PRNGKey(0))
     qparams = module_quantize(params, bits=8)
-    # weights land on <=255 distinct levels; biases untouched
+    # weights land on <=255 distinct levels PER LAYER; biases untouched
     w = np.asarray(qparams["blocks"]["wqkv"][0])
     assert len(np.unique(w)) <= 255
+    # per-layer scales: each layer's grid is set by ITS absmax
+    w_all = np.asarray(qparams["blocks"]["wqkv"])
+    scales = [np.abs(w_all[l]).max() for l in range(w_all.shape[0])]
+    assert not np.allclose(scales[0], scales[1]) or w_all.shape[0] == 1
     np.testing.assert_array_equal(np.asarray(qparams["blocks"]["bo"]),
                                   np.asarray(params["blocks"]["bo"]))
     # the quantized model still runs and stays close
