@@ -110,11 +110,11 @@ def main() -> None:
         if on_tpu:
             config = dataclasses.replace(bert.BERT_LARGE, max_seq_len=128,
                                          dtype=jnp.bfloat16)
-            micro_batch, gas, steps, warmup = 64, 1, 10, 2
+            mb_candidates, gas, steps, warmup = (64, 32, 16), 1, 10, 2
         else:
             config = bert.BertConfig(vocab_size=512, max_seq_len=64, n_layer=2,
                                      n_head=4, d_model=128, dtype=jnp.float32)
-            micro_batch, gas, steps, warmup = 4, 1, 4, 1
+            mb_candidates, gas, steps, warmup = (4,), 1, 4, 1
         model_spec = bert.model_spec(config)
         flops_per_tok = bert.flops_per_token(config)
         metric = "bert_large_mlm_samples_per_sec_per_chip"
@@ -123,11 +123,11 @@ def main() -> None:
         if on_tpu:
             config = dataclasses.replace(gpt.GPT2_125M, max_seq_len=1024,
                                          dtype=jnp.bfloat16, remat=False)
-            micro_batch, gas, steps, warmup = 16, 1, 10, 2
+            mb_candidates, gas, steps, warmup = (8, 4, 2), 1, 10, 2
         else:
             config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
                                    n_head=4, d_model=128, dtype=jnp.float32)
-            micro_batch, gas, steps, warmup = 4, 1, 4, 1
+            mb_candidates, gas, steps, warmup = (4,), 1, 4, 1
         model_spec = from_gpt(config)
         flops_per_tok = gpt.flops_per_token(config)
         metric = "gpt2_train_samples_per_sec_per_chip"
@@ -135,40 +135,67 @@ def main() -> None:
 
     seq = config.max_seq_len
     mm = initialize_mesh(ParallelDims(dp=-1))
-    ds_config = {
-        "train_micro_batch_size_per_gpu": micro_batch,
-        "gradient_accumulation_steps": gas,
-        "steps_per_print": 1 << 30,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "zero_optimization": {"stage": 2 if n_chips > 1 else 1},
-        "bf16": {"enabled": bool(on_tpu)},
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model_spec, config=ds_config, mesh_manager=mm,
-        rng=jax.random.PRNGKey(0))
 
-    rng = np.random.default_rng(0)
-    global_batch = micro_batch * mm.dp_world_size * gas
-    if bench_bert:
-        tokens = rng.integers(0, config.vocab_size,
-                              size=(global_batch, seq)).astype(np.int32)
-        labels = np.where(rng.random((global_batch, seq)) < 0.15, tokens, -100)
-        batch = {"tokens": tokens, "mlm_labels": labels.astype(np.int32)}
+    def build_and_warm(micro_batch):
+        """Engine + batch + compiled warmup at this micro-batch; raises the
+        XLA OOM through so the caller can back off."""
+        ds_config = {
+            "train_micro_batch_size_per_gpu": micro_batch,
+            "gradient_accumulation_steps": gas,
+            "steps_per_print": 1 << 30,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-4, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": 2 if n_chips > 1 else 1},
+            "bf16": {"enabled": bool(on_tpu)},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model_spec, config=ds_config, mesh_manager=mm,
+            rng=jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        global_batch = micro_batch * mm.dp_world_size * gas
+        if bench_bert:
+            tokens = rng.integers(0, config.vocab_size,
+                                  size=(global_batch, seq)).astype(np.int32)
+            labels = np.where(rng.random((global_batch, seq)) < 0.15,
+                              tokens, -100)
+            batch = {"tokens": tokens, "mlm_labels": labels.astype(np.int32)}
+        else:
+            batch = {"tokens": rng.integers(
+                0, config.vocab_size,
+                size=(global_batch, seq + 1)).astype(np.int32)}
+        for _ in range(warmup):
+            loss = engine.train_batch_fused(batch)
+        return engine, batch, global_batch, ds_config, loss
+
+    # warmup (compile) with HBM backoff: the largest micro-batch that
+    # compiles wins (OOM is a compile-time "Ran out of memory" on TPU).
+    # The fence is a host transfer of a param leaf: block_until_ready can
+    # return early on some experimental PJRT transports, but device_get
+    # cannot lie — it needs the real bytes of the final state.
+    last_oom = None
+    for micro_batch in mb_candidates:
+        try:
+            engine, batch, global_batch, ds_config, loss = \
+                build_and_warm(micro_batch)
+            break
+        except Exception as e:  # XlaRuntimeError has no stable module path
+            if "out of memory" not in str(e).lower():
+                raise
+            # keep only the message: the exception's traceback pins
+            # build_and_warm's frame (engine state, batch) in HBM, which
+            # would sabotage the smaller retry
+            last_oom = str(e).splitlines()[0][:300]
+            sys.stderr.write(f"bench: micro_batch={micro_batch} OOM, "
+                             "backing off\n")
     else:
-        batch = {"tokens": rng.integers(
-            0, config.vocab_size, size=(global_batch, seq + 1)).astype(np.int32)}
+        raise RuntimeError(f"all micro-batches OOM: {last_oom}")
 
-    # warmup (compile).  The fence is a host transfer of a param leaf:
-    # block_until_ready can return early on some experimental PJRT transports,
-    # but device_get cannot lie — it needs the real bytes of the final state.
     def fence():
         # host-transfer a CURRENT param leaf: device_get cannot return until
         # the final state of the last step is materialized
         leaf = jax.tree_util.tree_leaves(engine.state["params"])[0]
         np.asarray(jax.device_get(leaf))
 
-    for _ in range(warmup):
-        loss = engine.train_batch_fused(batch)
     fence()
 
     t0 = time.perf_counter()

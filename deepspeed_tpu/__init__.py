@@ -16,11 +16,14 @@ __version__ = "0.1.0"
 __git_branch__ = "main"
 
 from . import comm as _comm_pkg  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401 — reference parity
 from .comm.comm import init_distributed
 from .parallel.mesh import (MeshManager, ParallelDims, get_mesh_manager,
                             initialize_mesh)
 from .runtime.activation_checkpointing import checkpointing
 from .runtime.config import DeepSpeedConfig
+from .ops.transformer import (DeepSpeedTransformerConfig,
+                              DeepSpeedTransformerLayer)
 from .runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
 from .runtime import zero  # noqa: F401 — deepspeed.zero namespace parity
 from .module_inject.replace_policy import replace_transformer_layer

@@ -1,0 +1,6 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import (CpuAccelerator, TpuAccelerator,
+                               get_accelerator, set_accelerator)
+
+__all__ = ["DeepSpeedAccelerator", "TpuAccelerator", "CpuAccelerator",
+           "get_accelerator", "set_accelerator"]

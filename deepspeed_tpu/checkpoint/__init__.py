@@ -1,6 +1,9 @@
 from .deepspeed_checkpoint import DeepSpeedCheckpoint
+from .reshape_meg_2d import (merge_rows_to_global, reshape_meg_2d_parallel,
+                             split_global_to_rows)
 from .universal_checkpoint import (ds_to_universal, load_universal,
                                    load_universal_into_engine)
 
 __all__ = ["DeepSpeedCheckpoint", "ds_to_universal", "load_universal",
-           "load_universal_into_engine"]
+           "load_universal_into_engine", "reshape_meg_2d_parallel",
+           "merge_rows_to_global", "split_global_to_rows"]

@@ -1,0 +1,108 @@
+"""Offline 2D (pipeline × tensor) checkpoint reshaping.
+
+Counterpart of the reference's ``deepspeed/checkpoint/reshape_meg_2d.py``
+(:75 ``reshape_meg_2d_parallel``) and ``reshape_3d_utils.py``: a Megatron-
+style checkpoint written on a (pp_old × tp_old) grid of per-rank state
+dicts is re-laid onto a (pp_new × tp_new) grid.  The dp dimension needs no
+tooling in this framework — native checkpoints store global arrays — so
+the 3D reshape of the reference reduces to this 2D grid transform applied
+to *foreign* (torch/Megatron layout) checkpoints.
+
+Mechanism (pure numpy, no device):
+  1. each pipeline row merges its tp shards (``MegatronSDLoader._merge``);
+  2. stage-local ``layers.{i}.`` indices rebase onto the global layer axis;
+  3. the global layer list re-partitions into ``pp_new`` balanced stages
+     (same ``partition_uniform`` split the reference's PipelineModule uses);
+  4. every new stage re-slices into ``tp_new`` shards
+     (``MegatronSDLoader._split``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+from ..runtime.state_dict_factory import MegatronSDLoader
+from ..runtime.utils import partition_uniform
+
+_LAYER_RE = re.compile(r"(^|\.)layers\.(\d+)\.")
+
+
+def _layer_index(key: str):
+    m = _LAYER_RE.search(key)
+    return int(m.group(2)) if m else None
+
+
+def _with_layer_index(key: str, new_idx: int) -> str:
+    return _LAYER_RE.sub(lambda m: f"{m.group(1)}layers.{new_idx}.", key, 1)
+
+
+def merge_rows_to_global(grid: List[List[Dict[str, Any]]]
+                         ) -> Dict[str, Any]:
+    """(pp × tp) grid of state dicts → one global dict with globally
+    indexed ``layers.{i}.`` keys.  Non-layer keys (embeddings on stage 0,
+    final layernorm / head on the last stage) pass through; a duplicate
+    non-layer key across stages must agree (tied embeddings)."""
+    import numpy as np
+
+    from ..utils.logging import logger
+
+    loader = MegatronSDLoader([])
+    out: Dict[str, Any] = {}
+    offset = 0
+    for row in grid:
+        merged = loader._merge(row) if len(row) > 1 else dict(row[0])
+        local_max = -1
+        for key, val in merged.items():
+            idx = _layer_index(key)
+            if idx is None:
+                if key in out and not np.allclose(
+                        np.asarray(out[key]), np.asarray(val), atol=1e-6):
+                    logger.warning(
+                        f"non-layer tensor {key} differs across pipeline "
+                        "stages (untied copies?); keeping the first stage's")
+                out.setdefault(key, val)
+            else:
+                local_max = max(local_max, idx)
+                out[_with_layer_index(key, idx + offset)] = val
+        offset += local_max + 1
+    return out
+
+
+def split_global_to_rows(full: Dict[str, Any], pp: int, tp: int
+                         ) -> List[List[Dict[str, Any]]]:
+    """Global dict → (pp × tp) grid: balanced layer ranges per stage,
+    embeddings to stage 0, remaining non-layer keys to the last stage,
+    then a tp split per shard."""
+    loader = MegatronSDLoader([])
+    n_layers = 1 + max((i for i in map(_layer_index, full) if i is not None),
+                       default=-1)
+    bounds = partition_uniform(n_layers, pp)
+    grid: List[List[Dict[str, Any]]] = []
+    for stage in range(pp):
+        lo, hi = bounds[stage], bounds[stage + 1]
+        stage_sd: Dict[str, Any] = {}
+        for key, val in full.items():
+            idx = _layer_index(key)
+            if idx is None:
+                is_embed = "embed" in key.lower()
+                if (is_embed and stage == 0) or \
+                        (not is_embed and stage == pp - 1):
+                    stage_sd[key] = val
+            elif lo <= idx < hi:
+                stage_sd[_with_layer_index(key, idx - lo)] = val
+        grid.append([loader._split(stage_sd, tp, r) if tp > 1
+                     else dict(stage_sd) for r in range(tp)])
+    return grid
+
+
+def reshape_meg_2d_parallel(grid: List[List[Dict[str, Any]]],
+                            pp_new: int, tp_new: int
+                            ) -> List[List[Dict[str, Any]]]:
+    """(pp_old × tp_old) grid of Megatron state dicts → (pp_new × tp_new).
+
+    Reference ``reshape_meg_2d.py:75``; categories (qkv / column / row /
+    embedding / replicated) follow ``MegatronSDLoader``'s rules.
+    """
+    assert grid and grid[0], "empty checkpoint grid"
+    return split_global_to_rows(merge_rows_to_global(grid), pp_new, tp_new)

@@ -58,6 +58,20 @@ def accelerator_report() -> None:
               f" / {jax.process_count()}")
     except Exception as e:
         print(f"jax unavailable: {e}")
+        return
+    try:
+        from .accelerator import get_accelerator
+        accel = get_accelerator()
+        print(f"accelerator ............. {accel.device_name()} "
+              f"(comm backend: {accel.communication_backend_name()}, "
+              f"bf16: {accel.is_bf16_supported()})")
+        mem = accel.memory_stats()
+        if mem:
+            print(f"hbm in use / limit ...... "
+                  f"{mem.get('bytes_in_use', 0) / 2**30:.2f}GB / "
+                  f"{mem.get('bytes_limit', 0) / 2**30:.2f}GB")
+    except Exception as e:
+        print(f"accelerator report unavailable: {e}")
 
 
 def general_report() -> None:

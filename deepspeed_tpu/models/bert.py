@@ -45,6 +45,9 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     layer_norm_eps: float = 1e-12
     dropout: float = 0.0
+    # dropout on attention probabilities (reference attn_dropout; applied
+    # post-softmax on the dense path — the flash kernel has no prob matrix)
+    attn_dropout: float = 0.0
     remat: bool = False
     use_flash_attention: bool = True
     vocab_round_to: int = 128
@@ -156,14 +159,18 @@ def _layer_norm(x, scale, bias, eps):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _attention(q, k, v, pad_mask, seq_lens, config: BertConfig):
+def _attention(q, k, v, pad_mask, seq_lens, config: BertConfig,
+               prob_dropout_key=None):
     """Bidirectional MHA. q,k,v: [B,S,H,D].
 
     ``seq_lens`` [B] (right-padded batches — the standard MLM layout) keeps
     the Pallas flash path with per-row kv-length masking; an arbitrary
-    ``pad_mask`` [B, S] (holes) falls back to dense masked attention.
+    ``pad_mask`` [B, S] (holes) falls back to dense masked attention, as
+    does attention-probability dropout (``config.attn_dropout`` +
+    ``prob_dropout_key``, train only).
     """
-    if pad_mask is None and config.use_flash_attention:
+    use_prob_dropout = config.attn_dropout > 0.0 and prob_dropout_key is not None
+    if pad_mask is None and config.use_flash_attention and not use_prob_dropout:
         from ..ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=False, kv_lens=seq_lens)
     scale = 1.0 / math.sqrt(config.head_dim)
@@ -176,6 +183,8 @@ def _attention(q, k, v, pad_mask, seq_lens, config: BertConfig):
         # that survive the MLM label mask and poison the batch loss
         s = jnp.where(pad_mask[:, None, None, :], s, -1e9)
     p = jax.nn.softmax(s, axis=-1)
+    if use_prob_dropout:
+        p = _dropout(p, config.attn_dropout, prob_dropout_key)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
@@ -190,13 +199,16 @@ def _block(x, pad_mask, seq_lens, p, config: BertConfig, dropout_key=None):
     """Post-LN transformer encoder block (original BERT ordering)."""
     cdt = config.dtype
     eps = config.layer_norm_eps
-    k_attn = k_mlp = None
+    k_attn = k_mlp = k_prob = None
     if dropout_key is not None:
-        k_attn, k_mlp = jax.random.split(dropout_key)
+        if config.attn_dropout > 0.0:
+            k_attn, k_mlp, k_prob = jax.random.split(dropout_key, 3)
+        else:
+            k_attn, k_mlp = jax.random.split(dropout_key)
     qkv = jnp.einsum("bsd,dthe->bsthe", x, p["wqkv"].astype(cdt)) \
         + p["bqkv"].astype(cdt)
     attn = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask,
-                      seq_lens, config)
+                      seq_lens, config, prob_dropout_key=k_prob)
     attn_out = jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
         + p["bo"].astype(cdt)
     attn_out = _dropout(attn_out, config.dropout, k_attn)

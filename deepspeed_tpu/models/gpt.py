@@ -63,6 +63,12 @@ class GPTConfig:
     rotary_interleaved: bool = False    # GPT-J pairs dims; NeoX splits halves
     activation: str = "gelu"            # gelu | relu
     parallel_residual: bool = False     # NeoX: x + attn(ln1 x) + mlp(ln2 x)
+    # GPT-Neo (reference HFGPTNEOLayerPolicy, replace_policy.py:255): no
+    # 1/sqrt(Dh) softmax scaling, and every other layer attends through a
+    # banded causal window instead of the full prefix
+    attn_softmax_scale: Optional[float] = None  # None → 1/sqrt(head_dim)
+    local_attention_window: int = 0     # >0: banded-causal window width
+    local_attention_alternating: bool = False   # odd layers local (GPT-Neo)
     tie_word_embeddings: bool = True    # False -> separate lm_head param
     lm_head_bias: bool = False          # GPT-J: untied head carries a bias
     pos_offset: int = 0                 # OPT stores positions offset by 2
@@ -280,6 +286,8 @@ def _alibi_attention(q, k, v, config: GPTConfig, q_positions=None):
 def _activation_fn(x, config: GPTConfig):
     if config.activation == "relu":
         return jax.nn.relu(x)
+    if config.activation == "quick_gelu":   # CLIP: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
     return jax.nn.gelu(x, approximate=True)
 
 
@@ -291,8 +299,49 @@ def _dropout(x, rate: float, key):
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
-def _attention(q, k, v, config: GPTConfig):
-    """Causal MHA. q,k,v: [B, S, H, D]."""
+def _windowed_attention(q, k, v, config: GPTConfig, window, pos=None):
+    """Dense banded-causal attention: key j visible to query i iff
+    0 <= i - j < window (GPT-Neo local layers; window may be a traced
+    per-layer scalar so the alternating stack stays one `lax.scan`).
+
+    ``pos``: absolute position of the first query — scalar or [B] (ragged
+    decode against a padded KV cache); defaults to end-aligned
+    ``Sk - Sq`` (training / prefill on unpadded K/V).  One implementation
+    serves train, prefill, and cached decode.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = config.attn_softmax_scale
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    pos_arr = jnp.asarray(Sk - Sq if pos is None else pos)
+    steps = jnp.arange(Sq)
+    q_pos = pos_arr[:, None] + steps if pos_arr.ndim else pos_arr + steps
+    q_pos = jnp.atleast_2d(q_pos)                          # [B or 1, Sq]
+    dist = q_pos[:, :, None] - jnp.arange(Sk)[None, None, :]
+    mask = (dist >= 0) & (dist < window)
+    s = jnp.where(mask[:, None], s, float("-inf"))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def layer_window(config: GPTConfig, idx, full):
+    """Per-layer attention window (or None): GPT-Neo's alternating
+    global/local stack as one traced scalar — the single source of the
+    alternation rule for train, prefill, and decode."""
+    if config.local_attention_window <= 0:
+        return None
+    return jnp.where((idx % 2 == 1) | ~jnp.asarray(
+        config.local_attention_alternating),
+        config.local_attention_window, full)
+
+
+def _attention(q, k, v, config: GPTConfig, window=None):
+    """Causal MHA. q,k,v: [B, S, H, D].  ``window`` (optional traced
+    scalar) routes through the banded-causal dense path."""
+    if window is not None:
+        return _windowed_attention(q, k, v, config, window)
     if config.pos_embed == "alibi":
         return _alibi_attention(q, k, v, config)
     if config.sequence_parallel:
@@ -312,8 +361,10 @@ def _attention(q, k, v, config: GPTConfig):
     if config.use_flash_attention:
         # pallas kernel on TPU; internally falls back to the dense
         # reference on other backends or non-tiling shapes
-        return flash_attention(q, k, v, causal=True)
-    return mha_reference(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               sm_scale=config.attn_softmax_scale)
+    return mha_reference(q, k, v, causal=True,
+                         sm_scale=config.attn_softmax_scale)
 
 
 def qkv_proj(x, p, config: GPTConfig, positions=None):
@@ -375,7 +426,7 @@ def block_tail(x, attn, p, config: GPTConfig):
 
 
 def _attn_residual(x, layer_params, config: GPTConfig, positions=None,
-                   dropout_key=None):
+                   dropout_key=None, window=None):
     """Full attention sublayer with residual: x + W_o·attn(qkv(LN1(x))).
 
     Used by the MoE model (gpt_moe._moe_half_block), whose FFN half is an
@@ -383,12 +434,12 @@ def _attn_residual(x, layer_params, config: GPTConfig, positions=None,
     """
     p = layer_params
     q, k, v = qkv_proj(x, p, config, positions=positions)
-    attn = _attention(q, k, v, config)
+    attn = _attention(q, k, v, config, window=window)
     return attn_out_residual(x, attn, p, config, dropout_key)
 
 
 def _block(x, layer_params, config: GPTConfig, positions=None,
-           dropout_key=None):
+           dropout_key=None, window=None):
     """One transformer block on [B, S, d]."""
     k_attn = k_mlp = None
     if dropout_key is not None:
@@ -397,12 +448,12 @@ def _block(x, layer_params, config: GPTConfig, positions=None,
         # NeoX: both sublayers read the SAME input; residual sums them
         p = layer_params
         q, k, v = qkv_proj(x, p, config, positions=positions)
-        attn = _attention(q, k, v, config)
+        attn = _attention(q, k, v, config, window=window)
         return x + _dropout(attn_project(attn, p, config),
                             config.dropout, k_attn) \
             + mlp_out(x, p, config, k_mlp)
     h = _attn_residual(x, layer_params, config, positions=positions,
-                       dropout_key=k_attn)
+                       dropout_key=k_attn, window=window)
     return mlp_residual(h, layer_params, config, dropout_key=k_mlp)
 
 
@@ -440,9 +491,10 @@ def lm_logits(params: PyTree, x, config: GPTConfig) -> jnp.ndarray:
     return logits
 
 
-def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
-          dropout_rng=None, pld_theta=None) -> jnp.ndarray:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, padded_vocab] f32.
+def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
+             dropout_rng=None, pld_theta=None) -> jnp.ndarray:
+    """Embed + transformer stack: tokens [B, S] → hidden [B, S, d]
+    (pre-final-layernorm).
 
     ``pld_theta`` (engine-injected, train only) enables progressive layer
     drop: layer l keeps with prob 1 - (l+1)/L · (1-θ) — deeper layers drop
@@ -481,7 +533,8 @@ def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
     def scan_body(carry, xs):
         layer_params, idx = xs
         key = jax.random.fold_in(dropout_rng, idx) if use_dropout else None
-        out = block_fn(carry, layer_params, dropout_key=key)
+        out = block_fn(carry, layer_params, dropout_key=key,
+                       window=layer_window(config, idx, S))
         if use_pld:
             p_keep = 1.0 - (idx + 1.0) / L * (1.0 - pld_theta)
             gate_key = jax.random.fold_in(
@@ -492,7 +545,24 @@ def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
 
     x, _ = lax.scan(scan_body, x,
                     (params["blocks"], jnp.arange(config.n_layer)))
+    return x
+
+
+def apply(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
+          dropout_rng=None, pld_theta=None) -> jnp.ndarray:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, padded_vocab] f32."""
+    x = backbone(params, tokens, config, dropout_rng=dropout_rng,
+                 pld_theta=pld_theta)
     return lm_logits(params, x, config)
+
+
+def encode(params: PyTree, tokens: jnp.ndarray, config: GPTConfig
+           ) -> jnp.ndarray:
+    """Final-layernormed hidden states [B, S, d] — the text-encoder surface
+    (CLIP's ``last_hidden_state``; reference HFCLIPLayerPolicy,
+    replace_policy.py:205)."""
+    x = backbone(params, tokens, config)
+    return _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
 
 
 def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTConfig) -> jnp.ndarray:

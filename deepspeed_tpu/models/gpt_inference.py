@@ -61,12 +61,18 @@ def init_cache(config: gpt.GPTConfig, batch: int, max_len: int) -> KVCache:
                    length=jnp.zeros((), jnp.int32))
 
 
-def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig):
+def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig,
+                      window=None):
     """q: [B, S_q, H, D] attending to cache[:, :pos+S_q].
 
     ``pos`` is the number of tokens already in the cache before this call;
     query i sits at absolute position pos+i and sees cache slots ≤ pos+i.
+    ``window`` (traced per-layer scalar) routes through the banded path —
+    the same ``gpt._windowed_attention`` that serves training/prefill.
     """
+    if window is not None:
+        return gpt._windowed_attention(q, cache_k, cache_v, config, window,
+                                       pos=pos)
     if config.pos_embed == "alibi":
         # dense path with the alibi bias; cache slots beyond the query's
         # position fall out of the dist >= 0 mask.  pos: scalar or [B].
@@ -77,8 +83,10 @@ def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig):
         return gpt._alibi_attention(q, cache_k, cache_v, config,
                                     q_positions=q_positions)
     from ..ops.pallas.decode_attention import cached_attention
-    return cached_attention(q, cache_k, cache_v, pos,
-                            sm_scale=1.0 / math.sqrt(config.head_dim))
+    scale = config.attn_softmax_scale
+    if scale is None:
+        scale = 1.0 / math.sqrt(config.head_dim)
+    return cached_attention(q, cache_k, cache_v, pos, sm_scale=scale)
 
 
 def _block_tail(x, attn, p, config: gpt.GPTConfig):
@@ -102,16 +110,19 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
     x = gpt.embed(params, tokens, config, positions=positions)
 
     def layer(x, xs):
-        p, ck, cv = xs
+        p, ck, cv, idx = xs
         q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
         new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
         new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         # prefill attention runs on the unpadded k/v (training flash path);
         # only decode reads back through the padded cache
-        attn = gpt._attention(q, k, v, config)
+        attn = gpt._attention(q, k, v, config,
+                              window=gpt.layer_window(config, idx, S))
         return _block_tail(x, attn, p, config), (new_ck, new_cv)
 
-    x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["blocks"], cache.k, cache.v,
+                   jnp.arange(config.n_layer)))
     logits = gpt.lm_logits(params, x, config)
     return logits, KVCache(k=new_k, v=new_v,
                            length=jnp.asarray(S, jnp.int32))
@@ -133,7 +144,7 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
     x = gpt.embed(params, token[:, None], config, positions=positions)
 
     def layer(x, xs):
-        p, ck, cv = xs
+        p, ck, cv, idx = xs
         q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
         if ragged:
             rows = jnp.arange(B)
@@ -144,10 +155,14 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
                 ck, k.astype(ck.dtype), (0, pos, 0, 0))
             new_cv = lax.dynamic_update_slice(
                 cv, v.astype(cv.dtype), (0, pos, 0, 0))
-        attn = _cached_attention(q, new_ck, new_cv, pos, config)
+        attn = _cached_attention(
+            q, new_ck, new_cv, pos, config,
+            window=gpt.layer_window(config, idx, cache.max_len))
         return _block_tail(x, attn, p, config), (new_ck, new_cv)
 
-    x, (new_k, new_v) = lax.scan(layer, x, (params["blocks"], cache.k, cache.v))
+    x, (new_k, new_v) = lax.scan(
+        layer, x, (params["blocks"], cache.k, cache.v,
+                   jnp.arange(config.n_layer)))
     logits = gpt.lm_logits(params, x[:, 0], config)
     new_len = (jnp.max(pos) + 1) if ragged else pos + 1
     return logits, KVCache(k=new_k, v=new_v, length=new_len)

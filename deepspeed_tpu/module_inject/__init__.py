@@ -1,12 +1,15 @@
 from .load_checkpoint import load_sharded_state_dict, module_quantize
 from .replace_policy import (BLOOMLayerPolicy, GPTNEOXLayerPolicy,
-                             HFBertLayerPolicy, HFGPT2LayerPolicy,
-                             HFGPTJLayerPolicy, HFOPTLayerPolicy,
+                             HFBertLayerPolicy, HFCLIPLayerPolicy,
+                             HFGPT2LayerPolicy, HFGPTJLayerPolicy,
+                             HFGPTNEOLayerPolicy, HFOPTLayerPolicy,
                              MegatronLayerPolicy, convert_hf_bert,
-                             convert_hf_model, replace_transformer_layer)
+                             convert_hf_clip_text, convert_hf_model,
+                             replace_transformer_layer)
 
-__all__ = ["HFGPT2LayerPolicy", "HFOPTLayerPolicy", "BLOOMLayerPolicy",
-           "GPTNEOXLayerPolicy", "HFGPTJLayerPolicy", "HFBertLayerPolicy",
-           "MegatronLayerPolicy", "convert_hf_model", "convert_hf_bert",
+__all__ = ["HFGPT2LayerPolicy", "HFGPTNEOLayerPolicy", "HFOPTLayerPolicy",
+           "BLOOMLayerPolicy", "GPTNEOXLayerPolicy", "HFGPTJLayerPolicy",
+           "HFBertLayerPolicy", "HFCLIPLayerPolicy", "MegatronLayerPolicy",
+           "convert_hf_model", "convert_hf_bert", "convert_hf_clip_text",
            "replace_transformer_layer", "load_sharded_state_dict",
            "module_quantize"]

@@ -123,6 +123,194 @@ def _pad_vocab(w, padded_vocab: int):
 
 
 
+class HFGPTNEOLayerPolicy:
+    """transformers GPT-Neo (``GPTNeoForCausalLM``): separate bias-free
+    q/k/v projections, unscaled attention softmax, and alternating
+    global/local-window attention layers (reference replace_policy.py:255).
+
+    The local window maps onto ``GPTConfig.local_attention_window`` with
+    ``local_attention_alternating`` so the whole stack stays one
+    ``lax.scan`` with a per-layer traced window scalar.
+    """
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("attn.attention.q_proj.weight" in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        att_types = [t for pattern, n in getattr(
+            hf_config, "attention_types", [[["global"], 1]])
+            for t in pattern * n]
+        alternating = "local" in att_types
+        if alternating:
+            # the only layout GPT-Neo ships is strict global/local
+            # alternation; anything else needs a per-layer map we don't have
+            assert all(t == ("local" if i % 2 else "global")
+                       for i, t in enumerate(att_types)), \
+                f"unsupported GPT-Neo attention layout {att_types}"
+        inter = getattr(hf_config, "intermediate_size", None)
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            n_layer=hf_config.num_layers,
+            n_head=hf_config.num_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=inter if inter is not None else 4 * hf_config.hidden_size,
+            attn_softmax_scale=1.0,      # GPT-Neo never scales by 1/sqrt(Dh)
+            local_attention_window=(hf_config.window_size if alternating
+                                    else 0),
+            local_attention_alternating=alternating,
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "transformer." if any(k.startswith("transformer.")
+                                    for k in sd) else ""
+
+        def get(name):
+            return sd[pre + name]
+
+        def lw(i, name):
+            return _linear_w(get, f"h.{i}.{name}.weight")
+
+        def lb(i, name):
+            return _np(get(f"h.{i}.{name}.bias"))
+
+        def lnorm(i, name, part):
+            return _np(get(f"h.{i}.{name}.{part}"))
+
+        def qkv_w(i):
+            return np.stack(
+                [lw(i, f"attn.attention.{n}_proj").reshape(d, H, Dh)
+                 for n in ("q", "k", "v")], axis=1)
+
+        wte = _pad_vocab(_np(get("wte.weight")), config.padded_vocab)
+        block = {
+            "ln1_scale": np.stack([lnorm(i, "ln_1", "weight")
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([lnorm(i, "ln_1", "bias")
+                                  for i in range(L)]),
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            # q/k/v projections carry no bias in GPT-Neo
+            "bqkv": np.zeros((L, 3, H, Dh), np.float32),
+            "wo": np.stack([lw(i, "attn.attention.out_proj").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "attn.attention.out_proj")
+                            for i in range(L)]),
+            "ln2_scale": np.stack([lnorm(i, "ln_2", "weight")
+                                   for i in range(L)]),
+            "ln2_bias": np.stack([lnorm(i, "ln_2", "bias")
+                                  for i in range(L)]),
+            "wi": np.stack([lw(i, "mlp.c_fc") for i in range(L)]),
+            "bi": np.stack([lb(i, "mlp.c_fc") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "mlp.c_proj") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "mlp.c_proj") for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "wpe": _np(get("wpe.weight")),
+            "blocks": block,
+            "lnf_scale": _np(get("ln_f.weight")),
+            "lnf_bias": _np(get("ln_f.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+class HFCLIPLayerPolicy:
+    """transformers CLIP text encoder (``CLIPTextModel`` / the text tower
+    of ``CLIPModel``): pre-LN causal transformer with quick-gelu MLPs and
+    learned positions (reference replace_policy.py:205).  The converted
+    stack serves hidden states through ``gpt.encode`` (CLIP has no LM
+    head); ``last_hidden_state`` parity is the contract."""
+
+    @staticmethod
+    def match(sd: Dict[str, Any]) -> bool:
+        return any("text_model.encoder.layers" in k and
+                   "self_attn.q_proj.weight" in k for k in sd)
+
+    @staticmethod
+    def model_config(hf_config, dtype=jnp.float32) -> gpt.GPTConfig:
+        if hasattr(hf_config, "text_config"):   # full CLIPModel config
+            hf_config = hf_config.text_config
+        act = getattr(hf_config, "hidden_act", "quick_gelu")
+        return gpt.GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            n_layer=hf_config.num_hidden_layers,
+            n_head=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size,
+            activation="quick_gelu" if act == "quick_gelu" else "gelu",
+            dtype=dtype)
+
+    @staticmethod
+    def convert(sd: Dict[str, Any], config: gpt.GPTConfig) -> PyTree:
+        L, d = config.n_layer, config.d_model
+        H, Dh = config.n_head, config.head_dim
+        pre = "text_model."
+
+        def get(name):
+            return sd[pre + name]
+
+        def lw(i, name):
+            return _linear_w(get, f"encoder.layers.{i}.{name}.weight")
+
+        def lb(i, name):
+            return _np(get(f"encoder.layers.{i}.{name}.bias"))
+
+        def qkv_w(i):
+            return np.stack([lw(i, f"self_attn.{n}_proj").reshape(d, H, Dh)
+                             for n in ("q", "k", "v")], axis=1)
+
+        def qkv_b(i):
+            return np.stack([lb(i, f"self_attn.{n}_proj").reshape(H, Dh)
+                             for n in ("q", "k", "v")], axis=0)
+
+        wte = _pad_vocab(_np(get("embeddings.token_embedding.weight")),
+                         config.padded_vocab)
+        block = {
+            "ln1_scale": np.stack([_np(get(f"encoder.layers.{i}."
+                                           "layer_norm1.weight"))
+                                   for i in range(L)]),
+            "ln1_bias": np.stack([lb(i, "layer_norm1") for i in range(L)]),
+            "wqkv": np.stack([qkv_w(i) for i in range(L)]),
+            "bqkv": np.stack([qkv_b(i) for i in range(L)]),
+            "wo": np.stack([lw(i, "self_attn.out_proj").reshape(H, Dh, d)
+                            for i in range(L)]),
+            "bo": np.stack([lb(i, "self_attn.out_proj") for i in range(L)]),
+            "ln2_scale": np.stack([_np(get(f"encoder.layers.{i}."
+                                           "layer_norm2.weight"))
+                                   for i in range(L)]),
+            "ln2_bias": np.stack([lb(i, "layer_norm2") for i in range(L)]),
+            "wi": np.stack([lw(i, "mlp.fc1") for i in range(L)]),
+            "bi": np.stack([lb(i, "mlp.fc1") for i in range(L)]),
+            "wo_mlp": np.stack([lw(i, "mlp.fc2") for i in range(L)]),
+            "bo_mlp": np.stack([lb(i, "mlp.fc2") for i in range(L)]),
+        }
+        params = {
+            "wte": wte,
+            "wpe": _np(get("embeddings.position_embedding.weight")),
+            "blocks": block,
+            "lnf_scale": _np(get("final_layer_norm.weight")),
+            "lnf_bias": _np(get("final_layer_norm.bias")),
+        }
+        return _tree_to_jnp(params, config.param_dtype)
+
+
+def convert_hf_clip_text(hf_model, dtype=jnp.float32):
+    """Live HF CLIPTextModel (or CLIPModel) → (GPTConfig, params); serve
+    hidden states with ``gpt.encode``."""
+    sd = hf_model.state_dict()
+    if not any(k.startswith("text_model.") for k in sd):
+        sd = {"text_model." + k: v for k, v in sd.items()}
+    assert HFCLIPLayerPolicy.match(sd), "not a CLIP text-encoder state dict"
+    config = HFCLIPLayerPolicy.model_config(hf_model.config, dtype=dtype)
+    return config, HFCLIPLayerPolicy.convert(sd, config)
+
+
 class HFOPTLayerPolicy:
     """transformers OPT (``OPTForCausalLM``): separate q/k/v projections,
     relu MLP, learned positions stored with a +2 offset (reference
@@ -653,8 +841,8 @@ def convert_hf_bert(hf_model, dtype=jnp.float32):
     return config, HFBertLayerPolicy.convert(sd, config)
 
 
-POLICIES = [HFGPT2LayerPolicy, HFOPTLayerPolicy, BLOOMLayerPolicy,
-            GPTNEOXLayerPolicy, HFGPTJLayerPolicy]
+POLICIES = [HFGPT2LayerPolicy, HFGPTNEOLayerPolicy, HFOPTLayerPolicy,
+            BLOOMLayerPolicy, GPTNEOXLayerPolicy, HFGPTJLayerPolicy]
 
 
 def convert_hf_model(hf_model, dtype=jnp.float32

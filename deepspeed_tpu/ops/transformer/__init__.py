@@ -1,0 +1,4 @@
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer)
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

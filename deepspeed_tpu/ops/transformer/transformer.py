@@ -1,0 +1,145 @@
+"""Public fused transformer encoder layer.
+
+Counterpart of the reference's ``DeepSpeedTransformerLayer`` /
+``DeepSpeedTransformerConfig`` (``ops/transformer/transformer.py:459,38``):
+the standalone encoder block users drop into BERT-style pretraining.  The
+reference backs it with the hand-fused CUDA kernels under
+``csrc/transformer/``; here the block is jit-compiled JAX whose attention
+runs the Pallas flash kernel — XLA fuses the bias/gelu/dropout epilogues
+the CUDA build fuses by hand, so "kernel injection" is the default math.
+
+Both layer-norm orderings are supported (``pre_layer_norm`` like the
+reference), dropout is first-class (train mode needs a ``dropout_rng``),
+and the parameter tree uses the same layout as ``models/bert.py`` blocks so
+converted HF BERT weights slot straight in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models import bert as _bert
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config surface (transformer.py:38) minus CUDA-isms
+    (stream/stochastic-mode knobs have no TPU meaning)."""
+
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None     # default 4*hidden
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True
+    fp16: bool = False
+    bf16: bool = False
+
+    @property
+    def dtype(self):
+        if self.bf16:
+            return jnp.bfloat16
+        if self.fp16:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def ffn(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer:
+    """One encoder block: ``layer(x, pad_mask)`` → same-shape activations.
+
+    Functional state: ``layer.params`` is an ordinary pytree (optimizers /
+    ZeRO shard it like any other); ``__call__`` is pure given (params, x).
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig,
+                 rng: Optional[jax.Array] = None,
+                 initial_weights: Optional[PyTree] = None):
+        self.config = config
+        d, h = config.hidden_size, config.heads
+        assert d % h == 0, "heads must divide hidden_size"
+        self._bcfg = _bert.BertConfig(
+            vocab_size=1, max_seq_len=1, n_layer=1, n_head=h, d_model=d,
+            d_ff=config.ffn, dtype=config.dtype,
+            dropout=config.hidden_dropout_ratio,
+            attn_dropout=config.attn_dropout_ratio,
+            layer_norm_eps=config.layer_norm_eps)
+        if initial_weights is not None:
+            self.params = initial_weights
+            return
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        keys = jax.random.split(rng, 4)
+        std = config.initializer_range
+        f, hd = config.ffn, d // h
+        pdt = jnp.float32
+
+        def normal(k, shape):
+            return (jax.random.normal(k, shape) * std).astype(pdt)
+
+        self.params = {
+            "wqkv": normal(keys[0], (d, 3, h, hd)),
+            "bqkv": jnp.zeros((3, h, hd), pdt),
+            "wo": normal(keys[1], (h, hd, d)),
+            "bo": jnp.zeros((d,), pdt),
+            "ln1_scale": jnp.ones((d,), pdt),
+            "ln1_bias": jnp.zeros((d,), pdt),
+            "wi": normal(keys[2], (d, f)),
+            "bi": jnp.zeros((f,), pdt),
+            "wo_mlp": normal(keys[3], (f, d)),
+            "bo_mlp": jnp.zeros((d,), pdt),
+            "ln2_scale": jnp.ones((d,), pdt),
+            "ln2_bias": jnp.zeros((d,), pdt),
+        }
+
+    # ------------------------------------------------------------- forward
+    def apply(self, params: PyTree, x: jnp.ndarray,
+              pad_mask: Optional[jnp.ndarray] = None,
+              seq_lens: Optional[jnp.ndarray] = None,
+              dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Pure forward on explicit params (jit/grad this)."""
+        cfg, bcfg = self.config, self._bcfg
+        x = x.astype(cfg.dtype)
+        if not cfg.pre_layer_norm:
+            # original BERT post-LN ordering — exactly models/bert._block
+            return _bert._block(x, pad_mask, seq_lens, params, bcfg,
+                                dropout_key=dropout_rng)
+        # pre-LN ordering (reference pre_layer_norm=True)
+        k_attn = k_mlp = k_prob = None
+        if dropout_rng is not None:
+            if cfg.attn_dropout_ratio > 0.0:
+                k_attn, k_mlp, k_prob = jax.random.split(dropout_rng, 3)
+            else:
+                k_attn, k_mlp = jax.random.split(dropout_rng)
+        eps, cdt = cfg.layer_norm_eps, cfg.dtype
+        h = _bert._layer_norm(x, params["ln1_scale"], params["ln1_bias"], eps)
+        qkv = jnp.einsum("bsd,dthe->bsthe", h, params["wqkv"].astype(cdt)) \
+            + params["bqkv"].astype(cdt)
+        attn = _bert._attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                                pad_mask, seq_lens, bcfg,
+                                prob_dropout_key=k_prob)
+        attn_out = jnp.einsum("bshe,hed->bsd", attn,
+                              params["wo"].astype(cdt)) \
+            + params["bo"].astype(cdt)
+        x = x + _bert._dropout(attn_out, cfg.hidden_dropout_ratio, k_attn)
+        h2 = _bert._layer_norm(x, params["ln2_scale"], params["ln2_bias"], eps)
+        ff = jnp.einsum("bsd,df->bsf", h2, params["wi"].astype(cdt)) \
+            + params["bi"].astype(cdt)
+        ff = jax.nn.gelu(ff, approximate=False)
+        ff_out = jnp.einsum("bsf,fd->bsd", ff, params["wo_mlp"].astype(cdt)) \
+            + params["bo_mlp"].astype(cdt)
+        return x + _bert._dropout(ff_out, cfg.hidden_dropout_ratio, k_mlp)
+
+    def __call__(self, x, pad_mask=None, seq_lens=None, dropout_rng=None):
+        return self.apply(self.params, x, pad_mask=pad_mask,
+                          seq_lens=seq_lens, dropout_rng=dropout_rng)

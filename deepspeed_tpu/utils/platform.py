@@ -48,3 +48,20 @@ def force_cpu_platform(n_devices: int = 8) -> None:
                 f"the device-count flag is latched at first backend touch "
                 f"— run in a fresh process")
     jax.config.update("jax_platforms", "cpu")
+
+    # persistent compile cache: the CI host is single-core and the driver
+    # runs dryrun_multichip under a timeout — caching the compiled
+    # executables across processes keeps the gate fast and safe.  Only for
+    # a source checkout (.git marker): a pip install must not grow a cache
+    # dir inside site-packages.
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.isdir(os.path.join(repo_root, ".git")):
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(repo_root, ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass  # older jax without the persistent-cache config knobs

@@ -16,6 +16,9 @@ from deepspeed_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(n_devices=8)
 
+# (persistent XLA compile cache: force_cpu_platform enables it — the
+# suite is compile-dominated on the single-core CI host)
+
 import pytest  # noqa: E402
 
 

@@ -207,6 +207,54 @@ def test_sd_factory_partial_merge_and_split(tmp_path):
         split0["attention.query_key_value.weight"], expect_q, atol=1e-6)
 
 
+def _fake_layer(rng, d, f):
+    return {
+        "attention.query_key_value.weight": rng.normal(size=(3 * d, d)),
+        "attention.dense.weight": rng.normal(size=(d, d)),
+        "input_layernorm.weight": rng.normal(size=(d,)),
+        "mlp.dense_h_to_4h.weight": rng.normal(size=(f, d)),
+        "mlp.dense_4h_to_h.weight": rng.normal(size=(d, f)),
+    }
+
+
+def test_reshape_meg_2d_grid_roundtrip():
+    """(pp=2, tp=2) grid → global → (pp=4, tp=1) → global must be lossless,
+    with layer indices rebased per stage (reference reshape_meg_2d.py:75)."""
+    from deepspeed_tpu.checkpoint import (merge_rows_to_global,
+                                          reshape_meg_2d_parallel,
+                                          split_global_to_rows)
+
+    d, f, n_layers = 8, 16, 6
+    rng = np.random.default_rng(0)
+    full = {"word_embeddings.weight": rng.normal(size=(32, d)),
+            "final_layernorm.weight": rng.normal(size=(d,))}
+    for i in range(n_layers):
+        for k, v in _fake_layer(rng, d, f).items():
+            full[f"layers.{i}.{k}"] = v
+
+    grid22 = split_global_to_rows(full, pp=2, tp=2)
+    assert len(grid22) == 2 and len(grid22[0]) == 2
+    # embeddings only on stage 0; final LN only on the last stage; local
+    # layer indices start at 0 on every stage
+    assert "word_embeddings.weight" in grid22[0][0]
+    assert "word_embeddings.weight" not in grid22[1][0]
+    assert "final_layernorm.weight" in grid22[1][0]
+    assert any(k.startswith("layers.0.") for k in grid22[1][0])
+
+    grid41 = reshape_meg_2d_parallel(grid22, pp_new=4, tp_new=1)
+    assert len(grid41) == 4 and len(grid41[0]) == 1
+    back = merge_rows_to_global(grid41)
+    assert set(back) == set(full)
+    for k in full:
+        np.testing.assert_allclose(back[k], full[k], atol=1e-6, err_msg=k)
+
+    # tp-only reshape: (1 × 4) row merges back exactly too
+    grid14 = reshape_meg_2d_parallel(grid22, pp_new=1, tp_new=4)
+    back14 = merge_rows_to_global(grid14)
+    for k in full:
+        np.testing.assert_allclose(back14[k], full[k], atol=1e-6, err_msg=k)
+
+
 def test_sd_factory_json_descriptor(tmp_path):
     _, shards = _fake_megatron_shards(tp=2)
     paths = []

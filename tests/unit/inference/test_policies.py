@@ -64,7 +64,18 @@ def test_gpt_neox_injection_logit_parity():
     _parity(transformers.GPTNeoXForCausalLM(cfg), 128)
 
 
-@pytest.mark.parametrize("variant", ["opt", "bloom", "neox"])
+def test_gpt_neo_injection_logit_parity():
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+        attention_types=[[["global", "local"], 2]], window_size=8,
+        max_position_embeddings=64, intermediate_size=64,
+        embed_dropout=0.0, attention_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(6)
+    # window 8 < prompt 16 so the local layers' banded mask is exercised
+    _parity(transformers.GPTNeoForCausalLM(cfg), 128)
+
+
+@pytest.mark.parametrize("variant", ["opt", "bloom", "neox", "neo"])
 def test_variant_decode_matches_full_forward(variant):
     """Prefill + decode through the KV cache == full forward, for every
     architecture variant (alibi/rotary/offset positions in decode)."""
@@ -74,6 +85,9 @@ def test_variant_decode_matches_full_forward(variant):
         kw.update(activation="relu", pos_offset=2)
     elif variant == "bloom":
         kw.update(pos_embed="alibi", embed_layernorm=True)
+    elif variant == "neo":
+        kw.update(attn_softmax_scale=1.0, local_attention_window=4,
+                  local_attention_alternating=True)
     else:
         kw.update(pos_embed="rotary", rotary_pct=0.25,
                   parallel_residual=True, tie_word_embeddings=False)
@@ -101,6 +115,25 @@ def test_gptj_injection_logit_parity():
         attn_pdrop=0.0)
     torch.manual_seed(4)
     _parity(transformers.GPTJForCausalLM(cfg), 128)
+
+
+def test_clip_text_injection_hidden_parity():
+    """CLIP text tower → gpt.encode hidden-state parity (the policy serves
+    last_hidden_state; CLIP has no LM head)."""
+    from deepspeed_tpu.module_inject import convert_hf_clip_text
+
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0)
+    torch.manual_seed(7)
+    model = transformers.CLIPTextModel(cfg).eval()
+    gcfg, params = convert_hf_clip_text(model)
+    tokens = np.random.default_rng(1).integers(0, 128, size=(2, 16))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).last_hidden_state.numpy()
+    got = np.asarray(gpt.encode(params, jnp.asarray(tokens), gcfg))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
 
 
 def test_megatron_policy_roundtrip():

@@ -1,0 +1,85 @@
+"""GPT architecture-variant units: banded local attention (GPT-Neo),
+unscaled softmax, and the encoder (hidden-state) surface."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.ops.pallas import mha_reference
+
+
+def test_windowed_attention_matches_masked_reference():
+    """Band window w: same as dense causal attention where keys older than
+    w are masked out."""
+    B, S, H, D, w = 2, 16, 2, 8, 5
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.float32)
+    cfg = gpt.GPTConfig(n_head=H, d_model=H * D, local_attention_window=w)
+
+    got = gpt._windowed_attention(q, k, v, cfg, jnp.asarray(w))
+
+    # brute force: causal & dist < w
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    dist = jnp.arange(S)[:, None] - jnp.arange(S)[None, :]
+    mask = (dist >= 0) & (dist < w)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    # window >= S degenerates to plain causal attention
+    got_full = gpt._windowed_attention(q, k, v, cfg, jnp.asarray(S))
+    ref_full = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got_full), np.asarray(ref_full),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_unscaled_softmax_scale_flows_through():
+    B, S, H, D = 1, 8, 2, 4
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(x, (B, S, H, D), jnp.float32) for x in ks)
+    cfg = gpt.GPTConfig(n_head=H, d_model=H * D, attn_softmax_scale=1.0,
+                        use_flash_attention=False)
+    got = gpt._attention(q, k, v, cfg)
+    ref = mha_reference(q, k, v, causal=True, sm_scale=1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_encode_consistent_with_logits():
+    """encode() is the final-LN hidden state; with tied embeddings the
+    logits are exactly encode @ wte^T."""
+    cfg = gpt.GPTConfig(vocab_size=64, max_seq_len=32, n_layer=2, n_head=2,
+                        d_model=16, dtype=jnp.float32, vocab_round_to=64)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+    hidden = gpt.encode(params, tokens, cfg)
+    assert hidden.shape == (2, 10, 16)
+    logits = gpt.apply(params, tokens, cfg)
+    via_encode = jnp.einsum("bsd,vd->bsv", hidden, params["wte"])
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(via_encode),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_alternating_local_stack_differs_from_global():
+    """The GPT-Neo alternation must actually change layer-1 attention when
+    the sequence exceeds the window."""
+    base = dict(vocab_size=64, max_seq_len=32, n_layer=2, n_head=2,
+                d_model=16, dtype=jnp.float32, vocab_round_to=64)
+    cfg_local = gpt.GPTConfig(**base, attn_softmax_scale=1.0,
+                              local_attention_window=4,
+                              local_attention_alternating=True)
+    cfg_global = gpt.GPTConfig(**base, attn_softmax_scale=1.0)
+    params = gpt.init(cfg_global, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 64)
+    out_local = gpt.apply(params, tokens, cfg_local)
+    out_global = gpt.apply(params, tokens, cfg_global)
+    # early positions (inside the window) agree; late positions must differ
+    np.testing.assert_allclose(np.asarray(out_local[:, :4]),
+                               np.asarray(out_global[:, :4]),
+                               atol=1e-4, rtol=1e-4)
+    assert not np.allclose(np.asarray(out_local[:, 8:]),
+                           np.asarray(out_global[:, 8:]), atol=1e-4)

@@ -1,0 +1,54 @@
+"""Accelerator abstraction (reference accelerator/real_accelerator.py:15):
+selection, identity, capability, memory and fence surfaces on the CPU
+platform the test harness pins."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import (CpuAccelerator, TpuAccelerator,
+                                       get_accelerator, set_accelerator)
+
+
+def test_get_accelerator_singleton_matches_platform():
+    set_accelerator(None)
+    accel = get_accelerator()
+    assert accel is get_accelerator()          # cached
+    assert accel.device_name() == jax.devices()[0].platform
+    assert accel.is_available()
+    assert accel.device_count() == len(jax.devices())
+    assert accel.communication_backend_name() == "xla"
+
+
+def test_accelerator_device_naming_and_fence():
+    accel = get_accelerator()
+    assert accel.device_name(3) == f"{accel.device_name()}:3"
+    assert accel.current_device() == 0
+    accel.synchronize()                        # fence must not raise
+
+
+def test_accelerator_capabilities_and_rng():
+    accel = get_accelerator()
+    assert accel.is_bf16_supported()
+    assert accel.is_fp16_supported()
+    key = accel.manual_seed(17)
+    np.testing.assert_array_equal(np.asarray(key),
+                                  np.asarray(jax.random.PRNGKey(17)))
+
+
+def test_on_accelerator_and_memory_stats():
+    accel = get_accelerator()
+    x = jnp.ones((4,))
+    assert accel.on_accelerator(x)
+    assert not accel.on_accelerator(np.ones((4,)))
+    assert isinstance(accel.memory_allocated(), int)   # 0 on CPU is fine
+
+
+def test_explicit_accelerator_classes():
+    cpu = CpuAccelerator()
+    assert cpu.device_name() == "cpu"
+    tpu = TpuAccelerator()
+    assert tpu.device_name() == "tpu"
+    # on the CPU-pinned test platform the TPU accelerator sees no devices
+    assert tpu.device_count() == 0 or tpu.devices()[0].platform == "tpu"
