@@ -50,11 +50,21 @@ class DeepSpeedAccelerator(abc.ABC):
     # ------------------------------------------------------- execution
     def synchronize(self, device_index: Optional[int] = None) -> None:
         """Fence: block until all dispatched work on the device finished.
-        (reference: torch.cuda.synchronize)"""
-        import jax
+        (reference: torch.cuda.synchronize)
 
-        (jax.device_put(0.0, self.devices()[device_index or 0])
-         .block_until_ready())
+        A jitted no-op is enqueued on the device's compute stream — TPU
+        executes programs in order, so it completes only after everything
+        already queued — and ``device_get`` forces the result to the host
+        (``block_until_ready`` alone can return early on relay-backed
+        transports, and a bare ``device_put`` rides the DMA path without
+        waiting for queued compute).
+        """
+        import jax
+        import numpy as np
+
+        dev = self.devices()[device_index or 0]
+        x = jax.device_put(0.0, dev)
+        np.asarray(jax.device_get(jax.jit(lambda v: v + 1.0)(x)))
 
     # ------------------------------------------------------- capabilities
     @abc.abstractmethod

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .partitioning import EMBED, HEADS, KV, LAYERS, MLP, SEQ, VOCAB
+from ..utils.logging import logger
 
 PyTree = Any
 
@@ -45,6 +46,17 @@ class GPTConfig:
     param_dtype: Any = jnp.float32      # storage dtype of master params
     dropout: float = 0.0
     remat: bool = False
+    # jax.checkpoint policy when remat is on: "nothing" recomputes the
+    # whole block (min memory); "dots" saves matmul outputs with no batch
+    # dims; "attn_out" saves only the attention outputs (the flash
+    # kernel's fwd is the costliest recompute — saving its [B,S,H,D]
+    # output keeps the rest of the block rematerialized at ~48MB/layer)
+    remat_policy: str = "nothing"       # nothing | dots | attn_out
+    # sequence-chunked cross-entropy: compute the [B, chunk, V] logits one
+    # chunk at a time (rematerialized in backward) instead of holding the
+    # full [B, S, V] fp32 logits — the head is ~1/4 of a small model's
+    # FLOPs but its logits dominate HBM at large batch.  0 disables.
+    loss_chunk: int = 0
     use_flash_attention: bool = True    # pallas kernel when available
     vocab_round_to: int = 128           # pad vocab to a lane multiple
     sequence_parallel: Optional[str] = None  # None | 'ring' | 'ulysses'
@@ -288,7 +300,9 @@ def _activation_fn(x, config: GPTConfig):
         return jax.nn.relu(x)
     if config.activation == "quick_gelu":   # CLIP: x * sigmoid(1.702 x)
         return x * jax.nn.sigmoid(1.702 * x)
-    return jax.nn.gelu(x, approximate=True)
+    if config.activation == "gelu_exact":   # HF 'gelu' = erf form
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)  # HF 'gelu_new' (GPT-2/J/Neo)
 
 
 def _dropout(x, rate: float, key):
@@ -339,8 +353,17 @@ def layer_window(config: GPTConfig, idx, full):
 
 def _attention(q, k, v, config: GPTConfig, window=None):
     """Causal MHA. q,k,v: [B, S, H, D].  ``window`` (optional traced
-    scalar) routes through the banded-causal dense path."""
+    scalar) routes through the banded-causal dense path; in an
+    alternating stack the global layers (window >= S) keep the
+    memory-linear flash path via ``lax.cond`` — only the truly banded
+    layers materialize dense scores."""
     if window is not None:
+        if config.local_attention_alternating:
+            return lax.cond(
+                window >= k.shape[1],
+                lambda ops: _attention(*ops, config),
+                lambda ops: _windowed_attention(*ops, config, window),
+                (q, k, v))
         return _windowed_attention(q, k, v, config, window)
     if config.pos_embed == "alibi":
         return _alibi_attention(q, k, v, config)
@@ -357,14 +380,22 @@ def _attention(q, k, v, config: GPTConfig, window=None):
         return block_sparse_attention(q, k, v, layout,
                                       block=config.sparse_attention.block,
                                       causal=True)
+    from jax.ad_checkpoint import checkpoint_name
+
     from ..ops.pallas import flash_attention, mha_reference
     if config.use_flash_attention:
         # pallas kernel on TPU; internally falls back to the dense
-        # reference on other backends or non-tiling shapes
-        return flash_attention(q, k, v, causal=True,
-                               sm_scale=config.attn_softmax_scale)
-    return mha_reference(q, k, v, causal=True,
-                         sm_scale=config.attn_softmax_scale)
+        # reference on other backends or non-tiling shapes.  The output is
+        # name-tagged so remat_policy="attn_out" can save it — skipping the
+        # flash-forward recompute inside the backward pass.
+        return checkpoint_name(
+            flash_attention(q, k, v, causal=True,
+                            sm_scale=config.attn_softmax_scale),
+            "ds_attn_out")
+    return checkpoint_name(
+        mha_reference(q, k, v, causal=True,
+                      sm_scale=config.attn_softmax_scale),
+        "ds_attn_out")
 
 
 def qkv_proj(x, p, config: GPTConfig, positions=None):
@@ -473,22 +504,37 @@ def embed(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
     return x
 
 
-def lm_logits(params: PyTree, x, config: GPTConfig) -> jnp.ndarray:
-    """Final LN + (tied or separate) head.
+def _head_logits(params: PyTree, h, config: GPTConfig) -> jnp.ndarray:
+    """(Tied or separate) head on final-layernormed hiddens ``h``.
 
     Inputs stay in the compute dtype so the MXU runs at its bf16 rate; the
     accumulator/output is fp32 (``preferred_element_type``) for a stable
-    softmax — an fp32×fp32 vocab matmul is ~30% of GPT-2's step FLOPs at
-    a fraction of the MXU rate.
+    softmax.  The ONE head definition — full-logits (lm_logits) and the
+    chunked loss both route here.
     """
-    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     head = params["wte"] if config.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("...d,vd->...v", x.astype(config.dtype),
+    logits = jnp.einsum("...d,vd->...v", h.astype(config.dtype),
                         head.astype(config.dtype),
                         preferred_element_type=jnp.float32)
     if "lm_head_bias" in params:  # GPT-J's biased untied head
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits
+
+
+def _token_nll(logits, targets):
+    """Per-token masked NLL sums: (sum nll, count). targets < 0 are masked
+    (the -100 convention)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    return jnp.sum((logz - gold) * mask), jnp.sum(mask)
+
+
+def lm_logits(params: PyTree, x, config: GPTConfig) -> jnp.ndarray:
+    """Final LN + head → fp32 logits."""
+    return _head_logits(
+        params, _layer_norm(x, params["lnf_scale"], params["lnf_bias"]),
+        config)
 
 
 def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
@@ -523,8 +569,14 @@ def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
             # policy-driven remat (partitioned/offloaded checkpoints)
             block_fn = ckpt.wrap(block_fn)
         else:
-            block_fn = jax.checkpoint(
-                block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+            if config.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif config.remat_policy == "attn_out":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "ds_attn_out")
+            else:
+                policy = jax.checkpoint_policies.nothing_saveable
+            block_fn = jax.checkpoint(block_fn, policy=policy)
 
     use_dropout = dropout_rng is not None and config.dropout > 0
     use_pld = pld_theta is not None and dropout_rng is not None
@@ -579,13 +631,39 @@ def loss_fn(params: PyTree, batch: Dict[str, jnp.ndarray], config: GPTConfig) ->
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    chunk = config.loss_chunk
+    if chunk:
+        S = inputs.shape[1]
+        if S % chunk:
+            # largest divisor of S that fits the requested chunk — honest
+            # degradation instead of silently falling back to full logits
+            eff = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
+            logger.warning(f"loss_chunk={chunk} does not divide seq {S}; "
+                           f"using chunk {eff}")
+            chunk = eff
+        x = backbone(params, inputs, config, dropout_rng=dropout_rng,
+                     pld_theta=pld_theta)
+        h = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        B, S, d = h.shape
+        n = S // chunk
+        hc = h.reshape(B, n, chunk, d).transpose(1, 0, 2, 3)
+        tc = targets.reshape(B, n, chunk).transpose(1, 0, 2)
+
+        def chunk_nll(carry, xs):
+            hcb, tcb = xs
+            tot, cnt = _token_nll(_head_logits(params, hcb, config), tcb)
+            return (carry[0] + tot, carry[1] + cnt), None
+
+        (tot, cnt), _ = lax.scan(
+            jax.checkpoint(chunk_nll,
+                           policy=jax.checkpoint_policies.nothing_saveable),
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hc, tc))
+        return tot / jnp.maximum(cnt, 1.0)
     logits = apply(params, inputs, config, dropout_rng=dropout_rng,
                    pld_theta=pld_theta)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - gold
-    mask = (targets >= 0).astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    tot, cnt = _token_nll(logits, targets)
+    return tot / jnp.maximum(cnt, 1.0)
 
 
 def flops_per_token(config: GPTConfig) -> float:

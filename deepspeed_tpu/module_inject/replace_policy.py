@@ -243,7 +243,7 @@ class HFCLIPLayerPolicy:
             n_head=hf_config.num_attention_heads,
             d_model=hf_config.hidden_size,
             d_ff=hf_config.intermediate_size,
-            activation="quick_gelu" if act == "quick_gelu" else "gelu",
+            activation="quick_gelu" if act == "quick_gelu" else "gelu_exact",
             dtype=dtype)
 
     @staticmethod

@@ -377,7 +377,7 @@ def _pick_block(seq: int, want: int) -> Optional[int]:
 
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256,
+                    block_q: int = 1024, block_k: int = 1024,
                     kv_lens=None):
     """Memory-linear attention. q,k,v: [B, S, H, D] → [B, S, H, D].
 
