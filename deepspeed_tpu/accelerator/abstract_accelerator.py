@@ -16,6 +16,20 @@ import abc
 from typing import Any, Dict, List, Optional
 
 
+_FENCE = None
+
+
+def _fence_fn():
+    """Cached jitted no-op (jit caches by function identity — a fresh
+    lambda per fence would retrace/compile every call)."""
+    global _FENCE
+    if _FENCE is None:
+        import jax
+
+        _FENCE = jax.jit(lambda v: v + 1.0)
+    return _FENCE
+
+
 class DeepSpeedAccelerator(abc.ABC):
     """Platform interface.  Concrete: TpuAccelerator / CpuAccelerator."""
 
@@ -60,11 +74,9 @@ class DeepSpeedAccelerator(abc.ABC):
         waiting for queued compute).
         """
         import jax
-        import numpy as np
 
         dev = self.devices()[device_index or 0]
-        x = jax.device_put(0.0, dev)
-        np.asarray(jax.device_get(jax.jit(lambda v: v + 1.0)(x)))
+        jax.device_get(_fence_fn()(jax.device_put(0.0, dev)))
 
     # ------------------------------------------------------- capabilities
     @abc.abstractmethod

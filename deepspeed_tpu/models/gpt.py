@@ -48,9 +48,11 @@ class GPTConfig:
     remat: bool = False
     # jax.checkpoint policy when remat is on: "nothing" recomputes the
     # whole block (min memory); "dots" saves matmul outputs with no batch
-    # dims; "attn_out" saves only the attention outputs (the flash
-    # kernel's fwd is the costliest recompute — saving its [B,S,H,D]
-    # output keeps the rest of the block rematerialized at ~48MB/layer)
+    # dims; "attn_out" saves the [B,S,H,D] attention outputs (~48MB/layer)
+    # so the downstream block tail needn't recompute them.  NOTE: the
+    # flash kernel's logsumexp residual is internal to its custom_vjp and
+    # cannot be name-saved, so its backward still replays the fwd kernel
+    # under every policy.
     remat_policy: str = "nothing"       # nothing | dots | attn_out
     # sequence-chunked cross-entropy: compute the [B, chunk, V] logits one
     # chunk at a time (rematerialized in backward) instead of holding the
@@ -87,6 +89,8 @@ class GPTConfig:
     embed_layernorm: bool = False       # BLOOM's word_embeddings_layernorm
 
     def __post_init__(self):
+        assert self.remat_policy in ("nothing", "dots", "attn_out"), \
+            f"unknown remat_policy {self.remat_policy!r}"
         # alibi routes attention through its own biased-dense path; make the
         # non-composition with SP/sparse kernels loud rather than silently
         # ignoring the configured parallelism (same policy as the pipeline
@@ -356,12 +360,23 @@ def _attention(q, k, v, config: GPTConfig, window=None):
     scalar) routes through the banded-causal dense path; in an
     alternating stack the global layers (window >= S) keep the
     memory-linear flash path via ``lax.cond`` — only the truly banded
-    layers materialize dense scores."""
+    layers materialize dense scores.
+
+    Every path's output is name-tagged "ds_attn_out" so
+    ``remat_policy="attn_out"`` saves it regardless of variant.
+    """
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(_attention_impl(q, k, v, config, window),
+                           "ds_attn_out")
+
+
+def _attention_impl(q, k, v, config: GPTConfig, window=None):
     if window is not None:
         if config.local_attention_alternating:
             return lax.cond(
                 window >= k.shape[1],
-                lambda ops: _attention(*ops, config),
+                lambda ops: _attention_impl(*ops, config),
                 lambda ops: _windowed_attention(*ops, config, window),
                 (q, k, v))
         return _windowed_attention(q, k, v, config, window)
@@ -380,22 +395,14 @@ def _attention(q, k, v, config: GPTConfig, window=None):
         return block_sparse_attention(q, k, v, layout,
                                       block=config.sparse_attention.block,
                                       causal=True)
-    from jax.ad_checkpoint import checkpoint_name
-
     from ..ops.pallas import flash_attention, mha_reference
     if config.use_flash_attention:
         # pallas kernel on TPU; internally falls back to the dense
-        # reference on other backends or non-tiling shapes.  The output is
-        # name-tagged so remat_policy="attn_out" can save it — skipping the
-        # flash-forward recompute inside the backward pass.
-        return checkpoint_name(
-            flash_attention(q, k, v, causal=True,
-                            sm_scale=config.attn_softmax_scale),
-            "ds_attn_out")
-    return checkpoint_name(
-        mha_reference(q, k, v, causal=True,
-                      sm_scale=config.attn_softmax_scale),
-        "ds_attn_out")
+        # reference on other backends or non-tiling/short shapes
+        return flash_attention(q, k, v, causal=True,
+                               sm_scale=config.attn_softmax_scale)
+    return mha_reference(q, k, v, causal=True,
+                         sm_scale=config.attn_softmax_scale)
 
 
 def qkv_proj(x, p, config: GPTConfig, positions=None):

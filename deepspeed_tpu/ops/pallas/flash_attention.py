@@ -375,9 +375,19 @@ def _pick_block(seq: int, want: int) -> Optional[int]:
 
 # -------------------------------------------------------------------- public
 
+# Below this query length XLA's fused dense attention beats the streaming
+# kernel on TPU (measured v5e: dense wins at S=128/512, kernel at S=1024);
+# applies only when the caller left block sizes on auto AND the dense
+# score tensor stays small enough that the quadratic-memory path cannot
+# become the OOM cause (per-layer transient cap below).
+FLASH_MIN_SEQ = 1024
+DENSE_SCORES_BYTE_CAP = 1 << 30
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     kv_lens=None):
     """Memory-linear attention. q,k,v: [B, S, H, D] → [B, S, H, D].
 
@@ -387,17 +397,24 @@ def flash_attention(q, k, v, causal: bool = True,
     Lengths are clamped to ≥ 1 (a zero-length row has no defined
     attention output; callers mask its loss anyway).
 
-    Falls back to the dense reference when the backend has no Pallas path or
-    the sequence doesn't tile (tiny/odd test shapes, Sq > Sk causal).
+    Falls back to the dense reference when the backend has no Pallas path,
+    the sequence doesn't tile (tiny/odd test shapes, Sq > Sk causal), or —
+    with auto block sizes — the sequence is short enough that dense wins
+    (< FLASH_MIN_SEQ).
     """
+    auto_blocks = block_q is None and block_k is None
+    block_q = 1024 if block_q is None else block_q
+    block_k = 1024 if block_k is None else block_k
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
     if kv_lens is not None:
         kv_lens = jnp.maximum(jnp.asarray(kv_lens, jnp.int32), 1)
+    short_seq_dense = (auto_blocks and Sq < FLASH_MIN_SEQ
+                       and B * H * Sq * Sk * 4 <= DENSE_SCORES_BYTE_CAP)
     if (not use_pallas() or bq is None or bk is None
-            or (causal and Sq > Sk)):
+            or (causal and Sq > Sk) or short_seq_dense):
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              kv_lens=kv_lens)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)

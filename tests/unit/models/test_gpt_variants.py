@@ -90,7 +90,7 @@ def test_loss_chunk_matches_full_loss_even_when_nondividing():
     cfg = gpt.GPTConfig(vocab_size=64, max_seq_len=32, n_layer=2, n_head=2,
                         d_model=16, dtype=jnp.float32, vocab_round_to=64)
     params = gpt.init(cfg, jax.random.PRNGKey(0))
-    # seq len 20 is NOT divisible by chunk 8 → divisor fallback (4), not
+    # seq len 20 is NOT divisible by chunk 8 → divisor fallback (5), not
     # a silent full-logits path; loss must match exactly either way
     batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 21),
                                           0, 64)}
