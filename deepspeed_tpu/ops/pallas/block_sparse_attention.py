@@ -121,11 +121,13 @@ def _fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(j < cnt_ref[h, qi])
     def _update():
         kb = idx_ref[h, qi, j]
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        ks = k_ref[0].astype(jnp.float32)
-        vs = v_ref[0].astype(jnp.float32)
+        # input-dtype MXU operands, f32 accumulate (fp32-cast inputs would
+        # run the systolic array at a fraction of its bf16 rate)
+        q = q_ref[0]
+        ks = k_ref[0]
+        vs = v_ref[0]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _pos_mask(s, qi, kb, block, block)
         m_prev, l_prev = m_ref[...], l_ref[...]
@@ -136,7 +138,7 @@ def _fwd_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p, vs, preferred_element_type=jnp.float32)
+            p.astype(vs.dtype), vs, preferred_element_type=jnp.float32)
 
     @pl.when(j == na - 1)
     def _finalize():
@@ -180,6 +182,8 @@ def _run_fwd(q3, k3, v3, idx, cnt, causal, sm_scale, block, H):
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(idx, cnt, q3, k3, v3)
     return o, lse
 
@@ -201,10 +205,10 @@ def _bwd_dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(j < cnt_ref[h, qi])
     def _update():
         kb = idx_ref[h, qi, j]
-        q = q_ref[0].astype(jnp.float32)
-        ks = k_ref[0].astype(jnp.float32)
-        vs = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        ks = k_ref[0]
+        vs = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
@@ -214,7 +218,7 @@ def _bwd_dq_kernel(idx_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(ks.dtype)
         dq_acc[...] += jnp.dot(ds, ks, preferred_element_type=jnp.float32)
 
     @pl.when(j == na - 1)
@@ -239,10 +243,10 @@ def _bwd_dkv_kernel(idxT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(j < cntT_ref[h, ki])
     def _update():
         qb = idxT_ref[h, ki, j]
-        q = q_ref[0].astype(jnp.float32)
-        ks = k_ref[0].astype(jnp.float32)
-        vs = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        ks = k_ref[0]
+        vs = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
@@ -251,10 +255,11 @@ def _bwd_dkv_kernel(idxT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             s = _pos_mask(s, qb, ki, block, block)
         p = jnp.exp(s - lse)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -299,6 +304,8 @@ def _run_bwd(q3, k3, v3, o3, lse, do3, idx, cnt, idxT, cntT, causal,
         ),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(idx, cnt, q3, k3, v3, do3, lse, delta)
 
     def k_col_map(bh, ki, j, i_, c_):
@@ -338,6 +345,8 @@ def _run_bwd(q3, k3, v3, o3, lse, do3, idx, cnt, idxT, cntT, causal,
             jax.ShapeDtypeStruct((BH, S, D), v3.dtype),
         ],
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(idxT, cntT, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 

@@ -115,11 +115,14 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _update():
-        q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
-        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
-        vs = v_ref[0].astype(jnp.float32)
+        # MXU operands stay in the input dtype (bf16 in production) with
+        # f32 accumulation — an fp32 cast before the dot would run the
+        # systolic array at a fraction of its bf16 rate
+        q = q_ref[0]                                       # (BQ, D)
+        ks = k_ref[0]                                      # (BK, D)
+        vs = v_ref[0]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         if use_lens:
@@ -132,7 +135,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p, vs, preferred_element_type=jnp.float32)
+            p.astype(vs.dtype), vs, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -172,6 +175,8 @@ def _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(lens_arr, q3, k3, v3)
     return o, lse
 
@@ -197,10 +202,11 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _update():
-        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
-        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
-        vs = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
+        q = q_ref[0]                                       # (BQ, D)
+        ks = k_ref[0]                                      # (BK, D)
+        vs = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]                    # (BQ, 1)
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
@@ -212,7 +218,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(ks.dtype)
         dq_acc[...] += jnp.dot(ds, ks, preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -241,10 +247,11 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _update():
-        q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
-        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
-        vs = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
+        q = q_ref[0]                                       # (BQ, D)
+        ks = k_ref[0]                                      # (BK, D)
+        vs = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, :][:, None]
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
@@ -255,10 +262,11 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _lens_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -298,6 +306,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(lens_arr, q3, k3, v3, do3, lse, delta)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
@@ -329,6 +339,8 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret_mode(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(lens_arr, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 

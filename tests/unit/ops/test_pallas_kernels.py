@@ -224,3 +224,51 @@ def test_pick_block_respects_lane_rule():
     assert _pick_block(64, 256) == 64      # whole-sequence block
     assert _pick_block(1000, 256) == 1000  # 8-aligned odd seq, single block
     assert _pick_block(37, 256) is None
+
+
+def test_flash_attention_bf16_operands_match_reference(pallas_interpret):
+    """bf16 inputs exercise the input-dtype MXU path (p/ds downcasts are
+    no-ops under f32); fwd and grads must track the f32 dense reference
+    within bf16 tolerance."""
+    from deepspeed_tpu.ops.pallas import flash_attention, mha_reference
+    B, S, H, D = 2, 256, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in ks)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = mha_reference(*(x.astype(jnp.float32) for x in (q, k, v)),
+                        causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+    def loss_k(fn):
+        return lambda a, b, c: jnp.sum(
+            fn(a, b, c, causal=True).astype(jnp.float32) ** 2)
+
+    gk = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True, block_q=128,
+                        block_k=128).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        mha_reference(a, b, c, causal=True) ** 2), argnums=(0, 1, 2))(
+        *(x.astype(jnp.float32) for x in (q, k, v)))
+    for got, ref_g, name in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref_g), atol=6e-2, rtol=6e-2,
+                                   err_msg=f"d{name}")
+
+
+def test_block_sparse_bf16_operands_match_reference(pallas_interpret):
+    from deepspeed_tpu.ops.pallas import (block_sparse_attention,
+                                          sparse_mha_reference)
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    B, S, H, D, blk = 1, 256, 2, 32, 64
+    cfg = FixedSparsityConfig(num_heads=H, block=blk,
+                              num_local_blocks=2, num_global_blocks=1)
+    layout = cfg.make_layout(S)
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) for kk in ks)
+    out = block_sparse_attention(q, k, v, layout, block=blk, causal=True)
+    ref = sparse_mha_reference(*(x.astype(jnp.float32) for x in (q, k, v)),
+                               layout, block=blk, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=2e-2, rtol=2e-2)
