@@ -54,6 +54,9 @@ class GPTConfig:
     # cannot be name-saved, so its backward still replays the fwd kernel
     # under every policy.
     remat_policy: str = "nothing"       # nothing | dots | attn_out
+    # lax.scan unroll factor for the layer stack (XLA can overlap/fuse
+    # across unrolled iterations at the cost of program size)
+    scan_unroll: int = 1
     # sequence-chunked cross-entropy: compute the [B, chunk, V] logits one
     # chunk at a time (rematerialized in backward) instead of holding the
     # full [B, S, V] fp32 logits — the head is ~1/4 of a small model's
@@ -603,7 +606,8 @@ def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
         return out, None
 
     x, _ = lax.scan(scan_body, x,
-                    (params["blocks"], jnp.arange(config.n_layer)))
+                    (params["blocks"], jnp.arange(config.n_layer)),
+                    unroll=config.scan_unroll)
     return x
 
 

@@ -240,10 +240,6 @@ def test_flash_attention_bf16_operands_match_reference(pallas_interpret):
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref), atol=2e-2, rtol=2e-2)
 
-    def loss_k(fn):
-        return lambda a, b, c: jnp.sum(
-            fn(a, b, c, causal=True).astype(jnp.float32) ** 2)
-
     gk = jax.grad(lambda a, b, c: jnp.sum(
         flash_attention(a, b, c, causal=True, block_q=128,
                         block_k=128).astype(jnp.float32) ** 2),
@@ -272,3 +268,15 @@ def test_block_sparse_bf16_operands_match_reference(pallas_interpret):
                                layout, block=blk, causal=True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref), atol=2e-2, rtol=2e-2)
+    # gradients too: both bwd kernels downcast p/ds for the MXU
+    gk = jax.grad(lambda a, b, c: jnp.sum(
+        block_sparse_attention(a, b, c, layout, block=blk,
+                               causal=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        sparse_mha_reference(a, b, c, layout, block=blk, causal=True) ** 2),
+        argnums=(0, 1, 2))(*(x.astype(jnp.float32) for x in (q, k, v)))
+    for got, ref_g, name in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref_g), atol=6e-2, rtol=6e-2,
+                                   err_msg=f"d{name}")
