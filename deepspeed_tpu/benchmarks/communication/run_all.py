@@ -21,7 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ...utils.comms_logging import get_bw
@@ -78,7 +78,7 @@ def _build(op: str, mesh: Mesh) -> Callable:
         raise ValueError(f"unknown op {op}")
 
     f = shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                  check_rep=False)
+                  check_vma=False)
     return jax.jit(f)
 
 
