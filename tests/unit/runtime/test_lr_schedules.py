@@ -1,0 +1,132 @@
+"""All four LR schedules (reference runtime/lr_schedules.py:308,415,704,800)
+against their closed-form behavior, plus engine integration for each type."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle,
+                                                WarmupDecayLR, WarmupLR,
+                                                get_lr_schedule_class)
+from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
+
+
+class _Opt:
+    """Minimal optimizer façade the schedules drive."""
+
+    def __init__(self, lr=0.01):
+        self.param_groups = [{"lr": lr}]
+
+    def current_hyperparams(self):
+        return {"lr": self.param_groups[0]["lr"]}
+
+
+def _run(sched, n):
+    lrs = []
+    for _ in range(n):
+        sched.step()
+        lrs.append(sched.get_lr()[0])
+    return lrs
+
+
+def test_lr_range_test_linear_and_staircase():
+    lin = LRRangeTest(_Opt(), lr_range_test_min_lr=1e-3,
+                      lr_range_test_step_size=5, lr_range_test_step_rate=1.0)
+    lrs = _run(lin, 20)
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))      # monotone ramp
+    np.testing.assert_allclose(lrs[4], 1e-3 * 2.0, rtol=1e-6)  # +1 per 5 steps
+
+    stair = LRRangeTest(_Opt(), lr_range_test_min_lr=1e-3,
+                        lr_range_test_step_size=5, lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    slrs = _run(stair, 10)
+    assert len(set(np.round(slrs[:4], 10))) == 1          # flat within a stair
+    assert slrs[5] > slrs[3]
+
+
+def test_one_cycle_triangle_and_decay():
+    sched = OneCycle(_Opt(), cycle_min_lr=0.001, cycle_max_lr=0.01,
+                     cycle_first_step_size=10, cycle_second_step_size=10,
+                     decay_lr_rate=0.1, cycle_momentum=False)
+    lrs = _run(sched, 35)
+    peak = max(lrs)
+    np.testing.assert_allclose(peak, 0.01, rtol=1e-6)
+    assert lrs.index(peak) == 9                           # end of first leg
+    assert all(b <= a + 1e-12 for a, b in zip(lrs[9:19], lrs[10:20]))
+    # past the cycle: decay below min
+    assert lrs[-1] < 0.001
+
+
+def test_one_cycle_momentum_counterphase():
+    sched = OneCycle(_Opt(), cycle_min_lr=0.001, cycle_max_lr=0.01,
+                     cycle_first_step_size=10, cycle_momentum=True,
+                     cycle_min_mom=0.8, cycle_max_mom=0.9)
+    sched.step()
+    m0 = sched.get_mom()[0]
+    for _ in range(8):
+        sched.step()
+    m_late = sched.get_mom()[0]
+    assert m0 > m_late                                    # mom falls as lr rises
+
+
+def test_warmup_lr_log_and_linear():
+    log = WarmupLR(_Opt(), warmup_min_lr=0.0, warmup_max_lr=0.01,
+                   warmup_num_steps=16, warmup_type="log")
+    llrs = _run(log, 20)
+    lin = WarmupLR(_Opt(), warmup_min_lr=0.0, warmup_max_lr=0.01,
+                   warmup_num_steps=16, warmup_type="linear")
+    plrs = _run(lin, 20)
+    for lrs in (llrs, plrs):
+        assert all(b >= a - 1e-12 for a, b in zip(lrs, lrs[1:]))
+        np.testing.assert_allclose(lrs[-1], 0.01, rtol=1e-6)  # saturates
+    np.testing.assert_allclose(plrs[7], 0.01 * 8 / 16, rtol=1e-6)
+    np.testing.assert_allclose(llrs[7], 0.01 * math.log(9) / math.log(16),
+                               rtol=1e-6)
+
+
+def test_warmup_decay_reaches_zero_at_total():
+    sched = WarmupDecayLR(_Opt(), total_num_steps=40, warmup_min_lr=0.0,
+                          warmup_max_lr=0.01, warmup_num_steps=10,
+                          warmup_type="linear")
+    lrs = _run(sched, 45)
+    peak_i = int(np.argmax(lrs))
+    assert peak_i == 9
+    assert all(b <= a + 1e-12 for a, b in zip(lrs[9:], lrs[10:]))
+    np.testing.assert_allclose(lrs[39], 0.0, atol=1e-12)
+    assert lrs[-1] == 0.0                                 # clamped after total
+
+
+def test_get_lr_schedule_class_rejects_unknown():
+    assert get_lr_schedule_class("WarmupLR") is WarmupLR
+    with pytest.raises(ValueError):
+        get_lr_schedule_class("Nope")
+
+
+@pytest.mark.parametrize("scheduler", [
+    {"type": "LRRangeTest", "params": {"lr_range_test_min_lr": 1e-4,
+                                       "lr_range_test_step_size": 2}},
+    {"type": "OneCycle", "params": {"cycle_min_lr": 1e-4,
+                                    "cycle_max_lr": 1e-3,
+                                    "cycle_first_step_size": 3}},
+    {"type": "WarmupDecayLR", "params": {"total_num_steps": 8,
+                                         "warmup_max_lr": 1e-3,
+                                         "warmup_num_steps": 2}},
+])
+def test_engine_drives_every_schedule_type(scheduler):
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config={**base_config(micro_batch=2),
+                                    "scheduler": scheduler},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    seen = []
+    for i in range(4):
+        b = random_tokens(16, 16, seed=i)
+        engine.backward(engine.forward(b))
+        engine.step()
+        seen.append(engine.get_lr()[0])
+    assert len(set(np.round(seen, 12))) > 1, f"lr never moved: {seen}"
+    assert all(np.isfinite(seen))
