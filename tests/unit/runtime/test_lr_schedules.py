@@ -16,13 +16,11 @@ from tests.unit.common import base_config, make_mesh, random_tokens, tiny_model
 
 
 class _Opt:
-    """Minimal optimizer façade the schedules drive."""
+    """Minimal optimizer façade the schedules drive (param_groups is the
+    whole interface the schedules touch)."""
 
     def __init__(self, lr=0.01):
         self.param_groups = [{"lr": lr}]
-
-    def current_hyperparams(self):
-        return {"lr": self.param_groups[0]["lr"]}
 
 
 def _run(sched, n):
@@ -115,6 +113,8 @@ def test_get_lr_schedule_class_rejects_unknown():
     {"type": "WarmupDecayLR", "params": {"total_num_steps": 8,
                                          "warmup_max_lr": 1e-3,
                                          "warmup_num_steps": 2}},
+    {"type": "WarmupLR", "params": {"warmup_max_lr": 1e-3,
+                                    "warmup_num_steps": 3}},
 ])
 def test_engine_drives_every_schedule_type(scheduler):
     mm = make_mesh(dp=8)
