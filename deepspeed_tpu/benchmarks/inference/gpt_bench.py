@@ -1,0 +1,126 @@
+"""Inference latency benchmark: prefill + per-token decode percentiles.
+
+Counterpart of the reference's ``benchmarks/inference/gpt-bench.py``
+(:35-50 — per-token latency with p50/p90/p99 reporting).  Two measurement
+modes mirror the two serving shapes:
+
+- **per-token** (the reference's loop): one jitted ``decode_step`` per
+  emitted token, fenced with ``device_get`` so each sample is a real
+  host-visible token latency — the percentile distribution includes
+  dispatch jitter, exactly what an autoregressive server sees.
+- **fused loop**: ``engine.generate`` compiles the whole decode loop into
+  one XLA program (the role CUDA-graph capture plays in the reference);
+  reported as amortized tokens/sec for the offline-batch shape.
+
+Usage:
+    python -m deepspeed_tpu.benchmarks.inference.gpt_bench \
+        --model gpt2-125m --batch 4 --prompt 128 --new-tokens 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
+              new_tokens: int = 64, dtype: str = "bfloat16",
+              warmup: int = 3) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt, gpt_inference
+
+    import dataclasses
+    config = dataclasses.replace(
+        gpt.PRESETS[model],
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    params = gpt.init(config, jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(model=(config, params),
+                                          config={"dtype": dtype})
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size,
+                                      size=(batch, prompt)), jnp.int32)
+
+    def fence(x):
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0]))
+
+    # ---- prefill latency
+    # warmup decode steps also occupy cache slots — size for them or the
+    # tail of the measured distribution decodes against a clobbered cache
+    cache = gpt_inference.init_cache(config, batch,
+                                     prompt + new_tokens + warmup)
+    prefill = jax.jit(lambda p, t, c: gpt_inference.prefill(p, t, config, c))
+    logits, cache0 = prefill(params, tokens, cache)
+    fence(logits)                                      # compile
+    t0 = time.perf_counter()
+    logits, cache0 = prefill(params, tokens, cache)
+    fence(logits)
+    prefill_ms = (time.perf_counter() - t0) * 1000
+
+    # ---- per-token decode latencies (the reference's measurement)
+    decode = jax.jit(lambda p, tok, c: gpt_inference.decode_step(
+        p, tok, config, c))
+    # slice off the padded-vocab tail before argmax (engine.generate's
+    # pick does the same) so OOV ids never re-enter decode
+    tok = jnp.argmax(logits[:, -1, :config.vocab_size],
+                     axis=-1).astype(jnp.int32)
+    lat = []
+    c = cache0
+    for i in range(warmup + new_tokens):
+        t0 = time.perf_counter()
+        logits_i, c = decode(params, tok, c)
+        fence(logits_i)
+        if i >= warmup:
+            lat.append((time.perf_counter() - t0) * 1000)
+        tok = jnp.argmax(logits_i[:, :config.vocab_size],
+                         axis=-1).astype(jnp.int32)
+    lat = np.asarray(lat)
+
+    # ---- fused whole-loop generate (amortized)
+    out = engine.generate(tokens, max_new_tokens=new_tokens)   # compile
+    fence(out)
+    t0 = time.perf_counter()
+    out = engine.generate(tokens, max_new_tokens=new_tokens)
+    fence(out)
+    fused_s = time.perf_counter() - t0
+
+    return {
+        "model": model, "batch": batch, "prompt": prompt,
+        "new_tokens": new_tokens, "dtype": dtype,
+        "prefill_ms": round(prefill_ms, 2),
+        "token_latency_ms": {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p90": round(float(np.percentile(lat, 90)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+            "mean": round(float(lat.mean()), 3),
+        },
+        "per_token_tokens_per_sec": round(batch * 1000.0 / lat.mean(), 1),
+        "fused_loop_tokens_per_sec": round(batch * new_tokens / fused_s, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="gpt2-125m",
+                    help="preset name (see models.gpt.PRESETS)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+    result = run_bench(model=args.model, batch=args.batch,
+                       prompt=args.prompt, new_tokens=args.new_tokens,
+                       dtype=args.dtype, warmup=args.warmup)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Inference latency harness (reference benchmarks/inference/gpt-bench.py
+p50/p90/p99 methodology): runs end-to-end on a tiny preset and returns a
+complete, internally consistent report."""
+
+import deepspeed_tpu.models.gpt as gpt
+from deepspeed_tpu.benchmarks.inference.gpt_bench import run_bench
+
+
+def test_gpt_bench_report_shape(monkeypatch):
+    tiny = gpt.GPTConfig(vocab_size=128, max_seq_len=64, n_layer=2, n_head=2,
+                         d_model=32, vocab_round_to=128)
+    monkeypatch.setitem(gpt.PRESETS, "tiny-test", tiny)
+    r = run_bench(model="tiny-test", batch=2, prompt=8, new_tokens=4,
+                  dtype="float32", warmup=1)
+    assert r["prefill_ms"] > 0
+    pct = r["token_latency_ms"]
+    assert pct["p50"] <= pct["p90"] <= pct["p99"]
+    assert r["per_token_tokens_per_sec"] > 0
+    assert r["fused_loop_tokens_per_sec"] > 0
