@@ -43,6 +43,12 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     params = gpt.init(config, jax.random.PRNGKey(0))
     engine = deepspeed_tpu.init_inference(model=(config, params),
                                           config={"dtype": dtype})
+    # the manual prefill/decode path must use the SAME dtype-cast weights
+    # the engine serves with, or the two modes measure different memory
+    # traffic under one dtype label
+    params = engine.params
+    config = engine.model_config
+    warmup = max(1, warmup)   # first decode call is the XLA compile
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, config.vocab_size,
                                       size=(batch, prompt)), jnp.int32)

@@ -1,2 +1,4 @@
 from .config import DeepSpeedZeroConfig  # noqa: F401
 from .partitioner import ZeroPartitioner, ZeroShardings  # noqa: F401
+from .init_context import (GatheredParameters, Init,  # noqa: F401
+                           materialize_sharded)

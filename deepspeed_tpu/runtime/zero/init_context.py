@@ -1,0 +1,145 @@
+"""Public ``zero.Init`` / ``zero.GatheredParameters`` surfaces.
+
+Reference counterparts: ``zero.Init`` (partition_parameters.py:537 —
+partition at construction by monkey-patching ``nn.Module.__init__``) and
+``GatheredParameters`` (:1512 — temporarily assemble partitioned params
+for host-side access, re-partition on exit, propagating rank-0 edits).
+
+TPU translation:
+
+- Partition-at-construction needs no patching: ``materialize_sharded``
+  jits an init function with output shardings, so every leaf is born
+  sharded on the mesh (the engine's ``_init_state`` does exactly this for
+  its own state; ``Init`` exposes the same mechanism for ad-hoc trees).
+- Gather/modify/re-partition: a ZeRO-3 tree's leaves are global
+  ``jax.Array``s, so "gather" is ``device_get`` (XLA assembles the
+  shards) and re-partition is a ``device_put`` back onto each leaf's
+  original sharding.  ``GatheredParameters`` wraps that round-trip; when
+  given a live engine it writes edits through to BOTH the compute params
+  and the fp32 master (else the next optimizer step would revert them).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def materialize_sharded(init_fn: Callable[[jax.Array], PyTree],
+                        rng: jax.Array, shardings: PyTree) -> PyTree:
+    """Run ``init_fn(rng)`` inside jit with ``out_shardings`` — no leaf
+    ever exists unsharded (the zero.Init capability as a function)."""
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+class Init:
+    """Reference-shaped construction context (``deepspeed.zero.Init``).
+
+    The engine always materializes its state sharded, so entering the
+    context changes nothing for ``deepspeed_tpu.initialize`` — it exists
+    for call-site compatibility and for ad-hoc sharded construction via
+    :meth:`materialize`.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear: bool = True, remote_device=None,
+                 pin_memory: bool = False, config_dict_or_path=None,
+                 config=None, enabled: bool = True, dtype=None,
+                 mpu=None, mesh_manager=None):
+        self.enabled = enabled
+        self.mesh_manager = mesh_manager
+
+    def __enter__(self) -> "Init":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def materialize(self, init_fn: Callable[[jax.Array], PyTree],
+                    rng: jax.Array, shardings: PyTree) -> PyTree:
+        if not self.enabled:
+            return init_fn(rng)
+        return materialize_sharded(init_fn, rng, shardings)
+
+
+class GatheredParameters:
+    """Assemble full parameters on the host; re-shard on exit.
+
+    ``target`` may be:
+      - a **DeepSpeedEngine**: yields the full param tree as mutable
+        numpy arrays; on exit (unless ``modifier_rank is None``) edits
+        upload back with the engine's shardings, into both the compute
+        params and the fp32 master.
+      - a **param pytree**: read-only host view (edits are discarded, as
+        with the reference's default ``modifier_rank=None``).
+
+    Example (weight surgery on a live ZeRO-3 engine)::
+
+        with GatheredParameters(engine) as host:
+            host["wte"][0, :] = 0.0
+    """
+
+    def __init__(self, target, modifier_rank: Optional[int] = 0,
+                 fwd_module=None, enabled: bool = True):
+        self.enabled = enabled
+        self.modifier_rank = modifier_rank
+        self._engine = target if hasattr(target, "state") and \
+            hasattr(target, "_out_shardings") else None
+        self._tree = target if self._engine is None else None
+        self._host: Optional[PyTree] = None
+
+    def __enter__(self) -> PyTree:
+        if self._engine is not None and \
+                getattr(self._engine, "_offload_device", None) is not None:
+            raise NotImplementedError(
+                "GatheredParameters write-back on an offload-optimizer "
+                "engine is not supported: the authoritative fp32 master "
+                "lives host-side in the offload optimizer and a device "
+                "write would be reverted at the next step; edit through "
+                "engine._offload_opt or save/load a checkpoint instead")
+        if self._engine is None and self.modifier_rank is not None:
+            from ...utils.logging import logger
+            logger.warning(
+                "GatheredParameters over a plain pytree is a read-only "
+                "view (arrays are immutable; edits are discarded) — pass "
+                "the engine for write-back, or modifier_rank=None to "
+                "silence this")
+        tree = (self._engine.state["master"] if self._engine is not None
+                else self._tree)
+        if not self.enabled:
+            self._host = tree
+            return tree
+        # device_get assembles every leaf's shards into one host array;
+        # copy so in-place edits are safe and visible at __exit__
+        self._host = jax.tree_util.tree_map(
+            lambda l: np.array(jax.device_get(l)), tree)
+        return self._host
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if (exc_type is None and self.enabled
+                and self._engine is not None
+                and self.modifier_rank is not None):
+            eng = self._engine
+            sh = eng._out_shardings
+            master = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda h, old: jnp.asarray(h, old.dtype),
+                    self._host, eng.state["master"]),
+                sh.get("master", sh["params"]))
+            if eng.state["params"] is eng.state["master"]:
+                params = master
+            else:
+                params = jax.device_put(
+                    jax.tree_util.tree_map(
+                        lambda h, old: jnp.asarray(h, old.dtype),
+                        self._host, eng.state["params"]),
+                    sh["params"])
+            eng.state["master"] = master
+            eng.state["params"] = params
+        return False
