@@ -460,22 +460,34 @@ class DeepSpeedEngine:
 
         if self._offload_device is not None:
             # device side of the offloaded step: unscale, overflow check,
-            # clip — gradients then cross to the host for the Adam step
+            # clip — gradients then cross to the host for the Adam step.
+            # Mixed-precision runs hand the host 16-bit grads (the
+            # reference's cpu_offload moves fp16 partitions the same way):
+            # half the HBM for the out tree and half the d2h traffic; the
+            # host optimizer upcasts to fp32 before stepping.  grad_acc is
+            # donated — its buffers back the zeroed accumulator.
+            transfer_dtype = self.compute_dtype
+
             def grad_prep(grad_acc, scale_state):
                 scale = scale_state["loss_scale"]
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grad_acc)
-                overflow = (has_overflow(grads) if scaler_config.enabled
-                            else jnp.zeros((), bool))
                 if clip > 0:
                     grads, norm = clip_grads_by_global_norm(grads, clip)
                 else:
                     norm = global_grad_norm(grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(transfer_dtype), grads)
+                # overflow check AFTER the downcast: an fp16 transfer can
+                # introduce infs the fp32 tree didn't have — those must
+                # trigger the skip/scale-backoff too
+                overflow = (has_overflow(grads) if scaler_config.enabled
+                            else jnp.zeros((), bool))
                 new_scale = ls.update_state(scale_state, overflow, scaler_config)
                 zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
                 return grads, zero_acc, new_scale, norm, overflow
 
             self._micro_jit = jax.jit(micro, donate_argnums=(1,))
-            self._grad_prep_jit = jax.jit(grad_prep)
+            self._grad_prep_jit = jax.jit(grad_prep, donate_argnums=(0,))
             return
 
         def apply_core(params, master, opt_state, grad_acc, scale_state, hyper):
