@@ -459,32 +459,35 @@ class DeepSpeedEngine:
             return new_acc, loss
 
         if self._offload_device is not None:
-            # device side of the offloaded step: unscale, overflow check,
-            # clip — gradients then cross to the host for the Adam step.
-            # Mixed-precision runs hand the host 16-bit grads (the
-            # reference's cpu_offload moves fp16 partitions the same way):
-            # half the HBM for the out tree and half the d2h traffic; the
-            # host optimizer upcasts to fp32 before stepping.  grad_acc is
+            # device side of the offloaded step: clip/norm in fp32, then
+            # hand the host 16-bit grads that are still LOSS-SCALED — the
+            # scale keeps small components inside fp16's dynamic range (the
+            # reference's cpu_offload moves scaled fp16 partitions the same
+            # way) and the host unscales in fp32 before Adam.  Half the
+            # HBM for the out tree and half the d2h traffic; grad_acc is
             # donated — its buffers back the zeroed accumulator.
-            transfer_dtype = self.compute_dtype
-
             def grad_prep(grad_acc, scale_state):
                 scale = scale_state["loss_scale"]
-                grads = jax.tree_util.tree_map(lambda g: g / scale, grad_acc)
+                # norm of the UNSCALED grads without materializing an
+                # unscaled tree: ||g/scale|| = ||g|| / scale; clipping is a
+                # scalar coefficient so it folds into one multiply
+                norm = global_grad_norm(grad_acc) / scale
                 if clip > 0:
-                    grads, norm = clip_grads_by_global_norm(grads, clip)
+                    coef = jnp.minimum(1.0, clip / (norm + 1e-6))
+                    scaled = jax.tree_util.tree_map(
+                        lambda g: g * coef, grad_acc)
                 else:
-                    norm = global_grad_norm(grads)
-                grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(transfer_dtype), grads)
-                # overflow check AFTER the downcast: an fp16 transfer can
-                # introduce infs the fp32 tree didn't have — those must
-                # trigger the skip/scale-backoff too
-                overflow = (has_overflow(grads) if scaler_config.enabled
+                    scaled = grad_acc
+                transfer = jax.tree_util.tree_map(
+                    lambda g: g.astype(compute_dtype), scaled)
+                # overflow check on the tree that actually crosses: a
+                # scaled grad beyond fp16 max infs here and must trigger
+                # the skip/scale-backoff (nans propagate through too)
+                overflow = (has_overflow(transfer) if scaler_config.enabled
                             else jnp.zeros((), bool))
                 new_scale = ls.update_state(scale_state, overflow, scaler_config)
                 zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
-                return grads, zero_acc, new_scale, norm, overflow
+                return transfer, zero_acc, new_scale, norm, overflow
 
             self._micro_jit = jax.jit(micro, donate_argnums=(1,))
             self._grad_prep_jit = jax.jit(grad_prep, donate_argnums=(0,))
@@ -692,11 +695,15 @@ class DeepSpeedEngine:
         bf16 params upload back (fused precast in the C++ kernel).
         Returns whether the step overflowed (and was skipped)."""
         s = self.state
+        # the transferred grads are still loss-scaled (fp16 range safety);
+        # read the OLD scale before the state advances, unscale in fp32
+        old_scale = float(jax.device_get(s["scale"]["loss_scale"]))
         grads, zero_acc, new_scale, norm, overflow = self._grad_prep_jit(
             s["grad_acc"], s["scale"])
         overflow_host = bool(overflow)
         if not overflow_host:
-            host_grads = [np.asarray(jax.device_get(g), np.float32)
+            host_grads = [np.divide(jax.device_get(g), old_scale,
+                                    dtype=np.float32)
                           for g in jax.tree_util.tree_leaves(grads)]
             hyper = self.optimizer.current_hyperparams()
             outs = self._offload_opt.step(

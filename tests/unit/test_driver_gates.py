@@ -1,0 +1,59 @@
+"""The driver's round gates, as tests (round 1 failed on exactly these
+being unexercised): bench.py must emit one valid JSON line on a
+CPU-only host, and __graft_entry__ must expose a compilable entry() and a
+dryrun that executes real shardings.
+
+Both run in subprocesses: the gates themselves bootstrap jax platforms,
+which must happen in a fresh interpreter (the latched-backend hazard the
+platform helper documents).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run(code, timeout=540, env_extra=None):
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO})
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_bench_emits_one_json_line_on_cpu():
+    r = _run("import runpy, sys; sys.argv=['bench.py']; "
+             "runpy.run_path('bench.py', run_name='__main__')")
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout[-2000:]
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, out
+    assert out["value"] > 0
+    assert out["detail"]["platform"] == "cpu"
+
+
+def test_entry_is_jittable():
+    r = _run(
+        "from deepspeed_tpu.utils.platform import force_cpu_platform\n"
+        "force_cpu_platform(1)\n"
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "print('ENTRY_OK', out.shape)\n")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ENTRY_OK" in r.stdout
+
+
+def test_dryrun_multichip_all_phases():
+    r = _run("import __graft_entry__ as g; g.dryrun_multichip(8)")
+    assert r.returncode == 0, r.stderr[-2000:]
+    for phase in ("dryrun_multichip(8) OK", "moe(ep=2", "sp(ring",
+                  "pipeline(pp=4"):
+        assert phase in r.stdout, (phase, r.stdout[-2000:])
