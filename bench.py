@@ -236,6 +236,10 @@ def main() -> None:
         vs = round((samples_per_sec / n_chips) / baseline, 4) if on_tpu else 0.0
     else:
         vs = round(mfu / 0.45, 4) if mfu else 0.0
+    if not on_tpu:
+        # a wedged tunnel must not masquerade as a valid number: brand the
+        # top-level metric, not just detail.platform
+        metric += "_CPU_FALLBACK"
     result = {
         "metric": metric,
         "value": round(samples_per_sec / n_chips, 3),

@@ -89,6 +89,37 @@ class TpuOptimizer:
             self.param_groups = sd["param_groups"]
 
 
+def resolve_param_groups(param_groups: List[Dict[str, Any]],
+                         leaf_paths: List[str]) -> List[int]:
+    """Map each parameter leaf to a param-group index by tree path.
+
+    The functional analogue of torch param groups (reference users split
+    decay/no-decay groups by passing tensors; a pytree world can't hold
+    tensors in host dicts): a group may carry ``"params"`` — a list of
+    regex patterns matched (``re.search``) against the leaf's tree path
+    (``jax.tree_util.keystr``).  The first pattern-bearing group that
+    matches claims the leaf; unmatched leaves fall to the first group
+    without patterns (the default group), else group 0.
+    """
+    import re
+
+    default = 0
+    for gi, g in enumerate(param_groups):
+        if not g.get("params"):
+            default = gi
+            break
+    out = []
+    for path in leaf_paths:
+        idx = default
+        for gi, g in enumerate(param_groups):
+            pats = g.get("params")
+            if pats and any(re.search(p, path) for p in pats):
+                idx = gi
+                break
+        out.append(idx)
+    return out
+
+
 def bias_correction(step: jnp.ndarray, beta: float) -> jnp.ndarray:
     return 1.0 - jnp.power(beta, step)
 

@@ -36,7 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm import comm as dist
 from ..models.partitioning import FSDP_RULES, TP_RULES, tree_specs, validate_specs
-from ..ops.optimizer import TpuOptimizer, get_optimizer_class
+from ..ops.optimizer import (TpuOptimizer, get_optimizer_class,
+                             resolve_param_groups)
 from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MeshManager, ParallelDims,
                              get_mesh_manager, initialize_mesh)
 from ..utils.logging import log_dist, logger
@@ -134,6 +135,8 @@ class DeepSpeedEngine:
         if self._config.curriculum_enabled:
             from .data_pipeline.curriculum_scheduler import CurriculumScheduler
             self._curriculum = CurriculumScheduler(self._config.curriculum_params)
+            self._curriculum_buckets = self._seqlen_buckets(
+                self._config.curriculum_params)
 
         # checkpoint backend (reference _configure_checkpointing, torch vs
         # nebula): async_save runs writers in the background, committing
@@ -360,24 +363,43 @@ class DeepSpeedEngine:
         out_sh = (sh.params, sh.master, sh.grads)
         params, master_dev, grad_acc = jax.jit(
             init_all, out_shardings=out_sh)(rng)
-        # precision-exact fp32 master moves to the host; the device copy is
-        # dropped immediately (transient 4N bytes at init only)
-        master_leaves = [np.asarray(jax.device_get(l), np.float32)
-                         for l in jax.tree_util.tree_leaves(master_dev)]
-        del master_dev
         self._params_treedef = jax.tree_util.tree_structure(params)
 
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "offload_optimizer currently requires a single controller "
-                "process (the host runner fetches global grads); multi-host "
-                "offload needs per-shard masters")
+        # per-leaf param-group assignment (torch decay/no-decay groups by
+        # leaf path; reference steps each group with its own hyperparams)
         opt = self.optimizer
-        if getattr(opt, "param_groups", None) and len(opt.param_groups) > 1:
-            logger.warning(
-                "offload_optimizer applies param_groups[0]'s hyperparams to "
-                "every parameter; per-group weight decay is not honoured "
-                "under offload")
+        groups = getattr(opt, "param_groups", None) or [{}]
+        leaf_paths = [jax.tree_util.keystr(p) for p, _ in
+                      jax.tree_util.tree_flatten_with_path(params)[0]]
+        self._leaf_group_idx = resolve_param_groups(groups, leaf_paths)
+
+        # precision-exact fp32 master moves to the host; the device copy is
+        # dropped immediately (transient 4N bytes at init only).  Multi-host:
+        # each process keeps only its unique addressable master shards (the
+        # reference's per-rank cpu_offload, stage_1_and_2.py:98) and steps
+        # them locally; params are rebuilt from the shards + one SPMD
+        # reshard (all-gather on device).
+        self._offload_multihost = jax.process_count() > 1
+        self._master_shardings_flat = jax.tree_util.tree_leaves(sh.master)
+        if self._offload_multihost:
+            from .zero.offload_engine import unique_local_blocks
+            self._offload_layout: List[List[Tuple[Any, Tuple[int, ...]]]] = []
+            master_leaves, group_of = [], []
+            for li, leaf in enumerate(jax.tree_util.tree_leaves(master_dev)):
+                blocks = unique_local_blocks(leaf)
+                self._offload_layout.append(
+                    [(idx, b.shape) for idx, b in blocks])
+                for _, b in blocks:
+                    master_leaves.append(np.asarray(b, np.float32))
+                    group_of.append(self._leaf_group_idx[li])
+            self._reshard_params_jit = jax.jit(
+                lambda t: t, out_shardings=sh.params)
+        else:
+            master_leaves = [np.asarray(jax.device_get(l), np.float32)
+                             for l in jax.tree_util.tree_leaves(master_dev)]
+            group_of = list(self._leaf_group_idx)
+        del master_dev
+
         self._offload_opt = HostOffloadOptimizer(
             master_leaves,
             device=self._offload_device,
@@ -390,7 +412,8 @@ class DeepSpeedEngine:
             weight_decay=float(opt.param_groups[0].get("weight_decay", 0.0))
             if getattr(opt, "param_groups", None) else 0.0,
             adamw_mode=getattr(opt, "adam_w_mode", True),
-            bias_correction=getattr(opt, "bias_correction", True))
+            bias_correction=getattr(opt, "bias_correction", True),
+            group_of=group_of)
 
         scale_state = jax.device_put(
             ls.init_state(self.scaler_config), NamedSharding(self.mesh, P()))
@@ -598,12 +621,45 @@ class DeepSpeedEngine:
             jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n)),
             NamedSharding(self.mesh, P(None)))}
 
+    @staticmethod
+    def _seqlen_buckets(params) -> List[int]:
+        """Fixed compile-shape buckets for curriculum seqlens.
+
+        Every distinct truncation length is a new XLA program (SURVEY §7:
+        dynamic shapes under jit), so the scheduled difficulty is rounded UP
+        to a bucket — compile count stays <= n_buckets across the whole
+        schedule.  An explicit ``"seqlen_buckets"`` list wins; the default
+        doubles from min to max difficulty."""
+        hi = int(params["max_difficulty"])
+        explicit = params.get("seqlen_buckets")
+        if explicit:
+            buckets = sorted(int(b) for b in explicit)
+            if buckets[-1] < hi:
+                # a capped list would silently clamp training below the
+                # scheduled max difficulty for the rest of the run
+                buckets.append(hi)
+            return buckets
+        lo = max(1, int(params["min_difficulty"]))
+        buckets, b = [], lo
+        while b < hi:
+            buckets.append(b)
+            b *= 2
+        buckets.append(hi)
+        return buckets
+
     def _apply_curriculum(self, batch):
-        """Curriculum seqlen truncation (reference engine.py:1704)."""
+        """Curriculum seqlen truncation (reference engine.py:1704), bucketed
+        so difficulty stepping reuses compiled programs."""
         if self._curriculum is None or not isinstance(batch, dict) \
                 or "tokens" not in batch:
             return batch
         seqlen = self._curriculum.update_difficulty(self.global_steps + 1)
+        for b in self._curriculum_buckets:
+            if b >= seqlen:
+                seqlen = b
+                break
+        else:
+            seqlen = self._curriculum_buckets[-1]
         toks = batch["tokens"]
         if seqlen + 1 < np.shape(toks)[-1]:
             batch = {**batch, "tokens": toks[..., :seqlen + 1]}
@@ -680,14 +736,32 @@ class DeepSpeedEngine:
     def _reseed_offload_master(self) -> None:
         """Rebuild the host fp32 master from the current device params
         (used when a checkpoint has no host optimizer state)."""
-        leaves = [np.asarray(jax.device_get(l), np.float32)
-                  for l in jax.tree_util.tree_leaves(self.state["params"])]
+        if self._offload_multihost:
+            from .zero.offload_engine import local_block
+            leaves = []
+            for li, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self.state["params"])):
+                for idx, _ in self._offload_layout[li]:
+                    leaves.append(np.asarray(local_block(leaf, idx),
+                                             np.float32))
+        else:
+            leaves = [np.asarray(jax.device_get(l), np.float32)
+                      for l in jax.tree_util.tree_leaves(self.state["params"])]
         self._offload_opt.load_state_dict({
             "step": 0,
             "master": [l.ravel() for l in leaves],
             "m": [np.zeros(l.size, np.float32) for l in leaves],
             "v": [np.zeros(l.size, np.float32) for l in leaves],
         })
+
+    def _group_hyper(self) -> List[Dict[str, float]]:
+        """Per-group scalar hyperparams for this step (scheduler-mutated).
+        Groups inherit any hyperparam they omit from group 0's current
+        values (torch style: an extra group without "lr" keeps the base lr
+        — never a silent 0.0)."""
+        base = self.optimizer.current_hyperparams()
+        return [{k: float(g.get(k, base[k])) for k in base}
+                for g in self.optimizer.param_groups]
 
     def _apply_offload_step(self) -> bool:
         """Gas-boundary step with host-resident optimizer states: device
@@ -702,27 +776,58 @@ class DeepSpeedEngine:
             s["grad_acc"], s["scale"])
         overflow_host = bool(overflow)
         if not overflow_host:
-            host_grads = [np.divide(jax.device_get(g), old_scale,
-                                    dtype=np.float32)
-                          for g in jax.tree_util.tree_leaves(grads)]
-            hyper = self.optimizer.current_hyperparams()
+            bf16 = self.compute_dtype == jnp.bfloat16
+            group_hyper = self._group_hyper()
+
+            def to_arr(out, dtype, shape):
+                if bf16:
+                    return out.view(jnp.bfloat16).reshape(shape)
+                return np.asarray(out, dtype).reshape(shape)
+
+            grad_leaves = jax.tree_util.tree_leaves(grads)
+            if self._offload_multihost:
+                from .zero.offload_engine import local_block
+                host_grads = [
+                    np.divide(local_block(gleaf, idx), old_scale,
+                              dtype=np.float32)
+                    for li, gleaf in enumerate(grad_leaves)
+                    for idx, _ in self._offload_layout[li]]
+            else:
+                host_grads = [np.divide(jax.device_get(g), old_scale,
+                                        dtype=np.float32)
+                              for g in grad_leaves]
             outs = self._offload_opt.step(
-                host_grads, float(hyper["lr"]),
-                weight_decay=float(hyper["weight_decay"])
-                if "weight_decay" in hyper else None,
-                bf16_out=self.compute_dtype == jnp.bfloat16)
+                host_grads, group_hyper[0]["lr"], bf16_out=bf16,
+                group_hyper=group_hyper)
             param_leaves = jax.tree_util.tree_leaves(s["params"])
-            new_leaves = []
-            for out, leaf in zip(outs, param_leaves):
-                if self.compute_dtype == jnp.bfloat16:
-                    arr = out.view(jnp.bfloat16).reshape(leaf.shape)
-                else:
-                    arr = np.asarray(out, leaf.dtype).reshape(leaf.shape)
-                new_leaves.append(arr)
-            new_params_host = jax.tree_util.tree_unflatten(
-                self._params_treedef, new_leaves)
-            s["params"] = jax.device_put(
-                new_params_host, self._out_shardings["params"])
+            if self._offload_multihost:
+                # rebuild global params: per-shard device_put onto the
+                # master partition, then one jitted reshard (the stage-1
+                # weight-update all-gather) to the param sharding
+                from .zero.offload_engine import index_key
+                new_leaves, pos = [], 0
+                for li, pleaf in enumerate(param_leaves):
+                    blocks = {}
+                    for idx, bshape in self._offload_layout[li]:
+                        blocks[index_key(idx, pleaf.shape)] = to_arr(
+                            outs[pos], pleaf.dtype, bshape)
+                        pos += 1
+                    msh = self._master_shardings_flat[li]
+                    dmap = msh.addressable_devices_indices_map(pleaf.shape)
+                    arrs = [jax.device_put(blocks[index_key(i, pleaf.shape)],
+                                           d) for d, i in dmap.items()]
+                    new_leaves.append(jax.make_array_from_single_device_arrays(
+                        pleaf.shape, msh, arrs))
+                master_sharded = jax.tree_util.tree_unflatten(
+                    self._params_treedef, new_leaves)
+                s["params"] = self._reshard_params_jit(master_sharded)
+            else:
+                new_params_host = jax.tree_util.tree_unflatten(
+                    self._params_treedef,
+                    [to_arr(out, leaf.dtype, leaf.shape)
+                     for out, leaf in zip(outs, param_leaves)])
+                s["params"] = jax.device_put(
+                    new_params_host, self._out_shardings["params"])
             s["master"] = s["params"]
         s["grad_acc"] = zero_acc
         s["scale"] = new_scale

@@ -17,14 +17,16 @@ The updated master is precast to bf16 inside the C++ kernel (the fused
 copy-out), so the upload to HBM ships half the bytes and no device-side cast
 is needed — the reference's adam_update_copy overlap, adapted to bf16.
 
-Multi-host note: each process steps the shard(s) its devices own; here the
-runner consumes whatever host arrays the engine hands it (the engine fetches
-its addressable shards).
+Multi-host note: each process steps the shard(s) its devices own (the
+reference's per-rank cpu_offload, ``stage_1_and_2.py:98``): the runner
+consumes whatever host arrays the engine hands it — full leaves on a single
+controller, the process's unique addressable master shards under
+``jax.process_count() > 1`` (extracted with the helpers below).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -32,6 +34,49 @@ from ...ops.adam.cpu_adam import cpu_adam_step
 from ...ops.op_builder.cpu_adam import CPUAdamBuilder
 from ...utils.logging import logger
 from ..swap_tensor import AioConfig, OptimizerStateSwapper
+
+
+# ---------------------------------------------------------------- shard maths
+def index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a Shard.index (tuple of slices) to ((start, stop), ...)."""
+    return tuple((0 if s.start is None else int(s.start),
+                  int(dim) if s.stop is None else int(s.stop))
+                 for s, dim in zip(index, shape))
+
+
+def unique_local_blocks(leaf) -> List[Tuple[tuple, np.ndarray]]:
+    """This process's unique addressable shards of a jax.Array, as
+    (index, host ndarray) sorted by global index (dedupes replication)."""
+    seen = {}
+    for s in leaf.addressable_shards:
+        key = index_key(s.index, leaf.shape)
+        if key not in seen:
+            seen[key] = (s.index, np.asarray(s.data))
+    return [seen[k] for k in sorted(seen)]
+
+
+def local_block(leaf, index) -> np.ndarray:
+    """The data of ``leaf`` at global ``index`` from this process's shards.
+
+    Exact-match first (grads sharded like the master, ZeRO >=2); otherwise a
+    covering shard is sliced (grads replicated, ZeRO-1 offload)."""
+    key = index_key(index, leaf.shape)
+    covering = None
+    for s in leaf.addressable_shards:
+        skey = index_key(s.index, leaf.shape)
+        if skey == key:
+            return np.asarray(s.data)
+        if covering is None and all(a0 <= b0 and a1 >= b1
+                                    for (a0, a1), (b0, b1) in zip(skey, key)):
+            covering = (skey, s)
+    if covering is None:
+        raise ValueError(f"no addressable shard covers index {key}; "
+                         "multi-host offload needs gradients sharded like "
+                         "(or replicated over) the master partition")
+    skey, s = covering
+    rel = tuple(slice(b0 - a0, b1 - a0)
+                for (a0, _), (b0, b1) in zip(skey, key))
+    return np.asarray(s.data)[rel]
 
 
 class HostOffloadOptimizer:
@@ -43,8 +88,14 @@ class HostOffloadOptimizer:
                  pipeline_read: bool = True, pipeline_write: bool = True,
                  betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
-                 bias_correction: bool = True, num_threads: int = 0):
+                 bias_correction: bool = True, num_threads: int = 0,
+                 group_of: Optional[Sequence[int]] = None):
         assert device in ("cpu", "nvme"), device
+        # param-group index per master array (resolve_param_groups order);
+        # step()'s group_hyper is indexed by these, honouring per-group
+        # lr/weight_decay the way the reference's CPU Adam steps each
+        # param_group with its own hyperparams (stage_1_and_2.py step:1746)
+        self.group_of = list(group_of) if group_of is not None else None
         self.device = device
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -90,18 +141,27 @@ class HostOffloadOptimizer:
 
     def step(self, host_grads: List[np.ndarray], lr: float,
              weight_decay: Optional[float] = None,
-             bf16_out: bool = True) -> List[np.ndarray]:
+             bf16_out: bool = True,
+             group_hyper: Optional[List[Dict[str, float]]] = None
+             ) -> List[np.ndarray]:
         """One Adam step over every group; returns per-group updated params
         as bf16 bit arrays (uint16) when ``bf16_out`` else fp32, each in the
         group's original shape (bf16 arrays are flat bit views to reshape
         after ``.view(bfloat16)``).  ``weight_decay`` overrides the
-        construction-time value so host steps track a scheduled wd."""
+        construction-time value so host steps track a scheduled wd.
+        ``group_hyper`` (one dict per param_group, indexed via ``group_of``)
+        overrides lr/weight_decay per array for per-group hyperparams."""
         assert len(host_grads) == self.num_groups
         if weight_decay is not None:
             self.weight_decay = weight_decay
         self.step_count += 1
         outs: List[np.ndarray] = []
         for i, g in enumerate(host_grads):
+            lr_i, wd_i = lr, self.weight_decay
+            if group_hyper is not None and self.group_of is not None:
+                gh = group_hyper[self.group_of[i]]
+                lr_i = float(gh.get("lr", lr))
+                wd_i = float(gh.get("weight_decay", self.weight_decay))
             g = np.ascontiguousarray(g, np.float32).ravel()
             if self._swapper is None:
                 p, m, v = self._master[i], self._m[i], self._v[i]
@@ -110,8 +170,8 @@ class HostOffloadOptimizer:
                 state = self._swapper.get(self._key(i), prefetch_next=nxt)
                 p, m, v = state["master"], state["m"], state["v"]
             out16 = np.empty(p.size, np.uint16) if bf16_out else None
-            cpu_adam_step(self._lib, p, g, m, v, self.step_count, lr,
-                          self.beta1, self.beta2, self.eps, self.weight_decay,
+            cpu_adam_step(self._lib, p, g, m, v, self.step_count, lr_i,
+                          self.beta1, self.beta2, self.eps, wd_i,
                           self.adamw_mode, self.bias_correction,
                           bf16_out=out16, num_threads=self.num_threads)
             if self._swapper is not None:

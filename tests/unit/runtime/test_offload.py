@@ -167,6 +167,50 @@ def test_offload_load_without_optimizer_state_reseeds_master(tmp_path):
     assert np.abs(stepped - trained_leaf).max() < 0.1  # moved a little, not reset
 
 
+def test_resolve_param_groups_by_path():
+    from deepspeed_tpu.ops.optimizer import resolve_param_groups
+    groups = [{"lr": 1e-3, "weight_decay": 0.1},
+              {"params": ["ln"], "weight_decay": 0.0}]
+    paths = ["['wte']", "['blocks']['ln1_bias']", "['lnf_scale']"]
+    assert resolve_param_groups(groups, paths) == [0, 1, 1]
+    # no default (pattern-free) group: unmatched leaves fall to group 0
+    only_patterns = [{"params": ["wte"]}, {"params": ["ln"]}]
+    assert resolve_param_groups(only_patterns, paths) == [0, 1, 1]
+
+
+def test_offload_per_group_weight_decay():
+    """Per-group hyperparams under offload (the reference steps each
+    param_group with its own wd in the CPU Adam path): a zero-grad step is
+    pure decoupled decay, so no-decay-group leaves stay bit-identical while
+    default-group leaves shrink by exactly (1 - lr*wd)."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+    reset_mesh_manager()
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    lr, wd = 0.5, 0.25
+    opt = FusedAdam(lr=lr, weight_decay=wd)
+    opt.param_groups = [dict(opt.param_groups[0]),
+                        {"params": ["ln"], "lr": lr, "weight_decay": 0.0}]
+    cfg = _ds_config(offload_device="cpu")
+    del cfg["optimizer"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(_tiny_config()), optimizer=opt, config=cfg,
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(engine.state["params"])[0]
+    before = {jax.tree_util.keystr(p): np.asarray(jax.device_get(l), np.float32)
+              for p, l in flat}
+    engine._take_model_step()  # grad_acc is all-zero at init → pure decay
+    flat = jax.tree_util.tree_flatten_with_path(engine.state["params"])[0]
+    for p, l in flat:
+        key = jax.tree_util.keystr(p)
+        after = np.asarray(jax.device_get(l), np.float32)
+        if "ln" in key:
+            np.testing.assert_array_equal(after, before[key], err_msg=key)
+        else:
+            np.testing.assert_allclose(after, before[key] * (1 - lr * wd),
+                                       rtol=1e-6, err_msg=key)
+
+
 def test_offload_fp16_scaled_transfer_trains():
     """fp16 + offload: grads cross the host link loss-SCALED (small
     components survive fp16's range), the host unscales in fp32, and the
