@@ -108,6 +108,15 @@ def resolve_param_groups(param_groups: List[Dict[str, Any]],
         if not g.get("params"):
             default = gi
             break
+    for g in param_groups:
+        for p in g.get("params") or ():
+            if not isinstance(p, str):
+                raise TypeError(
+                    f"param_groups[...]['params'] must hold leaf-path regex "
+                    f"strings in this functional runtime (got {type(p).__name__}); "
+                    "torch-style groups holding tensors don't translate — use "
+                    "patterns like ['ln', 'bias'] matched against "
+                    "jax.tree_util.keystr paths")
     out = []
     for path in leaf_paths:
         idx = default

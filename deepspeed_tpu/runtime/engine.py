@@ -382,13 +382,22 @@ class DeepSpeedEngine:
         self._offload_multihost = jax.process_count() > 1
         self._master_shardings_flat = jax.tree_util.tree_leaves(sh.master)
         if self._offload_multihost:
-            from .zero.offload_engine import unique_local_blocks
-            self._offload_layout: List[List[Tuple[Any, Tuple[int, ...]]]] = []
+            from .zero.offload_engine import index_key, unique_local_blocks
+            # per leaf: [(global index, normalized key, block shape)] for
+            # the process's unique shards, and the static device->key put
+            # map for rebuilding the master-sharded global array each step
+            self._offload_layout = []
+            self._offload_putmap = []
             master_leaves, group_of = [], []
             for li, leaf in enumerate(jax.tree_util.tree_leaves(master_dev)):
                 blocks = unique_local_blocks(leaf)
                 self._offload_layout.append(
-                    [(idx, b.shape) for idx, b in blocks])
+                    [(idx, index_key(idx, leaf.shape), b.shape)
+                     for idx, b in blocks])
+                msh = self._master_shardings_flat[li]
+                self._offload_putmap.append(
+                    [(d, index_key(i, leaf.shape)) for d, i in
+                     msh.addressable_devices_indices_map(leaf.shape).items()])
                 for _, b in blocks:
                     master_leaves.append(np.asarray(b, np.float32))
                     group_of.append(self._leaf_group_idx[li])
@@ -741,7 +750,7 @@ class DeepSpeedEngine:
             leaves = []
             for li, leaf in enumerate(
                     jax.tree_util.tree_leaves(self.state["params"])):
-                for idx, _ in self._offload_layout[li]:
+                for idx, _, _ in self._offload_layout[li]:
                     leaves.append(np.asarray(local_block(leaf, idx),
                                              np.float32))
         else:
@@ -791,33 +800,28 @@ class DeepSpeedEngine:
                     np.divide(local_block(gleaf, idx), old_scale,
                               dtype=np.float32)
                     for li, gleaf in enumerate(grad_leaves)
-                    for idx, _ in self._offload_layout[li]]
+                    for idx, _, _ in self._offload_layout[li]]
             else:
                 host_grads = [np.divide(jax.device_get(g), old_scale,
                                         dtype=np.float32)
                               for g in grad_leaves]
-            outs = self._offload_opt.step(
-                host_grads, group_hyper[0]["lr"], bf16_out=bf16,
-                group_hyper=group_hyper)
+            outs = self._offload_opt.step(host_grads, bf16_out=bf16,
+                                          group_hyper=group_hyper)
             param_leaves = jax.tree_util.tree_leaves(s["params"])
             if self._offload_multihost:
                 # rebuild global params: per-shard device_put onto the
                 # master partition, then one jitted reshard (the stage-1
                 # weight-update all-gather) to the param sharding
-                from .zero.offload_engine import index_key
                 new_leaves, pos = [], 0
                 for li, pleaf in enumerate(param_leaves):
                     blocks = {}
-                    for idx, bshape in self._offload_layout[li]:
-                        blocks[index_key(idx, pleaf.shape)] = to_arr(
-                            outs[pos], pleaf.dtype, bshape)
+                    for _, key, bshape in self._offload_layout[li]:
+                        blocks[key] = to_arr(outs[pos], pleaf.dtype, bshape)
                         pos += 1
-                    msh = self._master_shardings_flat[li]
-                    dmap = msh.addressable_devices_indices_map(pleaf.shape)
-                    arrs = [jax.device_put(blocks[index_key(i, pleaf.shape)],
-                                           d) for d, i in dmap.items()]
+                    arrs = [jax.device_put(blocks[key], d)
+                            for d, key in self._offload_putmap[li]]
                     new_leaves.append(jax.make_array_from_single_device_arrays(
-                        pleaf.shape, msh, arrs))
+                        pleaf.shape, self._master_shardings_flat[li], arrs))
                 master_sharded = jax.tree_util.tree_unflatten(
                     self._params_treedef, new_leaves)
                 s["params"] = self._reshard_params_jit(master_sharded)
@@ -1040,5 +1044,18 @@ class DeepSpeedEngine:
                 "lr_scheduler" in client_state:
             self._lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         if "optimizer_param_groups" in client_state and load_optimizer_states:
-            self.optimizer.param_groups = client_state["optimizer_param_groups"]
+            restored = client_state["optimizer_param_groups"]
+            if len(restored) == len(self.optimizer.param_groups):
+                self.optimizer.param_groups = restored
+            else:
+                # the leaf->group mapping (offload group_of, _group_hyper
+                # indexing) derives from the CONSTRUCTED groups; a
+                # checkpoint with a different group structure cannot be
+                # applied positionally
+                logger.warning(
+                    f"checkpoint has {len(restored)} param groups but the "
+                    f"optimizer was constructed with "
+                    f"{len(self.optimizer.param_groups)}; keeping the "
+                    "constructed groups (hyperparams from the checkpoint "
+                    "are NOT restored)")
         return load_dir, client_state

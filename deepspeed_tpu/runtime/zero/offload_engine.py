@@ -139,7 +139,7 @@ class HostOffloadOptimizer:
     def num_groups(self) -> int:
         return len(self._shapes)
 
-    def step(self, host_grads: List[np.ndarray], lr: float,
+    def step(self, host_grads: List[np.ndarray], lr: Optional[float] = None,
              weight_decay: Optional[float] = None,
              bf16_out: bool = True,
              group_hyper: Optional[List[Dict[str, float]]] = None
@@ -147,21 +147,26 @@ class HostOffloadOptimizer:
         """One Adam step over every group; returns per-group updated params
         as bf16 bit arrays (uint16) when ``bf16_out`` else fp32, each in the
         group's original shape (bf16 arrays are flat bit views to reshape
-        after ``.view(bfloat16)``).  ``weight_decay`` overrides the
-        construction-time value so host steps track a scheduled wd.
-        ``group_hyper`` (one dict per param_group, indexed via ``group_of``)
-        overrides lr/weight_decay per array for per-group hyperparams."""
+        after ``.view(bfloat16)``).
+
+        Hyperparams come from ONE of two channels: ``group_hyper`` (one
+        dict per param_group, indexed via ``group_of`` — the engine path,
+        honours per-group lr/weight_decay) or the scalar ``lr`` /
+        ``weight_decay`` args (direct callers; ``weight_decay`` persists as
+        the new construction-time value)."""
         assert len(host_grads) == self.num_groups
         if weight_decay is not None:
             self.weight_decay = weight_decay
         self.step_count += 1
         outs: List[np.ndarray] = []
         for i, g in enumerate(host_grads):
-            lr_i, wd_i = lr, self.weight_decay
             if group_hyper is not None and self.group_of is not None:
                 gh = group_hyper[self.group_of[i]]
-                lr_i = float(gh.get("lr", lr))
+                lr_i = float(gh["lr"])
                 wd_i = float(gh.get("weight_decay", self.weight_decay))
+            else:
+                assert lr is not None, "step() needs lr or group_hyper"
+                lr_i, wd_i = lr, self.weight_decay
             g = np.ascontiguousarray(g, np.float32).ravel()
             if self._swapper is None:
                 p, m, v = self._master[i], self._m[i], self._v[i]
