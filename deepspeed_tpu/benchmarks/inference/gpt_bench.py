@@ -37,9 +37,10 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     from deepspeed_tpu.models import gpt, gpt_inference
 
     import dataclasses
+    # int8 = weight-only int8 serving: codes + scales in HBM, bf16 compute
     config = dataclasses.replace(
         gpt.PRESETS[model],
-        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+        dtype=jnp.float32 if dtype == "float32" else jnp.bfloat16)
     params = gpt.init(config, jax.random.PRNGKey(0))
     engine = deepspeed_tpu.init_inference(model=(config, params),
                                           config={"dtype": dtype})
@@ -119,7 +120,7 @@ def main() -> None:
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--dtype", default="bfloat16",
-                    choices=["bfloat16", "float32"])
+                    choices=["bfloat16", "float32", "int8"])
     ap.add_argument("--warmup", type=int, default=3)
     args = ap.parse_args()
     result = run_bench(model=args.model, batch=args.batch,

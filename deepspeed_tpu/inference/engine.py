@@ -41,6 +41,12 @@ class InferenceEngine:
         self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
         self._config = config
         dtype = config.jnp_dtype
+        # dtype="int8" means weight-only int8 serving (reference
+        # pt_binding.cpp int8 gemm paths): weights stored int8 + grouped
+        # scales, activations/compute bf16 on the MXU
+        self._weight_int8 = dtype == jnp.int8
+        if self._weight_int8:
+            dtype = jnp.bfloat16
         self.model_config = dataclasses.replace(model_config, dtype=dtype)
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
@@ -78,6 +84,11 @@ class InferenceEngine:
         self._family = fam
         if want_tp:
             self._shard_params_tp()
+        if self._weight_int8:
+            from .quantization import quantize_params_int8
+            self.params, n_q = quantize_params_int8(self.params)
+            logger.info(f"[inference] int8 weight-only serving: {n_q} "
+                        "weights stored as int8 codes + per-vector scales")
         self._forward_jit = jax.jit(self._apply_fn)
         self._generate_cache: Dict[Tuple, Any] = {}
 
@@ -228,6 +239,13 @@ class InferenceEngine:
     # ----------------------------------------------------------- checkpoint
 
     def save_16bit_model(self, path: str) -> None:
-        flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
+        from .quantization import Int8Param
+        # int8 engines dequantize to the compute dtype first: the contract
+        # is a 16-bit weight per leaf under the leaf's own key
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(self.model_config.dtype)
+            if isinstance(p, Int8Param) else p,
+            self.params, is_leaf=lambda p: isinstance(p, Int8Param))
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
         arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
         np.savez(path, **arrays)

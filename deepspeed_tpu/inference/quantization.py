@@ -1,0 +1,110 @@
+"""Weight-only int8 serving.
+
+Counterpart of the reference's int8 inference path
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1652-1720`` int8 gemm +
+dequant, ``csrc/quantization/quantize.cu`` grouped scales): weights are
+*stored* int8 with per-vector fp32 scales and dequantized on the fly, fused
+by XLA into the consuming matmul/gather.  On TPU the serving bottleneck at
+decode time is HBM weight traffic, so storing codes halves the bytes per
+step; compute stays bf16 on the MXU (the reference likewise upconverts for
+the gemm epilogue).
+
+Scheme: one symmetric scale per last-dim vector (group size = the weight's
+last dim, e.g. head_dim for ``wqkv``, d_model for ``wi``) — the grouped
+layout of ``ops/pallas/quantizer.py`` with ``groups = prod(shape[:-1])``,
+reshaped back so the codes keep the weight's original shape (and therefore
+its TP sharding).
+
+``Int8Param`` is a registered pytree node that duck-types the one operation
+every model-family weight read performs (``.astype(dtype)``), so the whole
+GPT family — prefill, decode, scans over stacked layers — serves int8
+without touching the model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+#: leaf names (last path component) that hold the big matmul weights in the
+#: canonical stacked GPT family (models/gpt.py; module_inject emits the same
+#: names for every injected architecture); lm_head covers untied-embedding
+#: configs (GPT-J/NeoX style), where it is the single largest matrix
+QUANTIZE_LEAVES = frozenset({"wqkv", "wo", "wi", "wo_mlp", "wte", "lm_head"})
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Int8Param:
+    """int8 codes in the weight's original shape + per-vector fp32 scales
+    (``shape[:-1] + (1,)``).  ``astype`` dequantizes; XLA fuses the scale
+    multiply into the consumer (matmul operand read or embedding gather)."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    def astype(self, dtype):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_leaf(w: jnp.ndarray) -> Int8Param:
+    """Symmetric per-last-dim-vector int8 quantization via the grouped
+    quantizer kernel (``ops/pallas/quantizer.quantize`` with
+    ``groups = prod(shape[:-1])``), codes reshaped back to the weight's
+    shape."""
+    import numpy as np
+
+    from ..ops.pallas.quantizer import quantize
+
+    groups = max(1, int(np.prod(w.shape[:-1])))
+    q, scale, _ = quantize(w.astype(jnp.float32), groups=groups, bits=8,
+                           symmetric=True)
+    return Int8Param(q=q.reshape(w.shape),
+                     scale=scale.reshape(w.shape[:-1] + (1,)))
+
+
+_quantize_jit = jax.jit(quantize_leaf)
+
+
+def quantize_params_int8(params: PyTree) -> Tuple[PyTree, int]:
+    """Replace the big matmul weights with :class:`Int8Param` leaves.
+
+    Returns ``(new_params, n_quantized)``.  Layer norms, biases, and
+    position embeddings stay in the compute dtype (tiny, precision-critical
+    — matching the reference which only routes gemm weights through int8).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    n_quantized = 0
+    out = []
+    for path, leaf in flat:
+        name = str(getattr(path[-1], "key", path[-1])) if path else ""
+        if name in QUANTIZE_LEAVES and getattr(leaf, "ndim", 0) >= 2:
+            out.append(_quantize_jit(leaf))
+            n_quantized += 1
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out), n_quantized
