@@ -75,7 +75,10 @@ class DeepSpeedAccelerator(abc.ABC):
         """
         import jax
 
-        dev = self.devices()[device_index or 0]
+        devices = self.devices()
+        if not devices:
+            return  # nothing dispatched anywhere: a fence is trivially done
+        dev = devices[0 if device_index is None else device_index]
         jax.device_get(_fence_fn()(jax.device_put(0.0, dev)))
 
     # ------------------------------------------------------- capabilities

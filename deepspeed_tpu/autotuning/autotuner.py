@@ -100,40 +100,20 @@ class Autotuner:
         return self._model_info
 
     def _state_bytes(self, cand: Candidate) -> int:
-        """Analytic per-device bytes for params+master+grads+opt state."""
-        info = self.model_info()
-        n = info["num_params"]
-        dp = self.mesh_manager.dp_world_size
-        stage = cand.get("zero_stage", 0)
+        """Analytic per-device bytes for params+master+grads+opt state
+        (shared memory model, runtime/memory_model.py)."""
+        from ..runtime.memory_model import zero_state_bytes
         mixed = any(self.base_config.get(k, {}).get("enabled")
                     for k in ("fp16", "bf16"))
-        param_b = n * (2 if mixed else 4)
-        master_b = n * 4 if (mixed or stage >= 1) else 0
-        grad_b = n * 4
-        opt_b = n * 8  # adam m+v fp32
-        if stage >= 1:
-            master_b //= dp
-            opt_b //= dp
-        if stage >= 2:
-            grad_b //= dp
-        if stage >= 3:
-            param_b //= dp
-        if cand.get("offload"):
-            master_b = opt_b = 0  # host-resident
-        return param_b + master_b + grad_b + opt_b
+        return zero_state_bytes(self.model_info()["num_params"],
+                                self.mesh_manager.dp_world_size,
+                                cand.get("zero_stage", 0), mixed,
+                                bool(cand.get("offload")))
 
     def _device_budget(self) -> Optional[int]:
-        if self.config.device_memory_bytes is not None:
-            return int(self.config.device_memory_bytes * self.config.memory_fraction)
-        import jax
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
-            if total:
-                return int(total * self.config.memory_fraction)
-        except Exception:
-            pass
-        return None  # unknown (CPU) -> no pruning
+        from ..runtime.memory_model import device_budget
+        return device_budget(self.config.memory_fraction,
+                             self.config.device_memory_bytes)
 
     # ------------------------------------------------------------ search space
     def _micro_batch_candidates(self) -> List[int]:

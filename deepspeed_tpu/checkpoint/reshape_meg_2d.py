@@ -85,8 +85,16 @@ def split_global_to_rows(full: Dict[str, Any], pp: int, tp: int
         for key, val in full.items():
             idx = _layer_index(key)
             if idx is None:
-                is_embed = "embed" in key.lower()
-                if (is_embed and stage == 0) or \
+                low = key.lower()
+                is_embed = "embed" in low
+                # WORD embeddings go to stage 0 AND (for pp>1) the last
+                # stage: real Megatron checkpoints carry the tied copy on
+                # the final stage for the LM head; position embeddings stay
+                # stage-0-only (merge_rows_to_global dedupes the agreeing
+                # duplicates on the way back)
+                tied_copy = pp > 1 and stage == pp - 1 and \
+                    ("word" in low or low.startswith("wte"))
+                if (is_embed and (stage == 0 or tied_copy)) or \
                         (not is_embed and stage == pp - 1):
                     stage_sd[key] = val
             elif lo <= idx < hi:

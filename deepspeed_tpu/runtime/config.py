@@ -35,10 +35,16 @@ class CommsLoggerConfig:
         self.prof_ops = get_scalar_param(d, C.COMMS_LOGGER_PROF_OPS, C.COMMS_LOGGER_PROF_OPS_DEFAULT)
 
 
+#: the HF-integration sentinel (reference config.py "auto" values, filled
+#: by the trainer there; SURVEY §5) — resolved here from mesh + model info
+AUTO = "auto"
+
+
 class DeepSpeedConfig:
     """Parse + validate a DeepSpeed JSON config for the TPU runtime."""
 
-    def __init__(self, config: Union[str, Dict], mpu=None, mesh_manager=None):
+    def __init__(self, config: Union[str, Dict], mpu=None, mesh_manager=None,
+                 model=None):
         if isinstance(config, (str, os.PathLike)):
             if not os.path.exists(config):
                 raise DeepSpeedConfigError(
@@ -64,9 +70,87 @@ class DeepSpeedConfig:
             except Exception:
                 self.world_size = 1
 
+        self._resolve_auto(self._param_dict, model)
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
+
+    # ------------------------------------------------------------------ "auto"
+    def _resolve_auto(self, pd: Dict[str, Any], model) -> None:
+        """Resolve HF-style ``"auto"`` values (reference configs carry them
+        for the trainer to fill): the batch triple resolves through the
+        standard batch algebra — a fully-auto triple sizes the micro-batch
+        from device memory + the model's state bytes — gradient clipping
+        takes HF's max_grad_norm default, and every other ``"auto"`` falls
+        back to the field's typed default."""
+        triple = (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                  C.GRADIENT_ACCUMULATION_STEPS)
+        had_auto_triple = any(pd.get(k) == AUTO for k in triple)
+        for k in triple:
+            if pd.get(k) == AUTO:
+                pd[k] = None
+        if pd.get(C.GRADIENT_CLIPPING) == AUTO:
+            pd[C.GRADIENT_CLIPPING] = 1.0  # HF TrainingArguments max_grad_norm
+
+        def strip(d: Dict[str, Any]) -> None:
+            for k in list(d):
+                if d[k] == AUTO:
+                    del d[k]  # absent -> the section's typed default
+                elif isinstance(d[k], dict):
+                    strip(d[k])
+
+        for k in list(pd):
+            if isinstance(pd[k], dict):
+                strip(pd[k])
+            elif pd[k] == AUTO:
+                del pd[k]
+        # sizing runs AFTER the strip so the memory estimate reads the
+        # resolved precision/offload values; whenever both batch sizes were
+        # auto'd away (gas may stay numeric) the micro-batch is synthesized
+        if had_auto_triple and pd.get(C.TRAIN_BATCH_SIZE) is None and \
+                pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU) is None:
+            pd[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = \
+                self._auto_micro_batch(pd, model)
+
+    def _auto_micro_batch(self, pd: Dict[str, Any], model) -> int:
+        """Largest power-of-two micro-batch whose state + activation bytes
+        fit the device (the autotuner's analytic memory model,
+        autotuning/autotuner.py:_state_bytes, at config time)."""
+        if model is None:
+            return 1
+        try:
+            import jax
+            import numpy as np
+
+            from .memory_model import device_budget, zero_state_bytes
+            shapes = model.param_shapes()
+            n = sum(int(np.prod(l.shape))
+                    for l in jax.tree_util.tree_leaves(shapes))
+            budget = device_budget()
+            if budget is None:
+                return 1  # unknown memory (CPU) -> conservative
+            # pd is post-strip here: "auto" leaves are gone, so these reads
+            # see the values the runtime will actually use
+            zero = pd.get(ZERO_OPTIMIZATION, {})
+            stage = int(zero.get("stage", 0))
+            mixed = bool(pd.get(C.FP16, {}).get(C.FP16_ENABLED)) or \
+                bool(pd.get(C.BFLOAT16, {}).get(C.BFLOAT16_ENABLED)) or \
+                bool(pd.get(C.BFLOAT16_OLD, {}).get(C.BFLOAT16_ENABLED))
+            off = zero.get("offload_optimizer")
+            offload = bool(off) and (not isinstance(off, dict)
+                                     or off.get("device", "cpu") != "none")
+            free = budget - zero_state_bytes(n, self.world_size, stage,
+                                             mixed, offload)
+            cfg = model.meta.get("config") if hasattr(model, "meta") else None
+            if cfg is None or free <= 0:
+                return 1
+            # remat-era activation estimate: ~4 bytes x S x d per layer
+            act_per_sample = 4 * cfg.max_seq_len * cfg.d_model * cfg.n_layer
+            micro = max(1, free // max(1, act_per_sample))
+            return 1 << (int(micro).bit_length() - 1)  # floor to power of 2
+        except Exception as e:  # never let sizing heuristics kill startup
+            logger.warning(f"auto micro-batch sizing failed ({e}); using 1")
+            return 1
 
     # ------------------------------------------------------------------ params
     def _initialize_params(self, pd: Dict[str, Any]) -> None:

@@ -89,7 +89,8 @@ class DeepSpeedEngine:
 
         self.mesh_manager = mesh_manager or get_mesh_manager()
         self.mesh = self.mesh_manager.mesh
-        self._config = config_class or DeepSpeedConfig(config, mesh_manager=self.mesh_manager)
+        self._config = config_class or DeepSpeedConfig(
+            config, mesh_manager=self.mesh_manager, model=model)
         self.module = model  # name kept for reference parity
         self.training_data = training_data
         self.collate_fn = collate_fn

@@ -1,0 +1,48 @@
+"""Analytic ZeRO memory model, shared by the autotuner's candidate pruning
+(``autotuning/autotuner.py``) and the config's ``"auto"`` micro-batch sizing
+(``runtime/config.py``).
+
+The reference profiles memory by running (autotuner.py model-info run); here
+the ZeRO plan is declarative, so per-device state bytes are arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def zero_state_bytes(num_params: int, dp: int, stage: int,
+                     mixed_precision: bool, offload: bool) -> int:
+    """Per-device bytes for params + fp32 master + grads + Adam moments."""
+    n, dp = int(num_params), max(1, int(dp))
+    param_b = n * (2 if mixed_precision else 4)
+    master_b = n * 4 if (mixed_precision or stage >= 1) else 0
+    grad_b = n * 4
+    opt_b = n * 8  # adam m+v fp32
+    if stage >= 1:
+        master_b //= dp
+        opt_b //= dp
+    if stage >= 2:
+        grad_b //= dp
+    if stage >= 3:
+        param_b //= dp
+    if offload:
+        master_b = opt_b = 0  # host-resident
+    return param_b + master_b + grad_b + opt_b
+
+
+def device_budget(memory_fraction: float = 0.85,
+                  device_memory_bytes: Optional[int] = None) -> Optional[int]:
+    """Usable HBM bytes on the local device, or None when unknown (CPU)."""
+    if device_memory_bytes is not None:
+        return int(device_memory_bytes * memory_fraction)
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if total:
+            return int(total * memory_fraction)
+    except Exception:
+        pass
+    return None

@@ -73,19 +73,21 @@ class GatheredParameters:
 
     ``target`` may be:
       - a **DeepSpeedEngine**: yields the full param tree as mutable
-        numpy arrays; on exit (unless ``modifier_rank is None``) edits
-        upload back with the engine's shardings, into both the compute
-        params and the fp32 master.
-      - a **param pytree**: read-only host view (edits are discarded, as
-        with the reference's default ``modifier_rank=None``).
+        numpy arrays; pass ``modifier_rank=0`` for write-back — on exit
+        edits upload with the engine's shardings, into both the compute
+        params and the fp32 master.  The default ``modifier_rank=None``
+        is a read-only gather (matching the reference default,
+        partition_parameters.py ``GatheredParameters``) and skips the
+        host round-trip on exit.
+      - a **param pytree**: read-only host view (edits are discarded).
 
     Example (weight surgery on a live ZeRO-3 engine)::
 
-        with GatheredParameters(engine) as host:
+        with GatheredParameters(engine, modifier_rank=0) as host:
             host["wte"][0, :] = 0.0
     """
 
-    def __init__(self, target, modifier_rank: Optional[int] = 0,
+    def __init__(self, target, modifier_rank: Optional[int] = None,
                  fwd_module=None, enabled: bool = True):
         self.enabled = enabled
         self.modifier_rank = modifier_rank
@@ -95,7 +97,7 @@ class GatheredParameters:
         self._host: Optional[PyTree] = None
 
     def __enter__(self) -> PyTree:
-        if self._engine is not None and \
+        if self._engine is not None and self.modifier_rank is not None and \
                 getattr(self._engine, "_offload_device", None) is not None:
             raise NotImplementedError(
                 "GatheredParameters write-back on an offload-optimizer "

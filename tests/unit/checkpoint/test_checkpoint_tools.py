@@ -234,10 +234,14 @@ def test_reshape_meg_2d_grid_roundtrip():
 
     grid22 = split_global_to_rows(full, pp=2, tp=2)
     assert len(grid22) == 2 and len(grid22[0]) == 2
-    # embeddings only on stage 0; final LN only on the last stage; local
-    # layer indices start at 0 on every stage
+    # word embeddings on stage 0 AND the last stage (Megatron carries the
+    # tied copy for the LM head on pp>1 grids); final LN only on the last
+    # stage; local layer indices start at 0 on every stage
     assert "word_embeddings.weight" in grid22[0][0]
-    assert "word_embeddings.weight" not in grid22[1][0]
+    assert "word_embeddings.weight" in grid22[1][0]
+    np.testing.assert_array_equal(
+        merge_rows_to_global([grid22[0]])["word_embeddings.weight"],
+        full["word_embeddings.weight"])
     assert "final_layernorm.weight" in grid22[1][0]
     assert any(k.startswith("layers.0.") for k in grid22[1][0])
 

@@ -88,3 +88,72 @@ def test_fp16_section(mm8):
              "fp16": {"enabled": True, "initial_scale_power": 8,
                        "loss_scale_window": 100}}, mm8)
     assert c.fp16_enabled and c.initial_scale_power == 8
+
+
+# ------------------------------------------------------------------ "auto"
+
+def test_auto_batch_triple_resolves(mm8):
+    """HF-style "auto" (VERDICT r2 #10): a fully-auto batch triple sizes
+    micro from memory (1 on CPU without a model), gas defaults to 1, and
+    the train batch follows the algebra."""
+    c = cfg({"train_batch_size": "auto",
+             "train_micro_batch_size_per_gpu": "auto",
+             "gradient_accumulation_steps": "auto"}, mm8)
+    assert c.train_micro_batch_size_per_gpu == 1
+    assert c.gradient_accumulation_steps == 1
+    assert c.train_batch_size == 8
+
+
+def test_auto_batch_sizes_with_numeric_gas(mm8):
+    """HF configs often pin only gas: both batch sizes "auto" + numeric
+    gas must synthesize the micro-batch, not crash."""
+    c = cfg({"train_batch_size": "auto",
+             "train_micro_batch_size_per_gpu": "auto",
+             "gradient_accumulation_steps": 4}, mm8)
+    assert c.train_micro_batch_size_per_gpu == 1
+    assert c.gradient_accumulation_steps == 4
+    assert c.train_batch_size == 32
+
+
+def test_auto_gas_derives_from_given_pair(mm8):
+    c = cfg({"train_batch_size": 64,
+             "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": "auto"}, mm8)
+    assert c.gradient_accumulation_steps == 4
+    c = cfg({"train_batch_size": "auto",
+             "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 4}, mm8)
+    assert c.train_batch_size == 64
+
+
+def test_auto_scalars_fall_to_defaults(mm8):
+    c = cfg({"train_batch_size": 8,
+             "gradient_clipping": "auto",
+             "steps_per_print": "auto",
+             "fp16": {"enabled": "auto", "loss_scale_window": "auto"},
+             "zero_optimization": {"stage": 2,
+                                   "offload_optimizer": "auto",
+                                   "allgather_bucket_size": "auto"}}, mm8)
+    assert c.gradient_clipping == 1.0        # HF max_grad_norm default
+    assert c.steps_per_print == 10           # section default
+    assert c.fp16_enabled is False
+    assert c.zero_optimization_stage == 2
+    assert c.zero_config.offload_optimizer_config.device == "none"
+
+
+def test_auto_micro_batch_uses_model_memory(mm8):
+    """With a model and a known device budget the auto micro-batch comes
+    from the analytic memory model (power of two, >= 1)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.model import from_gpt
+    model = from_gpt(gpt.GPTConfig(vocab_size=256, max_seq_len=64, n_layer=2,
+                                   n_head=2, d_model=64, dtype=jnp.float32))
+    c = DeepSpeedConfig({"train_batch_size": "auto",
+                         "train_micro_batch_size_per_gpu": "auto",
+                         "gradient_accumulation_steps": "auto"},
+                        mesh_manager=mm8, model=model)
+    # CPU devices report no bytes_limit -> conservative 1; on a real chip
+    # this is free_bytes // activation_bytes floored to a power of 2
+    assert c.train_micro_batch_size_per_gpu >= 1
+    assert c.train_batch_size == c.train_micro_batch_size_per_gpu * 8

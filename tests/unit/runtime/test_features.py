@@ -306,7 +306,7 @@ def test_gathered_parameters_weight_surgery_on_zero3_engine():
         mesh_manager=mm, rng=jax.random.PRNGKey(0))
     old_sh = jax.tree_util.tree_leaves(engine.state["params"])[0].sharding
 
-    with GatheredParameters(engine) as host:
+    with GatheredParameters(engine, modifier_rank=0) as host:
         leaf_name = next(iter(host))
         first = host[leaf_name]
         while isinstance(first, dict):
@@ -347,3 +347,32 @@ def test_gathered_parameters_tree_is_read_only_view():
     with GatheredParameters(tree) as host:
         host["w"][...] = 9.0
     np.testing.assert_allclose(np.asarray(tree["w"]), 1.0)  # untouched
+
+
+def test_gathered_parameters_engine_default_is_read_only():
+    """modifier_rank defaults to None (reference default): an engine
+    gather without it is a read-only view — edits are NOT uploaded and
+    exit skips the device round-trip."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.zero import GatheredParameters
+    from tests.unit.common import base_config, make_mesh, tiny_model
+
+    mm = make_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=base_config(micro_batch=2, stage=1),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    before = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(engine.state["master"])[0]))
+    with GatheredParameters(engine) as host:
+        first = host
+        while isinstance(first, dict):
+            first = first[next(iter(first))]
+        first[...] = 123.0
+    after = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(engine.state["master"])[0]))
+    np.testing.assert_array_equal(after, before)
