@@ -131,6 +131,10 @@ def main() -> None:
             config = dataclasses.replace(gpt.GPT2_350M, max_seq_len=1024,
                                          dtype=jnp.bfloat16, remat=True)
             mb_candidates, gas, steps, warmup = (32, 24, 16), 1, 10, 2
+            # interactive tuning override (e.g. BENCH_MB=48,40,32)
+            if os.environ.get("BENCH_MB"):
+                mb_candidates = tuple(
+                    int(x) for x in os.environ["BENCH_MB"].split(","))
         else:
             config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
                                    n_head=4, d_model=128, dtype=jnp.float32)

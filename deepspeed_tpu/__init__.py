@@ -176,6 +176,26 @@ def init_inference(model=None, config=None, **kwargs):
             "init_inference(ModelSpec) needs materialized params"
         model_config, params = model.meta["config"], model.params
     else:
+        # generic (diffusers) policies first, matched on the state dict —
+        # the reference's generic_policies loop (replace_module.py); a
+        # UNet/VAE returns its served wrapper directly
+        sd = model if isinstance(model, dict) else (
+            model.state_dict() if hasattr(model, "state_dict") else None)
+        if sd is not None:
+            import jax.numpy as jnp
+
+            from .module_inject.replace_policy import GENERIC_POLICIES
+            dtype = inf_config.jnp_dtype
+            if dtype == jnp.int8:   # weight-only int8 is LM-path-only
+                dtype = jnp.bfloat16
+            extra = {k: cfg_dict[k] for k in ("n_head", "groups")
+                     if k in cfg_dict}
+            for policy in GENERIC_POLICIES:
+                if policy.match(sd):
+                    return policy.apply(
+                        sd, dtype=dtype,
+                        enable_cuda_graph=inf_config.enable_cuda_graph,
+                        **extra)
         from .module_inject import convert_hf_model
         model_config, params = convert_hf_model(
             model, dtype=inf_config.jnp_dtype)

@@ -86,7 +86,7 @@ def split_global_to_rows(full: Dict[str, Any], pp: int, tp: int
             idx = _layer_index(key)
             if idx is None:
                 low = key.lower()
-                is_embed = "embed" in low
+                is_embed = "embed" in low or low.startswith(("wte", "wpe"))
                 # WORD embeddings go to stage 0 AND (for pp>1) the last
                 # stage: real Megatron checkpoints carry the tied copy on
                 # the final stage for the LM head; position embeddings stay

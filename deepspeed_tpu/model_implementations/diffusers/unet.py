@@ -1,0 +1,53 @@
+"""DSUNet: the served UNet wrapper.
+
+Counterpart of the reference's ``model_implementations/diffusers/unet.py``
+(``DSUNet``): there, the torch module is wrapped with CUDA-graph capture and
+``channels_last``; here the native NHWC UNet (``models/diffusion.py``) is
+wrapped with jit — one compiled XLA program per input signature plays the
+graph-capture role — exposing the same serving surface (``in_channels``,
+``dtype``, ``fwd_count``, callable forward).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models.diffusion import UNetConfig, unet_apply
+
+PyTree = Any
+
+
+class DSUNet:
+    def __init__(self, config: UNetConfig, params: PyTree,
+                 enable_cuda_graph: bool = True):
+        # enable_cuda_graph accepted for surface parity; jit IS the capture
+        self.config = config
+        self.params = params
+        self.in_channels = config.in_channels
+        self.dtype = config.dtype
+        self.fwd_count = 0
+        self._jit = jax.jit(
+            lambda p, s, t, c: unet_apply(p, s, t, c, config))
+
+    def forward(self, sample, timestep, encoder_hidden_states,
+                return_dict: bool = True):
+        """sample [B, H, W, C] NHWC (or [B, C, H, W] NCHW, transposed in),
+        timestep scalar or [B], encoder_hidden_states [B, S, D]."""
+        sample = jnp.asarray(sample)
+        nchw = sample.shape[-1] != self.in_channels and \
+            sample.shape[1] == self.in_channels
+        if nchw:
+            sample = sample.transpose(0, 2, 3, 1)
+        out = self._jit(self.params, sample, jnp.asarray(timestep),
+                        jnp.asarray(encoder_hidden_states))
+        if nchw:
+            out = out.transpose(0, 3, 1, 2)
+        self.fwd_count += 1
+        if return_dict:
+            return {"sample": out}
+        return (out,)
+
+    __call__ = forward

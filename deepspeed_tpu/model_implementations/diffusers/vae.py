@@ -1,0 +1,63 @@
+"""DSVAE: the served AutoencoderKL wrapper.
+
+Counterpart of the reference's ``model_implementations/diffusers/vae.py``
+(``DSVAE``): separate compiled encode/decode programs (the reference builds
+separate CUDA graphs for each), NHWC layout, native JAX compute
+(``models/diffusion.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models.diffusion import VAEConfig, vae_decode, vae_encode
+
+PyTree = Any
+
+
+class DSVAE:
+    def __init__(self, config: VAEConfig, params: PyTree,
+                 enable_cuda_graph: bool = True):
+        self.config = config
+        self.params = params
+        self.dtype = config.dtype
+        self._decode_jit = jax.jit(lambda p, z: vae_decode(p, z, config))
+        self._encode_jit = jax.jit(lambda p, x: vae_encode(p, x, config))
+        self._encode_sample_jit = jax.jit(
+            lambda p, x, r: vae_encode(p, x, config, rng=r))
+
+    def _to_nhwc(self, x, channels):
+        x = jnp.asarray(x)
+        if x.shape[-1] != channels and x.shape[1] == channels:
+            return x.transpose(0, 2, 3, 1), True
+        return x, False
+
+    def decode(self, latents, return_dict: bool = True):
+        z, nchw = self._to_nhwc(latents, self.config.latent_channels)
+        img = self._decode_jit(self.params, z)
+        if nchw:
+            img = img.transpose(0, 3, 1, 2)
+        if return_dict:
+            return {"sample": img}
+        return (img,)
+
+    def encode(self, images, return_dict: bool = True,
+               rng: Optional[jax.Array] = None):
+        """rng=None returns the latent mean; pass a PRNG key for a
+        reparameterized sample from the latent distribution."""
+        x, nchw = self._to_nhwc(images, self.config.in_channels)
+        z = self._encode_jit(self.params, x) if rng is None else \
+            self._encode_sample_jit(self.params, x, rng)
+        if nchw:
+            z = z.transpose(0, 3, 1, 2)
+        if return_dict:
+            return {"latent_dist_mean": z}
+        return (z,)
+
+    def forward(self, images):
+        return self.decode(self.encode(images, return_dict=False)[0])
+
+    __call__ = forward

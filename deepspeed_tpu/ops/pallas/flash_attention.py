@@ -92,6 +92,21 @@ def _block_visible(qi, ki, block_q, block_k, offset):
     return ki * block_k <= qi * block_q + block_q - 1 + offset
 
 
+def _block_crosses_mask(qi, ki, block_q, block_k, offset, causal, use_lens,
+                        kv_len):
+    """Whether this tile needs masking at all.  Interior tiles (fully below
+    the diagonal AND fully inside every row's live prefix) skip the
+    iota/compare/select VPU work — on short-head-dim shapes the kernels are
+    VPU-bound (exp + mask ops), not MXU-bound, so this is the fast path."""
+    crosses = False
+    if causal:
+        # last key column of the tile vs first query row of the tile
+        crosses = (ki + 1) * block_k - 1 > qi * block_q + offset
+    if use_lens:
+        crosses = jnp.logical_or(crosses, (ki + 1) * block_k > kv_len)
+    return crosses
+
+
 # ------------------------------------------------------------------- forward
 
 def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -113,8 +128,7 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     if use_lens:
         run = jnp.logical_and(run, ki * block_k < kv_len)
 
-    @pl.when(run)
-    def _update():
+    def _update(masked: bool):
         # MXU operands stay in the input dtype (bf16 in production) with
         # f32 accumulation — an fp32 cast before the dot would run the
         # systolic array at a fraction of its bf16 rate
@@ -123,9 +137,9 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         vs = v_ref[0]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked and causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        if use_lens:
+        if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
@@ -136,6 +150,15 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p.astype(vs.dtype), vs, preferred_element_type=jnp.float32)
+
+    if causal or use_lens:
+        crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
+                                      causal, use_lens, kv_len)
+        pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
+        pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
+            lambda: _update(False))
+    else:
+        pl.when(run)(lambda: _update(False))
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -200,8 +223,7 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if use_lens:
         run = jnp.logical_and(run, ki * block_k < kv_len)
 
-    @pl.when(run)
-    def _update():
+    def _update(masked: bool):
         # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
         q = q_ref[0]                                       # (BQ, D)
         ks = k_ref[0]                                      # (BK, D)
@@ -211,15 +233,24 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked and causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        if use_lens:
+        if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(ks.dtype)
         dq_acc[...] += jnp.dot(ds, ks, preferred_element_type=jnp.float32)
+
+    if causal or use_lens:
+        crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
+                                      causal, use_lens, kv_len)
+        pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
+        pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
+            lambda: _update(False))
+    else:
+        pl.when(run)(lambda: _update(False))
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -245,8 +276,7 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # the whole K block is beyond this row's live prefix: dk/dv stay 0
         run = jnp.logical_and(run, ki * block_k < kv_len)
 
-    @pl.when(run)
-    def _update():
+    def _update(masked: bool):
         # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
         q = q_ref[0]                                       # (BQ, D)
         ks = k_ref[0]                                      # (BK, D)
@@ -256,9 +286,9 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if masked and causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        if use_lens:
+        if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dv_acc[...] += jax.lax.dot_general(
@@ -269,6 +299,15 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal or use_lens:
+        crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
+                                      causal, use_lens, kv_len)
+        pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
+        pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
+            lambda: _update(False))
+    else:
+        pl.when(run)(lambda: _update(False))
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -414,9 +453,13 @@ def flash_attention(q, k, v, causal: bool = True,
     with auto block sizes — the sequence is short enough that dense wins
     (< FLASH_MIN_SEQ).
     """
+    import os
     auto_blocks = block_q is None and block_k is None
-    block_q = 1024 if block_q is None else block_q
-    block_k = 1024 if block_k is None else block_k
+    # env knobs for on-chip block sweeps (perf tuning; default measured-best)
+    if block_q is None:
+        block_q = int(os.environ.get("FLASH_BLOCK_Q", 1024))
+    if block_k is None:
+        block_k = int(os.environ.get("FLASH_BLOCK_K", 1024))
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     bq = _pick_block(Sq, block_q)

@@ -53,7 +53,10 @@ class DeepSpeedConfig:
                 self._param_dict = json.load(
                     f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
         elif isinstance(config, dict):
-            self._param_dict = dict(config)
+            import copy
+            # deep copy: "auto" resolution edits nested sections in place
+            # and must never mutate the caller's dict
+            self._param_dict = copy.deepcopy(config)
         else:
             raise DeepSpeedConfigError(
                 f"Expected a string path or dict, got {type(config)}")
