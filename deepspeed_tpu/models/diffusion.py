@@ -168,8 +168,13 @@ def _spatial_transformer(x, ctx, p, groups: int, n_head: int):
     return x + h.reshape(B, H, W, C)
 
 
-def _downsample(x, p):
-    return _conv(x, p["conv_w"], p["conv_b"], stride=2)
+def _downsample(x, p, pad=((1, 1), (1, 1))):
+    """Stride-2 conv.  diffusers' UNet Downsample2D pads symmetrically
+    (padding=1); the VAE encoder pads asymmetrically (0,1) — pass it."""
+    y = lax.conv_general_dilated(
+        x, p["conv_w"].astype(x.dtype), window_strides=(2, 2),
+        padding=list(pad), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return nhwc_bias_add(y, p["conv_b"].astype(x.dtype))
 
 
 def _upsample(x, p):
@@ -279,7 +284,8 @@ def vae_encode(params: PyTree, img: jnp.ndarray, config: VAEConfig,
         for j in range(config.layers_per_block):
             x = _resblock(x, None, down["resnets"][j], g)
         if "downsample" in down:
-            x = _downsample(x, down["downsample"])
+            # diffusers VAE encoder downsample pads (0,1) asymmetrically
+            x = _downsample(x, down["downsample"], pad=((0, 1), (0, 1)))
     x = _resblock(x, None, p["mid_resnet1"], g)
     if "mid_attn" in p:
         x = _vae_mid_attention(x, p["mid_attn"], g)
@@ -322,7 +328,7 @@ def _init_resblock(rng, cin, cout, temb_dim, pdt):
 
 
 def _init_transformer(rng, c, ctx_dim, pdt):
-    ks = jax.random.split(rng, 10)
+    ks = jax.random.split(rng, 12)
     s = 1.0 / math.sqrt(c)
     lin = lambda k, i, o: (jax.random.normal(k, (i, o)) /
                            math.sqrt(i)).astype(pdt)
@@ -341,8 +347,8 @@ def _init_transformer(rng, c, ctx_dim, pdt):
                       "v_w": lin(ks[8], ctx_dim, c), "o_w": lin(ks[9], c, c),
                       "o_b": jnp.zeros((c,), pdt)},
             "norm3_scale": jnp.ones((c,), pdt), "norm3_bias": jnp.zeros((c,), pdt),
-            "ff_in_w": lin(ks[0], c, 8 * c), "ff_in_b": jnp.zeros((8 * c,), pdt),
-            "ff_out_w": lin(ks[1], 4 * c, c), "ff_out_b": jnp.zeros((c,), pdt),
+            "ff_in_w": lin(ks[10], c, 8 * c), "ff_in_b": jnp.zeros((8 * c,), pdt),
+            "ff_out_w": lin(ks[11], 4 * c, c), "ff_out_b": jnp.zeros((c,), pdt),
         },
     }
 
