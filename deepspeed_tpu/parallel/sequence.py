@@ -81,7 +81,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, causal: bool):
         vma = tuple(jax.typeof(q).vma)
     except (AttributeError, TypeError):  # pragma: no cover - older jax
         vma = ()
-    pvary = (lambda x: lax.pvary(x, vma)) if vma else (lambda x: x)
+    if vma and hasattr(lax, "pcast"):
+        pvary = lambda x: lax.pcast(x, vma, to="varying")
+    elif vma:  # pragma: no cover - pre-pcast jax
+        pvary = lambda x: lax.pvary(x, vma)
+    else:
+        pvary = lambda x: x
     m0 = pvary(jnp.full((B, H, S), NEG_INF, jnp.float32))
     l0 = pvary(jnp.zeros((B, H, S), jnp.float32))
     o0 = pvary(jnp.zeros((B, S, H, D), jnp.float32))
