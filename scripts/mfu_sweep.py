@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""On-chip MFU sweep for the GPT-2 350M headline bench.
+
+Runs `bench.py` under a sequence of tuning configurations (micro-batch and
+flash block sizes via the BENCH_MB / FLASH_BLOCK_Q / FLASH_BLOCK_K env
+knobs), appending one JSON line per run to the log.  Ordered safest-first;
+each run gets a generous timeout and is stopped with SIGTERM (never
+SIGKILL — a hard kill mid-TPU-operation has wedged the axon relay before;
+see docs/performance.md measurement notes).
+
+Usage:  python scripts/mfu_sweep.py [logfile]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (label, env overrides) — safest/known-good first so a wedge later in the
+#: list still leaves earlier numbers on the record
+CONFIGS = [
+    ("baseline-mb32-b1024", {}),
+    ("mb32-bq512", {"FLASH_BLOCK_Q": "512"}),
+    ("mb32-b512", {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_K": "512"}),
+    ("mb40", {"BENCH_MB": "40,32"}),
+    ("mb48", {"BENCH_MB": "48,40,32"}),
+    ("mb48-bq512", {"BENCH_MB": "48,40,32", "FLASH_BLOCK_Q": "512"}),
+]
+
+RUN_TIMEOUT_S = 1200
+TERM_GRACE_S = 180
+
+
+def run_one(label: str, env_over: dict, log):
+    env = {**os.environ, **env_over}
+    t0 = time.time()
+    proc = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True, cwd=REPO)
+    try:
+        out, _ = proc.communicate(timeout=RUN_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"[sweep] {label}: timed out, SIGTERM + grace\n")
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=TERM_GRACE_S)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[sweep] {label}: ignoring unterminated run "
+                            "(NOT killing: a SIGKILL mid-TPU-op wedges the "
+                            "relay); stop the sweep and wait it out\n")
+            return False
+    line = next((l for l in (out or "").splitlines()
+                 if l.startswith("{")), None)
+    try:
+        result = json.loads(line) if line else None
+    except json.JSONDecodeError:  # truncated line from a terminated run
+        result = {"parse_error": line[:200]}
+    rec = {"label": label, "env": env_over, "wall_s": round(time.time() - t0, 1),
+           "rc": proc.returncode, "result": result}
+    log.write(json.dumps(rec) + "\n")
+    log.flush()
+    mfu = (rec["result"] or {}).get("detail", {}).get("mfu")
+    sys.stderr.write(f"[sweep] {label}: mfu={mfu} rc={proc.returncode}\n")
+    return True
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mfu_sweep.jsonl"
+    with open(path, "a") as log:
+        for label, env_over in CONFIGS:
+            if not run_one(label, env_over, log):
+                break
+    sys.stderr.write(f"[sweep] results in {path}\n")
+
+
+if __name__ == "__main__":
+    main()
