@@ -46,6 +46,21 @@ def _ste_bwd(_, g):
 _ste.defvjp(_ste_fwd, _ste_bwd)
 
 
+def _binary_quant(w32):
+    """1-bit: sign(w) scaled by mean |w| (reference BinaryQuantizer,
+    basic_layer.py — XNOR-style scaling)."""
+    return jnp.sign(w32) * jnp.mean(jnp.abs(w32))
+
+
+def _ternary_quant(w32):
+    """2-bit ternary: {-a, 0, a} with threshold 0.7·mean|w| and ``a`` the
+    mean magnitude of the surviving weights (reference TernaryQuantizer)."""
+    thres = 0.7 * jnp.mean(jnp.abs(w32))
+    mask = (jnp.abs(w32) > thres).astype(jnp.float32)
+    alpha = jnp.sum(jnp.abs(w32) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sign(w32) * alpha * mask
+
+
 def fake_quantize_ste(w: jnp.ndarray, bits, symmetric: bool = True,
                       stochastic: bool = False,
                       key: Optional[jax.Array] = None) -> jnp.ndarray:
@@ -53,20 +68,32 @@ def fake_quantize_ste(w: jnp.ndarray, bits, symmetric: bool = True,
 
     ``bits`` may be a traced scalar (the schedule lowers it over steps
     in-graph).  Per-tensor scaling; symmetric or asymmetric (zero-point).
+    Symmetric mode extends below 3 bits with the reference's special
+    quantizers: ternary at 2 bits, binary at 1.  Asymmetric mode requires
+    >= 3 bits (the reference's symmetric-only restriction for
+    ternary/binary) — statically known lower bits raise; a traced schedule
+    scalar clamps to the 2-level floor instead.
     """
+    if not symmetric and isinstance(bits, (int, float)) and bits <= 2:
+        raise ValueError(
+            f"asymmetric quantization requires >= 3 bits (got {bits}); "
+            "ternary/binary quantization is symmetric-only")
     w32 = w.astype(jnp.float32)
     bits = jnp.asarray(bits, jnp.float32)
     if symmetric:
-        levels = jnp.power(2.0, bits - 1.0) - 1.0
+        levels = jnp.maximum(jnp.power(2.0, bits - 1.0) - 1.0, 1.0)
         amax = jnp.maximum(jnp.max(jnp.abs(w32)), 1e-8)
         scale = amax / levels
         q = w32 / scale
         q = q + jax.random.uniform(key, w32.shape, minval=-0.5, maxval=0.5) \
             if stochastic and key is not None else q
         q = jnp.clip(jnp.round(q), -levels, levels)
-        dq = q * scale
+        # all three paths trace (bits may be a schedule scalar); the select
+        # keeps one compiled program across the whole bits schedule
+        dq = jnp.where(bits <= 1.0, _binary_quant(w32),
+                       jnp.where(bits <= 2.0, _ternary_quant(w32), q * scale))
     else:
-        levels = jnp.power(2.0, bits) - 1.0
+        levels = jnp.maximum(jnp.power(2.0, bits) - 1.0, 1.0)
         lo, hi = jnp.min(w32), jnp.max(w32)
         scale = jnp.maximum(hi - lo, 1e-8) / levels
         q = (w32 - lo) / scale

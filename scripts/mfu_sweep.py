@@ -54,6 +54,11 @@ def run_one(label: str, env_over: dict, log):
             sys.stderr.write(f"[sweep] {label}: ignoring unterminated run "
                             "(NOT killing: a SIGKILL mid-TPU-op wedges the "
                             "relay); stop the sweep and wait it out\n")
+            log.write(json.dumps({"label": label, "env": env_over,
+                                  "wall_s": round(time.time() - t0, 1),
+                                  "rc": None, "timeout": True,
+                                  "result": None}) + "\n")
+            log.flush()
             return False
     line = next((l for l in (out or "").splitlines()
                  if l.startswith("{")), None)

@@ -212,3 +212,30 @@ def test_rejects_pipeline_models():
         dtype=jnp.float32, num_stages=2, num_micro_batches=2, vocab_round_to=128)
     with pytest.raises(ValueError, match="pipeline"):
         init_compression(gpt_pipeline.model_spec(pcfg, mm.mesh), WQ_CONFIG)
+
+
+def test_binary_and_ternary_quantizers():
+    """bits<=2 route through the reference's special quantizers (ternary
+    threshold 0.7 mean|w|, binary sign*mean|w|), stay finite, and keep STE
+    gradients."""
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 32)),
+                    jnp.float32)
+    # binary: exactly two magnitudes (+/- mean |w|)
+    b = fake_quantize_ste(w, 1)
+    assert bool(jnp.all(jnp.isfinite(b)))
+    np.testing.assert_allclose(np.unique(np.abs(np.asarray(b))),
+                               [float(jnp.mean(jnp.abs(w)))], rtol=1e-6)
+    # ternary: {-a, 0, a}, zeros below 0.7*mean|w|
+    t = fake_quantize_ste(w, 2)
+    vals = np.unique(np.round(np.asarray(t), 6))
+    assert len(vals) == 3 and vals[1] == 0.0
+    thres = 0.7 * float(jnp.mean(jnp.abs(w)))
+    np.testing.assert_array_equal(np.asarray(t) == 0.0,
+                                  np.abs(np.asarray(w)) <= thres)
+    # STE: gradient of sum(quantized) w.r.t. w is identity
+    g = jax.grad(lambda x: jnp.sum(fake_quantize_ste(x, 1)))(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+    # traced bits schedule down to 1 bit compiles once and stays finite
+    f = jax.jit(lambda w, bits: fake_quantize_ste(w, bits))
+    for bits in (8.0, 4.0, 2.0, 1.0):
+        assert bool(jnp.all(jnp.isfinite(f(w, jnp.float32(bits)))))
