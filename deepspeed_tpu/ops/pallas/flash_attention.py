@@ -258,8 +258,23 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                    block_q, block_k, offset, use_lens, H):
+                    dk_ref, dv_ref, *rest, sm_scale, causal,
+                    block_q, block_k, offset, use_lens, H, emit_dq):
+    """K-sweep backward kernel, two forms selected by the static
+    ``emit_dq``:
+
+    - ``emit_dq=False``: the dk/dv half of the classic two-kernel backward
+      (dq comes from ``_bwd_dq_kernel``'s separate sweep).
+    - ``emit_dq=True``: the fused single-sweep backward — this K-block's dq
+      contribution is additionally emitted to a per-ki partial buffer
+      (each (bh, ki, qi) block written exactly once; XLA sums over ki),
+      removing the dq kernel's recomputation of s and dp and its extra
+      pass over q/k/v/do: 7 → 5 matmul-equivalents.
+    """
+    if emit_dq:
+        dqp_ref, dk_acc, dv_acc = rest
+    else:
+        dqp_ref, (dk_acc, dv_acc) = None, rest
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -299,6 +314,13 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        if emit_dq:
+            dqp_ref[0, 0] = jnp.dot(ds, ks,
+                                    preferred_element_type=jnp.float32)
+
+    def _idle():
+        # every dq-partial block must be written (unwritten = garbage)
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
 
     if causal or use_lens:
         crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
@@ -306,13 +328,21 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
         pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
             lambda: _update(False))
+        if emit_dq:
+            pl.when(jnp.logical_not(run))(_idle)
     else:
+        # run is the literal True here: every block executes _update
         pl.when(run)(lambda: _update(False))
 
     @pl.when(qi == nq - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+#: ki extent above which the fused single-sweep backward's dq-partial
+#: buffer (nk x |dq| fp32) costs more HBM than the second sweep saves
+MAX_FUSED_BWD_NK = 4
 
 
 def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
@@ -324,6 +354,46 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
     lens_arr = jnp.asarray(lens if lens is not None else [0], jnp.int32)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]                   # (BH, 1, Sq)
+
+    nk = Sk // block_k
+    if nk <= MAX_FUSED_BWD_NK:
+        fused = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                                  causal=causal, block_q=block_q,
+                                  block_k=block_k, offset=offset,
+                                  use_lens=use_lens, H=H, emit_dq=True)
+        dk, dv, dqp = pl.pallas_call(
+            fused,
+            grid=(BH, nk, Sq // block_q),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+                pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+                pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda bh, ki, qi: (bh, ki, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+                jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+                jax.ShapeDtypeStruct((BH, nk, Sq, D), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            interpret=interpret_mode(),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+        )(lens_arr, q3, k3, v3, do3, lse, delta)
+        dq = jnp.sum(dqp, axis=1).astype(q3.dtype)
+        return dq, dk, dv
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block_q=block_q,
@@ -352,7 +422,7 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, offset=offset,
-                                   use_lens=use_lens, H=H)
+                                   use_lens=use_lens, H=H, emit_dq=False)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, Sk // block_k, Sq // block_q),

@@ -54,6 +54,61 @@ def test_flash_attention_backward(pallas_interpret, causal):
                                    atol=1e-4, rtol=1e-4, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_fused_and_two_kernel_paths_agree(pallas_interpret,
+                                                         causal):
+    """The single-sweep fused backward (nk <= MAX_FUSED_BWD_NK) and the
+    two-kernel dq/dkv form (nk above it) must both match the dense
+    reference on the same inputs."""
+    from deepspeed_tpu.ops.pallas import flash_attention, mha_reference
+    from deepspeed_tpu.ops.pallas.flash_attention import MAX_FUSED_BWD_NK
+    shape = (1, 768, 2, 32)   # block_k=128 -> nk=6 (two-kernel); 256 -> 3
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+
+    def grads(block_k):
+        return jax.grad(lambda a, b, c: jnp.sum(jnp.square(
+            flash_attention(a, b, c, causal=causal, block_q=128,
+                            block_k=block_k))), argnums=(0, 1, 2))(q, k, v)
+
+    assert 768 // 128 > MAX_FUSED_BWD_NK >= 768 // 256
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(jnp.square(
+        mha_reference(a, b, c, causal=causal))), argnums=(0, 1, 2))(q, k, v)
+    for block_k in (128, 256):
+        for g, r, name in zip(grads(block_k), g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=2e-4, rtol=2e-4,
+                err_msg=f"d{name} block_k={block_k}")
+
+
+@pytest.mark.parametrize("seq", [256, 640])   # nk=2 (fused) / nk=5 (2-kernel)
+def test_flash_backward_with_kv_lens_both_paths(pallas_interpret, seq):
+    """Right-padded rows through BOTH backward forms: the fused kernel's
+    masked/idle branches at nk=2 and the two-kernel dq/dkv lens masking at
+    nk above MAX_FUSED_BWD_NK."""
+    from deepspeed_tpu.ops.pallas import flash_attention, mha_reference
+    from deepspeed_tpu.ops.pallas.flash_attention import MAX_FUSED_BWD_NK
+    assert (seq // 128 <= MAX_FUSED_BWD_NK) == (seq == 256)
+    shape = (2, seq, 2, 32)
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+    lens = jnp.asarray([seq // 2 - 28, seq], jnp.int32)
+    w = (jnp.arange(seq)[None, :, None, None] < lens[:, None, None, None])
+
+    def loss(fn):
+        return lambda a, b, c: jnp.sum(jnp.square(
+            fn(a, b, c) * w.astype(a.dtype)))
+
+    g_k = jax.grad(loss(lambda a, b, c: flash_attention(
+        a, b, c, causal=False, kv_lens=lens, block_q=128, block_k=128)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss(lambda a, b, c: mha_reference(
+        a, b, c, causal=False, kv_lens=lens)), argnums=(0, 1, 2))(q, k, v)
+    for g, r, name in zip(g_k, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4,
+                                   rtol=2e-4, err_msg=f"d{name}")
+
+
 def test_flash_attention_cross_length_causal(pallas_interpret):
     """Sq != Sk causal (decode-style): kernel matches the end-aligned
     reference semantics, so the kernel and fallback paths agree."""
