@@ -167,7 +167,13 @@ def init_inference(model=None, config=None, **kwargs):
     cfg_dict.update(kwargs)
     inf_config = DeepSpeedInferenceConfig.from_dict(cfg_dict)
 
+    from .models import bert as bert_mod
     from .models import gpt as gpt_mod
+    if isinstance(model, tuple) and len(model) == 2 \
+            and isinstance(model[0], bert_mod.BertConfig):
+        from .inference.engine import BertInferenceEngine
+        return BertInferenceEngine(model[0], model[1], inf_config,
+                                   mesh_manager=get_mesh_manager(optional=True))
     if isinstance(model, tuple) and len(model) == 2 \
             and isinstance(model[0], gpt_mod.GPTConfig):
         model_config, params = model
@@ -175,6 +181,11 @@ def init_inference(model=None, config=None, **kwargs):
         assert model.params is not None, \
             "init_inference(ModelSpec) needs materialized params"
         model_config, params = model.meta["config"], model.params
+        if isinstance(model_config, bert_mod.BertConfig):
+            from .inference.engine import BertInferenceEngine
+            return BertInferenceEngine(
+                model_config, params, inf_config,
+                mesh_manager=get_mesh_manager(optional=True))
     else:
         # generic (diffusers) policies first, matched on the state dict —
         # the reference's generic_policies loop (replace_module.py); a
@@ -196,6 +207,23 @@ def init_inference(model=None, config=None, **kwargs):
                         sd, dtype=dtype,
                         enable_cuda_graph=inf_config.enable_cuda_graph,
                         **extra)
+            from .module_inject.replace_policy import HFBertLayerPolicy
+            # RoBERTa/ELECTRA share BERT's attention key names but not the
+            # embeddings layout the converter handles — require the exact
+            # BertForMaskedLM/BertModel prefix so unsupported models fall
+            # through to the clear "no policy matched" error
+            convertible_bert = (
+                HFBertLayerPolicy.match(sd) and hasattr(model, "config") and
+                ("bert.embeddings.word_embeddings.weight" in sd or
+                 "embeddings.word_embeddings.weight" in sd))
+            if convertible_bert:
+                from .inference.engine import BertInferenceEngine
+                bcfg = HFBertLayerPolicy.model_config(model.config,
+                                                      dtype=dtype)
+                bparams = HFBertLayerPolicy.convert(sd, bcfg)
+                return BertInferenceEngine(
+                    bcfg, bparams, inf_config,
+                    mesh_manager=get_mesh_manager(optional=True))
         from .module_inject import convert_hf_model
         model_config, params = convert_hf_model(
             model, dtype=inf_config.jnp_dtype)

@@ -32,6 +32,56 @@ from .config import DeepSpeedInferenceConfig
 PyTree = Any
 
 
+def _serving_dtype(config: DeepSpeedInferenceConfig):
+    """(compute dtype, weight_int8): dtype="int8" means weight-only int8
+    serving (reference pt_binding.cpp int8 gemm paths) — weights stored
+    int8 + grouped scales, activations/compute bf16 on the MXU."""
+    dtype = config.jnp_dtype
+    if dtype == jnp.int8:
+        return jnp.bfloat16, True
+    return dtype, False
+
+
+def _validate_tp(config: DeepSpeedInferenceConfig, mesh_manager) -> bool:
+    """Shared TP config/mesh validation; returns whether to shard."""
+    mesh_tp = (mesh_manager.mesh.shape.get(MODEL_AXIS, 1)
+               if mesh_manager is not None else 1)
+    want_tp = config.tp.enabled and config.tp_size > 1
+    if want_tp and mesh_tp <= 1:
+        raise ValueError(
+            f"tensor_parallel.tp_size={config.tp_size} requested but the "
+            f"mesh has no model axis (model={mesh_tp}); initialize a "
+            "mesh with tp first (ParallelDims(tp=...))")
+    if want_tp and mesh_tp != config.tp_size:
+        raise ValueError(
+            f"tensor_parallel.tp_size={config.tp_size} does not match "
+            f"the mesh's model axis ({mesh_tp})")
+    if mesh_tp > 1 and not want_tp:
+        logger.warning(
+            f"mesh has model={mesh_tp} but tensor_parallel disabled in "
+            "the inference config; serving replicated (unsharded)")
+    return want_tp
+
+
+def _shard_and_quantize(params: PyTree, logical_axes, mesh_manager,
+                        want_tp: bool, weight_int8: bool) -> PyTree:
+    """Shared TP sharding (the reference's ReplaceWithTensorSlicing, done
+    declaratively) + weight-only int8 conversion."""
+    if want_tp:
+        from ..models.partitioning import TP_RULES, tree_shardings
+        mesh = mesh_manager.mesh
+        shardings = tree_shardings(logical_axes, mesh, TP_RULES)
+        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        logger.info(f"[inference] TP sharding over model axis "
+                    f"({mesh.shape[MODEL_AXIS]} ways)")
+    if weight_int8:
+        from .quantization import quantize_params_int8
+        params, n_q = quantize_params_int8(params)
+        logger.info(f"[inference] int8 weight-only serving: {n_q} "
+                    "weights stored as int8 codes + per-vector scales")
+    return params
+
+
 class InferenceEngine:
     """Wraps (config, params) with jitted prefill/decode/generate."""
 
@@ -40,33 +90,12 @@ class InferenceEngine:
                  mesh_manager: Optional[MeshManager] = None):
         self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
         self._config = config
-        dtype = config.jnp_dtype
-        # dtype="int8" means weight-only int8 serving (reference
-        # pt_binding.cpp int8 gemm paths): weights stored int8 + grouped
-        # scales, activations/compute bf16 on the MXU
-        self._weight_int8 = dtype == jnp.int8
-        if self._weight_int8:
-            dtype = jnp.bfloat16
+        dtype, self._weight_int8 = _serving_dtype(config)
         self.model_config = dataclasses.replace(model_config, dtype=dtype)
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
             else p, params)
-        mesh_tp = (self.mesh_manager.mesh.shape.get(MODEL_AXIS, 1)
-                   if self.mesh_manager is not None else 1)
-        want_tp = config.tp.enabled and config.tp_size > 1
-        if want_tp and mesh_tp <= 1:
-            raise ValueError(
-                f"tensor_parallel.tp_size={config.tp_size} requested but the "
-                f"mesh has no model axis (model={mesh_tp}); initialize a "
-                "mesh with tp first (ParallelDims(tp=...))")
-        if want_tp and mesh_tp != config.tp_size:
-            raise ValueError(
-                f"tensor_parallel.tp_size={config.tp_size} does not match "
-                f"the mesh's model axis ({mesh_tp})")
-        if mesh_tp > 1 and not want_tp:
-            logger.warning(
-                f"mesh has model={mesh_tp} but tensor_parallel disabled in "
-                "the inference config; serving replicated (unsharded)")
+        want_tp = _validate_tp(config, self.mesh_manager)
         # model-family dispatch: dense GPT vs MoE (reference MoE inference,
         # ops/transformer/inference/moe_inference.py + engine.py:190 expert
         # groups — here the expert mesh axis shards the expert stacks)
@@ -82,28 +111,11 @@ class InferenceEngine:
             self._apply_fn = lambda p, t: gpt.apply(p, t, cfg)
             self._logical_axes = gpt.logical_axes(cfg)
         self._family = fam
-        if want_tp:
-            self._shard_params_tp()
-        if self._weight_int8:
-            from .quantization import quantize_params_int8
-            self.params, n_q = quantize_params_int8(self.params)
-            logger.info(f"[inference] int8 weight-only serving: {n_q} "
-                        "weights stored as int8 codes + per-vector scales")
+        self.params = _shard_and_quantize(
+            self.params, self._logical_axes, self.mesh_manager, want_tp,
+            self._weight_int8)
         self._forward_jit = jax.jit(self._apply_fn)
         self._generate_cache: Dict[Tuple, Any] = {}
-
-    # ------------------------------------------------------------------- tp
-
-    def _shard_params_tp(self) -> None:
-        """Head/ffn-dim sharding over the 'model' axis (the reference's
-        ReplaceWithTensorSlicing, done declaratively)."""
-        from ..models.partitioning import TP_RULES, tree_shardings
-        mesh = self.mesh_manager.mesh
-        shardings = tree_shardings(self._logical_axes, mesh, TP_RULES)
-        self.params = jax.tree_util.tree_map(
-            jax.device_put, self.params, shardings)
-        logger.info(f"[inference] TP sharding over model axis "
-                    f"({mesh.shape[MODEL_AXIS]} ways)")
 
     # -------------------------------------------------------------- forward
 
@@ -239,13 +251,95 @@ class InferenceEngine:
     # ----------------------------------------------------------- checkpoint
 
     def save_16bit_model(self, path: str) -> None:
-        from .quantization import Int8Param
-        # int8 engines dequantize to the compute dtype first: the contract
-        # is a 16-bit weight per leaf under the leaf's own key
-        params = jax.tree_util.tree_map(
-            lambda p: p.astype(self.model_config.dtype)
-            if isinstance(p, Int8Param) else p,
-            self.params, is_leaf=lambda p: isinstance(p, Int8Param))
-        flat, _ = jax.tree_util.tree_flatten_with_path(params)
-        arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
-        np.savez(path, **arrays)
+        _save_16bit(self.params, self.model_config.dtype, path)
+
+
+def _save_16bit(params, dtype, path: str) -> None:
+    from .quantization import Int8Param
+    # int8 engines dequantize to the compute dtype first: the contract
+    # is a 16-bit weight per leaf under the leaf's own key
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(dtype) if isinstance(p, Int8Param) else p,
+        params, is_leaf=lambda p: isinstance(p, Int8Param))
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
+    np.savez(path, **arrays)
+
+
+class BertInferenceEngine:
+    """Encoder-family serving: one jitted full-sequence forward (no KV
+    cache).  The reference injects BERT through the same replace_module
+    path as the decoder families (``module_inject/replace_policy.py:143``
+    HFBertLayerPolicy → ``DeepSpeedTransformerInference`` in encoder
+    mode); here the native ``models/bert.py`` encoder serves, with the
+    same dtype / TP-sharding / weight-only-int8 treatment as
+    :class:`InferenceEngine`."""
+
+    def __init__(self, model_config, params: PyTree,
+                 config: DeepSpeedInferenceConfig,
+                 mesh_manager: Optional[MeshManager] = None):
+        from ..models import bert
+        self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
+        self._config = config
+        dtype, self._weight_int8 = _serving_dtype(config)
+        self.model_config = dataclasses.replace(model_config, dtype=dtype)
+        self.params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
+            else p, params)
+        want_tp = _validate_tp(config, self.mesh_manager)
+        self.params = _shard_and_quantize(
+            self.params, bert.logical_axes(self.model_config),
+            self.mesh_manager, want_tp, self._weight_int8)
+        cfg = self.model_config
+        # separate compiled programs for the masked/unmasked shapes (the
+        # concrete-mask fast path in bert.encode must see None statically)
+        self._fwd = jax.jit(
+            lambda p, t, tt: bert.apply(p, t, cfg, tt))
+        self._fwd_masked = jax.jit(
+            lambda p, t, tt, am: bert.apply(p, t, cfg, tt, am))
+        self._enc = jax.jit(
+            lambda p, t, tt: bert.encode(p, t, cfg, tt))
+        self._enc_masked = jax.jit(
+            lambda p, t, tt, am: bert.encode(p, t, cfg, tt, am))
+        self._pool = jax.jit(
+            lambda p, t, tt: bert.pooled_output(
+                p, bert.encode(p, t, cfg, tt), cfg))
+        self._pool_masked = jax.jit(
+            lambda p, t, tt, am: bert.pooled_output(
+                p, bert.encode(p, t, cfg, tt, am), cfg))
+
+    def _args(self, tokens, token_type_ids, attention_mask):
+        """Normalized (tokens, type ids, mask-or-None); an all-ones mask
+        collapses to None so the unmasked program serves it."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        tt = jnp.zeros_like(tokens) if token_type_ids is None \
+            else jnp.asarray(token_type_ids, jnp.int32)
+        if attention_mask is not None and np.asarray(attention_mask).all():
+            attention_mask = None
+        return tokens, tt, attention_mask
+
+    def forward(self, tokens, token_type_ids=None, attention_mask=None):
+        """tokens [B, S] → MLM logits [B, S, padded_vocab] fp32."""
+        tokens, tt, am = self._args(tokens, token_type_ids, attention_mask)
+        if am is not None:
+            return self._fwd_masked(self.params, tokens, tt, jnp.asarray(am))
+        return self._fwd(self.params, tokens, tt)
+
+    __call__ = forward
+
+    def encode(self, tokens, token_type_ids=None, attention_mask=None):
+        """tokens [B, S] → hidden states [B, S, d]."""
+        tokens, tt, am = self._args(tokens, token_type_ids, attention_mask)
+        if am is not None:
+            return self._enc_masked(self.params, tokens, tt, jnp.asarray(am))
+        return self._enc(self.params, tokens, tt)
+
+    def pooled(self, tokens, token_type_ids=None, attention_mask=None):
+        """tokens [B, S] → [CLS] pooler output [B, d]."""
+        tokens, tt, am = self._args(tokens, token_type_ids, attention_mask)
+        if am is not None:
+            return self._pool_masked(self.params, tokens, tt, jnp.asarray(am))
+        return self._pool(self.params, tokens, tt)
+
+    def save_16bit_model(self, path: str) -> None:
+        _save_16bit(self.params, self.model_config.dtype, path)

@@ -1,0 +1,116 @@
+"""BERT serving through init_inference (reference injects BERT via the same
+replace_module path as decoder families; here the native encoder serves)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.engine import BertInferenceEngine
+from deepspeed_tpu.models import bert
+
+CFG = bert.BertConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=4,
+                      d_model=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def engine_and_params():
+    params = bert.init(CFG, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model=(CFG, params),
+                                       config={"dtype": "float32"})
+    return eng, params
+
+
+def test_dispatch_and_forward_parity(engine_and_params):
+    eng, params = engine_and_params
+    assert isinstance(eng, BertInferenceEngine)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 32)), jnp.int32)
+    got = eng(tokens)
+    want = bert.apply(params, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    assert got.shape == (2, 32, CFG.padded_vocab)
+
+
+def test_masked_forward_and_pooled(engine_and_params):
+    eng, params = engine_and_params
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 32)), jnp.int32)
+    mask = np.ones((2, 32), np.int32)
+    mask[0, 20:] = 0
+    got = eng(tokens, attention_mask=mask)
+    want = bert.apply(params, tokens, CFG, attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    pooled = eng.pooled(tokens)
+    assert pooled.shape == (2, CFG.d_model)
+    hidden = eng.encode(tokens)
+    assert hidden.shape == (2, 32, CFG.d_model)
+    # padded batches mask through encode/pooled too (pad keys must not
+    # leak into attention)
+    h_masked = eng.encode(tokens, attention_mask=mask)
+    h_want = bert.encode(params, tokens, CFG,
+                         attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(h_masked), np.asarray(h_want),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(h_masked), np.asarray(hidden))
+    p_masked = eng.pooled(tokens, attention_mask=mask)
+    assert p_masked.shape == (2, CFG.d_model)
+
+
+def test_bert_model_spec_dispatch():
+    """The third documented entry point: a BERT ModelSpec with materialized
+    params routes to the encoder engine, not the GPT path."""
+    import dataclasses
+    spec = dataclasses.replace(bert.model_spec(CFG),
+                               params=bert.init(CFG, jax.random.PRNGKey(3)))
+    eng = deepspeed_tpu.init_inference(model=spec,
+                                       config={"dtype": "float32"})
+    assert isinstance(eng, BertInferenceEngine)
+    tokens = jnp.asarray(np.random.default_rng(4).integers(
+        0, 256, size=(1, 16)), jnp.int32)
+    assert eng(tokens).shape == (1, 16, CFG.padded_vocab)
+
+
+def test_hf_bert_module_dispatches_to_encoder_engine():
+    """init_inference on a live HF BertForMaskedLM routes through
+    HFBertLayerPolicy to the encoder engine with logit parity."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    eng = deepspeed_tpu.init_inference(model=hf,
+                                       config={"dtype": "float32"})
+    assert isinstance(eng, BertInferenceEngine)
+    tokens = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.numpy()
+    got = np.asarray(eng(tokens))[:, :, :128]
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_bert_int8_serving():
+    from deepspeed_tpu.inference.quantization import Int8Param
+    params = bert.init(CFG, jax.random.PRNGKey(0))
+    bf16 = deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "bfloat16"})
+    int8 = deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "int8"})
+    assert isinstance(int8.params["blocks"]["wqkv"], Int8Param)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 32)), jnp.int32)
+    lg_bf16 = np.asarray(bf16(tokens), np.float32)
+    lg_int8 = np.asarray(int8(tokens), np.float32)
+    # log-softmax drift from weight quantization stays small
+    p_bf16 = jax.nn.log_softmax(lg_bf16[..., :256], axis=-1)
+    p_int8 = jax.nn.log_softmax(lg_int8[..., :256], axis=-1)
+    assert float(jnp.mean(jnp.abs(p_bf16 - p_int8))) < 0.05
