@@ -215,12 +215,15 @@ def init_inference(model=None, config=None, **kwargs):
             convertible_bert = (
                 HFBertLayerPolicy.match(sd) and hasattr(model, "config") and
                 ("bert.embeddings.word_embeddings.weight" in sd or
-                 "embeddings.word_embeddings.weight" in sd))
+                 "embeddings.word_embeddings.weight" in sd) and
+                # task heads (classification/QA) would be silently dropped
+                # — only the MLM/encoder surface converts
+                not any(k.startswith(("classifier.", "qa_outputs."))
+                        for k in sd))
             if convertible_bert:
                 from .inference.engine import BertInferenceEngine
-                bcfg = HFBertLayerPolicy.model_config(model.config,
-                                                      dtype=dtype)
-                bparams = HFBertLayerPolicy.convert(sd, bcfg)
+                from .module_inject.replace_policy import convert_hf_bert
+                bcfg, bparams = convert_hf_bert(model, dtype=dtype)
                 return BertInferenceEngine(
                     bcfg, bparams, inf_config,
                     mesh_manager=get_mesh_manager(optional=True))
