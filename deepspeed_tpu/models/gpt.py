@@ -376,6 +376,19 @@ def _attention(q, k, v, config: GPTConfig, window=None):
 
 def _attention_impl(q, k, v, config: GPTConfig, window=None):
     if window is not None:
+        # long sequences take the banded flash kernel: O(S·window) FLOPs
+        # at O(block) memory, tiles below the band skipped; a traced
+        # window >= S degenerates to pure causal, so ONE kernel serves
+        # the whole alternating global/local stack (no lax.cond)
+        from ..ops.pallas import flash_attention as _fa
+        from ..ops.pallas.flash_attention import (FLASH_MIN_SEQ, _pick_block,
+                                                  use_pallas)
+        Sq, Sk = q.shape[1], k.shape[1]
+        if (config.use_flash_attention and use_pallas()
+                and Sq >= FLASH_MIN_SEQ and Sq <= Sk
+                and _pick_block(Sq, 1024) and _pick_block(Sk, 1024)):
+            return _fa(q, k, v, causal=True,
+                       sm_scale=config.attn_softmax_scale, window=window)
         if config.local_attention_alternating:
             return lax.cond(
                 window >= k.shape[1],

@@ -87,36 +87,59 @@ def _lens_mask(s, ki, block_k, kv_len):
     return jnp.where(k_pos < kv_len, s, NEG_INF)
 
 
+def _band_lower_mask(s, qi, ki, block_q, block_k, offset, window):
+    """Mask keys below the banded-causal window: keep k_pos such that
+    q_pos + offset - k_pos < window (GPT-Neo local attention; ``window``
+    is a traced scalar, >= Sk degenerates to no-op pure causal)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos + offset - k_pos < window, s, NEG_INF)
+
+
 def _block_visible(qi, ki, block_q, block_k, offset):
     """Whether any (q, k) pair in this tile survives the causal mask."""
     return ki * block_k <= qi * block_q + block_q - 1 + offset
 
 
 def _block_crosses_mask(qi, ki, block_q, block_k, offset, causal, use_lens,
-                        kv_len):
+                        kv_len, use_window=False, window=0):
     """Whether this tile needs masking at all.  Interior tiles (fully below
-    the diagonal AND fully inside every row's live prefix) skip the
-    iota/compare/select VPU work — on short-head-dim shapes the kernels are
-    VPU-bound (exp + mask ops), not MXU-bound, so this is the fast path."""
+    the diagonal AND fully inside every row's live prefix AND inside the
+    band) skip the iota/compare/select VPU work — on short-head-dim shapes
+    the kernels are VPU-bound (exp + mask ops), not MXU-bound, so this is
+    the fast path."""
     crosses = False
     if causal:
         # last key column of the tile vs first query row of the tile
         crosses = (ki + 1) * block_k - 1 > qi * block_q + offset
     if use_lens:
         crosses = jnp.logical_or(crosses, (ki + 1) * block_k > kv_len)
+    if use_window:
+        # some (q, k) pair falls below the band's lower edge: the tile's
+        # max distance (last q row vs first k column) reaches the window
+        max_dist = (qi + 1) * block_q - 1 + offset - ki * block_k
+        crosses = jnp.logical_or(crosses, max_dist >= window)
     return crosses
+
+
+def _band_block_visible(qi, ki, block_q, block_k, offset, window):
+    """Whether any pair in this tile is inside the band's lower edge (the
+    min distance — first q row vs last k column — must be < window)."""
+    return qi * block_q + offset - ((ki + 1) * block_k - 1) < window
 
 
 # ------------------------------------------------------------------- forward
 
-def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(lens_ref, win_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *,
-                sm_scale, causal, block_q, block_k, offset, use_lens, H):
+                sm_scale, causal, block_q, block_k, offset, use_lens,
+                use_window, H):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     kv_len = lens_ref[bh // H] if use_lens else 0
+    window = win_ref[0] if use_window else 0
 
     @pl.when(ki == 0)
     def _init():
@@ -127,6 +150,9 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
     if use_lens:
         run = jnp.logical_and(run, ki * block_k < kv_len)
+    if use_window:
+        run = jnp.logical_and(run, _band_block_visible(
+            qi, ki, block_q, block_k, offset, window))
 
     def _update(masked: bool):
         # MXU operands stay in the input dtype (bf16 in production) with
@@ -141,19 +167,26 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
+        if masked and use_window:
+            s = _band_lower_mask(s, qi, ki, block_q, block_k, offset, window)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        # banded/lens tiles can fully mask a row (m_new still -inf): guard
+        # the subtraction so exp(-inf - -inf) never produces NaN — the
+        # row's p and alpha correctly come out 0
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(m_prev - m_safe)
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p.astype(vs.dtype), vs, preferred_element_type=jnp.float32)
 
-    if causal or use_lens:
+    if causal or use_lens or use_window:
         crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
-                                      causal, use_lens, kv_len)
+                                      causal, use_lens, kv_len,
+                                      use_window, window)
         pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
         pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
             lambda: _update(False))
@@ -167,18 +200,22 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0, :] = (m_ref[...] + jnp.log(l))[:, 0]
 
 
-def _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
+def _fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     offset = Sk - Sq
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k, offset=offset,
-                               use_lens=lens is not None, H=H)
+                               use_lens=lens is not None,
+                               use_window=win is not None, H=H)
     lens_arr = jnp.asarray(lens if lens is not None else [0], jnp.int32)
+    win_arr = jnp.asarray([win] if win is not None else [0],
+                          jnp.int32).reshape(1)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // block_q, Sk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
@@ -200,20 +237,21 @@ def _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
         interpret=interpret_mode(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(lens_arr, q3, k3, v3)
+    )(lens_arr, win_arr, q3, k3, v3)
     return o, lse
 
 
 # ------------------------------------------------------------------ backward
 
-def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, sm_scale, causal, block_q, block_k,
-                   offset, use_lens, H):
+def _bwd_dq_kernel(lens_ref, win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, sm_scale, causal, block_q,
+                   block_k, offset, use_lens, use_window, H):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     kv_len = lens_ref[bh // H] if use_lens else 0
+    window = win_ref[0] if use_window else 0
 
     @pl.when(ki == 0)
     def _init():
@@ -222,6 +260,9 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = _block_visible(qi, ki, block_q, block_k, offset) if causal else True
     if use_lens:
         run = jnp.logical_and(run, ki * block_k < kv_len)
+    if use_window:
+        run = jnp.logical_and(run, _band_block_visible(
+            qi, ki, block_q, block_k, offset, window))
 
     def _update(masked: bool):
         # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
@@ -237,15 +278,18 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
+        if masked and use_window:
+            s = _band_lower_mask(s, qi, ki, block_q, block_k, offset, window)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dp = jax.lax.dot_general(do, vs, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * sm_scale).astype(ks.dtype)
         dq_acc[...] += jnp.dot(ds, ks, preferred_element_type=jnp.float32)
 
-    if causal or use_lens:
+    if causal or use_lens or use_window:
         crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
-                                      causal, use_lens, kv_len)
+                                      causal, use_lens, kv_len,
+                                      use_window, window)
         pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
         pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
             lambda: _update(False))
@@ -257,9 +301,10 @@ def _bwd_dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *rest, sm_scale, causal,
-                    block_q, block_k, offset, use_lens, H, emit_dq):
+def _bwd_dkv_kernel(lens_ref, win_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *rest, sm_scale, causal,
+                    block_q, block_k, offset, use_lens, use_window, H,
+                    emit_dq):
     """K-sweep backward kernel, two forms selected by the static
     ``emit_dq``:
 
@@ -280,6 +325,7 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
     kv_len = lens_ref[bh // H] if use_lens else 0
+    window = win_ref[0] if use_window else 0
 
     @pl.when(qi == 0)
     def _init():
@@ -290,6 +336,9 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if use_lens:
         # the whole K block is beyond this row's live prefix: dk/dv stay 0
         run = jnp.logical_and(run, ki * block_k < kv_len)
+    if use_window:
+        run = jnp.logical_and(run, _band_block_visible(
+            qi, ki, block_q, block_k, offset, window))
 
     def _update(masked: bool):
         # input-dtype MXU operands, f32 accumulate (see _fwd_kernel note)
@@ -305,6 +354,8 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         if masked and use_lens:
             s = _lens_mask(s, ki, block_k, kv_len)
+        if masked and use_window:
+            s = _band_lower_mask(s, qi, ki, block_q, block_k, offset, window)
         p = jnp.exp(s - lse)                               # (BQ, BK)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -322,9 +373,10 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # every dq-partial block must be written (unwritten = garbage)
         dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
 
-    if causal or use_lens:
+    if causal or use_lens or use_window:
         crosses = _block_crosses_mask(qi, ki, block_q, block_k, offset,
-                                      causal, use_lens, kv_len)
+                                      causal, use_lens, kv_len,
+                                      use_window, window)
         pl.when(jnp.logical_and(run, crosses))(lambda: _update(True))
         pl.when(jnp.logical_and(run, jnp.logical_not(crosses)))(
             lambda: _update(False))
@@ -345,26 +397,29 @@ def _bwd_dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 MAX_FUSED_BWD_NK = 4
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
-         H):
+def _bwd(q3, k3, v3, o3, lse, do3, lens, win, causal, sm_scale, block_q,
+         block_k, H):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     offset = Sk - Sq
     use_lens = lens is not None
     lens_arr = jnp.asarray(lens if lens is not None else [0], jnp.int32)
+    win_arr = jnp.asarray([win] if win is not None else [0],
+                          jnp.int32).reshape(1)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]                   # (BH, 1, Sq)
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, offset=offset, use_lens=use_lens,
+                  use_window=win is not None, H=H)
 
     nk = Sk // block_k
     if nk <= MAX_FUSED_BWD_NK:
-        fused = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                                  causal=causal, block_q=block_q,
-                                  block_k=block_k, offset=offset,
-                                  use_lens=use_lens, H=H, emit_dq=True)
+        fused = functools.partial(_bwd_dkv_kernel, emit_dq=True, **common)
         dk, dv, dqp = pl.pallas_call(
             fused,
             grid=(BH, nk, Sq // block_q),
             in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
                 pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -391,18 +446,16 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
             interpret=interpret_mode(),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
-        )(lens_arr, q3, k3, v3, do3, lse, delta)
+        )(lens_arr, win_arr, q3, k3, v3, do3, lse, delta)
         dq = jnp.sum(dqp, axis=1).astype(q3.dtype)
         return dq, dk, dv
 
-    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                                  causal=causal, block_q=block_q,
-                                  block_k=block_k, offset=offset,
-                                  use_lens=use_lens, H=H)
+    dq_kernel = functools.partial(_bwd_dq_kernel, **common)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, Sq // block_q, Sk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
@@ -417,16 +470,14 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
         interpret=interpret_mode(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(lens_arr, q3, k3, v3, do3, lse, delta)
+    )(lens_arr, win_arr, q3, k3, v3, do3, lse, delta)
 
-    dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                                   causal=causal, block_q=block_q,
-                                   block_k=block_k, offset=offset,
-                                   use_lens=use_lens, H=H, emit_dq=False)
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, emit_dq=False, **common)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, Sk // block_k, Sq // block_q),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -450,31 +501,34 @@ def _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale, block_q, block_k,
         interpret=interpret_mode(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(lens_arr, q3, k3, v3, do3, lse, delta)
+    )(lens_arr, win_arr, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------------- custom vjp
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
-    o, _ = _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H):
+    o, _ = _fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H)
     return o
 
 
-def _flash_fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H):
-    o, lse = _fwd(q3, k3, v3, lens, causal, sm_scale, block_q, block_k, H)
-    return o, (q3, k3, v3, o, lse, lens)
+def _flash_fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H):
+    o, lse = _fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k,
+                  H)
+    return o, (q3, k3, v3, o, lse, lens, win)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, H, res, do3):
     import numpy as np
-    q3, k3, v3, o3, lse, lens = res
-    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, lens, causal, sm_scale,
+    q3, k3, v3, o3, lse, lens, win = res
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, lens, win, causal, sm_scale,
                       block_q, block_k, H)
-    # int32 lens: float0 cotangent (non-differentiable input)
+    # int32 lens/window: float0 cotangents (non-differentiable inputs)
     lens_ct = None if lens is None else np.zeros(lens.shape, jax.dtypes.float0)
-    return dq, dk, dv, lens_ct
+    win_ct = None if win is None else np.zeros(jnp.shape(win),
+                                               jax.dtypes.float0)
+    return dq, dk, dv, lens_ct, win_ct
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -509,7 +563,8 @@ def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    kv_lens=None):
+                    kv_lens=None,
+                    window=None):
     """Memory-linear attention. q,k,v: [B, S, H, D] → [B, S, H, D].
 
     ``kv_lens`` [B] masks keys at positions ≥ kv_lens[b] — right-padded
@@ -517,6 +572,13 @@ def flash_attention(q, k, v, causal: bool = True,
     beyond a row's live prefix are skipped in fwd AND both backward sweeps.
     Lengths are clamped to ≥ 1 (a zero-length row has no defined
     attention output; callers mask its loss anyway).
+
+    ``window`` (causal only; int or traced scalar) restricts visibility to
+    the banded-causal ``0 <= dist < window`` (GPT-Neo local attention):
+    tiles entirely below the band are skipped in fwd and both backward
+    sweeps, so cost is O(S·window) FLOPs at O(block) memory.  A traced
+    ``window >= Sk`` degenerates to pure causal, so one compiled program
+    serves an alternating global/local layer stack.
 
     Falls back to the dense reference when the backend has no Pallas path,
     the sequence doesn't tile (tiny/odd test shapes, Sq > Sk causal), or —
@@ -536,10 +598,17 @@ def flash_attention(q, k, v, causal: bool = True,
     bk = _pick_block(Sk, block_k)
     if kv_lens is not None:
         kv_lens = jnp.maximum(jnp.asarray(kv_lens, jnp.int32), 1)
+    if window is not None:
+        assert causal, "window masking is defined for causal attention"
+        window = jnp.maximum(jnp.asarray(window, jnp.int32), 1)
     short_seq_dense = (auto_blocks and Sq < FLASH_MIN_SEQ
                        and B * H * Sq * Sk * 4 <= DENSE_SCORES_BYTE_CAP)
     if (not use_pallas() or bq is None or bk is None
             or (causal and Sq > Sk) or short_seq_dense):
+        if window is not None:
+            raise ValueError(
+                "flash_attention(window=...) has no dense fallback here; "
+                "route short/odd shapes through gpt._windowed_attention")
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              kv_lens=kv_lens)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
@@ -547,5 +616,6 @@ def flash_attention(q, k, v, causal: bool = True,
     def to3(x):  # [B,S,H,D] → [B*H, S, D]
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
-    o3 = _flash(to3(q), to3(k), to3(v), kv_lens, causal, scale, bq, bk, H)
+    o3 = _flash(to3(q), to3(k), to3(v), kv_lens, window, causal, scale,
+                bq, bk, H)
     return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)

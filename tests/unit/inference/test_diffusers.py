@@ -274,6 +274,43 @@ def test_policy_apply_builds_served_wrapper():
     assert bool(jnp.all(jnp.isfinite(out["sample"])))
 
 
+def test_text_to_image_with_clip_conditioning():
+    """End-to-end SD shape: HF CLIP text tower (converted through the
+    injection policy) conditions the UNet's cross attention; the whole
+    prompt -> image path runs."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from deepspeed_tpu.inference.diffusion_pipeline import DiffusionPipeline
+    from deepspeed_tpu.model_implementations.diffusers import DSUNet, DSVAE
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.module_inject import convert_hf_clip_text
+
+    clip_cfg = transformers.CLIPTextConfig(
+        vocab_size=128, hidden_size=UCFG.cross_attn_dim,
+        intermediate_size=24, num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0)
+    torch.manual_seed(0)
+    clip = transformers.CLIPTextModel(clip_cfg).eval()
+    gcfg, cparams = convert_hf_clip_text(clip)
+    encode = jax.jit(lambda p, t: gpt.encode(p, t, gcfg))
+
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, size=(1, 8)), jnp.int32)
+    empty = jnp.zeros_like(prompt)
+    ctx = encode(cparams, prompt)
+    un = encode(cparams, empty)
+    assert ctx.shape == (1, 8, UCFG.cross_attn_dim)
+
+    pipe = DiffusionPipeline(
+        DSUNet(UCFG, df.unet_init(UCFG, jax.random.PRNGKey(0))),
+        DSVAE(VCFG, df.vae_init(VCFG, jax.random.PRNGKey(1))))
+    img = pipe(ctx, uncond_embeds=un, steps=3, guidance_scale=7.5,
+               height=32, width=32)
+    assert img.shape == (1, 32, 32, 3)
+    assert bool(jnp.all(jnp.isfinite(img)))
+
+
 def test_diffusion_pipeline_samples():
     """The whole DDIM loop (guided, 4 steps) + VAE decode compiles into one
     program and produces finite images of the right shape."""
