@@ -99,6 +99,12 @@ class DiffusionPipeline:
         """
         ucfg = self.unet.config
         factor = 2 ** (len(self.vae.config.block_channels) - 1)
+        for dim, val in (("height", height), ("width", width)):
+            if val is not None and val % factor:
+                raise ValueError(
+                    f"{dim}={val} must be a multiple of the VAE downsample "
+                    f"factor {factor} (would silently render "
+                    f"{val // factor * factor} pixels)")
         h = (height or ucfg.sample_size * factor) // factor
         w = (width or ucfg.sample_size * factor) // factor
         if not 1 <= steps < self.num_train_steps:

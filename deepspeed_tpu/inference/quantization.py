@@ -34,8 +34,13 @@ PyTree = Any
 #: leaf names (last path component) that hold the big matmul weights in the
 #: canonical stacked GPT family (models/gpt.py; module_inject emits the same
 #: names for every injected architecture); lm_head covers untied-embedding
-#: configs (GPT-J/NeoX style), where it is the single largest matrix
-QUANTIZE_LEAVES = frozenset({"wqkv", "wo", "wi", "wo_mlp", "wte", "lm_head"})
+#: configs (GPT-J/NeoX style), where it is the single largest matrix.
+#: ``wte`` is deliberately NOT here: with tied embeddings it doubles as the
+#: logit matrix — the most precision-sensitive gemm in the model — and the
+#: reference's int8 path likewise keeps embeddings 16-bit and only routes
+#: linear/gemm weights through int8.  Callers that want the extra HBM
+#: savings on an untied ``wte`` pass ``leaves=QUANTIZE_LEAVES | {"wte"}``.
+QUANTIZE_LEAVES = frozenset({"wqkv", "wo", "wi", "wo_mlp", "lm_head"})
 
 
 @jax.tree_util.register_pytree_node_class
@@ -90,19 +95,23 @@ def quantize_leaf(w: jnp.ndarray) -> Int8Param:
 _quantize_jit = jax.jit(quantize_leaf)
 
 
-def quantize_params_int8(params: PyTree) -> Tuple[PyTree, int]:
+def quantize_params_int8(params: PyTree, leaves=None) -> Tuple[PyTree, int]:
     """Replace the big matmul weights with :class:`Int8Param` leaves.
 
-    Returns ``(new_params, n_quantized)``.  Layer norms, biases, and
-    position embeddings stay in the compute dtype (tiny, precision-critical
-    — matching the reference which only routes gemm weights through int8).
+    Returns ``(new_params, n_quantized)``.  Layer norms, biases, embeddings,
+    and position embeddings stay in the compute dtype (tiny or
+    precision-critical — matching the reference which only routes gemm
+    weights through int8).  ``leaves`` overrides the quantized-leaf name set
+    (default :data:`QUANTIZE_LEAVES`).
     """
+    if leaves is None:
+        leaves = QUANTIZE_LEAVES
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     n_quantized = 0
     out = []
     for path, leaf in flat:
         name = str(getattr(path[-1], "key", path[-1])) if path else ""
-        if name in QUANTIZE_LEAVES and getattr(leaf, "ndim", 0) >= 2:
+        if name in leaves and getattr(leaf, "ndim", 0) >= 2:
             out.append(_quantize_jit(leaf))
             n_quantized += 1
         else:

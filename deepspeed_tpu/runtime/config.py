@@ -142,8 +142,13 @@ class DeepSpeedConfig:
             off = zero.get("offload_optimizer")
             offload = bool(off) and (not isinstance(off, dict)
                                      or off.get("device", "cpu") != "none")
-            free = budget - zero_state_bytes(n, self.world_size, stage,
-                                             mixed, offload)
+            # ZeRO shards over the data-parallel extent, which under tp/pp
+            # meshes is smaller than world_size — using world_size here
+            # would undersize the state estimate and oversize the batch
+            from ..parallel.mesh import get_mesh_manager
+            mm = get_mesh_manager(optional=True)
+            dp = mm.dp_world_size if mm is not None else self.world_size
+            free = budget - zero_state_bytes(n, dp, stage, mixed, offload)
             cfg = model.meta.get("config") if hasattr(model, "meta") else None
             if cfg is None or free <= 0:
                 return 1

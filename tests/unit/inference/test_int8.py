@@ -34,9 +34,10 @@ def test_quantize_leaf_roundtrip():
 def test_quantize_params_selects_matmul_weights():
     params = gpt.init(CFG, jax.random.PRNGKey(0))
     qparams, n_q = quantize_params_int8(params)
-    # wte + per-layer stacks wqkv/wo/wi/wo_mlp
-    assert n_q == 5
-    assert isinstance(qparams["wte"], Int8Param)
+    # per-layer stacks wqkv/wo/wi/wo_mlp; wte stays 16-bit by default (tied
+    # embeddings double as the logit matrix — precision-sensitive)
+    assert n_q == 4
+    assert not isinstance(qparams["wte"], Int8Param)
     assert isinstance(qparams["blocks"]["wqkv"], Int8Param)
     # norms/biases/positions untouched
     assert not isinstance(qparams["lnf_scale"], Int8Param)
@@ -47,7 +48,12 @@ def test_quantize_params_selects_matmul_weights():
     untied = dataclasses.replace(CFG, tie_word_embeddings=False)
     uparams = gpt.init(untied, jax.random.PRNGKey(0))
     uq, un = quantize_params_int8(uparams)
-    assert un == 6 and isinstance(uq["lm_head"], Int8Param)
+    assert un == 5 and isinstance(uq["lm_head"], Int8Param)
+    assert not isinstance(uq["wte"], Int8Param)
+    # opt-in: callers can still quantize an (untied) embedding explicitly
+    from deepspeed_tpu.inference.quantization import QUANTIZE_LEAVES
+    wq, wn = quantize_params_int8(uparams, leaves=QUANTIZE_LEAVES | {"wte"})
+    assert wn == 6 and isinstance(wq["wte"], Int8Param)
 
 
 def test_int8_save_16bit_model_dequantizes(tmp_path):
