@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -383,14 +382,14 @@ def _attention_impl(q, k, v, config: GPTConfig, window=None):
         # the whole alternating global/local stack (no lax.cond)
         from ..ops.pallas import flash_attention as _fa
         from ..ops.pallas.flash_attention import (FLASH_MIN_SEQ, _pick_block,
+                                                  resolve_env_blocks,
                                                   use_pallas)
         Sq, Sk = q.shape[1], k.shape[1]
         # resolve the same env-derived blocks flash_attention will use, so
         # this guard and the kernel's own tiling check can never disagree
-        # (an FLASH_BLOCK_Q override must fall back here, not ValueError
+        # (a FLASH_BLOCK_Q override must fall back here, not ValueError
         # inside the no-dense-fallback window path)
-        _bq = int(os.environ.get("FLASH_BLOCK_Q", 1024))
-        _bk = int(os.environ.get("FLASH_BLOCK_K", 1024))
+        _bq, _bk = resolve_env_blocks()
         if (config.use_flash_attention and use_pallas()
                 and Sq >= FLASH_MIN_SEQ and Sq <= Sk
                 and _pick_block(Sq, _bq) and _pick_block(Sk, _bk)):

@@ -534,6 +534,17 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, H, res, do3):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def resolve_env_blocks() -> tuple:
+    """The (block_q, block_k) the kernel will use when the caller passes
+    none: FLASH_BLOCK_Q/FLASH_BLOCK_K env knobs (on-chip block sweeps) over
+    the measured-best default.  Callers that pre-check tiling feasibility
+    (models/gpt.py's windowed-flash guard) MUST resolve through this same
+    helper so guard and kernel can never disagree."""
+    import os
+    return (int(os.environ.get("FLASH_BLOCK_Q", 1024)),
+            int(os.environ.get("FLASH_BLOCK_K", 1024)))
+
+
 def _pick_block(seq: int, want: int) -> Optional[int]:
     """A block size dividing ``seq`` that satisfies Mosaic tiling: each of
     the last two block dims must be divisible by (8, 128) or span the full
@@ -585,13 +596,11 @@ def flash_attention(q, k, v, causal: bool = True,
     with auto block sizes — the sequence is short enough that dense wins
     (< FLASH_MIN_SEQ).
     """
-    import os
     auto_blocks = block_q is None and block_k is None
-    # env knobs for on-chip block sweeps (perf tuning; default measured-best)
-    if block_q is None:
-        block_q = int(os.environ.get("FLASH_BLOCK_Q", 1024))
-    if block_k is None:
-        block_k = int(os.environ.get("FLASH_BLOCK_K", 1024))
+    if block_q is None or block_k is None:
+        env_q, env_k = resolve_env_blocks()
+        block_q = env_q if block_q is None else block_q
+        block_k = env_k if block_k is None else block_k
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     bq = _pick_block(Sq, block_q)

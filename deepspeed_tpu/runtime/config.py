@@ -61,15 +61,22 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"Expected a string path or dict, got {type(config)}")
 
-        # dp extent for batch arithmetic
+        # dp extent for batch arithmetic (ZeRO shards over dp, which under
+        # tp/pp meshes is smaller than the device count — an active mesh's
+        # dp extent beats the raw device count as the fallback)
         if mesh_manager is not None:
             self.world_size = mesh_manager.dp_world_size
         elif mpu is not None:
             self.world_size = mpu.get_data_parallel_world_size()
         else:
             try:
-                import jax
-                self.world_size = jax.device_count()
+                from ..parallel.mesh import get_mesh_manager
+                mm = get_mesh_manager(optional=True)
+                if mm is not None:
+                    self.world_size = mm.dp_world_size
+                else:
+                    import jax
+                    self.world_size = jax.device_count()
             except Exception:
                 self.world_size = 1
 
@@ -142,13 +149,10 @@ class DeepSpeedConfig:
             off = zero.get("offload_optimizer")
             offload = bool(off) and (not isinstance(off, dict)
                                      or off.get("device", "cpu") != "none")
-            # ZeRO shards over the data-parallel extent, which under tp/pp
-            # meshes is smaller than world_size — using world_size here
-            # would undersize the state estimate and oversize the batch
-            from ..parallel.mesh import get_mesh_manager
-            mm = get_mesh_manager(optional=True)
-            dp = mm.dp_world_size if mm is not None else self.world_size
-            free = budget - zero_state_bytes(n, dp, stage, mixed, offload)
+            # self.world_size IS the dp extent (resolved at construction
+            # from the mesh manager / mpu when one exists)
+            free = budget - zero_state_bytes(n, self.world_size, stage,
+                                             mixed, offload)
             cfg = model.meta.get("config") if hasattr(model, "meta") else None
             if cfg is None or free <= 0:
                 return 1

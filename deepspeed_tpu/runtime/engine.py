@@ -492,14 +492,29 @@ class DeepSpeedEngine:
             return new_acc, loss
 
         if self._offload_device is not None:
-            # device side of the offloaded step: clip/norm in fp32, then
-            # hand the host 16-bit grads that are still LOSS-SCALED — the
-            # scale keeps small components inside fp16's dynamic range (the
-            # reference's cpu_offload moves scaled fp16 partitions the same
-            # way) and the host unscales in fp32 before Adam.  Half the
-            # HBM for the out tree and half the d2h traffic; grad_acc is
-            # donated — its buffers back the zeroed accumulator.
-            def grad_prep(grad_acc, scale_state):
+            # Device side of the offloaded step, STREAMED per leaf (the
+            # reference's fixed-size IPG-bucket discipline,
+            # stage_1_and_2.py:868 reduce_independent_p_g_buckets_...: a
+            # full extra gradient-sized tree never exists on device).
+            #
+            #   1. grad_stats: scalar-only pass over the fp32 accumulator —
+            #      global norm, clip coefficient, overflow flag, next loss
+            #      scale.  No big outputs, nothing donated.
+            #   2. prep_leaf (per leaf, accumulator leaf donated): clip ×
+            #      cast to the 16-bit compute dtype in one fused kernel;
+            #      the zeroed accumulator aliases the donated buffer.  The
+            #      caller host-pulls the 16-bit leaf and frees it before
+            #      touching the next, so the transient is ONE leaf, not the
+            #      2 bytes/param whole-tree copy that kept 1.3B off a 16 GB
+            #      chip (docs/performance.md round-3 finding).
+            #
+            # Grads cross the PCIe still LOSS-SCALED (the scale keeps small
+            # components inside fp16's dynamic range — the reference's
+            # cpu_offload moves scaled fp16 partitions the same way); the
+            # host unscales in fp32 before Adam.
+            finfo_max = float(jnp.finfo(compute_dtype).max)
+
+            def grad_stats(grad_acc, scale_state):
                 scale = scale_state["loss_scale"]
                 # norm of the UNSCALED grads without materializing an
                 # unscaled tree: ||g/scale|| = ||g|| / scale; clipping is a
@@ -507,23 +522,33 @@ class DeepSpeedEngine:
                 norm = global_grad_norm(grad_acc) / scale
                 if clip > 0:
                     coef = jnp.minimum(1.0, clip / (norm + 1e-6))
-                    scaled = jax.tree_util.tree_map(
-                        lambda g: g * coef, grad_acc)
                 else:
-                    scaled = grad_acc
-                transfer = jax.tree_util.tree_map(
-                    lambda g: g.astype(compute_dtype), scaled)
-                # overflow check on the tree that actually crosses: a
-                # scaled grad beyond fp16 max infs here and must trigger
-                # the skip/scale-backoff (nans propagate through too)
-                overflow = (has_overflow(transfer) if scaler_config.enabled
-                            else jnp.zeros((), bool))
+                    coef = jnp.ones((), jnp.float32)
+                if scaler_config.enabled:
+                    # what has_overflow(transfer) used to see on the cast
+                    # tree, computed from scalars: a non-finite norm means
+                    # inf/nan grads; a finite max beyond the compute
+                    # dtype's range would inf on the cast.  (An inf norm
+                    # with finite leaves also lands here — the old path
+                    # silently stepped with zeroed grads; skipping is the
+                    # reference's CheckOverflow semantics.)
+                    absmax = global_grad_norm(grad_acc, float("inf"))
+                    overflow = jnp.logical_or(
+                        jnp.logical_not(jnp.isfinite(norm)),
+                        absmax * coef > finfo_max)
+                else:
+                    overflow = jnp.zeros((), bool)
                 new_scale = ls.update_state(scale_state, overflow, scaler_config)
-                zero_acc = jax.tree_util.tree_map(jnp.zeros_like, grad_acc)
-                return transfer, zero_acc, new_scale, norm, overflow
+                return coef, new_scale, norm, overflow
+
+            def prep_leaf(g, coef):
+                return (g * coef).astype(compute_dtype), jnp.zeros_like(g)
 
             self._micro_jit = jax.jit(micro, donate_argnums=(1,))
-            self._grad_prep_jit = jax.jit(grad_prep, donate_argnums=(0,))
+            self._grad_stats_jit = jax.jit(grad_stats)
+            self._prep_leaf_jit = jax.jit(prep_leaf, donate_argnums=(0,))
+            self._zero_leaf_jit = jax.jit(
+                lambda g: jnp.zeros_like(g), donate_argnums=(0,))
             return
 
         def apply_core(params, master, opt_state, grad_acc, scale_state, hyper):
@@ -775,17 +800,26 @@ class DeepSpeedEngine:
 
     def _apply_offload_step(self) -> bool:
         """Gas-boundary step with host-resident optimizer states: device
-        preps grads, host Adam steps the fp32 master (native SIMD kernel),
-        bf16 params upload back (fused precast in the C++ kernel).
+        preps grads STREAMED one leaf at a time (prep → host pull → free —
+        the reference's IPG-bucket discipline, stage_1_and_2.py:868), host
+        Adam steps the fp32 master (native SIMD kernel), bf16 params upload
+        back leaf-by-leaf (fused precast in the C++ kernel).  Peak device
+        overhead beyond the persistent state is one 16-bit leaf, never a
+        full gradient- or parameter-sized tree.
         Returns whether the step overflowed (and was skipped)."""
         s = self.state
         # the transferred grads are still loss-scaled (fp16 range safety);
         # read the OLD scale before the state advances, unscale in fp32
         old_scale = float(jax.device_get(s["scale"]["loss_scale"]))
-        grads, zero_acc, new_scale, norm, overflow = self._grad_prep_jit(
+        coef, new_scale, norm, overflow = self._grad_stats_jit(
             s["grad_acc"], s["scale"])
         overflow_host = bool(overflow)
-        if not overflow_host:
+        acc_leaves = jax.tree_util.tree_leaves(s["grad_acc"])
+        if overflow_host:
+            # skipped step: no transfers — just re-zero the accumulator
+            # in place (donated buffers)
+            zero_leaves = [self._zero_leaf_jit(g) for g in acc_leaves]
+        else:
             bf16 = self.compute_dtype == jnp.bfloat16
             group_hyper = self._group_hyper()
 
@@ -794,47 +828,64 @@ class DeepSpeedEngine:
                     return out.view(jnp.bfloat16).reshape(shape)
                 return np.asarray(out, dtype).reshape(shape)
 
-            grad_leaves = jax.tree_util.tree_leaves(grads)
             if self._offload_multihost:
                 from .zero.offload_engine import local_block
-                host_grads = [
-                    np.divide(local_block(gleaf, idx), old_scale,
-                              dtype=np.float32)
-                    for li, gleaf in enumerate(grad_leaves)
-                    for idx, _, _ in self._offload_layout[li]]
-            else:
-                host_grads = [np.divide(jax.device_get(g), old_scale,
-                                        dtype=np.float32)
-                              for g in grad_leaves]
+            host_grads, zero_leaves = [], []
+            for li, g in enumerate(acc_leaves):
+                transfer, zeroed = self._prep_leaf_jit(g, coef)
+                zero_leaves.append(zeroed)
+                if self._offload_multihost:
+                    host_grads.extend(
+                        np.divide(local_block(transfer, idx), old_scale,
+                                  dtype=np.float32)
+                        for idx, _, _ in self._offload_layout[li])
+                else:
+                    host_grads.append(np.divide(jax.device_get(transfer),
+                                                old_scale, dtype=np.float32))
+                transfer.delete()  # free before the next leaf materializes
             outs = self._offload_opt.step(host_grads, bf16_out=bf16,
                                           group_hyper=group_hyper)
-            param_leaves = jax.tree_util.tree_leaves(s["params"])
+            del host_grads
+            param_leaves = list(jax.tree_util.tree_leaves(s["params"]))
             if self._offload_multihost:
                 # rebuild global params: per-shard device_put onto the
                 # master partition, then one jitted reshard (the stage-1
                 # weight-update all-gather) to the param sharding
                 new_leaves, pos = [], 0
-                for li, pleaf in enumerate(param_leaves):
+                s["params"] = s["master"] = None
+                for li in range(len(param_leaves)):
+                    pdtype, pshape = param_leaves[li].dtype, param_leaves[li].shape
+                    param_leaves[li] = None  # old leaf freed here
                     blocks = {}
                     for _, key, bshape in self._offload_layout[li]:
-                        blocks[key] = to_arr(outs[pos], pleaf.dtype, bshape)
+                        blocks[key] = to_arr(outs[pos], pdtype, bshape)
                         pos += 1
                     arrs = [jax.device_put(blocks[key], d)
                             for d, key in self._offload_putmap[li]]
                     new_leaves.append(jax.make_array_from_single_device_arrays(
-                        pleaf.shape, self._master_shardings_flat[li], arrs))
+                        pshape, self._master_shardings_flat[li], arrs))
                 master_sharded = jax.tree_util.tree_unflatten(
                     self._params_treedef, new_leaves)
                 s["params"] = self._reshard_params_jit(master_sharded)
             else:
-                new_params_host = jax.tree_util.tree_unflatten(
-                    self._params_treedef,
-                    [to_arr(out, leaf.dtype, leaf.shape)
-                     for out, leaf in zip(outs, param_leaves)])
-                s["params"] = jax.device_put(
-                    new_params_host, self._out_shardings["params"])
+                # leaf-by-leaf upload: dropping every reference to the old
+                # leaf (the list slot AND the state trees — s["master"]
+                # aliases s["params"]) before the next device_put keeps the
+                # transient at one leaf; a whole-tree device_put would hold
+                # old + new params concurrently
+                param_shardings = jax.tree_util.tree_leaves(
+                    self._out_shardings["params"])
+                s["params"] = s["master"] = None
+                for i, out in enumerate(outs):
+                    dtype, shape = param_leaves[i].dtype, param_leaves[i].shape
+                    param_leaves[i] = None  # old leaf freed here
+                    param_leaves[i] = jax.device_put(
+                        to_arr(out, dtype, shape), param_shardings[i])
+                s["params"] = jax.tree_util.tree_unflatten(
+                    self._params_treedef, param_leaves)
             s["master"] = s["params"]
-        s["grad_acc"] = zero_acc
+        s["grad_acc"] = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(s["grad_acc"]), zero_leaves)
         s["scale"] = new_scale
         self._last_global_norm = norm
         return overflow_host

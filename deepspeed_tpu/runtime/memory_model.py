@@ -31,6 +31,22 @@ def zero_state_bytes(num_params: int, dp: int, stage: int,
     return param_b + master_b + grad_b + opt_b
 
 
+def offload_peak_bytes(num_params: int, largest_leaf_params: int,
+                       mixed_precision: bool = True) -> int:
+    """Peak device bytes of the streamed ZeRO-offload step
+    (``engine._apply_offload_step``), excluding activations.
+
+    Persistent: 16-bit params + fp32 gradient accumulator.  The prep →
+    transfer → free / upload loops stream one leaf at a time (the
+    reference's fixed-size IPG-bucket discipline,
+    ``stage_1_and_2.py:868``), so the only transient is ONE 16-bit leaf
+    — never a gradient- or parameter-sized tree.  Master + Adam moments
+    are host-resident (offload) and cost no HBM.
+    """
+    p = 2 if mixed_precision else 4
+    return int(num_params) * (p + 4) + int(largest_leaf_params) * p
+
+
 def device_budget(memory_fraction: float = 0.85,
                   device_memory_bytes: Optional[int] = None) -> Optional[int]:
     """Usable HBM bytes on the local device, or None when unknown (CPU)."""
