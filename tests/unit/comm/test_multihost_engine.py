@@ -46,16 +46,34 @@ CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
                     d_model=64, dtype=jnp.float32)
 
 
-def run(offload):
+def run(offload, tp=False):
+    # With tp: dp=2 x tp=2 over 4 devices in 2 processes, the device order
+    # arranged so every `model` (TP) group SPANS the process boundary --
+    # the layout a real pod slice runs on every layer (VERDICT r3 #3).
+    # XLA inserts the TP collectives across the process link inside one
+    # SPMD program.
     reset_mesh_manager()
-    ds = {"train_micro_batch_size_per_gpu": 2,   # x dp=4 -> global batch 8
+    if tp:
+        by_proc = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        # flat order is filled (data, model)-major with model fastest, so
+        # interleaving processes makes each model pair cross-process
+        order = [by_proc[0], by_proc[2], by_proc[1], by_proc[3]]
+        mm = initialize_mesh(ParallelDims(dp=-1, tp=2), devices=order)
+        for pair in mm.mesh.devices.reshape(-1, 2):  # [dp, model]
+            assert {d.process_index for d in pair} == {0, 1}, (
+                "model group does not cross the process boundary: %s" % pair)
+    else:
+        mm = initialize_mesh(ParallelDims(dp=-1))
+    # micro x dp -> global batch 8 either way
+    ds = {"train_micro_batch_size_per_gpu": 8 // mm.dp_world_size,
           "gradient_accumulation_steps": 1,
           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
           "zero_optimization": {"stage": 2},
           "steps_per_print": 1 << 30}
+    if tp:
+        ds["tensor_parallel"] = {"enabled": True, "size": 2}
     if offload:
         ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
-    mm = initialize_mesh(ParallelDims(dp=-1))
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=from_gpt(CFG), config=ds, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))
@@ -69,10 +87,13 @@ def run(offload):
         losses.append(float(jax.device_get(loss)))
     return losses
 
+
 out = {"rank": dist.get_rank(),
        "n_global_devices": jax.device_count(),
        "device": run(offload=False),
-       "offload": run(offload=True)}
+       "offload": run(offload=True),
+       "tp_device": run(offload=False, tp=True),
+       "tp_offload": run(offload=True, tp=True)}
 with open(os.environ["PROBE_OUT"], "w") as f:
     json.dump(out, f)
 """
@@ -155,8 +176,11 @@ def test_two_process_engine_train_step(tmp_path):
         np.testing.assert_allclose(res["device"], expect, rtol=1e-5)
         # per-rank host Adam (native SIMD kernel) tracks the device Adam
         np.testing.assert_allclose(res["offload"], expect, rtol=3e-4)
-    # both ranks observed identical losses (replicated scalar)
-    np.testing.assert_allclose(results[0]["offload"], results[1]["offload"],
-                               rtol=1e-7)
-    np.testing.assert_allclose(results[0]["device"], results[1]["device"],
-                               rtol=1e-7)
+        # TP groups spanning the process boundary: same math, the
+        # collectives merely ride the cross-process link (VERDICT r3 #3)
+        np.testing.assert_allclose(res["tp_device"], expect, rtol=1e-5)
+        np.testing.assert_allclose(res["tp_offload"], expect, rtol=3e-4)
+    # both ranks observed identical losses (replicated scalar) on every path
+    for key in ("device", "offload", "tp_device", "tp_offload"):
+        np.testing.assert_allclose(results[0][key], results[1][key],
+                                   rtol=1e-7, err_msg=key)
