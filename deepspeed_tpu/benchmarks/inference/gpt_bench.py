@@ -37,13 +37,18 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     from deepspeed_tpu.models import gpt, gpt_inference
 
     import dataclasses
-    # int8 = weight-only int8 serving: codes + scales in HBM, bf16 compute
+    # int8 = weight-only int8 serving: codes + scales in HBM, bf16 compute.
+    # int8-compute = TRUE int8 gemms (int8xint8->int32 + scale epilogue) —
+    # the compute-bound prefill/batch-serving shape (reference
+    # pt_binding.cpp int8 paths).
     config = dataclasses.replace(
         gpt.PRESETS[model],
         dtype=jnp.float32 if dtype == "float32" else jnp.bfloat16)
     params = gpt.init(config, jax.random.PRNGKey(0))
+    eng_cfg = ({"dtype": "int8", "quant": {"int8_compute": True}}
+               if dtype == "int8-compute" else {"dtype": dtype})
     engine = deepspeed_tpu.init_inference(model=(config, params),
-                                          config={"dtype": dtype})
+                                          config=eng_cfg)
     # the manual prefill/decode path must use the SAME dtype-cast weights
     # the engine serves with, or the two modes measure different memory
     # traffic under one dtype label
@@ -120,7 +125,7 @@ def main() -> None:
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--dtype", default="bfloat16",
-                    choices=["bfloat16", "float32", "int8"])
+                    choices=["bfloat16", "float32", "int8", "int8-compute"])
     ap.add_argument("--warmup", type=int, default=3)
     args = ap.parse_args()
     result = run_bench(model=args.model, batch=args.batch,

@@ -22,6 +22,12 @@ class DeepSpeedTPConfig(DeepSpeedConfigModel):
 class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
     bits: int = 8
+    #: True routes the qkv/mlp/head gemms through the int8×int8→int32 MXU
+    #: path with dynamic activation quantization (ops/int8.py — reference
+    #: pt_binding.cpp int8 gemms) instead of weight-only dequant serving;
+    #: pays off in compute-bound prefill/batch serving.  Requires
+    #: dtype="int8".
+    int8_compute: bool = False
 
 
 @dataclasses.dataclass

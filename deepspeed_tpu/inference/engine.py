@@ -64,9 +64,11 @@ def _validate_tp(config: DeepSpeedInferenceConfig, mesh_manager) -> bool:
 
 
 def _shard_and_quantize(params: PyTree, logical_axes, mesh_manager,
-                        want_tp: bool, weight_int8: bool) -> PyTree:
+                        want_tp: bool, weight_int8: bool,
+                        int8_compute: bool = False) -> PyTree:
     """Shared TP sharding (the reference's ReplaceWithTensorSlicing, done
-    declaratively) + weight-only int8 conversion."""
+    declaratively) + int8 conversion (weight-only dequant serving, or the
+    true int8-dot compute path when ``int8_compute``)."""
     if want_tp:
         from ..models.partitioning import TP_RULES, tree_shardings
         mesh = mesh_manager.mesh
@@ -74,7 +76,13 @@ def _shard_and_quantize(params: PyTree, logical_axes, mesh_manager,
         params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         logger.info(f"[inference] TP sharding over model axis "
                     f"({mesh.shape[MODEL_AXIS]} ways)")
-    if weight_int8:
+    if int8_compute:
+        from .quantization import quantize_params_int8_compute
+        params, n_q = quantize_params_int8_compute(params)
+        logger.info(f"[inference] TRUE int8 compute serving: {n_q} weights "
+                    "as int8 codes + per-output-channel scales "
+                    "(int8xint8->int32 gemms)")
+    elif weight_int8:
         from .quantization import quantize_params_int8
         params, n_q = quantize_params_int8(params)
         logger.info(f"[inference] int8 weight-only serving: {n_q} "
@@ -91,6 +99,11 @@ class InferenceEngine:
         self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
         self._config = config
         dtype, self._weight_int8 = _serving_dtype(config)
+        self._int8_compute = bool(config.quantization.int8_compute)
+        if self._int8_compute and not self._weight_int8:
+            raise ValueError(
+                'quant.int8_compute requires dtype="int8" (got '
+                f"{config.dtype!r})")
         self.model_config = dataclasses.replace(model_config, dtype=dtype)
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)
@@ -102,6 +115,13 @@ class InferenceEngine:
         from ..models.gpt_moe import GPTMoEConfig
         cfg = self.model_config
         if isinstance(cfg, GPTMoEConfig):
+            if self._int8_compute:
+                # the MoE tree stacks layers under dense_blocks /
+                # moe_attn_blocks and experts under moe_blocks — layouts
+                # the contract-axes converter does not describe yet
+                raise NotImplementedError(
+                    "quant.int8_compute serves the dense GPT family; MoE "
+                    "serving uses weight-only int8 (dtype='int8')")
             from ..models import gpt_moe, gpt_moe_inference as fam
             self._apply_fn = lambda p, t: gpt_moe.apply(p, t, cfg,
                                                         train=False)[0]
@@ -113,7 +133,7 @@ class InferenceEngine:
         self._family = fam
         self.params = _shard_and_quantize(
             self.params, self._logical_axes, self.mesh_manager, want_tp,
-            self._weight_int8)
+            self._weight_int8, int8_compute=self._int8_compute)
         self._forward_jit = jax.jit(self._apply_fn)
         self._generate_cache: Dict[Tuple, Any] = {}
 
@@ -255,12 +275,14 @@ class InferenceEngine:
 
 
 def _save_16bit(params, dtype, path: str) -> None:
+    from ..ops.int8 import Int8ComputeParam
     from .quantization import Int8Param
     # int8 engines dequantize to the compute dtype first: the contract
     # is a 16-bit weight per leaf under the leaf's own key
+    _q = (Int8Param, Int8ComputeParam)
     params = jax.tree_util.tree_map(
-        lambda p: p.astype(dtype) if isinstance(p, Int8Param) else p,
-        params, is_leaf=lambda p: isinstance(p, Int8Param))
+        lambda p: p.astype(dtype) if isinstance(p, _q) else p,
+        params, is_leaf=lambda p: isinstance(p, _q))
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     arrays = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
     np.savez(path, **arrays)
@@ -282,6 +304,10 @@ class BertInferenceEngine:
         self.mesh_manager = mesh_manager or get_mesh_manager(optional=True)
         self._config = config
         dtype, self._weight_int8 = _serving_dtype(config)
+        if config.quantization.int8_compute:
+            raise NotImplementedError(
+                "quant.int8_compute serves the GPT decoder families; the "
+                "encoder engine uses weight-only int8 (dtype='int8')")
         self.model_config = dataclasses.replace(model_config, dtype=dtype)
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)

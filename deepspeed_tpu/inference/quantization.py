@@ -95,6 +95,43 @@ def quantize_leaf(w: jnp.ndarray) -> Int8Param:
 _quantize_jit = jax.jit(quantize_leaf)
 
 
+#: per-leaf contracted-axis spec for TRUE int8 compute (per-layer view;
+#: leaves under "blocks" are layer-stacked and shift by one at quantize
+#: time).  The scale must be constant along these axes so it factors out
+#: of the integer dot — see ops/int8.py.  ``wte`` is excluded (embedding
+#: gather + tied-logit precision), biases/norms stay float.
+INT8_COMPUTE_CONTRACT = {
+    "wqkv": (0,),      # [d, 3, H, Dh] contracted over d
+    "wo": (0, 1),      # [H, Dh, d] contracted over (H, Dh)
+    "wi": (0,),        # [d, ffn]
+    "wo_mlp": (0,),    # [ffn, d]
+    "lm_head": (1,),   # [V, d] contracted over d
+}
+
+
+def quantize_params_int8_compute(params: PyTree) -> Tuple[PyTree, int]:
+    """Replace the big matmul weights with :class:`ops.int8.Int8ComputeParam`
+    leaves (int8 codes + per-output-channel scales) for the true
+    int8×int8→int32 serving path.  Returns ``(new_params, n_quantized)``."""
+    from ..ops.int8 import quantize_for_int8_compute
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    n_quantized = 0
+    out = []
+    for path, leaf in flat:
+        name = str(getattr(path[-1], "key", path[-1])) if path else ""
+        axes = INT8_COMPUTE_CONTRACT.get(name)
+        if axes is not None and getattr(leaf, "ndim", 0) >= 2:
+            stacked = any(
+                str(getattr(p, "key", p)) == "blocks" for p in path[:-1])
+            out.append(jax.jit(quantize_for_int8_compute,
+                               static_argnums=(1, 2))(leaf, axes, stacked))
+            n_quantized += 1
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out), n_quantized
+
+
 def quantize_params_int8(params: PyTree, leaves=None) -> Tuple[PyTree, int]:
     """Replace the big matmul weights with :class:`Int8Param` leaves.
 

@@ -427,6 +427,22 @@ def _attention_impl(q, k, v, config: GPTConfig, window=None):
                          sm_scale=config.attn_softmax_scale)
 
 
+def _wdot(spec, x, w, out_dtype, preferred_element_type=None):
+    """Weight-gemm dispatcher shared by every projection site: float (or
+    weight-only ``Int8Param``, which dequantizes via ``astype``) weights
+    run the einsum in the compute dtype; ``Int8ComputeParam`` routes
+    through the true int8×int8→int32 dot with the scale epilogue
+    (``ops/int8.py`` — reference pt_binding.cpp int8 gemm serving)."""
+    from ..ops.int8 import Int8ComputeParam, int8_einsum
+    if isinstance(w, Int8ComputeParam):
+        return int8_einsum(spec, x, w,
+                           preferred_element_type or out_dtype)
+    if preferred_element_type is not None:
+        return jnp.einsum(spec, x, w.astype(out_dtype),
+                          preferred_element_type=preferred_element_type)
+    return jnp.einsum(spec, x, w.astype(out_dtype))
+
+
 def qkv_proj(x, p, config: GPTConfig, positions=None):
     """LN1 + qkv projection: [B,S,d] → (q, k, v) each [B,S,H,Dh].
 
@@ -436,7 +452,7 @@ def qkv_proj(x, p, config: GPTConfig, positions=None):
     """
     cdt = config.dtype
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
-    qkv = jnp.einsum("bsd,dthe->bsthe", h, p["wqkv"].astype(cdt)) + p["bqkv"].astype(cdt)
+    qkv = _wdot("bsd,dthe->bsthe", h, p["wqkv"], cdt) + p["bqkv"].astype(cdt)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if config.pos_embed == "rotary":
         if positions is None:
@@ -450,8 +466,7 @@ def attn_project(attn, p, config: GPTConfig):
     """Attention output projection W_o·attn + b_o (no residual) — the one
     definition every train/inference/MoE path shares."""
     cdt = config.dtype
-    return jnp.einsum("bshe,hed->bsd", attn, p["wo"].astype(cdt)) \
-        + p["bo"].astype(cdt)
+    return _wdot("bshe,hed->bsd", attn, p["wo"], cdt) + p["bo"].astype(cdt)
 
 
 def attn_out_residual(x, attn, p, config: GPTConfig, dropout_key=None):
@@ -465,13 +480,13 @@ def mlp_out(x, p, config: GPTConfig, dropout_key=None):
     the attention branch instead of chaining)."""
     cdt = config.dtype
     h2 = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
-    ff = jnp.einsum("bsd,df->bsf", h2, p["wi"].astype(cdt)) + p["bi"].astype(cdt)
+    ff = _wdot("bsd,df->bsf", h2, p["wi"], cdt) + p["bi"].astype(cdt)
     ff = _activation_fn(ff, config)
     if config.act_quant_bits is not None:
         from ..compression.transforms import quantize_activation
         ff = quantize_activation(ff, config.act_quant_bits,
                                  symmetric=config.act_quant_symmetric)
-    ff_out = jnp.einsum("bsf,fd->bsd", ff, p["wo_mlp"].astype(cdt)) + p["bo_mlp"].astype(cdt)
+    ff_out = _wdot("bsf,fd->bsd", ff, p["wo_mlp"], cdt) + p["bo_mlp"].astype(cdt)
     return _dropout(ff_out, config.dropout, dropout_key)
 
 
@@ -542,9 +557,8 @@ def _head_logits(params: PyTree, h, config: GPTConfig) -> jnp.ndarray:
     chunked loss both route here.
     """
     head = params["wte"] if config.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("...d,vd->...v", h.astype(config.dtype),
-                        head.astype(config.dtype),
-                        preferred_element_type=jnp.float32)
+    logits = _wdot("...d,vd->...v", h.astype(config.dtype), head,
+                   config.dtype, preferred_element_type=jnp.float32)
     if "lm_head_bias" in params:  # GPT-J's biased untied head
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits

@@ -1,0 +1,115 @@
+"""True int8 compute: int8×int8→int32 MXU gemms with a scale epilogue.
+
+Counterpart of the reference's int8 gemm serving path
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1652-1720`` int8 qkv/mlp
+gemms + ``csrc/quantization/quantize.cu`` activation quantization): weights
+carry per-OUTPUT-channel scales (constant along the contracted input axes,
+so the scale factors out of the integer dot), activations are quantized
+dynamically per row, and the matmul runs as an integer dot with
+``preferred_element_type=int32`` — XLA lowers it to the MXU's int8 path on
+TPU generations that have one (v5e+), at worst to the bf16 path with the
+operands' HBM traffic still halved.
+
+This differs from weight-only serving (``inference/quantization.Int8Param``,
+per-last-dim-vector scales + dequant-into-matmul): weight-only wins when
+decode is HBM-bound; true int8 compute pays off in compute-bound
+prefill/batch serving.  Opt in via ``quant: {"int8_compute": true}`` in the
+inference config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: smallest representable scale — guards div-by-zero on all-zero rows/cols
+_EPS = 1e-12
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Int8ComputeParam:
+    """int8 codes in the weight's original shape + fp32 scales shaped with
+    1s on the contracted (input) axes and full extent on the output axes —
+    the layout that lets the scale multiply move OUTSIDE the integer dot.
+
+    ``contract_axes`` is static aux data and refers to the PER-LAYER view:
+    stacked layer leaves ([L, ...]) quantize/scale per layer, and
+    ``lax.scan`` slices codes and scales along the stacking axis together.
+
+    ``astype`` dequantizes (same duck-type contract as ``Int8Param``), so
+    any code path that does not route through :func:`int8_einsum` — e.g.
+    an embedding gather — still works, just without integer compute.
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+    contract_axes: Tuple[int, ...] = dataclasses.field(default=())
+
+    def tree_flatten(self):
+        return (self.q, self.scale), tuple(self.contract_axes)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    def astype(self, dtype):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_for_int8_compute(w: jnp.ndarray, contract_axes: Tuple[int, ...],
+                              stacked: bool = False) -> Int8ComputeParam:
+    """Symmetric int8 quantization with per-output-channel scales.
+
+    ``contract_axes`` index the per-layer view; ``stacked`` shifts them by
+    one for [L, ...] layer-stacked leaves (scales still vary per layer).
+    """
+    axes = tuple(a + 1 for a in contract_axes) if stacked else tuple(contract_axes)
+    w = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, _EPS)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return Int8ComputeParam(q=q, scale=scale, contract_axes=tuple(contract_axes))
+
+
+def int8_einsum(spec: str, x: jnp.ndarray, w: Int8ComputeParam, out_dtype):
+    """``einsum(spec, x, w)`` as an integer dot with a scale epilogue.
+
+    Contract (matches every weight-gemm site in ``models/gpt.py``): the
+    contracted axes are the TRAILING axes of ``x`` and ``w.contract_axes``
+    of the weight; the output is x's batch dims followed by the weight's
+    output dims (einsum default ordering).
+
+    The activation is quantized per row (one scale per flattened batch
+    element, reduced over the contracted axes) — the reference's dynamic
+    per-token activation quantization (``quantize.cu``).
+    """
+    k = len(w.contract_axes)
+    x_axes = tuple(range(x.ndim - k, x.ndim))
+    x32 = x.astype(jnp.float32)
+    xmax = jnp.max(jnp.abs(x32), axis=x_axes, keepdims=True)
+    xs = jnp.maximum(xmax / 127.0, _EPS)
+    xq = jnp.clip(jnp.round(x32 / xs), -127, 127).astype(jnp.int8)
+    acc = jnp.einsum(spec, xq, w.q, preferred_element_type=jnp.int32)
+    # epilogue: out = acc * x_scale (batch dims) * w_scale (output dims)
+    n_batch = x.ndim - k
+    n_out = acc.ndim - n_batch
+    xs_b = xs.reshape(xs.shape[:n_batch] + (1,) * n_out)
+    ws_o = w.scale.reshape(tuple(d for a, d in enumerate(w.scale.shape)
+                                 if a not in w.contract_axes))
+    return (acc.astype(jnp.float32) * xs_b * ws_o).astype(out_dtype)

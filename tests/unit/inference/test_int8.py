@@ -125,3 +125,124 @@ def test_int8_bench_row():
     assert r["dtype"] == "int8"
     assert r["per_token_tokens_per_sec"] > 0
     assert r["fused_loop_tokens_per_sec"] > 0
+
+
+# ----------------------------------------------------------- true int8 compute
+
+def test_int8_compute_einsum_parity():
+    """ops/int8.py: the integer dot + scale epilogue tracks the float
+    einsum at every gemm layout the GPT family uses (VERDICT r3 #4;
+    reference pt_binding.cpp:1652-1720 int8 gemms)."""
+    from deepspeed_tpu.ops.int8 import (int8_einsum,
+                                        quantize_for_int8_compute)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    cases = [
+        ("bsd,dthe->bsthe", x, (16, 3, 4, 8), (0,)),        # wqkv
+        ("bshe,hed->bsd",
+         jnp.asarray(rng.normal(size=(2, 8, 4, 8)), jnp.float32),
+         (4, 8, 16), (0, 1)),                               # wo
+        ("bsd,df->bsf", x, (16, 64), (0,)),                 # wi
+        ("...d,vd->...v", x, (32, 16), (1,)),               # lm_head
+    ]
+    for spec, xi, wshape, axes in cases:
+        w = jnp.asarray(rng.normal(size=wshape), jnp.float32)
+        wp = quantize_for_int8_compute(w, axes)
+        ref = jnp.einsum(spec, xi, w)
+        out = int8_einsum(spec, xi, wp, jnp.float32)
+        rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.02, (spec, rel)
+    # the dot really is integer: int8 operands, int32 accumulation
+    w = jnp.asarray(rng.normal(size=(16, 64)), jnp.float32)
+    wp = quantize_for_int8_compute(w, (0,))
+    jx = str(jax.make_jaxpr(
+        lambda a, b: int8_einsum("bsd,df->bsf", a, b, jnp.float32))(x, wp))
+    assert "preferred_element_type=int32" in jx
+
+
+def test_int8_compute_stacked_leaf_scans():
+    """Layer-stacked Int8ComputeParam leaves slice codes AND scales along
+    the stacking axis (lax.scan over blocks), keeping the static
+    contract_axes aux."""
+    from deepspeed_tpu.ops.int8 import int8_einsum, quantize_for_int8_compute
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    ws = jnp.asarray(rng.normal(size=(3, 16, 3, 4, 8)), jnp.float32)
+    wps = quantize_for_int8_compute(ws, (0,), stacked=True)
+    assert wps.scale.shape == (3, 1, 3, 4, 8)
+    layer1 = jax.tree_util.tree_map(lambda a: a[1], wps)
+    assert layer1.contract_axes == (0,)
+    ref = jnp.einsum("bsd,dthe->bsthe", x, ws[1])
+    out = int8_einsum("bsd,dthe->bsthe", x, layer1, jnp.float32)
+    rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.02, rel
+
+
+def test_int8_compute_engine_ppl_and_generate():
+    """quant.int8_compute serving: weights become Int8ComputeParam, the
+    compiled forward contains integer dots, and quality stays close to
+    bf16 on the same batch."""
+    from deepspeed_tpu.ops.int8 import Int8ComputeParam
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 64)), jnp.int32)
+
+    bf16 = deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "bfloat16"})
+    qc = deepspeed_tpu.init_inference(
+        model=(CFG, params),
+        config={"dtype": "int8", "quant": {"int8_compute": True}})
+    assert isinstance(qc.params["blocks"]["wqkv"], Int8ComputeParam)
+    assert qc.params["blocks"]["wqkv"].q.dtype == jnp.int8
+    # per-output-channel scales: constant along the contracted input dim
+    assert qc.params["blocks"]["wqkv"].scale.shape[1] == 1
+    # integer dots in the traced forward
+    jx = str(jax.make_jaxpr(qc._apply_fn)(qc.params, tokens))
+    assert "preferred_element_type=int32" in jx
+    # quality: ppl within a few % of bf16 (weights AND activations 8-bit)
+    l_bf16 = _loss(bf16.forward(tokens), tokens)
+    l_q = _loss(qc.forward(tokens), tokens)
+    ppl_delta = abs(np.exp(l_q) / np.exp(l_bf16) - 1.0)
+    assert ppl_delta < 0.05, (l_bf16, l_q, ppl_delta)
+    # the whole decode loop runs through the int8 path
+    out = qc.generate(tokens[:, :16], max_new_tokens=8)
+    assert out.shape == (2, 8)
+    assert bool(jnp.all((out >= 0) & (out < CFG.vocab_size)))
+
+
+def test_int8_compute_validation():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="int8_compute"):
+        deepspeed_tpu.init_inference(
+            model=(CFG, params),
+            config={"dtype": "bfloat16", "quant": {"int8_compute": True}})
+
+
+def test_int8_compute_bench_row():
+    from deepspeed_tpu.benchmarks.inference.gpt_bench import run_bench
+    import deepspeed_tpu.models.gpt as g
+    g.PRESETS["tiny-test"] = CFG
+    try:
+        r = run_bench(model="tiny-test", batch=1, prompt=16, new_tokens=4,
+                      dtype="int8-compute", warmup=1)
+    finally:
+        del g.PRESETS["tiny-test"]
+    assert r["dtype"] == "int8-compute"
+    assert r["prefill_ms"] > 0
+    assert r["per_token_tokens_per_sec"] > 0
+
+
+def test_int8_compute_moe_guarded():
+    """The MoE family's stacked layouts (dense_blocks/moe_attn_blocks/
+    moe_blocks) are not described by the contract-axes converter — the
+    engine must refuse clearly, not crash in the scale epilogue."""
+    from deepspeed_tpu.models import gpt_moe
+    from deepspeed_tpu.models.gpt_moe import GPTMoEConfig
+    cfg = GPTMoEConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
+                       d_model=16, dtype=jnp.bfloat16, num_experts=2,
+                       vocab_round_to=128)
+    params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="int8_compute"):
+        deepspeed_tpu.init_inference(
+            model=(cfg, params),
+            config={"dtype": "int8", "quant": {"int8_compute": True}})
