@@ -109,11 +109,22 @@ INT8_COMPUTE_CONTRACT = {
 }
 
 
+def _quantize_compute_jit():
+    from ..ops.int8 import quantize_for_int8_compute
+    return jax.jit(quantize_for_int8_compute, static_argnums=(1, 2))
+
+
+_quantize_compute_cached = None
+
+
 def quantize_params_int8_compute(params: PyTree) -> Tuple[PyTree, int]:
     """Replace the big matmul weights with :class:`ops.int8.Int8ComputeParam`
     leaves (int8 codes + per-output-channel scales) for the true
     int8×int8→int32 serving path.  Returns ``(new_params, n_quantized)``."""
-    from ..ops.int8 import quantize_for_int8_compute
+    global _quantize_compute_cached
+    if _quantize_compute_cached is None:  # one jit cache across engine inits
+        _quantize_compute_cached = _quantize_compute_jit()
+    qz = _quantize_compute_cached
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     n_quantized = 0
@@ -124,8 +135,7 @@ def quantize_params_int8_compute(params: PyTree) -> Tuple[PyTree, int]:
         if axes is not None and getattr(leaf, "ndim", 0) >= 2:
             stacked = any(
                 str(getattr(p, "key", p)) == "blocks" for p in path[:-1])
-            out.append(jax.jit(quantize_for_int8_compute,
-                               static_argnums=(1, 2))(leaf, axes, stacked))
+            out.append(qz(leaf, axes, stacked))
             n_quantized += 1
         else:
             out.append(leaf)

@@ -437,10 +437,8 @@ def _wdot(spec, x, w, out_dtype, preferred_element_type=None):
     if isinstance(w, Int8ComputeParam):
         return int8_einsum(spec, x, w,
                            preferred_element_type or out_dtype)
-    if preferred_element_type is not None:
-        return jnp.einsum(spec, x, w.astype(out_dtype),
-                          preferred_element_type=preferred_element_type)
-    return jnp.einsum(spec, x, w.astype(out_dtype))
+    return jnp.einsum(spec, x, w.astype(out_dtype),
+                      preferred_element_type=preferred_element_type)
 
 
 def qkv_proj(x, p, config: GPTConfig, positions=None):

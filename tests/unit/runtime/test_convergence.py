@@ -1,0 +1,114 @@
+"""End-to-end convergence harness (VERDICT r3 #5).
+
+Counterpart of the reference's loss-curve regression runs
+(``tests/model/Megatron_GPT2/run_func_test.py`` + ``test_common.py:10`` —
+DeepSpeed configs must train to baseline losses, not just produce one
+finite step): the tiny GPT preset trains a few hundred steps on a
+DETERMINISTIC synthetic corpus under {ZeRO-1, ZeRO-2 + cpu offload,
+pipeline}, and every config must drive the loss from ~ln(V) to under a
+committed bound.  Multi-step curves catch optimizer/scaling bugs —
+wrong lr application, grad mis-scaling across gas/dp, state corruption
+across steps — that single-step parity tests cannot.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt, gpt_pipeline
+from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
+                                         reset_mesh_manager)
+from deepspeed_tpu.runtime.model import from_gpt
+
+V, SEQ, STEPS = 256, 32, 120
+#: committed bound: every config must land the mean of its last 10 losses
+#: under this (from ~ln(256)=5.55 at init; the probe run reaches ~0.01)
+LOSS_BOUND = 0.08
+
+CFG = gpt.GPTConfig(vocab_size=V, max_seq_len=64, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+def _corpus(n_rows: int = 8) -> np.ndarray:
+    """Deterministic affine next-token rule t[i+1] = (3*t[i] + 7) % V —
+    fully learnable, so the loss floor is ~0 and any optimizer-scale bug
+    shows up as a stalled curve."""
+    rows = []
+    for s in range(n_rows):
+        t = [(s * 17 + 3) % V]
+        for _ in range(SEQ):
+            t.append((t[-1] * 3 + 7) % V)
+        rows.append(t)
+    return np.asarray(rows, np.int32)
+
+
+def _assert_converged(name: str, losses: list) -> float:
+    tail = float(np.mean(losses[-10:]))
+    assert np.isfinite(losses).all(), (name, losses[-5:])
+    assert tail < LOSS_BOUND, (name, tail, losses[::25])
+    # the curve must actually descend, not start low
+    assert losses[0] > 3.0, (name, losses[0])
+    return tail
+
+
+def _train_dense(stage: int, offload: bool) -> list:
+    reset_mesh_manager()
+    ds = {"train_micro_batch_size_per_gpu": 1,  # x dp=8 -> global batch 8
+          "gradient_accumulation_steps": 1,
+          "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+          "zero_optimization": {"stage": stage},
+          "steps_per_print": 1 << 30}
+    if offload:
+        ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(CFG), config=ds, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    batch = {"tokens": _corpus()}
+    return [float(jax.device_get(engine.train_batch_fused(batch)))
+            for _ in range(STEPS)]
+
+
+def test_convergence_zero1_zero2offload_pipeline():
+    # ---- ZeRO-1, device optimizer
+    zero1 = _train_dense(stage=1, offload=False)
+    tail1 = _assert_converged("zero1", zero1)
+
+    # ---- ZeRO-2 + cpu offload (host SIMD Adam), same init/data
+    from deepspeed_tpu.ops.op_builder import get_builder
+    if get_builder("cpu_adam").is_compatible():
+        offl = _train_dense(stage=2, offload=True)
+        tail2 = _assert_converged("zero2+offload", offl)
+        # same model/init/data: the host Adam must track the device Adam
+        # over the WHOLE curve, not just one step
+        np.testing.assert_allclose(offl[:20], zero1[:20], rtol=5e-3,
+                                   atol=5e-3)
+        assert abs(tail2 - tail1) < 0.02, (tail1, tail2)
+
+    # ---- pipeline (2 stages, in-jit 1F1B), own init
+    reset_mesh_manager()
+    pipe_cfg = gpt_pipeline.GPTPipeConfig(
+        **{f.name: getattr(CFG, f.name)
+           for f in dataclasses.fields(gpt.GPTConfig)},
+        num_stages=2, num_micro_batches=2)
+    mm = initialize_mesh(ParallelDims(dp=-1, pp=2))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt_pipeline.model_spec(pipe_cfg, mm.mesh),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 1},
+                "pipeline": {"stages": 2},
+                "steps_per_print": 1 << 30},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    batch = {"tokens": _corpus()}  # 8 rows = micro 1 x dp 4 x 2 microbatches
+    pipe = [float(jax.device_get(engine.train_batch(batch=batch)))
+            for _ in range(STEPS)]
+    tail3 = _assert_converged("pipeline", pipe)
+    # all three optimizer paths end in the same converged basin
+    assert abs(tail3 - tail1) < 0.05, (tail1, tail3)
