@@ -246,3 +246,65 @@ def test_int8_compute_moe_guarded():
         deepspeed_tpu.init_inference(
             model=(cfg, params),
             config={"dtype": "int8", "quant": {"int8_compute": True}})
+
+
+def test_int8_on_trained_weights():
+    """Quantization error on TRAINED weight distributions (VERDICT r3 #7):
+    random-init gaussians are the easy case — training produces heavy
+    tails/outliers that per-vector scales must absorb.  Train the tiny
+    preset to convergence on a deterministic corpus, then assert both
+    int8 serving modes stay close to the bf16 engine on held-out-shaped
+    data AND still predict the learned rule."""
+    from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
+                                             reset_mesh_manager)
+    from deepspeed_tpu.runtime.model import from_gpt
+
+    reset_mesh_manager()
+    V = CFG.vocab_size
+    rows = []
+    for s in range(8):   # affine rule t[i+1] = (3 t[i] + 7) % V
+        t = [(s * 17 + 3) % V]
+        for _ in range(48):
+            t.append((t[-1] * 3 + 7) % V)
+        rows.append(t)
+    data = np.asarray(rows, np.int32)
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(CFG),
+        config={"train_micro_batch_size_per_gpu": 8 // mm.dp_world_size,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 1 << 30},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    for _ in range(120):
+        loss = eng.train_batch_fused({"tokens": data})
+    final = float(jax.device_get(loss))
+    assert final < 0.1, final   # really trained, not random
+    trained = jax.tree_util.tree_map(
+        lambda l: jnp.asarray(np.asarray(jax.device_get(l), np.float32)),
+        eng.state["params"])
+
+    tokens = jnp.asarray(data[:2, :49], jnp.int32)
+    bf16 = deepspeed_tpu.init_inference(model=(CFG, trained),
+                                        config={"dtype": "bfloat16"})
+    l_bf16 = _loss(bf16.forward(tokens), tokens)
+    # weight-only: ppl delta < 1% on trained distributions
+    int8 = deepspeed_tpu.init_inference(model=(CFG, trained),
+                                        config={"dtype": "int8"})
+    d_wo = abs(np.exp(_loss(int8.forward(tokens), tokens)) /
+               np.exp(l_bf16) - 1.0)
+    assert d_wo < 0.01, (l_bf16, d_wo)
+    # true int8 compute (8-bit activations too): < 5%
+    qc = deepspeed_tpu.init_inference(
+        model=(CFG, trained),
+        config={"dtype": "int8", "quant": {"int8_compute": True}})
+    d_qc = abs(np.exp(_loss(qc.forward(tokens), tokens)) /
+               np.exp(l_bf16) - 1.0)
+    assert d_qc < 0.05, (l_bf16, d_qc)
+    # the quantized engines still PREDICT THE RULE greedily
+    for engine in (int8, qc):
+        out = engine.generate(tokens[:, :16], max_new_tokens=8)
+        nxt = np.asarray(tokens[:, 16:24])
+        agree = float(np.mean(np.asarray(out) == nxt))
+        assert agree >= 0.75, (agree, np.asarray(out), nxt)
