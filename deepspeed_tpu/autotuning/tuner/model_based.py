@@ -3,9 +3,10 @@
 Counterpart of the reference's ``deepspeed/autotuning/tuner/model_based_tuner.py``
 (XGBoost cost model over experiment features).  XGBoost isn't in the image;
 the same explore-then-exploit loop runs over a ridge-regularised quadratic
-least-squares model (numpy) — features are (log2 mbs, zero stage, remat,
-offload), ample for the smooth mbs/stage throughput surfaces this tuner
-ranks.
+least-squares model (numpy), refitted on ALL measured trials before every
+pick — features are (log2 mbs, mbs/16, zero stage, remat, offload) plus
+their full quadratic expansion (21 terms), ample for both saturating and
+polynomial mbs/stage throughput surfaces.
 """
 
 from __future__ import annotations
@@ -21,10 +22,12 @@ from .base import BaseTuner, Candidate
 def _features(c: Candidate) -> List[float]:
     mbs = float(c.get("train_micro_batch_size_per_gpu", 1))
     stage = float(c.get("zero_stage", 0))
-    x = [math.log2(max(mbs, 1.0)), stage,
+    # both log2(mbs) (throughput saturation curves) and scaled raw mbs
+    # (polynomial memory/latency cliffs) — the quadratic expansion over
+    # the pair can represent either shape of the mbs response
+    x = [math.log2(max(mbs, 1.0)), mbs / 16.0, stage,
          1.0 if c.get("remat", False) else 0.0,
          1.0 if c.get("offload", False) else 0.0]
-    # quadratic expansion
     quad = [a * b for i, a in enumerate(x) for b in x[i:]]
     return [1.0] + x + quad
 
