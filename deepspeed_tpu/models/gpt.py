@@ -129,6 +129,7 @@ GPT2_125M = GPTConfig(n_layer=12, n_head=12, d_model=768)
 GPT2_350M = GPTConfig(n_layer=24, n_head=16, d_model=1024)
 GPT2_760M = GPTConfig(n_layer=24, n_head=16, d_model=1536)
 GPT2_1_3B = GPTConfig(n_layer=24, n_head=32, d_model=2048)
+GPT2_2_7B = GPTConfig(n_layer=32, n_head=32, d_model=2560)
 GPT3_6_7B = GPTConfig(n_layer=32, n_head=32, d_model=4096, max_seq_len=2048)
 GPT2_13B = GPTConfig(n_layer=40, n_head=40, d_model=5120, max_seq_len=2048)
 
@@ -137,6 +138,7 @@ PRESETS = {
     "gpt2-350m": GPT2_350M,
     "gpt2-760m": GPT2_760M,
     "gpt2-1.3b": GPT2_1_3B,
+    "gpt2-2.7b": GPT2_2_7B,
     "gpt3-6.7b": GPT3_6_7B,
     "gpt2-13b": GPT2_13B,
 }

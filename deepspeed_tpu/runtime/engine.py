@@ -46,7 +46,7 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                            STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
                            SynchronizedWallClockTimer, ThroughputTimer)
 from . import loss_scaler as ls
-from .config import DeepSpeedConfig
+from .config import DeepSpeedConfig, DeepSpeedConfigError
 from .dataloader import DeepSpeedDataLoader
 from .lr_schedules import get_lr_schedule_class
 from .model import ModelSpec
@@ -108,6 +108,7 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print)
 
         self.compute_dtype = _dtype_of(self._config)
+        self.grad_accum_dtype = self._resolve_grad_accum_dtype()
         self.scaler_config = ls.LossScalerConfig.from_ds_config(self._config)
         self.loss_scaler = ls.LossScaler(self.scaler_config)
 
@@ -305,7 +306,7 @@ class DeepSpeedEngine:
             master = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), master)
             opt_state = self.optimizer.init(master)
             grad_acc = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), master)
+                lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), master)
             if separate:
                 params = jax.tree_util.tree_map(
                     lambda p: p.astype(self.compute_dtype), master)
@@ -341,6 +342,31 @@ class DeepSpeedEngine:
         }
         self._last_global_norm: Optional[float] = None
 
+    def _resolve_grad_accum_dtype(self):
+        """``data_types.grad_accum_dtype`` (reference engine.py:809
+        get_data_types): the dtype gradients ACCUMULATE in across
+        micro-steps.  Default fp32 — unlike the reference, which defaults
+        fp16 models to fp16 accumulation, we keep the conservative choice
+        for every model dtype (fp32 adds are ~free on the VPU and gas>1
+        accumulation is exactly where 16-bit mantissas lose gradient
+        signal).  An explicit 16-bit setting halves the accumulator — the
+        dominant 4-bytes/param term of the ZeRO-offload footprint — which
+        is what lets the 2.7B class fit one 16 GB chip."""
+        v = self._config.grad_accum_dtype
+        if v is None:
+            return jnp.float32
+        table = {"fp32": jnp.float32, "float32": jnp.float32,
+                 "float": jnp.float32,
+                 "fp16": jnp.float16, "float16": jnp.float16,
+                 "half": jnp.float16,
+                 "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}
+        key = str(v).lower().replace("torch.", "")
+        if key not in table:
+            raise DeepSpeedConfigError(
+                f"data_types.grad_accum_dtype={v!r} (want one of "
+                f"{sorted(set(table))})")
+        return table[key]
+
     def _init_state_offload(self, rng: jax.Array) -> None:
         """Device holds compute-dtype params + grad accumulators; fp32
         master and Adam moments live with the host offload runner."""
@@ -358,7 +384,7 @@ class DeepSpeedEngine:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype), master)
             grad_acc = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), master)
+                lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), master)
             return params, master, grad_acc
 
         out_sh = (sh.params, sh.master, sh.grads)
@@ -459,6 +485,7 @@ class DeepSpeedEngine:
         mesh = self.mesh
         separate_master = self._separate_master
         compute_dtype = self.compute_dtype
+        accum_dtype = self.grad_accum_dtype
 
         def constrain(tree, specs):
             return jax.tree_util.tree_map(
@@ -486,7 +513,8 @@ class DeepSpeedEngine:
                     return loss * scale / gas, loss
 
                 grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(accum_dtype), grads)
             new_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
             new_acc = constrain(new_acc, grad_specs)
             return new_acc, loss
@@ -497,7 +525,9 @@ class DeepSpeedEngine:
             # stage_1_and_2.py:868 reduce_independent_p_g_buckets_...: a
             # full extra gradient-sized tree never exists on device).
             #
-            #   1. grad_stats: scalar-only pass over the fp32 accumulator —
+            #   1. grad_stats: scalar-only pass over the accumulator
+            #      (fp32 by default, 16-bit under data_types.
+            #      grad_accum_dtype; reductions upcast to fp32 inside) —
             #      global norm, clip coefficient, overflow flag, next loss
             #      scale.  No big outputs, nothing donated.
             #   2. prep_leaf (per leaf, accumulator leaf donated): clip ×
@@ -558,7 +588,10 @@ class DeepSpeedEngine:
             stage 0); callers handle donation accordingly.
             """
             scale = scale_state["loss_scale"]
-            grads = jax.tree_util.tree_map(lambda g: g / scale, grad_acc)
+            # unscale/clip/step in fp32 regardless of the accumulation
+            # dtype (a 16-bit accumulator still gets fp32 update math)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / scale, grad_acc)
             overflow = has_overflow(grads) if scaler_config.enabled else jnp.zeros((), bool)
             if clip > 0:
                 grads, norm = clip_grads_by_global_norm(grads, clip)

@@ -32,19 +32,23 @@ def zero_state_bytes(num_params: int, dp: int, stage: int,
 
 
 def offload_peak_bytes(num_params: int, largest_leaf_params: int,
-                       mixed_precision: bool = True) -> int:
+                       mixed_precision: bool = True,
+                       grad_accum_bytes: int = 4) -> int:
     """Peak device bytes of the streamed ZeRO-offload step
     (``engine._apply_offload_step``), excluding activations.
 
-    Persistent: 16-bit params + fp32 gradient accumulator.  The prep →
-    transfer → free / upload loops stream one leaf at a time (the
+    Persistent: 16-bit params + the gradient accumulator
+    (``grad_accum_bytes``/param — 4 for the default fp32, 2 when
+    ``data_types.grad_accum_dtype`` selects a 16-bit accumulator).  The
+    prep → transfer → free / upload loops stream one leaf at a time (the
     reference's fixed-size IPG-bucket discipline,
     ``stage_1_and_2.py:868``), so the only transient is ONE 16-bit leaf
     — never a gradient- or parameter-sized tree.  Master + Adam moments
     are host-resident (offload) and cost no HBM.
     """
     p = 2 if mixed_precision else 4
-    return int(num_params) * (p + 4) + int(largest_leaf_params) * p
+    return int(num_params) * (p + int(grad_accum_bytes)) \
+        + int(largest_leaf_params) * p
 
 
 def device_budget(memory_fraction: float = 0.85,
