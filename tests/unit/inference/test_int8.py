@@ -308,3 +308,31 @@ def test_int8_on_trained_weights():
         nxt = np.asarray(tokens[:, 16:24])
         agree = float(np.mean(np.asarray(out) == nxt))
         assert agree >= 0.75, (agree, np.asarray(out), nxt)
+
+
+def test_int8_compute_composes_with_tp():
+    """int8_compute x tensor parallelism: quantization happens AFTER TP
+    sharding, so codes and per-output-channel scales stay sharded over the
+    model axis, and the integer-dot serving output matches the unsharded
+    int8-compute engine."""
+    from deepspeed_tpu.ops.int8 import Int8ComputeParam
+    from deepspeed_tpu.parallel.mesh import (MODEL_AXIS, ParallelDims,
+                                             initialize_mesh,
+                                             reset_mesh_manager)
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0, 256)
+    reset_mesh_manager()
+    plain = deepspeed_tpu.init_inference(
+        model=(CFG, params),
+        config={"dtype": "int8", "quant": {"int8_compute": True}})
+    base = np.asarray(plain(prompt), np.float32)
+    mm = initialize_mesh(ParallelDims(dp=-1, tp=2))
+    sharded = deepspeed_tpu.init_inference(
+        model=(CFG, params),
+        config={"dtype": "int8", "quant": {"int8_compute": True},
+                "tensor_parallel": {"tp_size": 2}})
+    wq = sharded.params["blocks"]["wqkv"]
+    assert isinstance(wq, Int8ComputeParam)
+    assert MODEL_AXIS in str(wq.q.sharding.spec), wq.q.sharding
+    got = np.asarray(sharded(prompt), np.float32)
+    np.testing.assert_allclose(got, base, atol=2e-3, rtol=2e-3)
