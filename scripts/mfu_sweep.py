@@ -1,12 +1,15 @@
 #!/usr/bin/env python3
-"""On-chip MFU sweep for the GPT-2 350M headline bench.
+"""On-chip evidence sweep: MFU tuning rows + capability/inference rows.
 
-Runs `bench.py` under a sequence of tuning configurations (micro-batch and
-flash block sizes via the BENCH_MB / FLASH_BLOCK_Q / FLASH_BLOCK_K env
-knobs), appending one JSON line per run to the log.  Ordered safest-first;
-each run gets a generous timeout and is stopped with SIGTERM (never
-SIGKILL — a hard kill mid-TPU-operation has wedged the axon relay before;
-see docs/performance.md measurement notes).
+Runs the GPT-2 350M training bench under micro-batch / flash-block
+tuning configurations (BENCH_MB / FLASH_BLOCK_Q / FLASH_BLOCK_K env
+knobs), then the BERT headline, the ZeRO-offload capability ladder
+(2.7b → 1.3b), and the gpt_bench prefill/decode rows (bf16 / int8 /
+int8-compute), appending one JSON line per run to the log.  Ordered
+safest/most-valuable-first; each run gets a generous timeout and is
+stopped with SIGTERM (never SIGKILL — a hard kill mid-TPU-operation has
+wedged the axon relay before; see docs/performance.md measurement
+notes), and an unterminated wedge aborts the rest of the sweep.
 
 Usage:  python scripts/mfu_sweep.py [logfile]
 """
@@ -22,25 +25,39 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: (label, env overrides) — safest/known-good first so a wedge later in the
-#: list still leaves earlier numbers on the record
+#: (label, env overrides, bench argv) — safest/known-good first so a wedge
+#: later in the list still leaves earlier numbers on the record.  The
+#: default argv runs the driver's GPT-2 350M training bench; the tail rows
+#: capture the round-4 capability/inference evidence in the same log.
+_GPT_BENCH = ["-m", "deepspeed_tpu.benchmarks.inference.gpt_bench",
+              "--model", "gpt2-125m", "--batch", "8", "--prompt", "512",
+              "--new-tokens", "32"]
 CONFIGS = [
-    ("baseline-mb32-b1024", {}),
-    ("mb32-bq512", {"FLASH_BLOCK_Q": "512"}),
-    ("mb32-b512", {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_K": "512"}),
-    ("mb40", {"BENCH_MB": "40,32"}),
-    ("mb48", {"BENCH_MB": "48,40,32"}),
-    ("mb48-bq512", {"BENCH_MB": "48,40,32", "FLASH_BLOCK_Q": "512"}),
+    ("baseline-mb32-b1024", {}, None),
+    ("mb32-bq512", {"FLASH_BLOCK_Q": "512"}, None),
+    ("mb32-b512", {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_K": "512"}, None),
+    ("mb40", {"BENCH_MB": "40,32"}, None),
+    ("mb48", {"BENCH_MB": "48,40,32"}, None),
+    ("mb48-bq512", {"BENCH_MB": "48,40,32", "FLASH_BLOCK_Q": "512"}, None),
+    ("bert-large", {}, ["bench.py", "bert"]),
+    # the 2.7B offload ladder is the most memory-aggressive run in the
+    # list — keep it AFTER the headline tuning rows so a wedge here
+    # still leaves the MFU numbers on the record
+    ("offload-capability", {}, ["bench.py", "offload"]),
+    ("prefill-bf16", {}, _GPT_BENCH + ["--dtype", "bfloat16"]),
+    ("prefill-int8", {}, _GPT_BENCH + ["--dtype", "int8"]),
+    ("prefill-int8-compute", {}, _GPT_BENCH + ["--dtype", "int8-compute"]),
 ]
 
 RUN_TIMEOUT_S = 1200
 TERM_GRACE_S = 180
 
 
-def run_one(label: str, env_over: dict, log):
+def run_one(label: str, env_over: dict, log, argv=None):
     env = {**os.environ, **env_over}
     t0 = time.time()
-    proc = subprocess.Popen([sys.executable, os.path.join(REPO, "bench.py")],
+    argv = argv or ["bench.py"]   # cwd=REPO resolves the script path
+    proc = subprocess.Popen([sys.executable] + argv,
                             env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True, cwd=REPO)
     try:
@@ -78,8 +95,8 @@ def run_one(label: str, env_over: dict, log):
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mfu_sweep.jsonl"
     with open(path, "a") as log:
-        for label, env_over in CONFIGS:
-            if not run_one(label, env_over, log):
+        for label, env_over, argv in CONFIGS:
+            if not run_one(label, env_over, log, argv):
                 break
     sys.stderr.write(f"[sweep] results in {path}\n")
 
