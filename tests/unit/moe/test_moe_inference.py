@@ -82,3 +82,41 @@ def test_moe_expert_sharded_serving_matches_replicated():
     got = np.asarray(sharded(tokens))
     np.testing.assert_allclose(got, base, atol=2e-4, rtol=2e-4)
     reset_mesh_manager()
+
+
+def test_moe_int8_weight_only_serving():
+    """Weight-only int8 serves the MoE family through the same Int8Param
+    duck-typing as dense GPT (expert wi/wo and the attention stacks store
+    int8 codes; the gate/coefficient read dequantizes in the consuming
+    matmul).  Perplexity must track the fp-engine closely."""
+    import dataclasses
+
+    from deepspeed_tpu.inference.quantization import Int8Param
+    cfg = dataclasses.replace(CFG, dtype=jnp.bfloat16)
+    params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 128, size=(2, 32)), jnp.int32)
+
+    bf16 = deepspeed_tpu.init_inference(model=(cfg, params),
+                                        config={"dtype": "bfloat16"})
+    int8 = deepspeed_tpu.init_inference(model=(cfg, params),
+                                        config={"dtype": "int8"})
+    # the expert stacks really store int8 codes
+    moe_blocks = int8.params["moe_blocks"]
+    assert isinstance(moe_blocks["experts"]["wi"], Int8Param)
+    assert moe_blocks["experts"]["wi"].q.dtype == jnp.int8
+    assert isinstance(int8.params["moe_attn_blocks"]["wqkv"], Int8Param)
+    # gate router stays full precision (tiny, routing-critical)
+    assert not isinstance(moe_blocks["gate"]["wg"], Int8Param)
+
+    def loss(logits):
+        lg = logits[:, :-1, :cfg.vocab_size].astype(jnp.float32)
+        tg = tokens[:, 1:]
+        return float(jnp.mean(jax.nn.logsumexp(lg, axis=-1) -
+                              jnp.take_along_axis(lg, tg[..., None],
+                                                  axis=-1)[..., 0]))
+
+    l_bf16, l_int8 = loss(bf16.forward(tokens)), loss(int8.forward(tokens))
+    assert abs(np.exp(l_int8) / np.exp(l_bf16) - 1.0) < 0.02, (l_bf16, l_int8)
+    out = int8.generate(tokens[:, :8], max_new_tokens=4)
+    assert out.shape == (2, 4) and (np.asarray(out) < cfg.vocab_size).all()
