@@ -29,7 +29,7 @@ import numpy as np
 
 def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
               new_tokens: int = 64, dtype: str = "bfloat16",
-              warmup: int = 3) -> Dict[str, Any]:
+              warmup: int = 3, kv_cache_dtype: str = "auto") -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
 
@@ -47,6 +47,7 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     params = gpt.init(config, jax.random.PRNGKey(0))
     eng_cfg = ({"dtype": "int8", "quant": {"int8_compute": True}}
                if dtype == "int8-compute" else {"dtype": dtype})
+    eng_cfg["kv_cache_dtype"] = kv_cache_dtype
     engine = deepspeed_tpu.init_inference(model=(config, params),
                                           config=eng_cfg)
     # the manual prefill/decode path must use the SAME dtype-cast weights
@@ -65,8 +66,13 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     # ---- prefill latency
     # warmup decode steps also occupy cache slots — size for them or the
     # tail of the measured distribution decodes against a clobbered cache
-    cache = gpt_inference.init_cache(config, batch,
-                                     prompt + new_tokens + warmup)
+    # round to a 128 multiple like engine.generate does: cached_attention's
+    # Pallas path (incl. the int8 in-VMEM dequant kernel) needs a tileable
+    # S_max — an odd length would silently measure the dense fallback
+    cache_len = -(-(prompt + new_tokens + warmup) // 128) * 128
+    cache = gpt_inference.init_cache(
+        config, batch, cache_len,
+        kv_dtype="int8" if kv_cache_dtype == "int8" else None)
     prefill = jax.jit(lambda p, t, c: gpt_inference.prefill(p, t, config, c))
     logits, cache0 = prefill(params, tokens, cache)
     fence(logits)                                      # compile
@@ -105,6 +111,7 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     return {
         "model": model, "batch": batch, "prompt": prompt,
         "new_tokens": new_tokens, "dtype": dtype,
+        "kv_cache_dtype": kv_cache_dtype,
         "prefill_ms": round(prefill_ms, 2),
         "token_latency_ms": {
             "p50": round(float(np.percentile(lat, 50)), 3),
@@ -126,11 +133,16 @@ def main() -> None:
     ap.add_argument("--new-tokens", type=int, default=64)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32", "int8", "int8-compute"])
+    ap.add_argument("--kv-cache-dtype", default="auto",
+                    choices=["auto", "int8"],
+                    help="int8 stores the KV cache as codes + per-vector "
+                    "scales (half the HBM footprint/stream)")
     ap.add_argument("--warmup", type=int, default=3)
     args = ap.parse_args()
     result = run_bench(model=args.model, batch=args.batch,
                        prompt=args.prompt, new_tokens=args.new_tokens,
-                       dtype=args.dtype, warmup=args.warmup)
+                       dtype=args.dtype, warmup=args.warmup,
+                       kv_cache_dtype=args.kv_cache_dtype)
     print(json.dumps(result))
 
 

@@ -37,6 +37,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     layer-by-layer reference path."""
 
     dtype: str = "bfloat16"
+    #: "auto" caches K/V in the compute dtype; "int8" stores int8 codes +
+    #: per-vector fp32 scales (beyond-reference: the decode kernel
+    #: dequantizes in VMEM, halving decode HBM traffic and the cache's
+    #: memory footprint)
+    kv_cache_dtype: str = "auto"
     tensor_parallel: Dict = dataclasses.field(default_factory=dict)
     moe: Dict = dataclasses.field(default_factory=dict)
     quant: Dict = dataclasses.field(default_factory=dict)
@@ -54,6 +59,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             self.tensor_parallel = {"tp_size": self.tensor_parallel}
         self.tp = DeepSpeedTPConfig.from_dict(self.tensor_parallel or {})
         self.quantization = QuantizationConfig.from_dict(self.quant or {})
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} (want 'auto' or "
+                "'int8')")
 
     @property
     def tp_size(self) -> int:

@@ -114,7 +114,23 @@ class InferenceEngine:
         # groups — here the expert mesh axis shards the expert stacks)
         from ..models.gpt_moe import GPTMoEConfig
         cfg = self.model_config
+        self._kv_dtype = ("int8" if config.kv_cache_dtype == "int8"
+                          else None)
+        if self._kv_dtype is not None and not isinstance(cfg, GPTMoEConfig) \
+                and (getattr(cfg, "pos_embed", "") == "alibi"
+                     or getattr(cfg, "local_attention_window", 0) > 0):
+            # those decode paths are dense over the padded cache: an int8
+            # cache would be dequantized IN FULL every layer of every step
+            # — strictly worse than 'auto'; refuse rather than degrade
+            raise NotImplementedError(
+                "kv_cache_dtype='int8' rides the streaming decode kernel; "
+                "alibi/windowed-attention models decode through the dense "
+                "cache path — serve them with kv_cache_dtype='auto'")
         if isinstance(cfg, GPTMoEConfig):
+            if self._kv_dtype is not None:
+                raise NotImplementedError(
+                    "kv_cache_dtype='int8' serves the dense GPT family; "
+                    "MoE decode caches in the compute dtype")
             if self._int8_compute:
                 # the MoE tree stacks layers under dense_blocks /
                 # moe_attn_blocks and experts under moe_blocks — layouts
@@ -174,9 +190,13 @@ class InferenceEngine:
                 lg = jnp.where(lg < kth, -jnp.inf, lg)
             return jax.random.categorical(key, lg).astype(jnp.int32)
 
+        kv_dtype = self._kv_dtype
+
         def run(params, tokens, prompt_len, key, temperature, is_ragged):
             B, S = tokens.shape
-            cache = fam.init_cache(cfg, B, max_len)
+            cache = (fam.init_cache(cfg, B, max_len, kv_dtype=kv_dtype)
+                     if kv_dtype is not None else
+                     fam.init_cache(cfg, B, max_len))
             logits, cache = fam.prefill(params, tokens, cfg, cache)
             # logits at the last *prompt* token predict the first new token
             last = logits[jnp.arange(B), prompt_len - 1]
@@ -308,6 +328,10 @@ class BertInferenceEngine:
             raise NotImplementedError(
                 "quant.int8_compute serves the GPT decoder families; the "
                 "encoder engine uses weight-only int8 (dtype='int8')")
+        if config.kv_cache_dtype != "auto":
+            raise NotImplementedError(
+                "kv_cache_dtype applies to autoregressive decode; the "
+                "encoder engine has no KV cache")
         self.model_config = dataclasses.replace(model_config, dtype=dtype)
         self.params = jax.tree_util.tree_map(
             lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating)

@@ -38,12 +38,19 @@ PyTree = Any
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class KVCache:
+    """``k_scale``/``v_scale`` are ``None`` for a full-precision cache; for
+    an int8 cache (``kv_cache_dtype: "int8"``) k/v hold codes and the
+    scales are per-vector fp32 [L, B, S_max, H, 1] — half the cache HBM,
+    dequantized inside the decode kernel's VMEM stream."""
+
     k: jnp.ndarray        # [L, B, S_max, H, D]
     v: jnp.ndarray        # [L, B, S_max, H, D]
     length: jnp.ndarray   # [] int32 — tokens already cached
+    k_scale: Any = None
+    v_scale: Any = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.length), None
+        return (self.k, self.v, self.length, self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -53,23 +60,47 @@ class KVCache:
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def int8(self) -> bool:
+        return self.k_scale is not None
 
-def init_cache(config: gpt.GPTConfig, batch: int, max_len: int) -> KVCache:
+
+def init_cache(config: gpt.GPTConfig, batch: int, max_len: int,
+               kv_dtype=None) -> KVCache:
+    """``kv_dtype``: None → cache in the compute dtype; ``"int8"``/
+    ``jnp.int8`` → int8 codes + per-vector fp32 scales (beyond-reference:
+    halves decode HBM traffic and doubles the context/batch a chip's
+    cache budget holds)."""
     shape = (config.n_layer, batch, max_len, config.n_head, config.head_dim)
+    if kv_dtype in ("int8", jnp.int8):
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       length=jnp.zeros((), jnp.int32),
+                       k_scale=jnp.zeros(shape[:-1] + (1,), jnp.float32),
+                       v_scale=jnp.zeros(shape[:-1] + (1,), jnp.float32))
     return KVCache(k=jnp.zeros(shape, config.dtype),
                    v=jnp.zeros(shape, config.dtype),
                    length=jnp.zeros((), jnp.int32))
 
 
 def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig,
-                      window=None):
+                      window=None, k_scale=None, v_scale=None):
     """q: [B, S_q, H, D] attending to cache[:, :pos+S_q].
 
     ``pos`` is the number of tokens already in the cache before this call;
     query i sits at absolute position pos+i and sees cache slots ≤ pos+i.
     ``window`` (traced per-layer scalar) routes through the banded path —
     the same ``gpt._windowed_attention`` that serves training/prefill.
+    ``k_scale``/``v_scale`` mark an int8 cache: the streaming kernel
+    dequantizes in VMEM; the windowed/alibi dense paths dequantize up
+    front.
     """
+    from ..ops.pallas.decode_attention import cached_attention, dequantize_kv
+    if (window is not None or config.pos_embed == "alibi") \
+            and k_scale is not None:
+        cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
+        cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
+        k_scale = v_scale = None
     if window is not None:
         return gpt._windowed_attention(q, cache_k, cache_v, config, window,
                                        pos=pos)
@@ -82,11 +113,11 @@ def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig,
             else pos_arr + steps
         return gpt._alibi_attention(q, cache_k, cache_v, config,
                                     q_positions=q_positions)
-    from ..ops.pallas.decode_attention import cached_attention
     scale = config.attn_softmax_scale
     if scale is None:
         scale = 1.0 / math.sqrt(config.head_dim)
-    return cached_attention(q, cache_k, cache_v, pos, sm_scale=scale)
+    return cached_attention(q, cache_k, cache_v, pos, sm_scale=scale,
+                            k_scale=k_scale, v_scale=v_scale)
 
 
 def _block_tail(x, attn, p, config: gpt.GPTConfig):
@@ -108,24 +139,42 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
     B, S = tokens.shape
     positions = jnp.arange(S)
     x = gpt.embed(params, tokens, config, positions=positions)
+    int8 = cache.int8
+    if int8:
+        from ..ops.pallas.decode_attention import quantize_kv
 
     def layer(x, xs):
-        p, ck, cv, idx = xs
+        p, ck, cv, ksc, vsc, idx = xs
         q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        if int8:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new_ck = lax.dynamic_update_slice(ck, kq, (0, 0, 0, 0))
+            new_cv = lax.dynamic_update_slice(cv, vq, (0, 0, 0, 0))
+            ksc = lax.dynamic_update_slice(ksc, ks, (0, 0, 0, 0))
+            vsc = lax.dynamic_update_slice(vsc, vs, (0, 0, 0, 0))
+        else:
+            new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, 0, 0, 0))
+            new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, 0, 0, 0))
         # prefill attention runs on the unpadded k/v (training flash path);
         # only decode reads back through the padded cache
         attn = gpt._attention(q, k, v, config,
                               window=gpt.layer_window(config, idx, S))
-        return _block_tail(x, attn, p, config), (new_ck, new_cv)
+        return _block_tail(x, attn, p, config), (new_ck, new_cv, ksc, vsc)
 
-    x, (new_k, new_v) = lax.scan(
+    zero = jnp.zeros((config.n_layer,), jnp.int8)  # placeholder, not written
+    x, (new_k, new_v, new_ksc, new_vsc) = lax.scan(
         layer, x, (params["blocks"], cache.k, cache.v,
+                   cache.k_scale if int8 else zero,
+                   cache.v_scale if int8 else zero,
                    jnp.arange(config.n_layer)))
     logits = gpt.lm_logits(params, x, config)
     return logits, KVCache(k=new_k, v=new_v,
-                           length=jnp.asarray(S, jnp.int32))
+                           length=jnp.asarray(S, jnp.int32),
+                           k_scale=new_ksc if int8 else None,
+                           v_scale=new_vsc if int8 else None)
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
@@ -142,27 +191,41 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
     pos = lengths if ragged else cache.length
     positions = pos[:, None] if ragged else pos[None]
     x = gpt.embed(params, token[:, None], config, positions=positions)
+    int8 = cache.int8
+    if int8:
+        from ..ops.pallas.decode_attention import quantize_kv
+
+    def write(buf, val):
+        """One new [B, 1, H, *] column at pos (shared or per-row)."""
+        if ragged:
+            return buf.at[jnp.arange(B), pos].set(val[:, 0])
+        return lax.dynamic_update_slice(buf, val, (0, pos, 0, 0))
 
     def layer(x, xs):
-        p, ck, cv, idx = xs
+        p, ck, cv, ksc, vsc, idx = xs
         q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-        if ragged:
-            rows = jnp.arange(B)
-            new_ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
-            new_cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+        if int8:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new_ck, new_cv = write(ck, kq), write(cv, vq)
+            ksc, vsc = write(ksc, ks), write(vsc, vs)
         else:
-            new_ck = lax.dynamic_update_slice(
-                ck, k.astype(ck.dtype), (0, pos, 0, 0))
-            new_cv = lax.dynamic_update_slice(
-                cv, v.astype(cv.dtype), (0, pos, 0, 0))
+            new_ck = write(ck, k.astype(ck.dtype))
+            new_cv = write(cv, v.astype(cv.dtype))
         attn = _cached_attention(
             q, new_ck, new_cv, pos, config,
-            window=gpt.layer_window(config, idx, cache.max_len))
-        return _block_tail(x, attn, p, config), (new_ck, new_cv)
+            window=gpt.layer_window(config, idx, cache.max_len),
+            k_scale=ksc if int8 else None, v_scale=vsc if int8 else None)
+        return _block_tail(x, attn, p, config), (new_ck, new_cv, ksc, vsc)
 
-    x, (new_k, new_v) = lax.scan(
+    zero = jnp.zeros((config.n_layer,), jnp.int8)  # placeholder, not written
+    x, (new_k, new_v, new_ksc, new_vsc) = lax.scan(
         layer, x, (params["blocks"], cache.k, cache.v,
+                   cache.k_scale if int8 else zero,
+                   cache.v_scale if int8 else zero,
                    jnp.arange(config.n_layer)))
     logits = gpt.lm_logits(params, x[:, 0], config)
     new_len = (jnp.max(pos) + 1) if ragged else pos + 1
-    return logits, KVCache(k=new_k, v=new_v, length=new_len)
+    return logits, KVCache(k=new_k, v=new_v, length=new_len,
+                           k_scale=new_ksc if int8 else None,
+                           v_scale=new_vsc if int8 else None)

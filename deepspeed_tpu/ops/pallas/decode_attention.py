@@ -7,6 +7,12 @@ the KV cache with the current sequence length masked): one query token per
 cache.  The Pallas kernel streams cache blocks through VMEM with the
 online-softmax recurrence and skips blocks entirely beyond ``pos`` — the
 decode step's HBM traffic is the live cache prefix, not S_max.
+
+Int8 cache variant (beyond the reference): k/v arrive as int8 codes with
+per-vector fp32 scales and are dequantized IN VMEM after the block load,
+so the HBM stream — the decode bottleneck — ships half the bytes.  Decode
+is memory-bound, so this is a direct latency/batch-capacity lever, the
+same trade the weight-only int8 path makes for weights.
 """
 
 from __future__ import annotations
@@ -23,6 +29,20 @@ from jax.experimental.pallas import tpu as pltpu
 from .utils import interpret_mode, use_pallas
 
 NEG_INF = float("-inf")
+
+
+def dequantize_kv(codes, scale, dtype):
+    """int8 codes [..., D] + per-vector scale [..., 1] → ``dtype``."""
+    return (codes.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_kv(x):
+    """x [..., D] → (int8 codes, fp32 scale [..., 1]): symmetric
+    per-vector quantization of one K or V head vector."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    return jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8), scale
 
 
 def cached_attention_reference(q, cache_k, cache_v, pos,
@@ -44,8 +64,16 @@ def cached_attention_reference(q, cache_k, cache_v, pos,
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cache_v)
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                   *, sm_scale, block_k, H):
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+                   sm_scale, block_k, H, quantized):
+    """One online-softmax decode kernel serving both cache layouts: with
+    ``quantized`` the k/v blocks arrive as int8 codes plus per-vector fp32
+    scale columns (two extra refs) and dequantize in VMEM — half the HBM
+    bytes on the memory-bound decode path."""
+    if quantized:
+        kscale_ref, vscale_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -62,6 +90,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         q = q_ref[0].astype(jnp.float32) * sm_scale    # (1, D)
         ks = k_ref[0].astype(jnp.float32)              # (BK, D)
         vs = v_ref[0].astype(jnp.float32)
+        if quantized:
+            ks = ks * kscale_ref[0]
+            vs = vs * vscale_ref[0]
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (1, BK)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -80,22 +111,26 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-def _decode(q3, k3, v3, pos, sm_scale, block_k, H):
+def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None):
     BH, _, D = q3.shape
     Smax = k3.shape[1]
     B = BH // H
+    quantized = ks3 is not None
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
-                               block_k=block_k, H=H)
+                               block_k=block_k, H=H, quantized=quantized)
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0))
+    scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, ki: (bh, ki, 0))
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
+        kv_spec, kv_spec,
+    ] + ([scale_spec, scale_spec] if quantized else [])
+    args = (pos_arr, q3, k3, v3) + ((ks3, vs3) if quantized else ())
     return pl.pallas_call(
         kernel,
         grid=(BH, Smax // block_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, 1, D), q3.dtype),
         scratch_shapes=[
@@ -104,27 +139,39 @@ def _decode(q3, k3, v3, pos, sm_scale, block_k, H):
             pltpu.VMEM((1, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(pos_arr, q3, k3, v3)
+    )(*args)
 
 
 def cached_attention(q, cache_k, cache_v, pos,
-                     sm_scale: Optional[float] = None):
+                     sm_scale: Optional[float] = None,
+                     k_scale=None, v_scale=None):
     """q [B,Sq,H,D] over a padded cache [B,Smax,H,D], visibility ≤ pos+i.
 
     ``pos``: scalar, or a per-row [B] vector for ragged decode (each row's
     block sweep stops at ITS live prefix).  Single-token decode (Sq=1)
     takes the Pallas streaming kernel; other shapes (chunked prefill) use
     the dense reference.
+
+    With ``k_scale``/``v_scale`` ([B,Smax,H,1] fp32) the cache holds int8
+    codes; the kernel dequantizes in VMEM (halving the HBM stream), and the
+    non-kernel fallbacks dequantize before the dense math.
     """
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
+    int8_cache = k_scale is not None
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     block_k = next((b for b in (256, 128) if Smax % b == 0), None)
+
+    def to3(x, d=D):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], d)
+
     if Sq != 1 or not use_pallas() or block_k is None:
+        if int8_cache:
+            cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
+            cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
         return cached_attention_reference(q, cache_k, cache_v, pos, scale)
 
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
-
-    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k, H)
+    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k, H,
+                 ks3=to3(k_scale, 1) if int8_cache else None,
+                 vs3=to3(v_scale, 1) if int8_cache else None)
     return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)

@@ -47,6 +47,8 @@ CONFIGS = [
     ("prefill-bf16", {}, _GPT_BENCH + ["--dtype", "bfloat16"]),
     ("prefill-int8", {}, _GPT_BENCH + ["--dtype", "int8"]),
     ("prefill-int8-compute", {}, _GPT_BENCH + ["--dtype", "int8-compute"]),
+    ("decode-int8-kv", {}, _GPT_BENCH + ["--dtype", "bfloat16",
+                                         "--kv-cache-dtype", "int8"]),
 ]
 
 RUN_TIMEOUT_S = 1200

@@ -1,0 +1,144 @@
+"""Int8 KV cache (beyond-reference; see ops/pallas/decode_attention.py):
+codes + per-vector fp32 scales halve the cache's HBM footprint and the
+decode kernel's memory stream.  Decode is memory-bound, so this is the
+serving-side twin of weight-only int8.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt, gpt_inference
+from deepspeed_tpu.ops.pallas.decode_attention import (
+    cached_attention, cached_attention_reference, dequantize_kv, quantize_kv)
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=256, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+
+
+def test_quantize_kv_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 64), jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 16, 4, 1)
+    back = dequantize_kv(q, s, jnp.float32)
+    rel = float(jnp.linalg.norm(back - x) / jnp.linalg.norm(x))
+    assert rel < 0.01, rel
+
+
+@pytest.mark.parametrize("pos", [5, 100, [3, 120]])
+def test_int8_decode_kernel_matches_fp(pallas_interpret, pos):
+    """The in-VMEM dequant kernel must match the fp reference attention on
+    the dequantized cache exactly (same math, half the HBM stream), and
+    track the ORIGINAL fp cache within int8 quantization error."""
+    B, Smax, H, D = 2, 256, 4, 64
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, H, D), jnp.float32)
+    ck = jax.random.normal(kk, (B, Smax, H, D), jnp.float32)
+    cv = jax.random.normal(kv, (B, Smax, H, D), jnp.float32)
+    pos = jnp.asarray(pos, jnp.int32)
+    ck_q, ck_s = quantize_kv(ck)
+    cv_q, cv_s = quantize_kv(cv)
+
+    out_int8 = cached_attention(q, ck_q, cv_q, pos, k_scale=ck_s,
+                                v_scale=cv_s)
+    # exact vs the dense reference on the dequantized cache
+    ref_deq = cached_attention_reference(
+        q, dequantize_kv(ck_q, ck_s, jnp.float32),
+        dequantize_kv(cv_q, cv_s, jnp.float32), pos)
+    np.testing.assert_allclose(np.asarray(out_int8), np.asarray(ref_deq),
+                               atol=2e-5, rtol=2e-5)
+    # close to the original fp cache (per-vector int8 error only)
+    ref_fp = cached_attention_reference(q, ck, cv, pos)
+    np.testing.assert_allclose(np.asarray(out_int8), np.asarray(ref_fp),
+                               atol=0.03, rtol=0.03)
+
+
+def test_int8_cache_decode_matches_fp_cache():
+    """Full decode path: int8-cache decode tracks fp-cache decode across
+    steps, through the non-kernel fallback (CPU) and the rotary family."""
+    import dataclasses
+    for cfg in (CFG, dataclasses.replace(CFG, pos_embed="rotary")):
+        params = gpt.init(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 0, 256)
+        cache_fp = gpt_inference.init_cache(cfg, 2, 64)
+        cache_q = gpt_inference.init_cache(cfg, 2, 64, kv_dtype="int8")
+        assert cache_q.k.dtype == jnp.int8 and cache_q.int8
+        assert cache_q.k_scale.shape == (cfg.n_layer, 2, 64, cfg.n_head, 1)
+
+        lg_fp, cache_fp = gpt_inference.prefill(params, tokens[:, :8], cfg,
+                                                cache_fp)
+        lg_q, cache_q = gpt_inference.prefill(params, tokens[:, :8], cfg,
+                                              cache_q)
+        # prefill logits identical: prefill attends to the unpadded fp k/v
+        np.testing.assert_allclose(np.asarray(lg_q), np.asarray(lg_fp),
+                                   atol=1e-5, rtol=1e-5)
+        for i in range(8, 12):
+            lfp, cache_fp = gpt_inference.decode_step(params, tokens[:, i],
+                                                      cfg, cache_fp)
+            lq, cache_q = gpt_inference.decode_step(params, tokens[:, i],
+                                                    cfg, cache_q)
+            # int8 cache error stays small through the whole stack
+            np.testing.assert_allclose(np.asarray(lq), np.asarray(lfp),
+                                       atol=0.05, rtol=0.05,
+                                       err_msg=f"step {i} ({cfg.pos_embed})")
+
+
+def test_engine_kv_cache_int8_generate():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 256)
+    base = deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "float32"})
+    q = deepspeed_tpu.init_inference(
+        model=(CFG, params),
+        config={"dtype": "float32", "kv_cache_dtype": "int8"})
+    out_b = np.asarray(base.generate(prompt, max_new_tokens=8))
+    out_q = np.asarray(q.generate(prompt, max_new_tokens=8))
+    assert out_q.shape == (2, 8)
+    # greedy agreement: int8 cache noise can flip near-ties on random
+    # init, but most steps must agree
+    agree = float(np.mean(out_q == out_b))
+    assert agree >= 0.5, (agree, out_q, out_b)
+    # ragged prompts ride the same int8 cache path
+    out_r = q.generate(prompt, max_new_tokens=4, prompt_lens=[10, 16])
+    assert np.asarray(out_r).shape == (2, 4)
+
+
+def test_kv_cache_dtype_validation():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        deepspeed_tpu.init_inference(
+            model=(CFG, params),
+            config={"dtype": "float32", "kv_cache_dtype": "int4"})
+    # MoE family refuses clearly
+    from deepspeed_tpu.models import gpt_moe
+    mcfg = gpt_moe.GPTMoEConfig(vocab_size=128, max_seq_len=64, n_layer=2,
+                                n_head=2, d_model=32, dtype=jnp.float32,
+                                vocab_round_to=128, num_experts=2)
+    with pytest.raises(NotImplementedError, match="kv_cache_dtype"):
+        deepspeed_tpu.init_inference(
+            model=(mcfg, gpt_moe.init(mcfg, jax.random.PRNGKey(0))),
+            config={"dtype": "float32", "kv_cache_dtype": "int8"})
+
+
+def test_kv_cache_int8_refuses_dense_decode_paths():
+    """Alibi/windowed models decode through the dense cache path, where an
+    int8 cache would be dequantized in full every layer of every step —
+    the engine must refuse rather than silently degrade."""
+    import dataclasses
+    for variant in (dict(pos_embed="alibi"),
+                    dict(local_attention_window=32)):
+        cfg = dataclasses.replace(CFG, **variant)
+        params = gpt.init(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="kv_cache_dtype"):
+            deepspeed_tpu.init_inference(
+                model=(cfg, params),
+                config={"dtype": "float32", "kv_cache_dtype": "int8"})
