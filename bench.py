@@ -273,6 +273,12 @@ def main() -> None:
             "zero_optimization": {"stage": 2 if n_chips > 1 else 1},
             "bf16": {"enabled": bool(on_tpu)},
         }
+        # sweep knob: a 16-bit accumulator halves the grad tree's HBM,
+        # which can buy a bigger micro-batch (at gas=1 the backward's
+        # grads are already bf16, so nothing is lost)
+        if os.environ.get("BENCH_ACCUM_DTYPE"):
+            ds_config["data_types"] = {
+                "grad_accum_dtype": os.environ["BENCH_ACCUM_DTYPE"]}
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model_spec, config=ds_config, mesh_manager=mm,
             rng=jax.random.PRNGKey(0))
@@ -373,6 +379,7 @@ def main() -> None:
             "mfu": round(mfu, 4),
             "final_loss": float(loss),
             "zero_stage": ds_config["zero_optimization"]["stage"],
+            "grad_accum_dtype": os.environ.get("BENCH_ACCUM_DTYPE", "fp32"),
         },
     }
     if tpu_error is not None:

@@ -39,6 +39,9 @@ CONFIGS = [
     ("mb40", {"BENCH_MB": "40,32"}, None),
     ("mb48", {"BENCH_MB": "48,40,32"}, None),
     ("mb48-bq512", {"BENCH_MB": "48,40,32", "FLASH_BLOCK_Q": "512"}, None),
+    # bf16 accumulator halves the grad tree: try the next micro-batch up
+    ("mb64-bf16acc", {"BENCH_MB": "64,48",
+                      "BENCH_ACCUM_DTYPE": "bf16"}, None),
     ("bert-large", {}, ["bench.py", "bert"]),
     # the 2.7B offload ladder is the most memory-aggressive run in the
     # list — keep it AFTER the headline tuning rows so a wedge here
