@@ -128,6 +128,49 @@ def _block_tail(x, attn, p, config: gpt.GPTConfig):
     return gpt.mlp_residual(x + attn_out, p, config)
 
 
+def _layer_scan(x, params, cache: KVCache, config: gpt.GPTConfig, positions,
+                write, attn):
+    """The one layer-stack scan every cache-filling path shares.
+
+    ``write(buf, val)`` places this step's K/V (or scale) column(s) into
+    the cache buffer; int8 caches quantize per vector first and write
+    codes + scales through the same ``write``.  ``attn(q, k, v, new_ck,
+    new_cv, ksc, vsc, idx)`` computes the sublayer's attention (prefill
+    reads the fresh unpadded k/v; extend/decode read back through the
+    updated cache).  Returns (hidden states, updated KVCache with the
+    caller-provided ``length``-less fields filled in).
+    """
+    int8 = cache.int8
+    if int8:
+        from ..ops.pallas.decode_attention import quantize_kv
+
+    def layer(x, xs):
+        p, ck, cv, ksc, vsc, idx = xs
+        q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
+        if int8:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new_ck, new_cv = write(ck, kq), write(cv, vq)
+            ksc, vsc = write(ksc, ks), write(vsc, vs)
+        else:
+            new_ck = write(ck, k.astype(ck.dtype))
+            new_cv = write(cv, v.astype(cv.dtype))
+        a = attn(q, k, v, new_ck, new_cv,
+                 ksc if int8 else None, vsc if int8 else None, idx)
+        return _block_tail(x, a, p, config), (new_ck, new_cv, ksc, vsc)
+
+    zero = jnp.zeros((config.n_layer,), jnp.int8)  # placeholder, not written
+    x, (new_k, new_v, new_ksc, new_vsc) = lax.scan(
+        layer, x, (params["blocks"], cache.k, cache.v,
+                   cache.k_scale if int8 else zero,
+                   cache.v_scale if int8 else zero,
+                   jnp.arange(config.n_layer)))
+    return x, dataclasses.replace(
+        cache, k=new_k, v=new_v,
+        k_scale=new_ksc if int8 else None,
+        v_scale=new_vsc if int8 else None)
+
+
 def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
             cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
     """Run the prompt through the model, filling cache[0:S].
@@ -139,42 +182,66 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
     B, S = tokens.shape
     positions = jnp.arange(S)
     x = gpt.embed(params, tokens, config, positions=positions)
-    int8 = cache.int8
-    if int8:
-        from ..ops.pallas.decode_attention import quantize_kv
 
-    def layer(x, xs):
-        p, ck, cv, ksc, vsc, idx = xs
-        q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-        if int8:
-            kq, ks = quantize_kv(k)
-            vq, vs = quantize_kv(v)
-            new_ck = lax.dynamic_update_slice(ck, kq, (0, 0, 0, 0))
-            new_cv = lax.dynamic_update_slice(cv, vq, (0, 0, 0, 0))
-            ksc = lax.dynamic_update_slice(ksc, ks, (0, 0, 0, 0))
-            vsc = lax.dynamic_update_slice(vsc, vs, (0, 0, 0, 0))
-        else:
-            new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                              (0, 0, 0, 0))
-            new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                              (0, 0, 0, 0))
+    def write(buf, val):
+        return lax.dynamic_update_slice(buf, val, (0, 0, 0, 0))
+
+    def attn(q, k, v, new_ck, new_cv, ksc, vsc, idx):
         # prefill attention runs on the unpadded k/v (training flash path);
         # only decode reads back through the padded cache
-        attn = gpt._attention(q, k, v, config,
+        return gpt._attention(q, k, v, config,
                               window=gpt.layer_window(config, idx, S))
-        return _block_tail(x, attn, p, config), (new_ck, new_cv, ksc, vsc)
 
-    zero = jnp.zeros((config.n_layer,), jnp.int8)  # placeholder, not written
-    x, (new_k, new_v, new_ksc, new_vsc) = lax.scan(
-        layer, x, (params["blocks"], cache.k, cache.v,
-                   cache.k_scale if int8 else zero,
-                   cache.v_scale if int8 else zero,
-                   jnp.arange(config.n_layer)))
+    x, cache = _layer_scan(x, params, cache, config, positions, write, attn)
     logits = gpt.lm_logits(params, x, config)
-    return logits, KVCache(k=new_k, v=new_v,
-                           length=jnp.asarray(S, jnp.int32),
-                           k_scale=new_ksc if int8 else None,
-                           v_scale=new_vsc if int8 else None)
+    return logits, dataclasses.replace(cache,
+                                       length=jnp.asarray(S, jnp.int32))
+
+
+def extend(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
+           cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+    """Chunked prefill: append ``tokens`` [B, S_c] at positions
+    ``cache.length .. cache.length+S_c-1``, attending causally over the
+    cached prefix + the chunk.
+
+    Composes: ``prefill(p, t[:, :c]) ; extend(p, t[:, c:])`` equals one
+    full ``prefill`` (same logits for the appended chunk, same cache) —
+    long prompts process in bounded-activation chunks, and a multi-turn
+    server appends each new turn to the session's existing cache instead
+    of re-prefilling the whole conversation.  Works on fp and int8
+    caches (the chunk path reads the cache densely, dequantizing when
+    int8).
+
+    Returns (logits [B, S_c, padded_vocab] fp32, cache advanced by S_c).
+
+    Overflow: appending past ``max_len`` is checked eagerly (host call
+    with a concrete ``cache.length``); under an outer jit the length is
+    traced and the caller must size the cache — a clamped write would
+    silently corrupt the cached prefix.
+    """
+    B, Sc = tokens.shape
+    pos0 = cache.length
+    if not isinstance(pos0, jax.core.Tracer) and \
+            int(pos0) + Sc > cache.max_len:
+        raise ValueError(
+            f"extend of {Sc} tokens at length {int(pos0)} overflows the "
+            f"cache (max_len {cache.max_len}); dynamic_update_slice would "
+            "clamp and corrupt the cached prefix")
+    positions = pos0 + jnp.arange(Sc)   # [S_c], shared across rows
+    x = gpt.embed(params, tokens, config, positions=positions)
+
+    def write(buf, val):
+        return lax.dynamic_update_slice(buf, val, (0, pos0, 0, 0))
+
+    def attn(q, k, v, new_ck, new_cv, ksc, vsc, idx):
+        return _cached_attention(
+            q, new_ck, new_cv, pos0, config,
+            window=gpt.layer_window(config, idx, cache.max_len),
+            k_scale=ksc, v_scale=vsc)
+
+    x, cache = _layer_scan(x, params, cache, config, positions, write, attn)
+    logits = gpt.lm_logits(params, x, config)
+    return logits, dataclasses.replace(cache, length=pos0 + Sc)
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
@@ -191,9 +258,6 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
     pos = lengths if ragged else cache.length
     positions = pos[:, None] if ragged else pos[None]
     x = gpt.embed(params, token[:, None], config, positions=positions)
-    int8 = cache.int8
-    if int8:
-        from ..ops.pallas.decode_attention import quantize_kv
 
     def write(buf, val):
         """One new [B, 1, H, *] column at pos (shared or per-row)."""
@@ -201,31 +265,13 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
             return buf.at[jnp.arange(B), pos].set(val[:, 0])
         return lax.dynamic_update_slice(buf, val, (0, pos, 0, 0))
 
-    def layer(x, xs):
-        p, ck, cv, ksc, vsc, idx = xs
-        q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-        if int8:
-            kq, ks = quantize_kv(k)
-            vq, vs = quantize_kv(v)
-            new_ck, new_cv = write(ck, kq), write(cv, vq)
-            ksc, vsc = write(ksc, ks), write(vsc, vs)
-        else:
-            new_ck = write(ck, k.astype(ck.dtype))
-            new_cv = write(cv, v.astype(cv.dtype))
-        attn = _cached_attention(
+    def attn(q, k, v, new_ck, new_cv, ksc, vsc, idx):
+        return _cached_attention(
             q, new_ck, new_cv, pos, config,
             window=gpt.layer_window(config, idx, cache.max_len),
-            k_scale=ksc if int8 else None, v_scale=vsc if int8 else None)
-        return _block_tail(x, attn, p, config), (new_ck, new_cv, ksc, vsc)
+            k_scale=ksc, v_scale=vsc)
 
-    zero = jnp.zeros((config.n_layer,), jnp.int8)  # placeholder, not written
-    x, (new_k, new_v, new_ksc, new_vsc) = lax.scan(
-        layer, x, (params["blocks"], cache.k, cache.v,
-                   cache.k_scale if int8 else zero,
-                   cache.v_scale if int8 else zero,
-                   jnp.arange(config.n_layer)))
+    x, cache = _layer_scan(x, params, cache, config, positions, write, attn)
     logits = gpt.lm_logits(params, x[:, 0], config)
     new_len = (jnp.max(pos) + 1) if ragged else pos + 1
-    return logits, KVCache(k=new_k, v=new_v, length=new_len,
-                           k_scale=new_ksc if int8 else None,
-                           v_scale=new_vsc if int8 else None)
+    return logits, dataclasses.replace(cache, length=new_len)

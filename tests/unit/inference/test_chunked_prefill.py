@@ -1,0 +1,92 @@
+"""Chunked prefill (`gpt_inference.extend`): long prompts process in
+bounded-activation chunks, and a multi-turn server appends new turns to
+the session cache instead of re-prefilling the conversation."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt, gpt_inference
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=128, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+@pytest.mark.parametrize("variant", [{}, {"pos_embed": "rotary"},
+                                     {"pos_embed": "alibi"},
+                                     {"local_attention_window": 8}])
+def test_extend_composes_with_prefill(variant):
+    """prefill(t[:, :c]) ; extend(t[:, c:]) == prefill(t) — logits of the
+    appended chunk and subsequent decode steps match the one-shot run."""
+    cfg = dataclasses.replace(CFG, **variant)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, 256)
+
+    full_logits, full_cache = gpt_inference.prefill(
+        params, tokens, cfg, gpt_inference.init_cache(cfg, 2, 48))
+
+    _, cache = gpt_inference.prefill(
+        params, tokens[:, :10], cfg, gpt_inference.init_cache(cfg, 2, 48))
+    ext_logits, cache = gpt_inference.extend(params, tokens[:, 10:], cfg,
+                                             cache)
+    assert int(cache.length) == 24
+    np.testing.assert_allclose(np.asarray(ext_logits),
+                               np.asarray(full_logits[:, 10:]),
+                               atol=2e-4, rtol=2e-4, err_msg=str(variant))
+    # the caches decode identically afterwards
+    nxt = jnp.argmax(ext_logits[:, -1, :cfg.vocab_size], -1).astype(jnp.int32)
+    d_full, _ = gpt_inference.decode_step(params, nxt, cfg, full_cache)
+    d_ext, _ = gpt_inference.decode_step(params, nxt, cfg, cache)
+    np.testing.assert_allclose(np.asarray(d_ext), np.asarray(d_full),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_extend_multi_chunk_jit():
+    """Three chunks under jit (the long-prompt serving shape) reproduce the
+    one-shot prefill."""
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 30), 0, 256)
+    full_logits, _ = gpt_inference.prefill(
+        params, tokens, CFG, gpt_inference.init_cache(CFG, 1, 32))
+    ext = jax.jit(lambda p, t, c: gpt_inference.extend(p, t, CFG, c))
+    _, cache = gpt_inference.prefill(
+        params, tokens[:, :10], CFG, gpt_inference.init_cache(CFG, 1, 32))
+    outs = []
+    for lo in (10, 20):
+        lg, cache = ext(params, tokens[:, lo:lo + 10], cache)
+        outs.append(lg)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(full_logits[:, 10:]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_extend_int8_cache():
+    """extend writes quantized K/V into an int8 cache; the composed run
+    tracks the one-shot int8 prefill + decode within int8 error."""
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, 256)
+    _, cache = gpt_inference.prefill(
+        params, tokens[:, :8], CFG,
+        gpt_inference.init_cache(CFG, 2, 32, kv_dtype="int8"))
+    lg, cache = gpt_inference.extend(params, tokens[:, 8:], CFG, cache)
+    assert cache.int8 and int(cache.length) == 16
+    # vs the fp-cache composed run: int8 error only
+    _, fcache = gpt_inference.prefill(
+        params, tokens[:, :8], CFG, gpt_inference.init_cache(CFG, 2, 32))
+    flg, _ = gpt_inference.extend(params, tokens[:, 8:], CFG, fcache)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(flg),
+                               atol=0.05, rtol=0.05)
+
+
+def test_extend_overflow_raises_eagerly():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, 20), 0, 256)
+    _, cache = gpt_inference.prefill(
+        params, tokens[:, :12], CFG, gpt_inference.init_cache(CFG, 1, 16))
+    with pytest.raises(ValueError, match="overflows the cache"):
+        gpt_inference.extend(params, tokens[:, 12:], CFG, cache)
