@@ -32,6 +32,13 @@ from .config import DeepSpeedInferenceConfig
 PyTree = Any
 
 
+def _tile_cache_len(max_len: int, cap: int) -> int:
+    """Round a cache length up so the decode kernel tiles (and recompiles
+    amortize across nearby lengths), clamped to the model's context."""
+    max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
+    return min(max_len, cap)
+
+
 def _serving_dtype(config: DeepSpeedInferenceConfig):
     """(compute dtype, weight_int8): dtype="int8" means weight-only int8
     serving (reference pt_binding.cpp int8 gemm paths) — weights stored
@@ -270,11 +277,8 @@ class InferenceEngine:
                 f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
                 f"max_seq_len ({self.model_config.max_seq_len}); decoding "
                 "past it would silently overwrite the last cache slot")
-        max_len = S + max_new_tokens
-        # round the cache up so the decode kernel tiles (and recompiles
-        # amortize across nearby lengths)
-        max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
-        max_len = min(max_len, self.model_config.max_seq_len)
+        max_len = _tile_cache_len(S + max_new_tokens,
+                                  self.model_config.max_seq_len)
         sig = (max_len, max_new_tokens, not do_sample, eos_token_id,
                top_k, top_p)
         if sig not in self._generate_cache:
@@ -288,10 +292,121 @@ class InferenceEngine:
             self.params, tokens, lens,
             key, jnp.asarray(temperature, jnp.float32), is_ragged)
 
+    # -------------------------------------------------------------- session
+
+    def start_session(self, batch: int = 1,
+                      max_len: Optional[int] = None) -> "InferenceSession":
+        """A stateful multi-turn session over one persistent KV cache:
+        ``append`` prefills/extends with each turn's tokens (chunked
+        prefill — the conversation is never re-prefilled), ``generate``
+        decodes a reply that stays in the cache.  Dense GPT family only.
+        """
+        from ..models import gpt_inference
+        if self._family is not gpt_inference:
+            raise NotImplementedError(
+                "sessions ride the dense GPT family's chunked prefill; "
+                "MoE serving decodes stateless batches")
+        return InferenceSession(self, batch,
+                                max_len or self.model_config.max_seq_len)
+
+    def _session_programs(self):
+        """Jitted prefill/extend/decode shared by ALL of this engine's
+        sessions (jit caches key on the wrapped function object, so fresh
+        per-session lambdas would recompile per conversation)."""
+        if not hasattr(self, "_session_progs"):
+            from ..models import gpt_inference as fam
+            cfg = self.model_config
+            self._session_progs = {
+                "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
+                "extend": jax.jit(lambda p, t, c: fam.extend(p, t, cfg, c)),
+                "decode": jax.jit(
+                    lambda p, t, c: fam.decode_step(p, t, cfg, c)),
+                "reply": {},   # fused greedy loops, keyed by n_tokens
+            }
+        return self._session_progs
+
     # ----------------------------------------------------------- checkpoint
 
     def save_16bit_model(self, path: str) -> None:
         _save_16bit(self.params, self.model_config.dtype, path)
+
+
+class InferenceSession:
+    """One conversation's cache + the jitted programs that advance it.
+
+    The reference keeps no session state (each ``forward`` re-consumes the
+    whole history); here the KV cache persists across turns, so each turn
+    costs only its own tokens — with ``kv_cache_dtype: "int8"`` at half
+    the cache bytes.
+    """
+
+    def __init__(self, engine: InferenceEngine, batch: int, max_len: int):
+        from ..models import gpt_inference as fam
+        cfg = engine.model_config
+        self._engine = engine
+        self._progs = engine._session_programs()
+        max_len = _tile_cache_len(max_len, cfg.max_seq_len)
+        self.cache = fam.init_cache(cfg, batch, max_len,
+                                    kv_dtype=engine._kv_dtype)
+        self._last_logits = None
+
+    @property
+    def length(self) -> int:
+        return int(jax.device_get(self.cache.length))
+
+    def _check_room(self, n: int) -> None:
+        if self.length + n > self.cache.max_len:
+            raise ValueError(
+                f"session cache full: {self.length} + {n} tokens exceeds "
+                f"max_len {self.cache.max_len}")
+
+    def append(self, tokens) -> jnp.ndarray:
+        """Feed one turn's tokens [B, S]; returns its logits
+        [B, S, padded_vocab] (fp32)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        self._check_room(tokens.shape[1])
+        run = (self._progs["prefill"] if self.length == 0
+               else self._progs["extend"])
+        logits, self.cache = run(self._engine.params, tokens, self.cache)
+        self._last_logits = logits[:, -1]
+        return logits
+
+    def _reply_prog(self, n: int):
+        """One fused greedy loop (lax.scan over n tokens) per reply
+        length: a 128-token reply is ONE dispatch, not 256."""
+        if n not in self._progs["reply"]:
+            cfg = self._engine.model_config
+            from ..models import gpt_inference as fam
+
+            def reply(params, last, cache):
+                def step(carry, _):
+                    last, cache = carry
+                    nxt = jnp.argmax(last[:, :cfg.vocab_size],
+                                     -1).astype(jnp.int32)
+                    lg, cache = fam.decode_step(params, nxt, cfg, cache)
+                    return (lg, cache), nxt
+
+                (last, cache), toks = lax.scan((step), (last, cache),
+                                               None, length=n)
+                return toks.swapaxes(0, 1), last, cache
+
+            self._progs["reply"][n] = jax.jit(reply)
+        return self._progs["reply"][n]
+
+    def generate(self, max_new_tokens: int = 32) -> jnp.ndarray:
+        """Greedy-decode a reply in one fused XLA program; the reply's
+        K/V stays in the session cache, so the next ``append`` continues
+        the conversation."""
+        if self._last_logits is None:
+            raise ValueError("append() a prompt before generate()")
+        B = self.cache.k.shape[1]
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        self._check_room(max_new_tokens)
+        toks, self._last_logits, self.cache = self._reply_prog(
+            max_new_tokens)(self._engine.params, self._last_logits,
+                            self.cache)
+        return toks
 
 
 def _save_16bit(params, dtype, path: str) -> None:

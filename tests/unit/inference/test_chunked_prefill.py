@@ -90,3 +90,80 @@ def test_extend_overflow_raises_eagerly():
         params, tokens[:, :12], CFG, gpt_inference.init_cache(CFG, 1, 16))
     with pytest.raises(ValueError, match="overflows the cache"):
         gpt_inference.extend(params, tokens[:, 12:], CFG, cache)
+
+
+def test_inference_session_multi_turn():
+    """Engine-level session: two turns + replies over ONE persistent
+    cache must reproduce the stateless engine run on the concatenated
+    history."""
+    import deepspeed_tpu
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model=(CFG, params),
+                                       config={"dtype": "float32"})
+    rng = np.random.default_rng(0)
+    t1 = jnp.asarray(rng.integers(0, 256, (1, 10)), jnp.int32)
+    t2 = jnp.asarray(rng.integers(0, 256, (1, 7)), jnp.int32)
+
+    s = eng.start_session(batch=1, max_len=128)
+    s.append(t1)
+    r1 = s.generate(max_new_tokens=5)
+    assert s.length == 15
+    s.append(t2)
+    r2 = s.generate(max_new_tokens=5)
+    assert s.length == 27
+
+    # stateless reference: greedy over the concatenated history
+    hist = jnp.concatenate([t1, r1], axis=1)
+    ref1 = eng.generate(t1, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(ref1))
+    hist2 = jnp.concatenate([hist, t2], axis=1)
+    ref2 = eng.generate(hist2, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(ref2))
+
+    # cache-full and usage errors are loud
+    with pytest.raises(ValueError, match="session cache full"):
+        s.append(jnp.zeros((1, 128), jnp.int32))
+    fresh = eng.start_session(batch=1, max_len=64)
+    with pytest.raises(ValueError, match="append"):
+        fresh.generate(4)
+
+
+def test_inference_session_int8_cache():
+    import deepspeed_tpu
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(
+        model=(CFG, params),
+        config={"dtype": "float32", "kv_cache_dtype": "int8"})
+    s = eng.start_session(batch=2, max_len=64)
+    assert s.cache.int8
+    t = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 9)),
+                    jnp.int32)
+    s.append(t)
+    out = s.generate(max_new_tokens=4)
+    assert out.shape == (2, 4) and s.length == 13
+
+
+def test_session_moe_refuses():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt_moe
+    mcfg = gpt_moe.GPTMoEConfig(vocab_size=128, max_seq_len=64, n_layer=2,
+                                n_head=2, d_model=32, dtype=jnp.float32,
+                                vocab_round_to=128, num_experts=2)
+    eng = deepspeed_tpu.init_inference(
+        model=(mcfg, gpt_moe.init(mcfg, jax.random.PRNGKey(0))),
+        config={"dtype": "float32"})
+    with pytest.raises(NotImplementedError, match="session"):
+        eng.start_session()
+
+
+def test_sessions_share_compiled_programs():
+    import deepspeed_tpu
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model=(CFG, params),
+                                       config={"dtype": "float32"})
+    s1, s2 = eng.start_session(), eng.start_session()
+    # jit caches key on the function object: sessions must share programs
+    assert s1._progs is s2._progs
+    s1.append(jnp.zeros((1, 4), jnp.int32))
+    # zero-token reply is a defined no-op, not a stack error
+    assert s1.generate(max_new_tokens=0).shape == (1, 0)
