@@ -292,6 +292,40 @@ class InferenceEngine:
             self.params, tokens, lens,
             key, jnp.asarray(temperature, jnp.float32), is_ragged)
 
+    # ---------------------------------------------------------- speculative
+
+    def generate_speculative(self, tokens, draft, max_new_tokens: int = 32,
+                             draft_k: int = 4):
+        """Greedy generation with draft-model speculation
+        (``inference/speculative.py``): bit-identical tokens to
+        ``generate(greedy)``, fewer target forwards.  ``draft`` is a
+        ``(GPTConfig, params)`` tuple or another :class:`InferenceEngine`
+        over the same vocabulary.  Returns ``(tokens [1, N],
+        n_target_forwards)``.
+        """
+        from ..models import gpt_inference
+        from .speculative import speculative_generate
+        if self._family is not gpt_inference:
+            raise NotImplementedError(
+                "speculative decode serves the dense GPT family")
+        if isinstance(draft, InferenceEngine):
+            dcfg, dparams = draft.model_config, draft.params
+        else:
+            dcfg, dparams = draft
+        tokens = jnp.asarray(tokens, jnp.int32)
+        sig = ("spec", tokens.shape, int(max_new_tokens), int(draft_k),
+               str(dcfg))  # the draft ARCH is baked into the program
+        if sig not in self._generate_cache:
+            cfg, kv = self.model_config, self._kv_dtype
+
+            def run(tp, dp, t):
+                return speculative_generate(tp, cfg, dp, dcfg, t,
+                                            max_new_tokens, draft_k,
+                                            kv_dtype=kv)
+
+            self._generate_cache[sig] = jax.jit(run)
+        return self._generate_cache[sig](self.params, dparams, tokens)
+
     # -------------------------------------------------------------- session
 
     def start_session(self, batch: int = 1,

@@ -1,0 +1,130 @@
+"""Speculative decoding: draft-model proposals verified by the target in
+chunks (beyond the reference, which serves one token per target forward).
+
+Greedy variant with the exactness guarantee: each round the draft decodes
+``draft_k`` tokens autoregressively, the target verifies the whole chunk in
+ONE ``extend`` call (chunked prefill over the live cache), and the longest
+agreeing prefix plus the target's own next token are emitted.  The emitted
+tokens are exactly ``argmax`` of the target's verify logits, so the output
+is bit-identical to the target model decoding alone — the draft only
+changes how many target forwards that takes.  Decode is memory-bound on
+TPU (the whole weight set streams per token), so verifying k+1 positions
+per target pass is a direct latency lever whenever the draft agrees often.
+
+Cache rollback is O(1): rejected draft positions are simply left beyond
+``cache.length`` — visibility masking ignores them and sequential writes
+overwrite them, so "undo" is a scalar length reset.
+
+The whole loop (draft scan → verify extend → accept/rollback) runs inside
+one ``lax.while_loop`` — a single XLA program per (prompt_len, n_tokens)
+signature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import gpt, gpt_inference
+
+PyTree = Any
+
+
+def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
+                         draft_params: PyTree, draft_cfg: gpt.GPTConfig,
+                         prompt: jnp.ndarray, max_new_tokens: int,
+                         draft_k: int = 4,
+                         kv_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy speculative decode.  prompt [1, S] → (tokens [1, N],
+    n_target_forwards []).
+
+    ``n_target_forwards`` counts the verify passes (plus the prefill) the
+    run needed — the quantity speculation reduces; plain decode needs N.
+    Batch 1 (the latency-bound serving shape; per-row accept counts would
+    need ragged caches).
+    """
+    if prompt.shape[0] != 1:
+        raise NotImplementedError(
+            "speculative decode serves batch 1 (the latency-bound shape); "
+            "per-row accept counts need ragged caches")
+    if not (target_cfg.vocab_size == draft_cfg.vocab_size):
+        raise ValueError("draft and target must share a vocabulary "
+                         f"({draft_cfg.vocab_size} vs {target_cfg.vocab_size})")
+    from .engine import _tile_cache_len
+    N, K = int(max_new_tokens), int(draft_k)
+    V = target_cfg.vocab_size
+    S = prompt.shape[1]
+    # room for prompt + emitted + one full speculative overshoot; unlike
+    # plain generate, the LAST verify round can write up to K tokens past
+    # the final emission, so the whole overshoot must fit the context —
+    # a clamped cache would silently corrupt accepted K/V near the edge
+    need = S + N + K + 1
+    ctx = min(target_cfg.max_seq_len, draft_cfg.max_seq_len)
+    if need > ctx:
+        raise ValueError(
+            f"prompt ({S}) + max_new_tokens ({N}) + speculative overshoot "
+            f"({K + 1}) exceeds max_seq_len ({ctx}); reduce draft_k or the "
+            "token budget")
+    tcache = gpt_inference.init_cache(target_cfg, 1,
+                                      _tile_cache_len(need, ctx),
+                                      kv_dtype=kv_dtype)
+    dcache = gpt_inference.init_cache(draft_cfg, 1, _tile_cache_len(need, ctx))
+
+    tlogits, tcache = gpt_inference.prefill(target_params, prompt,
+                                            target_cfg, tcache)
+    _, dcache = gpt_inference.prefill(draft_params, prompt, draft_cfg, dcache)
+    cur = jnp.argmax(tlogits[:, -1, :V], -1).astype(jnp.int32)   # pending
+
+    out0 = jnp.zeros((N + K + 1,), jnp.int32)
+
+    def cond(st):
+        n, *_ = st
+        return n < N
+
+    def body(st):
+        n, cur, out, tcache, dcache, fwds = st
+        base = tcache.length           # == dcache.length == emitted prefix
+
+        # ---- draft: K greedy tokens from [cur, d1..d_{K-1}]
+        def dstep(carry, _):
+            tok, dc = carry
+            lg, dc = gpt_inference.decode_step(draft_params, tok,
+                                               draft_cfg, dc)
+            nxt = jnp.argmax(lg[:, :V], -1).astype(jnp.int32)
+            return (nxt, dc), nxt[0]
+
+        (last_d, dcache), drafts = lax.scan(dstep, (cur, dcache), None,
+                                            length=K)
+        # feed d_K too so the draft cache covers a full acceptance
+        _, dcache = gpt_inference.decode_step(draft_params, last_d,
+                                              draft_cfg, dcache)
+
+        # ---- verify: ONE target pass over [cur, d1..dK]
+        chunk = jnp.concatenate([cur, drafts])[None, :]          # [1, K+1]
+        vlogits, tcache = gpt_inference.extend(target_params, chunk,
+                                               target_cfg, tcache)
+        g = jnp.argmax(vlogits[0, :, :V], -1).astype(jnp.int32)  # [K+1]
+
+        # finalized this round: the pending ``cur`` plus the accepted
+        # drafts — and accepted drafts are exactly the target's own
+        # greedy tokens, so the window is [cur, g[:a]] with g[a] the new
+        # pending token (correction or bonus).  Writing the full K+1
+        # window is safe: slots past a+1 are provisional and overwritten
+        # by the next round's window at n+a+1.
+        agree = (drafts == g[:K]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(agree))                          # 0..K
+        out = lax.dynamic_update_slice(
+            out, jnp.concatenate([cur, g[:K]]), (n,))
+        new_len = base + 1 + a
+        tcache = dataclasses.replace(tcache, length=new_len)     # O(1) undo
+        dcache = dataclasses.replace(dcache, length=new_len)
+        return (n + a + 1, g[a][None], out, tcache, dcache, fwds + 1)
+
+    n, _, out, _, _, fwds = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), cur, out0, tcache, dcache, jnp.int32(1)))
+    return out[:N][None, :], fwds

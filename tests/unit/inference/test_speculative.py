@@ -1,0 +1,154 @@
+"""Speculative decoding (inference/speculative.py): greedy draft-and-verify
+must emit BIT-IDENTICAL tokens to the target model decoding alone — the
+draft only changes how many target forwards it takes."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.speculative import speculative_generate
+from deepspeed_tpu.models import gpt
+
+TARGET = gpt.GPTConfig(vocab_size=256, max_seq_len=256, n_layer=2, n_head=4,
+                       d_model=64, dtype=jnp.float32, vocab_round_to=128)
+DRAFT = gpt.GPTConfig(vocab_size=256, max_seq_len=256, n_layer=1, n_head=2,
+                      d_model=32, dtype=jnp.float32, vocab_round_to=128)
+
+
+def _models():
+    return (gpt.init(TARGET, jax.random.PRNGKey(0)),
+            gpt.init(DRAFT, jax.random.PRNGKey(1)))
+
+
+_TRAINED = {}
+
+
+def _train(cfg, steps=80, lr=3e-3):
+    """Train on the affine rule t[i+1] = (3 t[i] + 7) % V: the greedy
+    continuation then CHANGES token every step — a random-init model
+    emits a constant token, which cannot catch off-by-one emission bugs
+    (one hid behind exactly that degeneracy).  Cached per (cfg, steps)
+    across the module's tests."""
+    key = (repr(cfg), steps)
+    if key in _TRAINED:
+        return _TRAINED[key]
+    from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
+                                             reset_mesh_manager)
+    from deepspeed_tpu.runtime.model import from_gpt
+    reset_mesh_manager()
+    rows = []
+    for s in range(8):
+        t = [(s * 17 + 3) % 256]
+        for _ in range(48):
+            t.append((t[-1] * 3 + 7) % 256)
+        rows.append(t)
+    data = np.asarray(rows, np.int32)
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(cfg),
+        config={"train_micro_batch_size_per_gpu": 8 // mm.dp_world_size,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": lr}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 1 << 30},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    for _ in range(steps):
+        eng.train_batch_fused({"tokens": data})
+    _TRAINED[key] = jax.tree_util.tree_map(
+        lambda l: jnp.asarray(np.asarray(jax.device_get(l), np.float32)),
+        eng.state["params"])
+    return _TRAINED[key]
+
+
+@pytest.mark.parametrize("draft_k", [1, 3, 5])
+def test_speculative_matches_plain_greedy(draft_k):
+    """Trained target (token changes every step — shift-sensitive) +
+    random draft: output must still be bit-identical to plain greedy."""
+    tparams = _train(TARGET)
+    _, dparams = _models()
+    prompt = jnp.asarray([[3] + [(3 * 3 + 7) % 256]], jnp.int32)
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    want = np.asarray(eng.generate(prompt, max_new_tokens=16))
+    # the trained continuation really is shift-sensitive
+    assert (want[0][:-1] != want[0][1:]).all(), want
+    got, fwds = speculative_generate(tparams, TARGET, dparams, DRAFT,
+                                     prompt, 16, draft_k=draft_k)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # even an unrelated random draft costs at most one verify per token
+    assert 1 <= int(fwds) <= 16 + 1
+
+
+def test_speculative_trained_draft_speeds_up():
+    """A draft that learned the same rule gets its proposals accepted:
+    identical output, strictly fewer target forwards than plain decode."""
+    tparams = _train(TARGET)
+    dparams = _train(DRAFT, steps=120)
+    prompt = jnp.asarray([[3] + [(3 * 3 + 7) % 256]], jnp.int32)
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    want = np.asarray(eng.generate(prompt, max_new_tokens=24))
+    got, fwds = speculative_generate(tparams, TARGET, dparams, DRAFT,
+                                     prompt, 24, draft_k=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # plain decode = 24 target passes + prefill; speculation must beat it
+    assert int(fwds) < 24, int(fwds)
+
+
+def test_speculative_self_draft_accepts_everything():
+    """Draft == target: every proposal verifies, so each round emits
+    draft_k+1 tokens and the verify count collapses toward N/(k+1)."""
+    tparams, _ = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0, 256)
+    got, fwds = speculative_generate(tparams, TARGET, tparams, TARGET,
+                                     prompt, 16, draft_k=3)
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    want = np.asarray(eng.generate(prompt, max_new_tokens=16))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # ceil(16 / (3+1)) verify rounds + the prefill
+    assert int(fwds) == 16 // 4 + 1, int(fwds)
+
+
+def test_engine_generate_speculative():
+    tparams, dparams = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 10), 0, 256)
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    out, fwds = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                         max_new_tokens=12, draft_k=4)
+    want = np.asarray(eng.generate(prompt, max_new_tokens=12))
+    np.testing.assert_array_equal(np.asarray(out), want)
+    # draft engines work as the draft argument too
+    deng = deepspeed_tpu.init_inference(model=(DRAFT, dparams),
+                                        config={"dtype": "float32"})
+    out2, _ = eng.generate_speculative(prompt, deng, max_new_tokens=12,
+                                       draft_k=4)
+    np.testing.assert_array_equal(np.asarray(out2), want)
+
+
+def test_speculative_validation():
+    tparams, dparams = _models()
+    with pytest.raises(NotImplementedError, match="batch 1"):
+        speculative_generate(tparams, TARGET, dparams, DRAFT,
+                             jnp.zeros((2, 4), jnp.int32), 4)
+    other = dataclasses.replace(DRAFT, vocab_size=128)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(tparams, TARGET, dparams, other,
+                             jnp.zeros((1, 4), jnp.int32), 4)
+
+
+def test_speculative_context_overflow_raises():
+    """Near max_seq_len the speculative overshoot must be rejected up
+    front — a clamped cache write would silently break the bit-identical
+    guarantee."""
+    tparams, dparams = _models()
+    prompt = jnp.zeros((1, 240), jnp.int32)
+    with pytest.raises(ValueError, match="overshoot"):
+        speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
+                             16, draft_k=4)   # 240+16+5 > 256
