@@ -295,23 +295,33 @@ class InferenceEngine:
     # ---------------------------------------------------------- speculative
 
     def generate_speculative(self, tokens, draft, max_new_tokens: int = 32,
-                             draft_k: int = 4):
+                             draft_k: int = 7):
         """Greedy generation with draft-model speculation
         (``inference/speculative.py``): bit-identical tokens to
         ``generate(greedy)``, fewer target forwards.  ``draft`` is a
         ``(GPTConfig, params)`` tuple or another :class:`InferenceEngine`
         over the same vocabulary.  Returns ``(tokens [1, N],
-        n_target_forwards)``.
+        n_target_forwards)``.  ``draft_k + 1`` should be a multiple of 8
+        so the verify pass rides the chunk kernel (default 7).
         """
         from ..models import gpt_inference
+        from ..models.gpt_moe import GPTMoEConfig
         from .speculative import speculative_generate
         if self._family is not gpt_inference:
             raise NotImplementedError(
                 "speculative decode serves the dense GPT family")
         if isinstance(draft, InferenceEngine):
+            if draft._family is not gpt_inference:
+                raise NotImplementedError(
+                    "the draft must be a dense GPT-family engine")
             dcfg, dparams = draft.model_config, draft.params
         else:
             dcfg, dparams = draft
+        if not isinstance(dcfg, gpt.GPTConfig) or \
+                isinstance(dcfg, GPTMoEConfig):
+            raise TypeError(
+                "draft must be (gpt.GPTConfig, params) or a dense "
+                f"GPT-family InferenceEngine (got config {type(dcfg)})")
         tokens = jnp.asarray(tokens, jnp.int32)
         sig = ("spec", tokens.shape, int(max_new_tokens), int(draft_k),
                str(dcfg))  # the draft ARCH is baked into the program

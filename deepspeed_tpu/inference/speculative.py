@@ -37,7 +37,7 @@ PyTree = Any
 def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                          draft_params: PyTree, draft_cfg: gpt.GPTConfig,
                          prompt: jnp.ndarray, max_new_tokens: int,
-                         draft_k: int = 4,
+                         draft_k: int = 7,
                          kv_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Greedy speculative decode.  prompt [1, S] → (tokens [1, N],
     n_target_forwards []).
@@ -46,6 +46,11 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     run needed — the quantity speculation reduces; plain decode needs N.
     Batch 1 (the latency-bound serving shape; per-row accept counts would
     need ragged caches).
+
+    The verify chunk is ``draft_k + 1`` tokens; keep it a multiple of the
+    8-row sublane tile (the default, 7+1=8) so the verify ``extend``
+    rides the chunked-prefill Pallas kernel instead of the dense
+    fallback.
     """
     if prompt.shape[0] != 1:
         raise NotImplementedError(

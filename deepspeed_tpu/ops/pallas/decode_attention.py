@@ -142,6 +142,96 @@ def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None):
     )(*args)
 
 
+def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale, block_q, block_k, H, quantized):
+    """Chunked-prefill attention over the padded cache: queries are a
+    whole chunk at absolute positions ``pos .. pos+Sq-1`` (online softmax
+    per row, cache blocks streamed through VMEM, blocks beyond the
+    chunk's causal frontier skipped).  Memory-linear counterpart of the
+    dense fallback ``extend`` would otherwise take — O(block) VMEM
+    instead of an [Sq, Smax] score tensor."""
+    if quantized:
+        kscale_ref, vscale_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    pos = pos_ref[bh // H]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # highest key this q block may see: pos + (qi+1)*block_q - 1
+    @pl.when(ki * block_k <= pos + (qi + 1) * block_q - 1)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
+        ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
+        vs = v_ref[0].astype(jnp.float32)
+        if quantized:
+            ks = ks * kscale_ref[0]
+            vs = vs * vscale_ref[0]
+        s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (BQ, BK)
+        q_pos = pos + qi * block_q + \
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + \
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # block 0 always executes and every row's q_pos >= 0 sees key 0,
+        # so m turns finite on the first block — the plain online-softmax
+        # recurrence needs no -inf guards (same as the decode kernel)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, vs, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
+           vs3=None):
+    BH, Sq, D = q3.shape
+    Smax = k3.shape[1]
+    B = BH // H
+    quantized = ks3 is not None
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
+    kernel = functools.partial(_chunk_kernel, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k, H=H,
+                               quantized=quantized)
+    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0))
+    scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, qi, ki: (bh, ki, 0))
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), q_spec,
+                kv_spec, kv_spec] + \
+        ([scale_spec, scale_spec] if quantized else [])
+    args = (pos_arr, q3, k3, v3) + ((ks3, vs3) if quantized else ())
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, Sq // block_q, Smax // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(*args)
+
+
 def cached_attention(q, cache_k, cache_v, pos,
                      sm_scale: Optional[float] = None,
                      k_scale=None, v_scale=None):
@@ -149,29 +239,42 @@ def cached_attention(q, cache_k, cache_v, pos,
 
     ``pos``: scalar, or a per-row [B] vector for ragged decode (each row's
     block sweep stops at ITS live prefix).  Single-token decode (Sq=1)
-    takes the Pallas streaming kernel; other shapes (chunked prefill) use
-    the dense reference.
+    takes the Pallas streaming kernel; multi-token chunks (chunked
+    prefill / ``extend``) take the chunk kernel when the shapes tile —
+    O(block) VMEM instead of a dense [Sq, Smax] score tensor; remaining
+    shapes use the dense reference.
 
     With ``k_scale``/``v_scale`` ([B,Smax,H,1] fp32) the cache holds int8
-    codes; the kernel dequantizes in VMEM (halving the HBM stream), and the
-    non-kernel fallbacks dequantize before the dense math.
+    codes; the kernels dequantize in VMEM (halving the HBM stream), and
+    the non-kernel fallbacks dequantize before the dense math.
     """
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
     int8_cache = k_scale is not None
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     block_k = next((b for b in (256, 128) if Smax % b == 0), None)
+    # chunk path: scalar pos only (ragged chunks would need per-row
+    # frontiers), and the chunk must tile in the q (sublane) dimension
+    pos_is_scalar = jnp.ndim(pos) == 0
+    block_q = next((b for b in (256, 128, 8) if Sq % b == 0), None) \
+        if Sq > 1 else None
 
     def to3(x, d=D):
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], d)
 
-    if Sq != 1 or not use_pallas() or block_k is None:
-        if int8_cache:
-            cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
-            cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
-        return cached_attention_reference(q, cache_k, cache_v, pos, scale)
+    if use_pallas() and block_k is not None:
+        ks3 = to3(k_scale, 1) if int8_cache else None
+        vs3 = to3(v_scale, 1) if int8_cache else None
+        if Sq == 1:
+            o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale,
+                         block_k, H, ks3=ks3, vs3=vs3)
+            return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)
+        if block_q is not None and pos_is_scalar:
+            o3 = _chunk(to3(q), to3(cache_k), to3(cache_v), pos, scale,
+                        block_q, block_k, H, ks3=ks3, vs3=vs3)
+            return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
-    o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale, block_k, H,
-                 ks3=to3(k_scale, 1) if int8_cache else None,
-                 vs3=to3(v_scale, 1) if int8_cache else None)
-    return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)
+    if int8_cache:
+        cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
+        cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
+    return cached_attention_reference(q, cache_k, cache_v, pos, scale)
