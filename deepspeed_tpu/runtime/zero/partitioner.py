@@ -125,12 +125,44 @@ class ZeroPartitioner:
         return self.base_specs
 
     # -- shardings ---------------------------------------------------------
-    def _to_shardings(self, specs: PyTree) -> PyTree:
+    def _to_shardings(self, specs: PyTree, memory_kind=None) -> PyTree:
+        kw = {"memory_kind": memory_kind} if memory_kind else {}
         return jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s), specs, is_leaf=_spec_leaf)
+            lambda s: NamedSharding(self.mesh, s, **kw), specs,
+            is_leaf=_spec_leaf)
+
+    def param_memory_kind(self) -> Optional[str]:
+        """ZeRO-3 parameter offload, the TPU way: instead of the
+        reference's per-layer gather/partition coordinator
+        (``stage3.py`` + ``partition_parameters.py``), stored params get
+        host-memory shardings (``memory_kind="pinned_host"``) and XLA's
+        latency-hiding scheduler streams them to HBM as layers need
+        them — compiler-driven ZeRO-Infinity parameter offload.
+
+        Only the TPU backend compiles host-resident compute operands;
+        elsewhere the request is honored with a warning + device
+        placement so CPU CI and the driver gates keep running.
+        """
+        oc = getattr(self.config, "offload_param_config", None)
+        device = getattr(oc, "device", None) if oc is not None else None
+        if device in (None, "none"):
+            return None
+        if self.stage < 3:
+            logger.warning(
+                "offload_param requires ZeRO stage 3 (reference config "
+                "semantics); ignoring for stage %s", self.stage)
+            return None
+        if jax.default_backend() != "tpu":
+            logger.warning(
+                "offload_param needs TPU host-memory offload "
+                "(memory_kind='pinned_host'); backend %r keeps params in "
+                "device memory", jax.default_backend())
+            return None
+        return "pinned_host"
 
     def plan(self) -> ZeroShardings:
-        param_sh = self._to_shardings(self.param_specs())
+        param_sh = self._to_shardings(self.param_specs(),
+                                      memory_kind=self.param_memory_kind())
         grad_sh = self._to_shardings(self.grad_specs())
         master_sh = self._to_shardings(self.master_specs())
         master_specs = self.master_specs()
