@@ -618,8 +618,19 @@ class DeepSpeedEngine:
 
         self._micro_jit = jax.jit(micro, donate_argnums=(1,))
 
+        # offload_param (ZeRO-3 parameter offload): the stored-param
+        # placement is host memory — the step outputs must land back there
+        # or the offload is silently lost at the first optimizer step.
+        # None leaves mean "infer" (everything else keeps its placement).
+        pkind = self.zero_partitioner.param_memory_kind()
+        out_sh = None
+        if pkind is not None:
+            psh = self.shardings.params
+            out_sh = (psh, None, None, None, None, None, None)
+
         if separate_master:
-            self._apply_jit = jax.jit(apply_core, donate_argnums=(0, 1, 2, 3, 4))
+            self._apply_jit = jax.jit(apply_core, donate_argnums=(0, 1, 2, 3, 4),
+                                      out_shardings=out_sh)
 
             def fused(params, master, opt_state, grad_acc, scale_state, batches, hyper):
                 def body(acc, batch):
@@ -629,12 +640,15 @@ class DeepSpeedEngine:
                 out = apply_core(params, master, opt_state, grad_acc, scale_state, hyper)
                 return out + (jnp.mean(losses),)
 
-            self._fused_jit = jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4))
+            self._fused_jit = jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4),
+                                      out_shardings=None if out_sh is None
+                                      else out_sh + (None,))
         else:
             def apply_single(params, opt_state, grad_acc, scale_state, hyper):
                 return apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
 
-            self._apply_jit_single = jax.jit(apply_single, donate_argnums=(0, 1, 2, 3))
+            self._apply_jit_single = jax.jit(apply_single, donate_argnums=(0, 1, 2, 3),
+                                             out_shardings=out_sh)
 
             def fused_single(params, opt_state, grad_acc, scale_state, batches, hyper):
                 def body(acc, batch):
@@ -644,7 +658,9 @@ class DeepSpeedEngine:
                 out = apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
                 return out + (jnp.mean(losses),)
 
-            self._fused_jit_single = jax.jit(fused_single, donate_argnums=(0, 1, 2, 3))
+            self._fused_jit_single = jax.jit(fused_single, donate_argnums=(0, 1, 2, 3),
+                                             out_shardings=None if out_sh is None
+                                             else out_sh + (None,))
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=False,

@@ -158,6 +158,11 @@ class ZeroPartitioner:
                 "(memory_kind='pinned_host'); backend %r keeps params in "
                 "device memory", jax.default_backend())
             return None
+        if device == "nvme":
+            logger.warning(
+                "offload_param.device='nvme' has no NVMe spill path on "
+                "TPU yet; params pin to host RAM instead (nvme_path and "
+                "buffer knobs ignored) — ensure host RAM holds the shards")
         return "pinned_host"
 
     def plan(self) -> ZeroShardings:
@@ -165,7 +170,6 @@ class ZeroPartitioner:
                                       memory_kind=self.param_memory_kind())
         grad_sh = self._to_shardings(self.grad_specs())
         master_sh = self._to_shardings(self.master_specs())
-        master_specs = self.master_specs()
         params_treedef = jax.tree_util.tree_structure(
             self.param_shapes, is_leaf=lambda x: hasattr(x, "shape"))
 

@@ -415,3 +415,38 @@ def test_offload_param_cpu_backend_still_trains():
     cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
     _, losses = _train(cfg)
     assert losses[-1] < losses[0], losses
+
+
+def test_offload_param_step_outputs_keep_host_placement(monkeypatch):
+    """The step jits must return params INTO the host placement (VERDICT-
+    class hazard: without out_shardings the first optimizer step would
+    silently move offloaded params back to HBM).  Lowering-level check —
+    host-resident compute only compiles on TPU, but the placement
+    annotation is visible in the lowered module on any backend."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    from deepspeed_tpu.runtime.zero.partitioner import ZeroPartitioner
+    reset_mesh_manager()
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(_tiny_config()), config=_ds_config(stage=3),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    # flip the plan to host params post-hoc and rebuild the step programs
+    monkeypatch.setattr(ZeroPartitioner, "param_memory_kind",
+                        lambda self: "pinned_host")
+    engine.shardings = engine.zero_partitioner.plan()
+    engine._build_steps()
+    s = engine.state
+    if engine._separate_master:
+        args = (s["params"], s["master"], s["opt_state"], s["grad_acc"],
+                s["scale"], engine._hyper())
+        jit_fn = engine._apply_jit
+    else:
+        args = (s["params"], s["opt_state"], s["grad_acc"], s["scale"],
+                engine._hyper())
+        jit_fn = engine._apply_jit_single
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+    low = jit_fn.lower(*abstract)
+    txt = low.as_text()
+    assert "pinned_host" in txt or "_xla_buffer_placement" in txt, \
+        "params output lost the host placement in the step program"
