@@ -18,18 +18,23 @@ __git_branch__ = "main"
 from . import comm as _comm_pkg  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401 — reference parity
 from .comm.comm import init_distributed
+from .inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from .inference.engine import InferenceEngine  # noqa: F401
 from .parallel.mesh import (MeshManager, ParallelDims, get_mesh_manager,
                             initialize_mesh)
 from .runtime.activation_checkpointing import checkpointing
-from .runtime.config import DeepSpeedConfig
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from .runtime.lr_schedules import add_tuning_arguments  # noqa: F401
 from .ops.transformer import (DeepSpeedTransformerConfig,
                               DeepSpeedTransformerLayer)
+from .runtime.pipe.engine import PipelineEngine  # noqa: F401
 from .runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
 from .runtime import zero  # noqa: F401 — deepspeed.zero namespace parity
-from .module_inject.replace_policy import replace_transformer_layer
+from .module_inject.replace_policy import (  # noqa: F401
+    replace_transformer_layer, revert_transformer_layer)
 from .runtime.engine import DeepSpeedEngine
 from .runtime.model import ModelSpec, from_gpt
-from .utils.logging import logger
+from .utils.logging import log_dist, logger  # noqa: F401
 
 # guards Autotuner trial engines from re-entering the autotuner
 _autotuning_active = False

@@ -644,11 +644,12 @@ class DeepSpeedEngine:
                                       out_shardings=None if out_sh is None
                                       else out_sh + (None,))
         else:
+            # offload_param implies stage >= 3 implies separate_master, so
+            # this branch never carries a host placement (out_sh is None)
             def apply_single(params, opt_state, grad_acc, scale_state, hyper):
                 return apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
 
-            self._apply_jit_single = jax.jit(apply_single, donate_argnums=(0, 1, 2, 3),
-                                             out_shardings=out_sh)
+            self._apply_jit_single = jax.jit(apply_single, donate_argnums=(0, 1, 2, 3))
 
             def fused_single(params, opt_state, grad_acc, scale_state, batches, hyper):
                 def body(acc, batch):
@@ -658,9 +659,7 @@ class DeepSpeedEngine:
                 out = apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
                 return out + (jnp.mean(losses),)
 
-            self._fused_jit_single = jax.jit(fused_single, donate_argnums=(0, 1, 2, 3),
-                                             out_shardings=None if out_sh is None
-                                             else out_sh + (None,))
+            self._fused_jit_single = jax.jit(fused_single, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=False,

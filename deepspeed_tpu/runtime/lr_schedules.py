@@ -229,3 +229,41 @@ def get_lr_schedule_class(name: str):
     if name not in SCHEDULE_CLASSES:
         raise ValueError(f"unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
     return SCHEDULE_CLASSES[name]
+
+
+def add_tuning_arguments(parser):
+    """Add the convergence-tuning CLI group (reference
+    ``runtime/lr_schedules.py:55``): one flag per knob of the four
+    schedules, so launcher scripts can sweep LR policy from the command
+    line and feed the parsed values into the scheduler config."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    # LRRangeTest
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    # type=bool would parse any explicit value (even "False") as True —
+    # the reference inherits that argparse footgun; accept real booleans
+    group.add_argument("--lr_range_test_staircase",
+                       type=lambda v: str(v).lower() in ("1", "true", "yes"),
+                       default=False)
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
+    return parser

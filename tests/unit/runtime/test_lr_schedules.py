@@ -130,3 +130,31 @@ def test_engine_drives_every_schedule_type(scheduler):
         seen.append(engine.get_lr()[0])
     assert len(set(np.round(seen, 12))) > 1, f"lr never moved: {seen}"
     assert all(np.isfinite(seen))
+
+
+def test_add_tuning_arguments_parses_reference_flags():
+    """Reference __init__.py exports add_tuning_arguments; the flag set
+    must cover every schedule's knobs."""
+    import argparse
+
+    import deepspeed_tpu
+    p = deepspeed_tpu.add_tuning_arguments(argparse.ArgumentParser())
+    a = p.parse_args(["--lr_schedule", "OneCycle", "--cycle_min_lr", "0.02",
+                      "--warmup_num_steps", "5",
+                      "--lr_range_test_step_rate", "2.0"])
+    assert a.lr_schedule == "OneCycle"
+    assert a.cycle_min_lr == 0.02
+    assert a.warmup_num_steps == 5
+    assert a.lr_range_test_step_rate == 2.0
+
+
+def test_top_level_reference_exports():
+    import deepspeed_tpu as d
+    for name in ("InferenceEngine", "DeepSpeedInferenceConfig",
+                 "PipelineEngine", "DeepSpeedConfigError",
+                 "add_tuning_arguments", "revert_transformer_layer",
+                 "log_dist", "OnDevice", "DeepSpeedEngine", "zero",
+                 "checkpointing"):
+        assert hasattr(d, name), name
+    # replace is a pure conversion, so revert is the identity
+    assert d.revert_transformer_layer(model="m") == "m"
