@@ -295,14 +295,19 @@ class InferenceEngine:
     # ---------------------------------------------------------- speculative
 
     def generate_speculative(self, tokens, draft, max_new_tokens: int = 32,
-                             draft_k: int = 7):
-        """Greedy generation with draft-model speculation
-        (``inference/speculative.py``): bit-identical tokens to
-        ``generate(greedy)``, fewer target forwards.  ``draft`` is a
-        ``(GPTConfig, params)`` tuple or another :class:`InferenceEngine`
-        over the same vocabulary.  Returns ``(tokens [1, N],
-        n_target_forwards)``.  ``draft_k + 1`` should be a multiple of 8
-        so the verify pass rides the chunk kernel (default 7).
+                             draft_k: int = 7, temperature: float = 0.0,
+                             key=None):
+        """Generation with draft-model speculation
+        (``inference/speculative.py``): fewer target forwards, exact
+        output semantics.  ``temperature=0`` (default) is greedy —
+        bit-identical tokens to ``generate(greedy)``; ``temperature>0``
+        is speculative SAMPLING (rejection rule) — tokens distributed
+        exactly as target sampling at that temperature, seeded by
+        ``key``.  ``draft`` is a ``(GPTConfig, params)`` tuple or another
+        :class:`InferenceEngine` over the same vocabulary.  Returns
+        ``(tokens [1, N], n_target_forwards)``.  ``draft_k + 1`` should
+        be a multiple of 8 so the verify pass rides the chunk kernel
+        (default 7).
         """
         from ..models import gpt_inference
         from ..models.gpt_moe import GPTMoEConfig
@@ -324,17 +329,19 @@ class InferenceEngine:
                 f"GPT-family InferenceEngine (got config {type(dcfg)})")
         tokens = jnp.asarray(tokens, jnp.int32)
         sig = ("spec", tokens.shape, int(max_new_tokens), int(draft_k),
-               str(dcfg))  # the draft ARCH is baked into the program
+               float(temperature), str(dcfg))  # draft ARCH baked in
         if sig not in self._generate_cache:
             cfg, kv = self.model_config, self._kv_dtype
 
-            def run(tp, dp, t):
+            def run(tp, dp, t, k):
                 return speculative_generate(tp, cfg, dp, dcfg, t,
                                             max_new_tokens, draft_k,
-                                            kv_dtype=kv)
+                                            kv_dtype=kv,
+                                            temperature=temperature, key=k)
 
             self._generate_cache[sig] = jax.jit(run)
-        return self._generate_cache[sig](self.params, dparams, tokens)
+        key = key if key is not None else jax.random.PRNGKey(0)
+        return self._generate_cache[sig](self.params, dparams, tokens, key)
 
     # -------------------------------------------------------------- session
 

@@ -1,15 +1,19 @@
 """Speculative decoding: draft-model proposals verified by the target in
 chunks (beyond the reference, which serves one token per target forward).
 
-Greedy variant with the exactness guarantee: each round the draft decodes
-``draft_k`` tokens autoregressively, the target verifies the whole chunk in
-ONE ``extend`` call (chunked prefill over the live cache), and the longest
-agreeing prefix plus the target's own next token are emitted.  The emitted
-tokens are exactly ``argmax`` of the target's verify logits, so the output
-is bit-identical to the target model decoding alone — the draft only
-changes how many target forwards that takes.  Decode is memory-bound on
-TPU (the whole weight set streams per token), so verifying k+1 positions
-per target pass is a direct latency lever whenever the draft agrees often.
+Two modes, both with an exactness guarantee.  Greedy (``temperature=0``):
+each round the draft decodes ``draft_k`` tokens autoregressively, the
+target verifies the whole chunk in ONE ``extend`` call (chunked prefill
+over the live cache), and the longest agreeing prefix plus the target's
+own next token are emitted — bit-identical to the target decoding alone.
+Sampling (``temperature>0``): the :func:`spec_accept` rejection rule
+(Leviathan et al. 2023 / Chen et al. 2023) accepts each draft token with
+probability ``min(1, p_t/p_d)`` and resamples from the residual on
+rejection — the emitted tokens are distributed EXACTLY as sampling from
+the target at that temperature.  Either way the draft only changes how
+many target forwards the output takes.  Decode is memory-bound on TPU
+(the whole weight set streams per token), so verifying k+1 positions per
+target pass is a direct latency lever whenever the draft agrees often.
 
 Cache rollback is O(1): rejected draft positions are simply left beyond
 ``cache.length`` — visibility masking ignores them and sequential writes
@@ -34,13 +38,55 @@ from ..models import gpt, gpt_inference
 PyTree = Any
 
 
+def spec_accept(key: jax.Array, d_tokens: jnp.ndarray, d_probs: jnp.ndarray,
+                t_probs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The speculative-sampling acceptance rule (Leviathan et al. 2023 /
+    Chen et al. 2023): given K draft tokens with their draft distributions
+    ``d_probs [K, V]`` and the target distributions ``t_probs [K+1, V]``
+    over the same positions (+1 = the bonus position), accept draft token
+    i with probability ``min(1, p_t(x_i)/p_d(x_i))``; at the first
+    rejection, resample from the residual ``norm(max(p_t - p_d, 0))``;
+    if everything is accepted, sample the bonus from ``t_probs[K]``.
+
+    Returns ``(a, next_token)`` — the accepted count (0..K) and the one
+    extra emitted token.  The emitted marginal equals sampling from the
+    target alone (the theorem this function's unit test checks
+    empirically).
+    """
+    K = d_tokens.shape[0]
+    u_key, r_key = jax.random.split(key)
+    u = jax.random.uniform(u_key, (K,))
+    p_t = jnp.take_along_axis(t_probs[:K], d_tokens[:, None], 1)[:, 0]
+    p_d = jnp.take_along_axis(d_probs, d_tokens[:, None], 1)[:, 0]
+    accept = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    # residual at the first rejection (row a); bonus row when a == K
+    resid = jnp.maximum(t_probs[a] - jnp.where(a < K, d_probs[a % K], 0.0),
+                        0.0)
+    resid_sum = jnp.sum(resid)
+    # an all-accepted round has resid == t_probs[K] (no draft to subtract);
+    # a fully-overlapping residual (sum 0) falls back to the target row
+    probs = jnp.where(resid_sum > 1e-20, resid / jnp.maximum(resid_sum, 1e-20),
+                      t_probs[a])
+    nxt = jax.random.categorical(r_key, jnp.log(jnp.maximum(probs, 1e-30)))
+    return a, nxt.astype(jnp.int32)
+
+
 def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                          draft_params: PyTree, draft_cfg: gpt.GPTConfig,
                          prompt: jnp.ndarray, max_new_tokens: int,
                          draft_k: int = 7,
-                         kv_dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Greedy speculative decode.  prompt [1, S] → (tokens [1, N],
+                         kv_dtype=None, temperature: float = 0.0,
+                         key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative decode.  prompt [1, S] → (tokens [1, N],
     n_target_forwards []).
+
+    ``temperature == 0`` (default): greedy draft-and-verify — output
+    bit-identical to the target decoding alone.  ``temperature > 0``:
+    speculative SAMPLING (:func:`spec_accept` rejection rule) — the
+    emitted tokens are distributed exactly as sampling from the target
+    at that temperature, with the draft only changing the number of
+    target passes.
 
     ``n_target_forwards`` counts the verify passes (plus the prefill) the
     run needed — the quantity speculation reduces; plain decode needs N.
@@ -79,10 +125,19 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                                       kv_dtype=kv_dtype)
     dcache = gpt_inference.init_cache(draft_cfg, 1, _tile_cache_len(need, ctx))
 
+    sample = float(temperature) > 0.0
+    temp = jnp.float32(max(float(temperature), 1e-6))
+    key0 = key if key is not None else jax.random.PRNGKey(0)
+
     tlogits, tcache = gpt_inference.prefill(target_params, prompt,
                                             target_cfg, tcache)
     _, dcache = gpt_inference.prefill(draft_params, prompt, draft_cfg, dcache)
-    cur = jnp.argmax(tlogits[:, -1, :V], -1).astype(jnp.int32)   # pending
+    last_t = tlogits[:, -1, :V].astype(jnp.float32)
+    if sample:
+        key0, sub = jax.random.split(key0)
+        cur = jax.random.categorical(sub, last_t / temp).astype(jnp.int32)
+    else:
+        cur = jnp.argmax(last_t, -1).astype(jnp.int32)   # pending
 
     out0 = jnp.zeros((N + K + 1,), jnp.int32)
 
@@ -91,19 +146,28 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
         return n < N
 
     def body(st):
-        n, cur, out, tcache, dcache, fwds = st
+        n, cur, out, tcache, dcache, fwds, rng = st
         base = tcache.length           # == dcache.length == emitted prefix
+        rng, dkey, akey = jax.random.split(rng, 3)
 
-        # ---- draft: K greedy tokens from [cur, d1..d_{K-1}]
-        def dstep(carry, _):
+        # ---- draft: K tokens from [cur, d1..d_{K-1}] (greedy, or sampled
+        # at the SAME temperature so acceptance rates stay high)
+        def dstep(carry, dk):
             tok, dc = carry
             lg, dc = gpt_inference.decode_step(draft_params, tok,
                                                draft_cfg, dc)
-            nxt = jnp.argmax(lg[:, :V], -1).astype(jnp.int32)
-            return (nxt, dc), nxt[0]
+            lg = lg[:, :V].astype(jnp.float32)
+            if sample:
+                probs = jax.nn.softmax(lg / temp, -1)[0]
+                nxt = jax.random.categorical(dk, lg / temp, axis=-1
+                                             ).astype(jnp.int32)
+            else:
+                probs = jnp.zeros((V,), jnp.float32)
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+            return (nxt, dc), (nxt[0], probs)
 
-        (last_d, dcache), drafts = lax.scan(dstep, (cur, dcache), None,
-                                            length=K)
+        (last_d, dcache), (drafts, d_probs) = lax.scan(
+            dstep, (cur, dcache), jax.random.split(dkey, K))
         # feed d_K too so the draft cache covers a full acceptance
         _, dcache = gpt_inference.decode_step(draft_params, last_d,
                                               draft_cfg, dcache)
@@ -112,24 +176,31 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
         chunk = jnp.concatenate([cur, drafts])[None, :]          # [1, K+1]
         vlogits, tcache = gpt_inference.extend(target_params, chunk,
                                                target_cfg, tcache)
-        g = jnp.argmax(vlogits[0, :, :V], -1).astype(jnp.int32)  # [K+1]
+        vlg = vlogits[0, :, :V].astype(jnp.float32)              # [K+1, V]
 
-        # finalized this round: the pending ``cur`` plus the accepted
-        # drafts — and accepted drafts are exactly the target's own
-        # greedy tokens, so the window is [cur, g[:a]] with g[a] the new
-        # pending token (correction or bonus).  Writing the full K+1
-        # window is safe: slots past a+1 are provisional and overwritten
-        # by the next round's window at n+a+1.
-        agree = (drafts == g[:K]).astype(jnp.int32)
-        a = jnp.sum(jnp.cumprod(agree))                          # 0..K
+        if sample:
+            # rejection rule: emitted tokens are distributed exactly as
+            # target sampling; the window is [cur, accepted drafts] with
+            # nxt the pending resample/bonus token
+            t_probs = jax.nn.softmax(vlg / temp, -1)
+            a, nxt = spec_accept(akey, drafts, d_probs, t_probs)
+            nxt = nxt[None]
+        else:
+            # accepted drafts are exactly the target's own greedy tokens
+            g = jnp.argmax(vlg, -1).astype(jnp.int32)            # [K+1]
+            agree = (drafts == g[:K]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(agree))                      # 0..K
+            nxt = g[a][None]
+        # writing the full K+1 window is safe: slots past a+1 are
+        # provisional and overwritten by the next round's window at n+a+1
         out = lax.dynamic_update_slice(
-            out, jnp.concatenate([cur, g[:K]]), (n,))
+            out, jnp.concatenate([cur, drafts]), (n,))
         new_len = base + 1 + a
         tcache = dataclasses.replace(tcache, length=new_len)     # O(1) undo
         dcache = dataclasses.replace(dcache, length=new_len)
-        return (n + a + 1, g[a][None], out, tcache, dcache, fwds + 1)
+        return (n + a + 1, nxt, out, tcache, dcache, fwds + 1, rng)
 
-    n, _, out, _, _, fwds = lax.while_loop(
+    n, _, out, _, _, fwds, _ = lax.while_loop(
         cond, body,
-        (jnp.int32(0), cur, out0, tcache, dcache, jnp.int32(1)))
+        (jnp.int32(0), cur, out0, tcache, dcache, jnp.int32(1), key0))
     return out[:N][None, :], fwds

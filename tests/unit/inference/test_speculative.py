@@ -152,3 +152,89 @@ def test_speculative_context_overflow_raises():
     with pytest.raises(ValueError, match="overshoot"):
         speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
                              16, draft_k=4)   # 240+16+5 > 256
+
+
+# ---------------------------------------------------- speculative SAMPLING
+
+def test_spec_accept_preserves_target_distribution():
+    """The Leviathan/Chen acceptance rule's exactness theorem, checked
+    empirically: over draft randomness + accept randomness, the first
+    emitted token is distributed exactly as the target distribution —
+    for a draft close to, far from, and disjoint-ish from the target."""
+    from deepspeed_tpu.inference.speculative import spec_accept
+    V = 4
+    cases = [
+        (jnp.asarray([0.4, 0.3, 0.2, 0.1]), jnp.asarray([0.35, 0.35, 0.2, 0.1])),
+        (jnp.asarray([0.7, 0.1, 0.1, 0.1]), jnp.asarray([0.1, 0.1, 0.1, 0.7])),
+        (jnp.asarray([0.97, 0.01, 0.01, 0.01]), jnp.asarray([0.01, 0.97, 0.01, 0.01])),
+    ]
+    n = 40_000
+    for t_row, d_row in cases:
+        t_probs = jnp.stack([t_row, jnp.full((V,), 0.25)])  # [K+1=2, V]
+        d_probs = d_row[None, :]                            # [K=1, V]
+
+        def one(k):
+            kd, ka = jax.random.split(k)
+            d_tok = jax.random.categorical(kd, jnp.log(d_row))[None]
+            a, nxt = spec_accept(ka, d_tok.astype(jnp.int32), d_probs,
+                                 t_probs)
+            return jnp.where(a >= 1, d_tok[0], nxt)
+
+        toks = jax.vmap(one)(jax.random.split(jax.random.PRNGKey(0), n))
+        freq = np.bincount(np.asarray(toks), minlength=V) / n
+        np.testing.assert_allclose(freq, np.asarray(t_row), atol=0.012,
+                                   err_msg=str((t_row, d_row)))
+
+
+def test_spec_accept_bonus_is_target_row():
+    """All-accepted rounds sample the bonus token from t_probs[K]."""
+    from deepspeed_tpu.inference.speculative import spec_accept
+    V = 4
+    d_row = jnp.asarray([1.0, 0.0, 0.0, 0.0])   # deterministic draft
+    t_probs = jnp.stack([jnp.asarray([1.0, 0.0, 0.0, 0.0]),   # always accept
+                         jnp.asarray([0.1, 0.2, 0.3, 0.4])])
+
+    def one(k):
+        a, nxt = spec_accept(k, jnp.asarray([0], jnp.int32), d_row[None],
+                             t_probs)
+        return a, nxt
+
+    a, nxt = jax.vmap(one)(jax.random.split(jax.random.PRNGKey(1), 20_000))
+    assert int(jnp.min(a)) == 1   # always accepted
+    freq = np.bincount(np.asarray(nxt), minlength=V) / 20_000
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.012)
+
+
+def test_speculative_sampling_generate():
+    """temperature > 0: deterministic per key, varies across keys, valid
+    tokens; temperature=0 arg reproduces the greedy path exactly."""
+    tparams = _train(TARGET)
+    dparams = _train(DRAFT, steps=120)
+    prompt = jnp.asarray([[3] + [(3 * 3 + 7) % 256]], jnp.int32)
+    g0, _ = speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
+                                 12, draft_k=3, temperature=0.0)
+    g1, _ = speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
+                                 12, draft_k=3)
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(g1))
+
+    s1, f1 = speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
+                                  12, draft_k=3, temperature=0.8,
+                                  key=jax.random.PRNGKey(7))
+    s1b, _ = speculative_generate(tparams, TARGET, dparams, DRAFT, prompt,
+                                  12, draft_k=3, temperature=0.8,
+                                  key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1b))
+    outs = [np.asarray(speculative_generate(
+        tparams, TARGET, dparams, DRAFT, prompt, 12, draft_k=3,
+        temperature=0.8, key=jax.random.PRNGKey(s))[0]) for s in range(4)]
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:]), outs
+    assert all((o >= 0).all() and (o < 256).all() for o in outs)
+    assert 1 <= int(f1) <= 13
+    # the engine surface passes temperature/key through
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    out, _ = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                      max_new_tokens=8, draft_k=3,
+                                      temperature=0.8,
+                                      key=jax.random.PRNGKey(2))
+    assert np.asarray(out).shape == (1, 8)
