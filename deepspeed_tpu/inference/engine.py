@@ -138,13 +138,6 @@ class InferenceEngine:
                 raise NotImplementedError(
                     "kv_cache_dtype='int8' serves the dense GPT family; "
                     "MoE decode caches in the compute dtype")
-            if self._int8_compute:
-                # the MoE tree stacks layers under dense_blocks /
-                # moe_attn_blocks and experts under moe_blocks — layouts
-                # the contract-axes converter does not describe yet
-                raise NotImplementedError(
-                    "quant.int8_compute serves the dense GPT family; MoE "
-                    "serving uses weight-only int8 (dtype='int8')")
             from ..models import gpt_moe, gpt_moe_inference as fam
             self._apply_fn = lambda p, t: gpt_moe.apply(p, t, cfg,
                                                         train=False)[0]

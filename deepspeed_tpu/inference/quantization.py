@@ -108,6 +108,21 @@ INT8_COMPUTE_CONTRACT = {
     "lm_head": (1,),   # [V, d] contracted over d
 }
 
+#: MoE expert stacks carry a leading expert BATCH dim (einsum
+#: "ecd,edf->ecf"), so the contraction sits one axis deeper
+INT8_COMPUTE_CONTRACT_EXPERTS = {
+    "wi": (1,),        # [E, d, ffn]
+    "wo": (1,),        # [E, ffn, d]
+}
+
+#: the residual-MoE mlp reuses the plain 2-D layout, but its "wo" is
+#: [ffn, d] — NOT the attention projection's 3-D [H, Dh, d] the default
+#: table's "wo" entry describes
+INT8_COMPUTE_CONTRACT_RESIDUAL_MLP = {
+    "wi": (0,),
+    "wo": (0,),
+}
+
 
 def _quantize_compute_jit():
     from ..ops.int8 import quantize_for_int8_compute
@@ -129,12 +144,21 @@ def quantize_params_int8_compute(params: PyTree) -> Tuple[PyTree, int]:
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     n_quantized = 0
     out = []
+    #: path components marking layer-stacked subtrees (lax.scan slices
+    #: the leading layer/pair dim off codes and scales together)
+    stack_keys = {"blocks", "dense_blocks", "moe_attn_blocks", "moe_blocks"}
     for path, leaf in flat:
         name = str(getattr(path[-1], "key", path[-1])) if path else ""
-        axes = INT8_COMPUTE_CONTRACT.get(name)
+        parents = {str(getattr(p, "key", p)) for p in path[:-1]}
+        if "experts" in parents:
+            table = INT8_COMPUTE_CONTRACT_EXPERTS
+        elif "residual_mlp" in parents:
+            table = INT8_COMPUTE_CONTRACT_RESIDUAL_MLP
+        else:
+            table = INT8_COMPUTE_CONTRACT
+        axes = table.get(name)
         if axes is not None and getattr(leaf, "ndim", 0) >= 2:
-            stacked = any(
-                str(getattr(p, "key", p)) == "blocks" for p in path[:-1])
+            stacked = bool(parents & stack_keys)
             out.append(qz(leaf, axes, stacked))
             n_quantized += 1
         else:

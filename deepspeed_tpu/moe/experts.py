@@ -42,13 +42,21 @@ def experts_logical_axes() -> Dict[str, tuple]:
     }
 
 
+def _wdot(spec, x, w, cdt):
+    """The ONE weight-gemm dispatcher (``models/gpt._wdot``), re-exported
+    for the expert/residual gemm sites — per-expert scales ride the
+    shared batch label of the expert einsums."""
+    from ..models.gpt import _wdot as gpt_wdot
+    return gpt_wdot(spec, x, w, cdt)
+
+
 def experts_apply(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
                   compute_dtype=None) -> jnp.ndarray:
     """x: [E, C, d] → [E, C, d]; per-expert FFN, batched over the expert dim."""
     cdt = compute_dtype or x.dtype
-    h = jnp.einsum("ecd,edf->ecf", x, params["wi"].astype(cdt)) + \
+    h = _wdot("ecd,edf->ecf", x, params["wi"], cdt) + \
         params["bi"].astype(cdt)[:, None, :]
     h = jax.nn.gelu(h, approximate=True)
-    out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(cdt)) + \
+    out = _wdot("ecf,efd->ecd", h, params["wo"], cdt) + \
         params["bo"].astype(cdt)[:, None, :]
     return out

@@ -84,10 +84,13 @@ class MoE:
             params["experts"], x, train=train, rng=rng, constrain=constrain)
         if self.use_residual:
             # Residual-MoE (reference layer.py:108): out = moe + coef-mixed mlp
+            from .experts import _wdot
             r = params["residual_mlp"]
-            h = jax.nn.gelu(x @ r["wi"].astype(x.dtype) + r["bi"].astype(x.dtype),
-                            approximate=True)
-            mlp_out = h @ r["wo"].astype(x.dtype) + r["bo"].astype(x.dtype)
+            h = jax.nn.gelu(
+                _wdot("bsd,df->bsf", x, r["wi"], x.dtype) +
+                r["bi"].astype(x.dtype), approximate=True)
+            mlp_out = _wdot("bsf,fd->bsd", h, r["wo"], x.dtype) + \
+                r["bo"].astype(x.dtype)
             coef = jax.nn.softmax(
                 (x @ params["coefficient"].astype(x.dtype)).astype(jnp.float32),
                 axis=-1).astype(x.dtype)

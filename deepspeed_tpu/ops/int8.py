@@ -90,10 +90,12 @@ def quantize_for_int8_compute(w: jnp.ndarray, contract_axes: Tuple[int, ...],
 def int8_einsum(spec: str, x: jnp.ndarray, w: Int8ComputeParam, out_dtype):
     """``einsum(spec, x, w)`` as an integer dot with a scale epilogue.
 
-    Contract (matches every weight-gemm site in ``models/gpt.py``): the
-    contracted axes are the TRAILING axes of ``x`` and ``w.contract_axes``
-    of the weight; the output is x's batch dims followed by the weight's
-    output dims (einsum default ordering).
+    Contract (matches every weight-gemm site in ``models/gpt.py`` and the
+    MoE expert layer): the contracted axes are the TRAILING axes of ``x``
+    and ``w.contract_axes`` of the weight; x's leading axes are batch
+    dims and form a PREFIX of the output.  Shared batch labels between x
+    and w (the expert dim in ``"ecd,edf->ecf"``) are supported — the
+    weight-scale broadcast is derived from the spec.
 
     The activation is quantized per row (one scale per flattened batch
     element, reduced over the contracted axes) — the reference's dynamic
@@ -106,10 +108,24 @@ def int8_einsum(spec: str, x: jnp.ndarray, w: Int8ComputeParam, out_dtype):
     xs = jnp.maximum(xmax / 127.0, _EPS)
     xq = jnp.clip(jnp.round(x32 / xs), -127, 127).astype(jnp.int8)
     acc = jnp.einsum(spec, xq, w.q, preferred_element_type=jnp.int32)
-    # epilogue: out = acc * x_scale (batch dims) * w_scale (output dims)
+    # epilogue: out = acc * x_scale (batch-dim prefix) * w_scale, with the
+    # weight scale transposed/reshaped to the OUTPUT's trailing labels
     n_batch = x.ndim - k
     n_out = acc.ndim - n_batch
     xs_b = xs.reshape(xs.shape[:n_batch] + (1,) * n_out)
-    ws_o = w.scale.reshape(tuple(d for a, d in enumerate(w.scale.shape)
-                                 if a not in w.contract_axes))
+    lhs, rhs = spec.split("->")
+    w_spec = lhs.split(",")[1]
+    tail = rhs.split("...")[-1]          # labels after any ellipsis
+    w_lbls = [l for i, l in enumerate(w_spec) if i not in w.contract_axes]
+    sq = jnp.squeeze(w.scale, axis=tuple(w.contract_axes))  # dims = w_lbls
+    perm = [w_lbls.index(l) for l in tail if l in w_lbls]
+    sq = jnp.transpose(sq, perm)
+    shape, j = [], 0
+    for l in tail:
+        if l in w_lbls:
+            shape.append(sq.shape[j])
+            j += 1
+        else:
+            shape.append(1)
+    ws_o = sq.reshape(tuple(shape))
     return (acc.astype(jnp.float32) * xs_b * ws_o).astype(out_dtype)

@@ -232,20 +232,106 @@ def test_int8_compute_bench_row():
     assert r["per_token_tokens_per_sec"] > 0
 
 
-def test_int8_compute_moe_guarded():
-    """The MoE family's stacked layouts (dense_blocks/moe_attn_blocks/
-    moe_blocks) are not described by the contract-axes converter — the
-    engine must refuse clearly, not crash in the scale epilogue."""
+def test_int8_compute_moe():
+    """int8_compute serves the MoE family too: dense/attention stacks AND
+    the expert stacks (per-expert scales riding the shared batch label of
+    "ecd,edf->ecf") store int8 codes; the gate stays full precision; ppl
+    tracks the bf16 engine."""
     from deepspeed_tpu.models import gpt_moe
     from deepspeed_tpu.models.gpt_moe import GPTMoEConfig
-    cfg = GPTMoEConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
-                       d_model=16, dtype=jnp.bfloat16, num_experts=2,
-                       vocab_round_to=128)
+    from deepspeed_tpu.ops.int8 import Int8ComputeParam
+    cfg = GPTMoEConfig(vocab_size=256, max_seq_len=128, n_layer=2, n_head=2,
+                       d_model=32, dtype=jnp.bfloat16, num_experts=2,
+                       vocab_round_to=128, eval_capacity_factor=8.0,
+                       min_capacity=16)
     params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="int8_compute"):
-        deepspeed_tpu.init_inference(
-            model=(cfg, params),
-            config={"dtype": "int8", "quant": {"int8_compute": True}})
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 32)), jnp.int32)
+
+    bf16 = deepspeed_tpu.init_inference(model=(cfg, params),
+                                        config={"dtype": "bfloat16"})
+    qc = deepspeed_tpu.init_inference(
+        model=(cfg, params),
+        config={"dtype": "int8", "quant": {"int8_compute": True}})
+    experts = qc.params["moe_blocks"]["experts"]
+    assert isinstance(experts["wi"], Int8ComputeParam)
+    assert experts["wi"].contract_axes == (1,)   # expert dim is batch
+    # per-expert, per-output-channel scales: [pairs, E, 1, ffn]
+    assert experts["wi"].scale.shape[2] == 1
+    assert isinstance(qc.params["moe_attn_blocks"]["wqkv"], Int8ComputeParam)
+    assert not isinstance(qc.params["moe_blocks"]["gate"]["wg"],
+                          Int8ComputeParam)
+
+    def loss(logits):
+        lg = logits[:, :-1, :cfg.vocab_size].astype(jnp.float32)
+        tg = tokens[:, 1:]
+        return float(jnp.mean(jax.nn.logsumexp(lg, axis=-1) -
+                              jnp.take_along_axis(lg, tg[..., None],
+                                                  axis=-1)[..., 0]))
+
+    d = abs(np.exp(loss(qc.forward(tokens))) /
+            np.exp(loss(bf16.forward(tokens))) - 1.0)
+    assert d < 0.05, d
+    out = qc.generate(tokens[:, :8], max_new_tokens=4)
+    assert out.shape == (2, 4)
+    assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
+
+
+def test_int8_compute_einsum_batch_label():
+    """Shared batch labels between activation and weight (the expert dim
+    of "ecd,edf->ecf"): per-expert scales must broadcast to the right
+    output rows."""
+    from deepspeed_tpu.ops.int8 import (int8_einsum,
+                                        quantize_for_int8_compute)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(3, 8, 16)), jnp.float32)   # [E, C, d]
+    w = jnp.asarray(rng.normal(size=(3, 16, 32)), jnp.float32)  # [E, d, f]
+    wp = quantize_for_int8_compute(w, (1,))
+    assert wp.scale.shape == (3, 1, 32)
+    ref = jnp.einsum("ecd,edf->ecf", x, w)
+    out = int8_einsum("ecd,edf->ecf", x, wp, jnp.float32)
+    rel = float(jnp.linalg.norm(out - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.02, rel
+    # second expert gemm layout
+    w2 = jnp.asarray(rng.normal(size=(3, 32, 16)), jnp.float32)  # [E, f, d]
+    wp2 = quantize_for_int8_compute(w2, (1,))
+    h = jnp.asarray(rng.normal(size=(3, 8, 32)), jnp.float32)
+    ref2 = jnp.einsum("ecf,efd->ecd", h, w2)
+    out2 = int8_einsum("ecf,efd->ecd", h, wp2, jnp.float32)
+    assert float(jnp.linalg.norm(out2 - ref2) /
+                 jnp.linalg.norm(ref2)) < 0.02
+
+
+def test_int8_compute_residual_moe():
+    """Residual-MoE: the residual mlp's 2-D wi/wo quantize with their own
+    contract table (its 'wo' is [ffn, d], not the attention 3-D layout)."""
+    from deepspeed_tpu.models import gpt_moe
+    from deepspeed_tpu.models.gpt_moe import GPTMoEConfig
+    from deepspeed_tpu.ops.int8 import Int8ComputeParam
+    cfg = GPTMoEConfig(vocab_size=256, max_seq_len=64, n_layer=2, n_head=2,
+                       d_model=32, dtype=jnp.bfloat16, num_experts=2,
+                       vocab_round_to=128, use_residual=True,
+                       eval_capacity_factor=8.0, min_capacity=16)
+    params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 256, size=(2, 16)), jnp.int32)
+    bf16 = deepspeed_tpu.init_inference(model=(cfg, params),
+                                        config={"dtype": "bfloat16"})
+    qc = deepspeed_tpu.init_inference(
+        model=(cfg, params),
+        config={"dtype": "int8", "quant": {"int8_compute": True}})
+    rm = qc.params["moe_blocks"]["residual_mlp"]
+    assert isinstance(rm["wo"], Int8ComputeParam)
+    assert rm["wo"].contract_axes == (0,)
+    # coefficient mixer stays full precision (routing-critical, tiny)
+    assert not isinstance(qc.params["moe_blocks"]["coefficient"],
+                          Int8ComputeParam)
+    a = np.asarray(qc.forward(tokens), np.float32)
+    b = np.asarray(bf16.forward(tokens), np.float32)
+    assert np.isfinite(a).all()
+    # same model, int8 noise only
+    rel = np.linalg.norm(a - b) / np.linalg.norm(b)
+    assert rel < 0.1, rel
 
 
 def test_int8_on_trained_weights():
@@ -310,29 +396,4 @@ def test_int8_on_trained_weights():
         assert agree >= 0.75, (agree, np.asarray(out), nxt)
 
 
-def test_int8_compute_composes_with_tp():
-    """int8_compute x tensor parallelism: quantization happens AFTER TP
-    sharding, so codes and per-output-channel scales stay sharded over the
-    model axis, and the integer-dot serving output matches the unsharded
-    int8-compute engine."""
-    from deepspeed_tpu.ops.int8 import Int8ComputeParam
-    from deepspeed_tpu.parallel.mesh import (MODEL_AXIS, ParallelDims,
-                                             initialize_mesh,
-                                             reset_mesh_manager)
-    params = gpt.init(CFG, jax.random.PRNGKey(0))
-    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0, 256)
-    reset_mesh_manager()
-    plain = deepspeed_tpu.init_inference(
-        model=(CFG, params),
-        config={"dtype": "int8", "quant": {"int8_compute": True}})
-    base = np.asarray(plain(prompt), np.float32)
-    mm = initialize_mesh(ParallelDims(dp=-1, tp=2))
-    sharded = deepspeed_tpu.init_inference(
-        model=(CFG, params),
-        config={"dtype": "int8", "quant": {"int8_compute": True},
-                "tensor_parallel": {"tp_size": 2}})
-    wq = sharded.params["blocks"]["wqkv"]
-    assert isinstance(wq, Int8ComputeParam)
-    assert MODEL_AXIS in str(wq.q.sharding.spec), wq.q.sharding
-    got = np.asarray(sharded(prompt), np.float32)
-    np.testing.assert_allclose(got, base, atol=2e-3, rtol=2e-3)
+
