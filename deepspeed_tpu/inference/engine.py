@@ -173,21 +173,8 @@ class InferenceEngine:
             lg = lg[:, :cfg.vocab_size]
             if greedy:
                 return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            lg = lg / jnp.maximum(temperature, 1e-6)
-            if top_k > 0:
-                kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
-                lg = jnp.where(lg < kth, -jnp.inf, lg)
-            if top_p < 1.0:
-                # nucleus: mask tokens outside the smallest top-p mass set
-                sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_lg, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # keep everything strictly inside the nucleus plus the
-                # first token that crosses p
-                keep_sorted = cum - probs < top_p
-                cutoff = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # >= 1
-                kth = jnp.take_along_axis(sorted_lg, cutoff - 1, axis=-1)
-                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            from .sampling import filter_logits
+            lg = filter_logits(lg, temperature, top_k=top_k, top_p=top_p)
             return jax.random.categorical(key, lg).astype(jnp.int32)
 
         kv_dtype = self._kv_dtype
@@ -289,6 +276,7 @@ class InferenceEngine:
 
     def generate_speculative(self, tokens, draft, max_new_tokens: int = 32,
                              draft_k: int = 7, temperature: float = 0.0,
+                             top_k: int = 0, top_p: float = 1.0,
                              key=None):
         """Generation with draft-model speculation
         (``inference/speculative.py``): fewer target forwards, exact
@@ -308,6 +296,11 @@ class InferenceEngine:
         if self._family is not gpt_inference:
             raise NotImplementedError(
                 "speculative decode serves the dense GPT family")
+        if temperature <= 0 and (top_k > 0 or top_p < 1.0):
+            raise ValueError(
+                "top_k/top_p only apply to speculative SAMPLING — set "
+                "temperature > 0 (temperature=0 is greedy and would "
+                "silently ignore the filters)")
         if isinstance(draft, InferenceEngine):
             if draft._family is not gpt_inference:
                 raise NotImplementedError(
@@ -322,7 +315,8 @@ class InferenceEngine:
                 f"GPT-family InferenceEngine (got config {type(dcfg)})")
         tokens = jnp.asarray(tokens, jnp.int32)
         sig = ("spec", tokens.shape, int(max_new_tokens), int(draft_k),
-               float(temperature), str(dcfg))  # draft ARCH baked in
+               float(temperature), int(top_k), float(top_p),
+               str(dcfg))  # draft ARCH baked in
         if sig not in self._generate_cache:
             cfg, kv = self.model_config, self._kv_dtype
 
@@ -330,7 +324,8 @@ class InferenceEngine:
                 return speculative_generate(tp, cfg, dp, dcfg, t,
                                             max_new_tokens, draft_k,
                                             kv_dtype=kv,
-                                            temperature=temperature, key=k)
+                                            temperature=temperature,
+                                            top_k=top_k, top_p=top_p, key=k)
 
             self._generate_cache[sig] = jax.jit(run)
         key = key if key is not None else jax.random.PRNGKey(0)

@@ -1,0 +1,36 @@
+"""Shared logit filtering for sampling (temperature → top-k → top-p, the
+reference/HF order) — one implementation serving ``engine.generate``'s
+fused loop and the speculative sampler, so the two paths can never
+disagree about what "top_p=0.9" means.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def filter_logits(lg: jnp.ndarray, temperature, top_k: int = 0,
+                  top_p: float = 1.0) -> jnp.ndarray:
+    """lg [..., V] → temperature-scaled logits with everything outside
+    the top-k / nucleus set at -inf.  ``temperature`` may be traced;
+    ``top_k``/``top_p`` are static."""
+    lg = lg / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p < 1.0:
+        # nucleus: keep everything strictly inside the smallest top-p mass
+        # set plus the first token that crosses p
+        sorted_lg = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p
+        # clamp: at top_p <= 0 the keep-count would be 0 and the -1 index
+        # would WRAP to the smallest logit, silently disabling the filter
+        # — the most restrictive nucleus must keep exactly the top token
+        cutoff = jnp.maximum(
+            jnp.sum(keep_sorted, axis=-1, keepdims=True), 1)
+        kth = jnp.take_along_axis(sorted_lg, cutoff - 1, axis=-1)
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return lg

@@ -77,6 +77,7 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                          prompt: jnp.ndarray, max_new_tokens: int,
                          draft_k: int = 7,
                          kv_dtype=None, temperature: float = 0.0,
+                         top_k: int = 0, top_p: float = 1.0,
                          key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Speculative decode.  prompt [1, S] → (tokens [1, N],
     n_target_forwards []).
@@ -85,8 +86,10 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     bit-identical to the target decoding alone.  ``temperature > 0``:
     speculative SAMPLING (:func:`spec_accept` rejection rule) — the
     emitted tokens are distributed exactly as sampling from the target
-    at that temperature, with the draft only changing the number of
-    target passes.
+    at that temperature (with ``top_k``/``top_p`` applied to draft AND
+    target through the shared :func:`sampling.filter_logits`, so the
+    theorem holds against the filtered target), with the draft only
+    changing the number of target passes.
 
     ``n_target_forwards`` counts the verify passes (plus the prefill) the
     run needed — the quantity speculation reduces; plain decode needs N.
@@ -129,13 +132,18 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     temp = jnp.float32(max(float(temperature), 1e-6))
     key0 = key if key is not None else jax.random.PRNGKey(0)
 
+    from .sampling import filter_logits
+
+    def flt(lg):
+        return filter_logits(lg, temp, top_k=top_k, top_p=top_p)
+
     tlogits, tcache = gpt_inference.prefill(target_params, prompt,
                                             target_cfg, tcache)
     _, dcache = gpt_inference.prefill(draft_params, prompt, draft_cfg, dcache)
     last_t = tlogits[:, -1, :V].astype(jnp.float32)
     if sample:
         key0, sub = jax.random.split(key0)
-        cur = jax.random.categorical(sub, last_t / temp).astype(jnp.int32)
+        cur = jax.random.categorical(sub, flt(last_t)).astype(jnp.int32)
     else:
         cur = jnp.argmax(last_t, -1).astype(jnp.int32)   # pending
 
@@ -158,8 +166,9 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                                                draft_cfg, dc)
             lg = lg[:, :V].astype(jnp.float32)
             if sample:
-                probs = jax.nn.softmax(lg / temp, -1)[0]
-                nxt = jax.random.categorical(dk, lg / temp, axis=-1
+                f = flt(lg)
+                probs = jax.nn.softmax(f, -1)[0]
+                nxt = jax.random.categorical(dk, f, axis=-1
                                              ).astype(jnp.int32)
             else:
                 probs = jnp.zeros((V,), jnp.float32)
@@ -180,9 +189,10 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
 
         if sample:
             # rejection rule: emitted tokens are distributed exactly as
-            # target sampling; the window is [cur, accepted drafts] with
-            # nxt the pending resample/bonus token
-            t_probs = jax.nn.softmax(vlg / temp, -1)
+            # target sampling (of the filtered distribution); the window
+            # is [cur, accepted drafts] with nxt the pending
+            # resample/bonus token
+            t_probs = jax.nn.softmax(flt(vlg), -1)
             a, nxt = spec_accept(akey, drafts, d_probs, t_probs)
             nxt = nxt[None]
         else:

@@ -238,3 +238,67 @@ def test_speculative_sampling_generate():
                                       temperature=0.8,
                                       key=jax.random.PRNGKey(2))
     assert np.asarray(out).shape == (1, 8)
+
+
+def test_filter_logits_shared_semantics():
+    """One filter implementation serves generate and the speculative
+    sampler: temperature scaling, top-k cut, nucleus cut (first crossing
+    token kept), batched shapes."""
+    from deepspeed_tpu.inference.sampling import filter_logits
+    lg = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    f = filter_logits(lg, 1.0, top_k=2)
+    assert np.isfinite(np.asarray(f)[0, :2]).all()
+    assert np.isinf(np.asarray(f)[0, 2:]).all()
+    # nucleus 0.6: keep 0.5 (inside) + 0.25 (first crossing)
+    f = filter_logits(lg, 1.0, top_p=0.6)
+    assert np.isfinite(np.asarray(f)[0, :2]).all()
+    assert np.isinf(np.asarray(f)[0, 2:]).all()
+    # temperature divides before filtering (engine's order)
+    np.testing.assert_allclose(np.asarray(filter_logits(lg, 2.0))[0],
+                               np.asarray(lg)[0] / 2.0, rtol=1e-6)
+
+
+def test_speculative_sampling_top_filters():
+    """top_k/top_p apply to draft AND target: outputs stay inside the
+    target's top-k set at every step, deterministic per key."""
+    tparams = _train(TARGET)
+    dparams = _train(DRAFT, steps=120)
+    prompt = jnp.asarray([[3] + [(3 * 3 + 7) % 256]], jnp.int32)
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    out, _ = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                      max_new_tokens=10, draft_k=3,
+                                      temperature=0.8, top_k=1,
+                                      key=jax.random.PRNGKey(5))
+    # top_k=1 sampling IS greedy — must equal the greedy path exactly
+    want = np.asarray(eng.generate(prompt, max_new_tokens=10))
+    np.testing.assert_array_equal(np.asarray(out), want)
+    # nucleus run: valid + deterministic per key
+    o1, _ = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                     max_new_tokens=10, draft_k=3,
+                                     temperature=0.8, top_p=0.9,
+                                     key=jax.random.PRNGKey(6))
+    o2, _ = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                     max_new_tokens=10, draft_k=3,
+                                     temperature=0.8, top_p=0.9,
+                                     key=jax.random.PRNGKey(6))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert (np.asarray(o1) < 256).all() and (np.asarray(o1) >= 0).all()
+
+
+def test_filter_logits_top_p_zero_keeps_top_token():
+    """top_p<=0 must keep exactly the top token, not silently disable
+    the filter (the cutoff-0 index would wrap to the smallest logit)."""
+    from deepspeed_tpu.inference.sampling import filter_logits
+    lg = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    f = np.asarray(filter_logits(lg, 1.0, top_p=0.0))
+    assert np.isfinite(f[0, 0]) and np.isinf(f[0, 1:]).all()
+
+
+def test_speculative_filters_require_temperature():
+    tparams, dparams = _models()
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    with pytest.raises(ValueError, match="temperature"):
+        eng.generate_speculative(jnp.zeros((1, 4), jnp.int32),
+                                 (DRAFT, dparams), top_p=0.9)
