@@ -56,7 +56,7 @@ def _assert_converged(name: str, losses: list) -> float:
     return tail
 
 
-def _train_dense(stage: int, offload: bool) -> list:
+def _train_dense(stage: int, offload: bool, fp16: bool = False) -> list:
     reset_mesh_manager()
     ds = {"train_micro_batch_size_per_gpu": 1,  # x dp=8 -> global batch 8
           "gradient_accumulation_steps": 1,
@@ -65,13 +65,23 @@ def _train_dense(stage: int, offload: bool) -> list:
           "steps_per_print": 1 << 30}
     if offload:
         ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    cfg = CFG
+    if fp16:
+        ds["fp16"] = {"enabled": True, "initial_scale_power": 16,
+                      "loss_scale_window": 20}
+        cfg = dataclasses.replace(CFG, dtype=jnp.float16)
     mm = initialize_mesh(ParallelDims(dp=-1))
     engine, _, _, _ = deepspeed_tpu.initialize(
-        model=from_gpt(CFG), config=ds, mesh_manager=mm,
+        model=from_gpt(cfg), config=ds, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))
     batch = {"tokens": _corpus()}
-    return [float(jax.device_get(engine.train_batch_fused(batch)))
-            for _ in range(STEPS)]
+    losses = [float(jax.device_get(engine.train_batch_fused(batch)))
+              for _ in range(STEPS)]
+    if fp16:
+        # the dynamic scaler must end the run healthy: finite, positive,
+        # and grown past init-after-backoff territory
+        assert np.isfinite(engine.cur_scale) and engine.cur_scale >= 1.0
+    return losses
 
 
 def test_convergence_zero1_zero2offload_pipeline():
@@ -89,6 +99,13 @@ def test_convergence_zero1_zero2offload_pipeline():
         np.testing.assert_allclose(offl[:20], zero1[:20], rtol=5e-3,
                                    atol=5e-3)
         assert abs(tail2 - tail1) < 0.02, (tail1, tail2)
+
+    # ---- fp16 + dynamic loss scaling: the scaler must survive a few
+    # hundred steps (overflow skips, window growth) AND converge — scaler
+    # state bugs only show over long horizons
+    fp16 = _train_dense(stage=1, offload=False, fp16=True)
+    tail_fp16 = _assert_converged("fp16-dynamic-scale", fp16)
+    assert abs(tail_fp16 - tail1) < 0.05, (tail1, tail_fp16)
 
     # ---- pipeline (2 stages, in-jit 1F1B), own init
     reset_mesh_manager()
