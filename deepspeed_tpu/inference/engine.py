@@ -360,7 +360,8 @@ class InferenceEngine:
                 "extend": jax.jit(lambda p, t, c: fam.extend(p, t, cfg, c)),
                 "decode": jax.jit(
                     lambda p, t, c: fam.decode_step(p, t, cfg, c)),
-                "reply": {},   # fused greedy loops, keyed by n_tokens
+                "reply": {},   # fused reply loops, keyed by
+                               # (n_tokens, sample, top_k, top_p)
             }
         return self._session_progs
 
@@ -410,41 +411,59 @@ class InferenceSession:
         self._last_logits = logits[:, -1]
         return logits
 
-    def _reply_prog(self, n: int):
-        """One fused greedy loop (lax.scan over n tokens) per reply
-        length: a 128-token reply is ONE dispatch, not 256."""
-        if n not in self._progs["reply"]:
+    def _reply_prog(self, n: int, sample: bool, top_k: int, top_p: float):
+        """One fused reply loop (lax.scan over n tokens) per signature:
+        a 128-token reply is ONE dispatch, not 256."""
+        sig = (n, sample, top_k, top_p)
+        if sig not in self._progs["reply"]:
             cfg = self._engine.model_config
             from ..models import gpt_inference as fam
+            from .sampling import filter_logits
 
-            def reply(params, last, cache):
-                def step(carry, _):
+            def reply(params, last, cache, key, temperature):
+                def step(carry, k):
                     last, cache = carry
-                    nxt = jnp.argmax(last[:, :cfg.vocab_size],
-                                     -1).astype(jnp.int32)
-                    lg, cache = fam.decode_step(params, nxt, cfg, cache)
-                    return (lg, cache), nxt
+                    lg = last[:, :cfg.vocab_size]
+                    if sample:
+                        lg = filter_logits(lg, temperature, top_k=top_k,
+                                           top_p=top_p)
+                        nxt = jax.random.categorical(k, lg).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    lg2, cache = fam.decode_step(params, nxt, cfg, cache)
+                    return (lg2, cache), nxt
 
-                (last, cache), toks = lax.scan((step), (last, cache),
-                                               None, length=n)
+                (last, cache), toks = lax.scan(
+                    step, (last, cache), jax.random.split(key, n))
                 return toks.swapaxes(0, 1), last, cache
 
-            self._progs["reply"][n] = jax.jit(reply)
-        return self._progs["reply"][n]
+            self._progs["reply"][sig] = jax.jit(reply)
+        return self._progs["reply"][sig]
 
-    def generate(self, max_new_tokens: int = 32) -> jnp.ndarray:
-        """Greedy-decode a reply in one fused XLA program; the reply's
-        K/V stays in the session cache, so the next ``append`` continues
-        the conversation."""
+    def generate(self, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, key=None) -> jnp.ndarray:
+        """Decode a reply in one fused XLA program (greedy, or sampled
+        through the shared logit filter); the reply's K/V stays in the
+        session cache, so the next ``append`` continues the
+        conversation."""
         if self._last_logits is None:
             raise ValueError("append() a prompt before generate()")
+        if not do_sample and (top_k > 0 or top_p < 1.0):
+            raise ValueError(
+                "top_k/top_p only apply with do_sample=True (greedy "
+                "would silently ignore the filters)")
         B = self.cache.k.shape[1]
         if max_new_tokens <= 0:
             return jnp.zeros((B, 0), jnp.int32)
         self._check_room(max_new_tokens)
+        key = key if key is not None else jax.random.PRNGKey(0)
         toks, self._last_logits, self.cache = self._reply_prog(
-            max_new_tokens)(self._engine.params, self._last_logits,
-                            self.cache)
+            max_new_tokens, bool(do_sample),
+            int(top_k) if do_sample else 0,
+            float(top_p) if do_sample else 1.0)(
+            self._engine.params, self._last_logits, self.cache, key,
+            jnp.asarray(temperature, jnp.float32))
         return toks
 
 
