@@ -400,6 +400,21 @@ class InferenceSession:
                 f"session cache full: {self.length} + {n} tokens exceeds "
                 f"max_len {self.cache.max_len}")
 
+    def fork(self) -> "InferenceSession":
+        """A new session continuing from this one's exact state (prefix
+        caching): process a shared system prompt ONCE, then fork one
+        session per conversation.  ZERO-copy — jax arrays are immutable
+        and no inference program donates its cache buffers, so parent
+        and forks share the prefix K/V until each one's next
+        append/generate produces its own updated tree.  Compiled
+        programs stay shared too."""
+        new = object.__new__(InferenceSession)
+        new._engine = self._engine
+        new._progs = self._progs
+        new.cache = self.cache
+        new._last_logits = self._last_logits
+        return new
+
     def append(self, tokens) -> jnp.ndarray:
         """Feed one turn's tokens [B, S]; returns its logits
         [B, S, padded_vocab] (fp32)."""

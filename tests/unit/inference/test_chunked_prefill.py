@@ -201,3 +201,38 @@ def test_session_sampled_replies():
     # greedy + filters is a loud error, not a silent no-op
     with pytest.raises(ValueError, match="do_sample"):
         s.generate(4, top_p=0.9)
+
+
+def test_session_fork_prefix_caching():
+    """Process a shared system prompt once, fork per conversation: each
+    fork diverges independently and matches the stateless run on ITS
+    concatenated history; the parent is unaffected."""
+    import deepspeed_tpu
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model=(CFG, params),
+                                       config={"dtype": "float32"})
+    rng = np.random.default_rng(2)
+    system = jnp.asarray(rng.integers(0, 256, (1, 12)), jnp.int32)
+    base = eng.start_session(batch=1, max_len=128)
+    base.append(system)
+
+    turn_a = jnp.asarray(rng.integers(0, 256, (1, 5)), jnp.int32)
+    turn_b = jnp.asarray(rng.integers(0, 256, (1, 7)), jnp.int32)
+    fa, fb = base.fork(), base.fork()
+    fa.append(turn_a)
+    ra = np.asarray(fa.generate(6))
+    fb.append(turn_b)
+    rb = np.asarray(fb.generate(6))
+    assert not np.array_equal(ra, rb)  # genuinely diverged
+
+    # each fork == the stateless engine on its own concatenated history
+    np.testing.assert_array_equal(
+        ra, np.asarray(eng.generate(
+            jnp.concatenate([system, turn_a], 1), max_new_tokens=6)))
+    np.testing.assert_array_equal(
+        rb, np.asarray(eng.generate(
+            jnp.concatenate([system, turn_b], 1), max_new_tokens=6)))
+    # the parent still holds only the system prompt and continues cleanly
+    assert base.length == 12
+    base.append(turn_a)
+    np.testing.assert_array_equal(np.asarray(base.generate(6)), ra)
