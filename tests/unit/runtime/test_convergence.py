@@ -56,13 +56,17 @@ def _assert_converged(name: str, losses: list) -> float:
     return tail
 
 
-def _train_dense(stage: int, offload: bool, fp16: bool = False) -> list:
+def _train_dense(stage: int, offload: bool, fp16: bool = False,
+                 tp: int = 1) -> list:
     reset_mesh_manager()
-    ds = {"train_micro_batch_size_per_gpu": 1,  # x dp=8 -> global batch 8
+    mb = 8 // (8 // max(tp, 1))  # keep global batch 8 at any dp extent
+    ds = {"train_micro_batch_size_per_gpu": mb,
           "gradient_accumulation_steps": 1,
           "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
           "zero_optimization": {"stage": stage},
           "steps_per_print": 1 << 30}
+    if tp > 1:
+        ds["tensor_parallel"] = {"enabled": True, "size": tp}
     if offload:
         ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
     cfg = CFG
@@ -70,7 +74,7 @@ def _train_dense(stage: int, offload: bool, fp16: bool = False) -> list:
         ds["fp16"] = {"enabled": True, "initial_scale_power": 16,
                       "loss_scale_window": 20}
         cfg = dataclasses.replace(CFG, dtype=jnp.float16)
-    mm = initialize_mesh(ParallelDims(dp=-1))
+    mm = initialize_mesh(ParallelDims(dp=-1, tp=tp))
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=from_gpt(cfg), config=ds, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))
@@ -106,6 +110,13 @@ def test_convergence_zero1_zero2offload_pipeline():
     fp16 = _train_dense(stage=1, offload=False, fp16=True)
     tail_fp16 = _assert_converged("fp16-dynamic-scale", fp16)
     assert abs(tail_fp16 - tail1) < 0.05, (tail1, tail_fp16)
+
+    # ---- tensor parallelism (dp4 x tp2): same math, collectives inside
+    # every layer — the curve must track the pure-dp run
+    tp = _train_dense(stage=1, offload=False, tp=2)
+    tail_tp = _assert_converged("zero1+tp2", tp)
+    np.testing.assert_allclose(tp[:20], zero1[:20], rtol=5e-3, atol=5e-3)
+    assert abs(tail_tp - tail1) < 0.02, (tail1, tail_tp)
 
     # ---- pipeline (2 stages, in-jit 1F1B), own init
     reset_mesh_manager()
