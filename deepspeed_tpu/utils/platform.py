@@ -54,12 +54,31 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     # executables across processes keeps the gate fast and safe.  Only for
     # a source checkout (.git marker): a pip install must not grow a cache
     # dir inside site-packages.
+    #
+    # The cache dir is NAMESPACED BY THE HOST'S CPU FEATURE SET: XLA:CPU
+    # AOT artifacts bake in the compile machine's features (+amx, avx512
+    # variants, prefer-no-scatter, ...) and executing an artifact cached
+    # on a different machine SIGABRTs/SIGILLs at run time (observed: a
+    # deterministic "Fatal Python error: Aborted" inside a device_get
+    # when a stale cross-machine cache served a train step).  Keying the
+    # directory on the feature fingerprint makes a machine change start
+    # a fresh cache instead of executing poisoned artifacts.
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     if os.path.isdir(os.path.join(repo_root, ".git")):
         try:
+            import hashlib
+            try:
+                with open("/proc/cpuinfo") as f:
+                    info = f.read()
+                flags = next((l for l in info.splitlines()
+                              if l.startswith("flags")), info[:4096])
+            except OSError:
+                import platform as _pl
+                flags = f"{_pl.machine()}-{_pl.processor()}"
+            fp = hashlib.sha1(flags.encode()).hexdigest()[:10]
             jax.config.update("jax_compilation_cache_dir",
-                              os.path.join(repo_root, ".jax_cache"))
+                              os.path.join(repo_root, ".jax_cache", fp))
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.5)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
