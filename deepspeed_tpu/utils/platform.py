@@ -14,7 +14,8 @@ import os
 import re
 
 
-def force_cpu_platform(n_devices: int = 8) -> None:
+def force_cpu_platform(n_devices: int = 8,
+                       persistent_cache: bool = True) -> None:
     """Pin jax to the CPU platform with ``n_devices`` virtual devices.
 
     Must be called before the jax backend is initialized; raises if it's
@@ -63,9 +64,19 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     # when a stale cross-machine cache served a train step).  Keying the
     # directory on the feature fingerprint makes a machine change start
     # a fresh cache instead of executing poisoned artifacts.
+    # CAVEAT (persistent_cache=False callers): this jaxlib's XLA:CPU AOT
+    # round-trip is broken for SOME programs — an executable that
+    # compiles and runs fine can abort the process ("Fatal Python error:
+    # Aborted" inside a device_get) when LOADED from the persistent
+    # cache on a later run, even on the same machine (observed with the
+    # convergence suite's dp4×tp2 train step; cold run green, warm run
+    # SIGABRT).  The test suite therefore opts out: a deterministic
+    # crash on re-runs is far worse than cold-compile time.  The driver
+    # gates (dryrun/bench) keep the cache — their program set has proven
+    # load-stable across many warm runs and the gate timeout needs it.
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    if os.path.isdir(os.path.join(repo_root, ".git")):
+    if persistent_cache and os.path.isdir(os.path.join(repo_root, ".git")):
         try:
             import hashlib
             try:

@@ -14,10 +14,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from deepspeed_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
-force_cpu_platform(n_devices=8)
-
-# (persistent XLA compile cache: force_cpu_platform enables it — the
-# suite is compile-dominated on the single-core CI host)
+# persistent_cache=False: this jaxlib's XLA:CPU AOT cache round-trip is
+# broken for some programs — a cache-LOADED executable can abort the
+# whole process on a warm run (see utils/platform.py caveat).  The suite
+# pays cold-compile time for deterministic green.
+force_cpu_platform(n_devices=8, persistent_cache=False)
 
 import pytest  # noqa: E402
 
