@@ -83,11 +83,136 @@ def _init_devices(attempts: int = 3, probe_timeout_s: float = 120.0,
     return jax.devices(), str(last)
 
 
-def _bench_offload(devices, tpu_error) -> None:
-    """`python bench.py offload`: the largest-fitting GPT preset under
-    ZeRO + cpu offload_optimizer (BASELINE config #3 proxy on one chip;
-    reference capability anchor docs/_tutorials/zero.md:29 — 1.5B ZeRO-1
-    on 8 V100s; one v5e hosting 1.3B+offload matches it per-chip)."""
+def _is_oom(e: Exception) -> bool:
+    """True for any flavor of device OOM.  XLA:CPU says "Ran out of
+    memory"; the TPU PJRT runtime surfaces HBM exhaustion as
+    "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted)" — and at
+    runtime (the fence transfer), not only at compile time."""
+    msg = str(e).lower()
+    return "out of memory" in msg or "resource_exhausted" in msg \
+        or "resourceexhausted" in msg
+
+
+# ZeRO-offload capability ladder: largest first.  Each rung runs in its
+# own subprocess because one RESOURCE_EXHAUSTED poisons the TPU client
+# for every later allocation in the same process (measured: after a 2.7B
+# OOM even 350M mb=8 failed in-process, while the same config succeeds
+# fresh).  accum="bf16" rides the 16-bit gradient accumulator
+# (data_types.grad_accum_dtype) — at gas=1 the backward already produces
+# bf16 grads, so accumulating in bf16 loses nothing and halves the
+# dominant 4-bytes/param term.
+_OFFLOAD_LADDER = [("gpt2-2.7b", 2, "bf16"), ("gpt2-2.7b", 1, "bf16"),
+                   ("gpt2-1.3b", 2, None), ("gpt2-1.3b", 1, None),
+                   ("gpt2-760m", 4, None), ("gpt2-350m", 8, None)]
+_OFFLOAD_PARAMS = {"gpt2-2.7b": 2.65e9, "gpt2-1.3b": 1.31e9,
+                   "gpt2-760m": 0.79e9, "gpt2-350m": 0.35e9}
+
+
+def _probe_transfer_gbps() -> tuple:
+    """(h2d, d2h) GB/s measured in a subprocess (32 MB each way).
+
+    Host-offload training moves 2 bytes/param each way per step; on a
+    tunneled dev TPU that link can be ~100× slower than a real TPU VM's
+    PCIe, making big rungs untimeable.  The ladder uses this to skip
+    rungs that cannot finish in budget.  Returns (None, None) when the
+    probe fails (CPU fallback etc.) — callers then skip estimation."""
+    import subprocess
+    code = (
+        "import time, numpy as np, jax\n"
+        "x = np.ones((8, 1024, 1024), np.float32)\n"
+        "d = jax.device_put(x); d.block_until_ready()\n"
+        "t0 = time.perf_counter(); d = jax.device_put(x); "
+        "d.block_until_ready(); t1 = time.perf_counter()\n"
+        "y = jax.device_get(d); t2 = time.perf_counter()\n"
+        "import json; print('XFER ' + json.dumps("
+        "{'h2d': 0.03125/(t1-t0), 'd2h': 0.03125/(t2-t1), "
+        "'platform': jax.devices()[0].platform}))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=120)
+        for ln in r.stdout.splitlines():
+            if ln.startswith("XFER "):
+                d = json.loads(ln[5:])
+                if d.get("platform") == "cpu":
+                    return None, None  # host memcpy, not a device link
+                return d["h2d"], d["d2h"]
+    except Exception:
+        pass
+    return None, None
+
+
+def _estimate_rung_s(n_params: float, n_steps: int, h2d: float,
+                     d2h: float) -> float:
+    """Wall-time estimate for one ladder rung: param upload at init (host
+    init — the fp32 master never crosses the link), then per step bf16
+    grads down + bf16 params up, plus compile/Adam slack."""
+    b = 2 * n_params / 1e9  # GB each way
+    return 75 + b / h2d + n_steps * (b / d2h + b / h2d)
+
+
+def _bench_offload() -> None:
+    """`python bench.py offload` (parent): the largest-fitting GPT preset
+    under ZeRO + cpu offload_optimizer (BASELINE config #3 proxy on one
+    chip; reference capability anchor docs/_tutorials/zero.md:29 — 1.5B
+    ZeRO-1 on 8 V100s; one v5e hosting 1.3B+offload matches it per-chip).
+
+    The parent holds no device — it walks the ladder spawning one child
+    per rung and forwards the first success's JSON line."""
+    import subprocess
+
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_OFFLOAD_DEADLINE_S", "520"))
+    h2d, d2h = _probe_transfer_gbps()
+    if h2d is not None:
+        sys.stderr.write(f"bench offload: link h2d {h2d:.3f} GB/s, "
+                         f"d2h {d2h:.3f} GB/s\n")
+    last_err = "ladder exhausted"
+    for name, mb, accum in _OFFLOAD_LADDER:
+        budget = deadline - time.monotonic()
+        if budget < 45:
+            last_err = f"deadline before trying {name} mb={mb}"
+            break
+        # pick the most steps that fit this rung in the remaining budget
+        # (warmup, timed): prefer (1, 4); degrade to (1, 1) on a slow
+        # link — the child counts the warmup loss so loss-decreasing
+        # evidence survives; skip the rung if even that cannot finish
+        steps_plan = ""
+        if h2d is not None:
+            n = _OFFLOAD_PARAMS.get(name, 1e9)
+            if _estimate_rung_s(n, 5, h2d, d2h) > budget:
+                if _estimate_rung_s(n, 2, h2d, d2h) > budget:
+                    sys.stderr.write(f"bench offload: skip {name} mb={mb} "
+                                     "(link too slow for budget)\n")
+                    last_err = f"{name} skipped: link too slow"
+                    continue
+                steps_plan = "1,1"
+        env = dict(os.environ)
+        env["BENCH_OFFLOAD_ONE"] = f"{name}:{mb}:{accum or ''}"
+        if steps_plan:
+            env["BENCH_OFFLOAD_STEPS"] = steps_plan
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                                "offload"], env=env, capture_output=True,
+                               text=True, timeout=budget - 10)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench offload: {name} mb={mb} timed out\n")
+            last_err = f"{name} mb={mb} timed out"
+            continue
+        sys.stderr.write(r.stderr[-2000:])
+        lines = [ln for ln in r.stdout.splitlines() if '"metric"' in ln]
+        if r.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        last_err = (r.stderr or r.stdout).strip().splitlines()[-1][:200] \
+            if (r.stderr or r.stdout).strip() else f"rc={r.returncode}"
+        sys.stderr.write(f"bench offload: {name} mb={mb} failed "
+                         f"(rc={r.returncode})\n")
+    _emit_error(f"no offload config fits: {last_err}")
+
+
+def _bench_offload_child(devices, tpu_error) -> None:
+    """One ladder rung (env BENCH_OFFLOAD_ONE="name:mb:accum") in a fresh
+    process.  On CPU fallback runs a tiny disclosed proxy instead."""
     import dataclasses
 
     import jax
@@ -95,102 +220,84 @@ def _bench_offload(devices, tpu_error) -> None:
 
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt
-    from deepspeed_tpu.parallel.mesh import (ParallelDims, initialize_mesh,
-                                             reset_mesh_manager)
+    from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
     from deepspeed_tpu.runtime.model import from_gpt
 
     platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
+    name, mb_s, accum = os.environ["BENCH_OFFLOAD_ONE"].split(":")
+    mb, accum = int(mb_s), (accum or None)
     if on_tpu:
-        # 2.7B rides the 16-bit gradient accumulator
-        # (data_types.grad_accum_dtype) — at gas=1 the backward already
-        # produces bf16 grads, so accumulating them in bf16 loses nothing
-        # and halves the dominant 4-bytes/param term; 1.3B keeps the
-        # conservative fp32 accumulator
-        candidates = [("gpt2-2.7b", gpt.GPT2_2_7B, (2, 1), "bf16"),
-                      ("gpt2-1.3b", gpt.GPT2_1_3B, (4, 2, 1), None),
-                      ("gpt2-760m", gpt.GPT2_760M, (8, 4), None),
-                      ("gpt2-350m", gpt.GPT2_350M, (16, 8), None)]
-        seq, steps, warmup = 1024, 4, 1
-        dtype = jnp.bfloat16
+        presets = {"gpt2-2.7b": gpt.GPT2_2_7B, "gpt2-1.3b": gpt.GPT2_1_3B,
+                   "gpt2-760m": gpt.GPT2_760M, "gpt2-350m": gpt.GPT2_350M}
+        config = dataclasses.replace(presets[name], max_seq_len=1024,
+                                     dtype=jnp.bfloat16, remat=True)
+        steps, warmup = 4, 1
     else:
-        candidates = [("tiny", gpt.GPTConfig(
-            vocab_size=512, max_seq_len=128, n_layer=2, n_head=4,
-            d_model=128, dtype=jnp.float32), (4,), None)]
-        seq, steps, warmup = 128, 3, 1
-        dtype = jnp.float32
+        name, mb, accum = "tiny", 4, None
+        config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
+                               n_head=4, d_model=128, dtype=jnp.float32)
+        steps, warmup = 3, 1
+    if os.environ.get("BENCH_OFFLOAD_STEPS"):  # parent's slow-link plan
+        warmup, steps = map(int, os.environ["BENCH_OFFLOAD_STEPS"].split(","))
 
-    last_err = None
-    for name, preset, mbs, accum in candidates:
-        config = dataclasses.replace(preset, max_seq_len=seq, dtype=dtype,
-                                     remat=True) if on_tpu else preset
-        for mb in mbs:
-            try:
-                reset_mesh_manager()
-                mm = initialize_mesh(ParallelDims(dp=-1))
-                ds = {"train_micro_batch_size_per_gpu": mb,
-                      "gradient_accumulation_steps": 1,
-                      "steps_per_print": 1 << 30,
-                      "optimizer": {"type": "Adam",
-                                    "params": {"lr": 1e-4,
-                                               "weight_decay": 0.01}},
-                      "zero_optimization": {
-                          "stage": 2,
-                          "offload_optimizer": {"device": "cpu"}},
-                      "bf16": {"enabled": bool(on_tpu)}}
-                if accum is not None:
-                    ds["data_types"] = {"grad_accum_dtype": accum}
-                engine, _, _, _ = deepspeed_tpu.initialize(
-                    model=from_gpt(config), config=ds, mesh_manager=mm,
-                    rng=jax.random.PRNGKey(0))
-                rng = np.random.default_rng(0)
-                batch = {"tokens": rng.integers(
-                    0, config.vocab_size,
-                    size=(mb, config.max_seq_len + 1)).astype(np.int32)}
-                losses = []
-                for _ in range(warmup):
-                    engine.train_batch_fused(batch)
-                # fence: device_get of a CURRENT param leaf cannot return
-                # until warmup compute lands (same pattern as main())
-                np.asarray(jax.device_get(
-                    jax.tree_util.tree_leaves(engine.state["params"])[0]))
-                t0 = time.perf_counter()
-                for _ in range(steps):
-                    loss = engine.train_batch_fused(batch)
-                    losses.append(float(jax.device_get(loss)))
-                dt = time.perf_counter() - t0
-                n_params = sum(
-                    int(np.prod(l.shape)) for l in
-                    jax.tree_util.tree_leaves(engine.state["params"]))
-                metric = "gpt_zero_offload_samples_per_sec_per_chip"
-                if not on_tpu:
-                    metric += "_CPU_FALLBACK"
-                result = {
-                    "metric": metric,
-                    "value": round(steps * mb / dt, 3),
-                    "unit": "samples/s/chip",
-                    # capability metric: 1.0 when the 1.3B class trains
-                    # on one chip with a decreasing loss
-                    "vs_baseline": 1.0 if (on_tpu and n_params >= 1.2e9
-                                           and losses[-1] < losses[0])
-                    else 0.0,
-                    "detail": {"model": name, "params_m": round(n_params / 1e6),
-                               "micro_batch": mb, "seq_len": config.max_seq_len,
-                               "platform": platform, "losses": losses,
-                               "loss_decreasing": losses[-1] < losses[0],
-                               "zero_stage": 2, "offload": "cpu",
-                               "grad_accum_dtype": accum or "fp32"},
-                }
-                if tpu_error is not None:
-                    result["detail"]["tpu_error"] = tpu_error
-                print(json.dumps(result))
-                return
-            except Exception as e:
-                if "out of memory" not in str(e).lower():
-                    raise
-                last_err = str(e).splitlines()[0][:200]
-                sys.stderr.write(f"bench offload: {name} mb={mb} OOM\n")
-    raise RuntimeError(f"no offload config fits: {last_err}")
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    ds = {"train_micro_batch_size_per_gpu": mb,
+          "gradient_accumulation_steps": 1,
+          "steps_per_print": 1 << 30,
+          "optimizer": {"type": "Adam",
+                        "params": {"lr": 1e-4, "weight_decay": 0.01}},
+          "zero_optimization": {"stage": 2,
+                                "offload_optimizer": {"device": "cpu"}},
+          "bf16": {"enabled": bool(on_tpu)}}
+    if accum is not None:
+        ds["data_types"] = {"grad_accum_dtype": accum}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(config), config=ds, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(
+        0, config.vocab_size,
+        size=(mb, config.max_seq_len + 1)).astype(np.int32)}
+    warm_losses, losses = [], []
+    for _ in range(warmup):
+        loss = engine.train_batch_fused(batch)
+        warm_losses.append(float(jax.device_get(loss)))
+    # fence: device_get of a CURRENT param leaf cannot return until
+    # warmup compute lands (same pattern as main())
+    np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(engine.state["params"])[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch_fused(batch)
+        losses.append(float(jax.device_get(loss)))
+    dt = time.perf_counter() - t0
+    # warmup losses count toward training-progress evidence (on a slow
+    # link the plan may time only one step)
+    losses = warm_losses + losses
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(engine.state["params"]))
+    metric = "gpt_zero_offload_samples_per_sec_per_chip"
+    if not on_tpu:
+        metric += "_CPU_FALLBACK"
+    result = {
+        "metric": metric,
+        "value": round(steps * mb / dt, 3),
+        "unit": "samples/s/chip",
+        # capability metric: 1.0 when the 1.3B class trains on one chip
+        # with a decreasing loss
+        "vs_baseline": 1.0 if (on_tpu and n_params >= 1.2e9
+                               and losses[-1] < losses[0]) else 0.0,
+        "detail": {"model": name, "params_m": round(n_params / 1e6),
+                   "micro_batch": mb, "seq_len": config.max_seq_len,
+                   "platform": platform, "losses": losses,
+                   "loss_decreasing": losses[-1] < losses[0],
+                   "zero_stage": 2, "offload": "cpu",
+                   "grad_accum_dtype": accum or "fp32"},
+    }
+    if tpu_error is not None:
+        result["detail"]["tpu_error"] = tpu_error
+    print(json.dumps(result))
 
 
 def main() -> None:
@@ -201,9 +308,11 @@ def main() -> None:
     # ZeRO-offload model that fits one chip (capability proof).
     bench_bert = len(sys.argv) > 1 and sys.argv[1] == "bert"
     bench_offload = len(sys.argv) > 1 and sys.argv[1] == "offload"
+    if bench_offload and not os.environ.get("BENCH_OFFLOAD_ONE"):
+        return _bench_offload()  # parent: holds no device, spawns rungs
     devices, tpu_error = _init_devices()
     if bench_offload:
-        return _bench_offload(devices, tpu_error)
+        return _bench_offload_child(devices, tpu_error)
 
     import jax
     import jax.numpy as jnp
@@ -245,10 +354,6 @@ def main() -> None:
             config = dataclasses.replace(gpt.GPT2_350M, max_seq_len=1024,
                                          dtype=jnp.bfloat16, remat=True)
             mb_candidates, gas, steps, warmup = (32, 24, 16), 1, 10, 2
-            # interactive tuning override (e.g. BENCH_MB=48,40,32)
-            if os.environ.get("BENCH_MB"):
-                mb_candidates = tuple(
-                    int(x) for x in os.environ["BENCH_MB"].split(","))
         else:
             config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
                                    n_head=4, d_model=128, dtype=jnp.float32)
@@ -257,6 +362,10 @@ def main() -> None:
         flops_per_tok = gpt.flops_per_token(config)
         metric = "gpt2_train_samples_per_sec_per_chip"
         baseline = None
+    # tuning override and the OOM re-exec ladder (e.g. BENCH_MB=48,40,32)
+    if on_tpu and os.environ.get("BENCH_MB"):
+        mb_candidates = tuple(
+            int(x) for x in os.environ["BENCH_MB"].split(","))
 
     seq = config.max_seq_len
     mm = initialize_mesh(ParallelDims(dp=-1))
@@ -304,18 +413,41 @@ def main() -> None:
     # return early on some experimental PJRT transports, but device_get
     # cannot lie — it needs the real bytes of the final state.
     last_oom = None
-    for micro_batch in mb_candidates:
+    for mi, micro_batch in enumerate(mb_candidates):
         try:
             engine, batch, global_batch, ds_config, loss = \
                 build_and_warm(micro_batch)
             break
         except Exception as e:  # XlaRuntimeError has no stable module path
-            if "out of memory" not in str(e).lower():
+            if not _is_oom(e):
                 raise
-            # keep only the message: the exception's traceback pins
-            # build_and_warm's frame (engine state, batch) in HBM, which
-            # would sabotage the smaller retry
             last_oom = str(e).splitlines()[0][:300]
+            remaining = mb_candidates[mi + 1:]
+            if remaining and os.environ.get("BENCH_NO_REEXEC") != "1":
+                # a runtime RESOURCE_EXHAUSTED poisons this TPU client for
+                # every later allocation (measured; see _OFFLOAD_LADDER
+                # note), so retry the smaller micro-batches in a FRESH
+                # process and forward its result.  The relay backend
+                # allows concurrent attach (verified), but free our
+                # leftovers first so the child gets the HBM.
+                import gc
+                import subprocess
+                gc.collect()
+                sys.stderr.write(f"bench: micro_batch={micro_batch} OOM, "
+                                 "re-exec with smaller candidates\n")
+                env = dict(os.environ)
+                env["BENCH_MB"] = ",".join(str(m) for m in remaining)
+                r = subprocess.run([sys.executable] + sys.argv, env=env,
+                                   capture_output=True, text=True)
+                if on_tpu and "_CPU_FALLBACK" in r.stdout:
+                    # the child lost the chip; its tiny-model CPU number
+                    # would shadow a real TPU result — keep trying here
+                    sys.stderr.write("bench: re-exec child fell back to "
+                                     "CPU; continuing in-process\n")
+                else:
+                    sys.stderr.write(r.stderr[-2000:])
+                    sys.stdout.write(r.stdout)
+                    sys.exit(r.returncode)
             sys.stderr.write(f"bench: micro_batch={micro_batch} OOM, "
                              "backing off\n")
     else:

@@ -369,28 +369,91 @@ class DeepSpeedEngine:
 
     def _init_state_offload(self, rng: jax.Array) -> None:
         """Device holds compute-dtype params + grad accumulators; fp32
-        master and Adam moments live with the host offload runner."""
-        from .zero.offload_engine import HostOffloadOptimizer
+        master and Adam moments live with the host offload runner.
+
+        Initialization runs on the HOST CPU backend: the fp32 master never
+        touches the device.  The previous device-side init materialized
+        params + fp32 master + accumulator concurrently — 10 bytes/param
+        peak with a bf16 accumulator, which OOMs the 2.7B class on a 16 GB
+        chip before training even starts — and then pulled the 4 N-byte
+        master over the (slow) d2h direction.  Host init costs zero d2h
+        traffic, uploads only the 2 N-byte compute-dtype params, and is
+        bit-identical: JAX's threefry PRNG is deterministic across
+        backends.  (This is also the reference's construction order — the
+        fp32 master is cloned host-side from the 16-bit weights,
+        stage_1_and_2.py:98.)"""
+        from .zero.offload_engine import (HostOffloadOptimizer, index_key,
+                                          unique_local_blocks)
         sh = self.shardings
         self._separate_master = True
+        self._master_shardings_flat = jax.tree_util.tree_leaves(sh.master)
+        self._reshard_params_jit = jax.jit(lambda t: t,
+                                           out_shardings=sh.params)
+        np_compute = np.dtype(self.compute_dtype)  # ml_dtypes handles bf16
+        multihost = jax.process_count() > 1
 
-        def init_all(rng):
-            if self.module.params is not None:
-                master = self.module.params
+        master_dev_flat = None  # load path only (device fp32 transient)
+        if self.module.params is not None:
+            # load path: the provided weights may span non-addressable
+            # devices, so keep them device-side — reshard to the master
+            # partition in fp32 (transient, freed once the host blocks are
+            # pulled below), cast compute-dtype params from it
+            master_dev = jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda p: p.astype(jnp.float32), t),
+                out_shardings=sh.master)(self.module.params)
+            params = jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda p: p.astype(self.compute_dtype), t),
+                out_shardings=sh.params)(master_dev)
+            master_dev_flat, self._params_treedef = \
+                jax.tree_util.tree_flatten(master_dev)
+            del master_dev
+            master_flat = None
+        else:
+            # scratch path: init on the host CPU backend and upload only
+            # the 2 N-byte compute-dtype params
+            cpu0 = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu0):
+                host_init = jax.jit(self.module.init_fn)(
+                    jax.device_put(rng, cpu0))
+                master_host = jax.tree_util.tree_map(
+                    lambda p: np.asarray(p, np.float32), host_init)
+            del host_init
+            master_flat, self._params_treedef = jax.tree_util.tree_flatten(
+                master_host)
+            del master_host
+            param_sh_flat = jax.tree_util.tree_leaves(sh.params)
+            # leaf-by-leaf upload; multi-host puts per-device blocks of the
+            # master partition, then one SPMD reshard to the param sharding
+            params_flat = []
+            if multihost:
+                for m, msh in zip(master_flat, self._master_shardings_flat):
+                    blk = m.astype(np_compute)
+                    arrs = [jax.device_put(np.ascontiguousarray(blk[idx]), d)
+                            for d, idx in
+                            msh.addressable_devices_indices_map(
+                                m.shape).items()]
+                    params_flat.append(
+                        jax.make_array_from_single_device_arrays(
+                            m.shape, msh, arrs))
+                params = self._reshard_params_jit(
+                    jax.tree_util.tree_unflatten(self._params_treedef,
+                                                 params_flat))
             else:
-                master = self.module.init_fn(rng)
-            master = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.float32), master)
-            params = jax.tree_util.tree_map(
-                lambda p: p.astype(self.compute_dtype), master)
-            grad_acc = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), master)
-            return params, master, grad_acc
+                for m, psh in zip(master_flat, param_sh_flat):
+                    params_flat.append(
+                        jax.device_put(m.astype(np_compute), psh))
+                params = jax.tree_util.tree_unflatten(self._params_treedef,
+                                                      params_flat)
+            del params_flat
 
-        out_sh = (sh.params, sh.master, sh.grads)
-        params, master_dev, grad_acc = jax.jit(
-            init_all, out_shardings=out_sh)(rng)
-        self._params_treedef = jax.tree_util.tree_structure(params)
+        leaf_shapes = [l.shape for l in jax.tree_util.tree_leaves(params)]
+        grad_acc = jax.jit(
+            lambda: jax.tree_util.tree_unflatten(
+                self._params_treedef,
+                [jnp.zeros(s, self.grad_accum_dtype) for s in leaf_shapes]),
+            out_shardings=sh.grads)()
 
         # per-leaf param-group assignment (torch decay/no-decay groups by
         # leaf path; reference steps each group with its own hyperparams)
@@ -400,41 +463,61 @@ class DeepSpeedEngine:
                       jax.tree_util.tree_flatten_with_path(params)[0]]
         self._leaf_group_idx = resolve_param_groups(groups, leaf_paths)
 
-        # precision-exact fp32 master moves to the host; the device copy is
-        # dropped immediately (transient 4N bytes at init only).  Multi-host:
-        # each process keeps only its unique addressable master shards (the
-        # reference's per-rank cpu_offload, stage_1_and_2.py:98) and steps
-        # them locally; params are rebuilt from the shards + one SPMD
-        # reshard (all-gather on device).
-        self._offload_multihost = jax.process_count() > 1
-        self._master_shardings_flat = jax.tree_util.tree_leaves(sh.master)
+        # the fp32 master is already host-resident (never was on device).
+        # Multi-host: each process keeps only its unique addressable master
+        # shards (the reference's per-rank cpu_offload, stage_1_and_2.py:98)
+        # and steps them locally; params are rebuilt from the shards + one
+        # SPMD reshard (all-gather on device).  Every process computes the
+        # identical full init (threefry is deterministic), then slices its
+        # own blocks — a host-RAM transient, no cross-host traffic.
+        self._offload_multihost = multihost
         if self._offload_multihost:
-            from .zero.offload_engine import index_key, unique_local_blocks
             # per leaf: [(global index, normalized key, block shape)] for
             # the process's unique shards, and the static device->key put
             # map for rebuilding the master-sharded global array each step
             self._offload_layout = []
             self._offload_putmap = []
             master_leaves, group_of = [], []
-            for li, leaf in enumerate(jax.tree_util.tree_leaves(master_dev)):
-                blocks = unique_local_blocks(leaf)
-                self._offload_layout.append(
-                    [(idx, index_key(idx, leaf.shape), b.shape)
-                     for idx, b in blocks])
+            src_flat = master_dev_flat if master_dev_flat is not None \
+                else master_flat
+            for li, leaf in enumerate(src_flat):
                 msh = self._master_shardings_flat[li]
+                dev_map = msh.addressable_devices_indices_map(leaf.shape)
                 self._offload_putmap.append(
-                    [(d, index_key(i, leaf.shape)) for d, i in
-                     msh.addressable_devices_indices_map(leaf.shape).items()])
-                for _, b in blocks:
-                    master_leaves.append(np.asarray(b, np.float32))
-                    group_of.append(self._leaf_group_idx[li])
-            self._reshard_params_jit = jax.jit(
-                lambda t: t, out_shardings=sh.params)
-        else:
+                    [(d, index_key(i, leaf.shape))
+                     for d, i in dev_map.items()])
+                if master_dev_flat is not None:
+                    # load path: pull only this process's addressable
+                    # shards of the device master (already msh-sharded)
+                    blocks = unique_local_blocks(leaf)
+                    self._offload_layout.append(
+                        [(idx, index_key(idx, leaf.shape), b.shape)
+                         for idx, b in blocks])
+                    for _, b in blocks:
+                        master_leaves.append(np.asarray(b, np.float32))
+                        group_of.append(self._leaf_group_idx[li])
+                else:
+                    # scratch path: slice the host init (every process
+                    # computed the identical full tree — threefry is
+                    # deterministic — so this is pure host-RAM slicing)
+                    blocks = {}
+                    for idx in dev_map.values():
+                        blocks.setdefault(index_key(idx, leaf.shape), idx)
+                    self._offload_layout.append(
+                        [(blocks[k], k, leaf[blocks[k]].shape)
+                         for k in sorted(blocks)])
+                    for k in sorted(blocks):
+                        master_leaves.append(
+                            np.ascontiguousarray(leaf[blocks[k]]))
+                        group_of.append(self._leaf_group_idx[li])
+        elif master_dev_flat is not None:
             master_leaves = [np.asarray(jax.device_get(l), np.float32)
-                             for l in jax.tree_util.tree_leaves(master_dev)]
+                             for l in master_dev_flat]
             group_of = list(self._leaf_group_idx)
-        del master_dev
+        else:
+            master_leaves = master_flat
+            group_of = list(self._leaf_group_idx)
+        del master_flat, master_dev_flat
 
         self._offload_opt = HostOffloadOptimizer(
             master_leaves,

@@ -450,3 +450,35 @@ def test_offload_param_step_outputs_keep_host_placement(monkeypatch):
     txt = low.as_text()
     assert "pinned_host" in txt or "_xla_buffer_placement" in txt, \
         "params output lost the host placement in the step program"
+
+
+def test_offload_with_provided_params_matches_scratch_init():
+    """Offload init with pre-materialized ``ModelSpec.params`` (the load /
+    resume path — engine.py _init_state_offload's device-side branch) must
+    produce the same training trajectory as scratch init with the same
+    weights.  Guards the round-4 host-init rework: provided params may
+    span non-addressable devices, so they must stay device-side."""
+    import dataclasses as dc
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    from deepspeed_tpu.runtime.model import ModelSpec
+
+    reset_mesh_manager()
+    _, ref_losses = _train(_ds_config(offload_device="cpu"))
+
+    reset_mesh_manager()
+    cfg = _tiny_config()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))  # same seed as _train
+    spec = dc.replace(from_gpt(cfg), init_fn=None, params=params)
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=spec, config=_ds_config(offload_device="cpu"),
+        mesh_manager=mm, rng=jax.random.PRNGKey(7))  # rng must be unused
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 256, size=(8, 65)).astype(np.int32)}
+    losses = []
+    for _ in range(len(ref_losses)):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
