@@ -50,9 +50,16 @@ def _init_devices(attempts: int = 3, probe_timeout_s: float = 120.0,
     """
     import subprocess
 
-    probe = ("import jax, json; ds = jax.devices(); "
+    # the in-probe watchdog matters: if THIS process is killed (driver
+    # timeout) while the probe hangs in backend init, subprocess.run's
+    # timeout never fires and the orphan lives forever holding a TPU
+    # client connection — observed degrading the tunnel for every later
+    # run.  signal.alarm's default action kills at the kernel level even
+    # with the GIL stuck inside C++ init.
+    probe = (f"import signal; signal.alarm({max(5, int(probe_timeout_s) - 5)}); "
+             "import jax, json; ds = jax.devices(); "
              "print('BENCH_PROBE ' + json.dumps("
-             "{'n': len(ds), 'platform': ds[0].platform}))")
+             "{'n': len(ds), 'platform': ds[0].platform}), flush=True)")
     last = None
     for attempt in range(attempts):
         try:
@@ -102,7 +109,7 @@ def _is_oom(e: Exception) -> bool:
 # bf16 grads, so accumulating in bf16 loses nothing and halves the
 # dominant 4-bytes/param term.
 _OFFLOAD_LADDER = [("gpt2-2.7b", 2, "bf16"), ("gpt2-2.7b", 1, "bf16"),
-                   ("gpt2-1.3b", 2, None), ("gpt2-1.3b", 1, None),
+                   ("gpt2-1.3b", 2, "bf16"), ("gpt2-1.3b", 1, "bf16"),
                    ("gpt2-760m", 4, None), ("gpt2-350m", 8, None)]
 _OFFLOAD_PARAMS = {"gpt2-2.7b": 2.65e9, "gpt2-1.3b": 1.31e9,
                    "gpt2-760m": 0.79e9, "gpt2-350m": 0.35e9}
@@ -118,6 +125,7 @@ def _probe_transfer_gbps() -> tuple:
     probe fails (CPU fallback etc.) — callers then skip estimation."""
     import subprocess
     code = (
+        "import signal; signal.alarm(115)\n"  # orphan self-destruct
         "import time, numpy as np, jax\n"
         "x = np.ones((8, 1024, 1024), np.float32)\n"
         "d = jax.device_put(x); d.block_until_ready()\n"
@@ -142,12 +150,14 @@ def _probe_transfer_gbps() -> tuple:
 
 
 def _estimate_rung_s(n_params: float, n_steps: int, h2d: float,
-                     d2h: float) -> float:
+                     d2h: float, compressed: bool = False) -> float:
     """Wall-time estimate for one ladder rung: param upload at init (host
-    init — the fp32 master never crosses the link), then per step bf16
-    grads down + bf16 params up, plus compile/Adam slack."""
+    init — the fp32 master never crosses the link), then per step grads
+    down (bf16, or a 1-bit packed stream at ~1/16 the bytes) + bf16
+    params up, plus compile/Adam slack."""
     b = 2 * n_params / 1e9  # GB each way
-    return 75 + b / h2d + n_steps * (b / d2h + b / h2d)
+    down = b / 16 if compressed else b
+    return 75 + b / h2d + n_steps * (down / d2h + b / h2d)
 
 
 def _bench_offload() -> None:
@@ -172,29 +182,61 @@ def _bench_offload() -> None:
         if budget < 45:
             last_err = f"deadline before trying {name} mb={mb}"
             break
-        # pick the most steps that fit this rung in the remaining budget
-        # (warmup, timed): prefer (1, 4); degrade to (1, 1) on a slow
-        # link — the child counts the warmup loss so loss-decreasing
-        # evidence survives; skip the rung if even that cannot finish
-        steps_plan = ""
+        # pick the cheapest plan that fits this rung in the remaining
+        # budget, in fidelity order: uncompressed (1,4) → uncompressed
+        # (1,1) → onebit-compressed grad stream (1,4) → onebit (1,1) —
+        # the child counts the warmup loss so loss-decreasing evidence
+        # survives a single timed step; skip the rung if nothing fits
+        steps_plan, compress = "", ""
         if h2d is not None:
             n = _OFFLOAD_PARAMS.get(name, 1e9)
-            if _estimate_rung_s(n, 5, h2d, d2h) > budget:
-                if _estimate_rung_s(n, 2, h2d, d2h) > budget:
+            if _estimate_rung_s(n, 5, h2d, d2h) <= budget:
+                pass
+            elif _estimate_rung_s(n, 2, h2d, d2h) <= budget:
+                steps_plan = "1,1"
+            else:
+                # compressed stream also needs the bf16 residual in HBM:
+                # 2 (params) + acc + 2 (residual) bytes/param + slack
+                acc_b = 2 if accum == "bf16" else 4
+                if n * (4 + acc_b) > 14.5e9:
+                    sys.stderr.write(f"bench offload: skip {name} mb={mb} "
+                                     "(residual would not fit HBM)\n")
+                    last_err = f"{name} skipped: no HBM for residual"
+                    continue
+                if _estimate_rung_s(n, 5, h2d, d2h, True) <= budget:
+                    compress = "onebit"
+                elif _estimate_rung_s(n, 2, h2d, d2h, True) <= budget:
+                    steps_plan, compress = "1,1", "onebit"
+                else:
                     sys.stderr.write(f"bench offload: skip {name} mb={mb} "
                                      "(link too slow for budget)\n")
                     last_err = f"{name} skipped: link too slow"
                     continue
-                steps_plan = "1,1"
         env = dict(os.environ)
         env["BENCH_OFFLOAD_ONE"] = f"{name}:{mb}:{accum or ''}"
+        # orphan self-destruct: if this parent is killed, the child must
+        # not outlive the budget holding a TPU client (see probe note)
+        env["BENCH_CHILD_TTL"] = str(int(budget))
         if steps_plan:
             env["BENCH_OFFLOAD_STEPS"] = steps_plan
+        if compress:
+            env["BENCH_OFFLOAD_COMPRESS"] = compress
         try:
             r = subprocess.run([sys.executable, os.path.abspath(__file__),
                                 "offload"], env=env, capture_output=True,
                                text=True, timeout=budget - 10)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as te:
+            # the child emits one line per completed step — harvest the
+            # best finished measurement even from a deadline kill
+            out = te.stdout or ""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            lines = [ln for ln in out.splitlines() if '"metric"' in ln]
+            if lines:
+                sys.stderr.write(f"bench offload: {name} mb={mb} hit the "
+                                 "deadline; keeping its last step line\n")
+                print(lines[-1])
+                return
             sys.stderr.write(f"bench offload: {name} mb={mb} timed out\n")
             last_err = f"{name} mb={mb} timed out"
             continue
@@ -214,6 +256,10 @@ def _bench_offload_child(devices, tpu_error) -> None:
     """One ladder rung (env BENCH_OFFLOAD_ONE="name:mb:accum") in a fresh
     process.  On CPU fallback runs a tiny disclosed proxy instead."""
     import dataclasses
+    import signal
+
+    if os.environ.get("BENCH_CHILD_TTL"):
+        signal.alarm(int(os.environ["BENCH_CHILD_TTL"]))
 
     import jax
     import jax.numpy as jnp
@@ -252,6 +298,10 @@ def _bench_offload_child(devices, tpu_error) -> None:
           "bf16": {"enabled": bool(on_tpu)}}
     if accum is not None:
         ds["data_types"] = {"grad_accum_dtype": accum}
+    compress = os.environ.get("BENCH_OFFLOAD_COMPRESS", "")
+    if compress:
+        ds["zero_optimization"]["offload_optimizer"].update(
+            grad_compression=compress, compression_residual_dtype="bf16")
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=from_gpt(config), config=ds, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))
@@ -264,40 +314,50 @@ def _bench_offload_child(devices, tpu_error) -> None:
         loss = engine.train_batch_fused(batch)
         warm_losses.append(float(jax.device_get(loss)))
     # fence: device_get of a CURRENT param leaf cannot return until
-    # warmup compute lands (same pattern as main())
-    np.asarray(jax.device_get(
-        jax.tree_util.tree_leaves(engine.state["params"])[0]))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch_fused(batch)
-        losses.append(float(jax.device_get(loss)))
-    dt = time.perf_counter() - t0
-    # warmup losses count toward training-progress evidence (on a slow
-    # link the plan may time only one step)
-    losses = warm_losses + losses
+    # warmup compute lands (same pattern as main()); smallest leaf so the
+    # fence itself stays off the link
+    np.asarray(jax.device_get(min(
+        jax.tree_util.tree_leaves(engine.state["params"]),
+        key=lambda l: l.size)))
     n_params = sum(int(np.prod(l.shape)) for l in
                    jax.tree_util.tree_leaves(engine.state["params"]))
     metric = "gpt_zero_offload_samples_per_sec_per_chip"
     if not on_tpu:
         metric += "_CPU_FALLBACK"
-    result = {
-        "metric": metric,
-        "value": round(steps * mb / dt, 3),
-        "unit": "samples/s/chip",
-        # capability metric: 1.0 when the 1.3B class trains on one chip
-        # with a decreasing loss
-        "vs_baseline": 1.0 if (on_tpu and n_params >= 1.2e9
-                               and losses[-1] < losses[0]) else 0.0,
-        "detail": {"model": name, "params_m": round(n_params / 1e6),
-                   "micro_batch": mb, "seq_len": config.max_seq_len,
-                   "platform": platform, "losses": losses,
-                   "loss_decreasing": losses[-1] < losses[0],
-                   "zero_stage": 2, "offload": "cpu",
-                   "grad_accum_dtype": accum or "fp32"},
-    }
-    if tpu_error is not None:
-        result["detail"]["tpu_error"] = tpu_error
-    print(json.dumps(result))
+
+    def emit(done, dt):
+        # warmup losses count toward training-progress evidence (on a
+        # slow link the parent may harvest the line after one step)
+        all_losses = warm_losses + losses
+        result = {
+            "metric": metric,
+            "value": round(done * mb / dt, 3),
+            "unit": "samples/s/chip",
+            # capability metric: 1.0 when the 1.3B class trains on one
+            # chip with a decreasing loss
+            "vs_baseline": 1.0 if (on_tpu and n_params >= 1.2e9
+                                   and all_losses[-1] < all_losses[0])
+            else 0.0,
+            "detail": {"model": name, "params_m": round(n_params / 1e6),
+                       "micro_batch": mb, "seq_len": config.max_seq_len,
+                       "platform": platform, "losses": all_losses,
+                       "timed_steps": done,
+                       "loss_decreasing": all_losses[-1] < all_losses[0],
+                       "zero_stage": 2, "offload": "cpu",
+                       "grad_accum_dtype": accum or "fp32",
+                       "grad_compression": compress or "none"},
+        }
+        if tpu_error is not None:
+            result["detail"]["tpu_error"] = tpu_error
+        print(json.dumps(result), flush=True)
+
+    # one line per completed step (last line wins): a parent that kills
+    # this child on deadline still harvests the best finished measurement
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss = engine.train_batch_fused(batch)
+        losses.append(float(jax.device_get(loss)))
+        emit(i + 1, time.perf_counter() - t0)
 
 
 def main() -> None:
@@ -454,9 +514,13 @@ def main() -> None:
         raise RuntimeError(f"all micro-batches OOM: {last_oom}")
 
     def fence():
-        # host-transfer a CURRENT param leaf: device_get cannot return until
-        # the final state of the last step is materialized
-        leaf = jax.tree_util.tree_leaves(engine.state["params"])[0]
+        # host-transfer the SMALLEST current param leaf: device_get cannot
+        # return until the final state of the last step is materialized,
+        # and a small leaf keeps the fence off the (possibly slow) link —
+        # leaf[0] is the 100 MB embedding, which at tunnel speeds would
+        # dominate the measurement it is fencing
+        leaf = min(jax.tree_util.tree_leaves(engine.state["params"]),
+                   key=lambda l: l.size)
         np.asarray(jax.device_get(leaf))
 
     fence()

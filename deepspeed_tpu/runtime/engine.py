@@ -455,6 +455,39 @@ class DeepSpeedEngine:
                 [jnp.zeros(s, self.grad_accum_dtype) for s in leaf_shapes]),
             out_shardings=sh.grads)()
 
+        # error-feedback residual for compressed grad streaming (device-
+        # resident, sharded like the accumulators)
+        comp = getattr(self._offload_cfg, "grad_compression", "none")
+        if comp not in ("none", "onebit", "int8"):
+            raise DeepSpeedConfigError(
+                f"offload_optimizer.grad_compression={comp!r} "
+                "(want 'none', 'onebit' or 'int8')")
+        if comp != "none":
+            if multihost:
+                raise DeepSpeedConfigError(
+                    "offload_optimizer.grad_compression is single-process "
+                    "only (packed bit streams don't slice across hosts)")
+            cblk = int(self._offload_cfg.compression_block)
+            if cblk <= 0 or cblk % 8 != 0:
+                raise DeepSpeedConfigError(
+                    f"offload_optimizer.compression_block={cblk} must be a "
+                    "positive multiple of 8 (elements are bit-packed)")
+            rds = str(self._offload_cfg.compression_residual_dtype).lower()
+            if rds in ("bf16", "bfloat16"):
+                rdt = jnp.bfloat16
+            elif rds in ("fp32", "float32", "float"):
+                rdt = jnp.float32
+            else:
+                raise DeepSpeedConfigError(
+                    "offload_optimizer.compression_residual_dtype="
+                    f"{self._offload_cfg.compression_residual_dtype!r} "
+                    "(want 'fp32' or 'bf16')")
+            grads_sh_flat = jax.tree_util.tree_leaves(sh.grads)
+            self._offload_resid_leaves = list(jax.jit(
+                lambda: tuple(jnp.zeros(s, rdt) for s in leaf_shapes),
+                out_shardings=tuple(grads_sh_flat))())
+        self._offload_compress = comp
+
         # per-leaf param-group assignment (torch decay/no-decay groups by
         # leaf path; reference steps each group with its own hyperparams)
         opt = self.optimizer
@@ -657,9 +690,47 @@ class DeepSpeedEngine:
             def prep_leaf(g, coef):
                 return (g * coef).astype(compute_dtype), jnp.zeros_like(g)
 
+            # error-feedback compressed prep: unscale+clip in fp32, add
+            # the carried residual, quantize per block, keep the new
+            # quantization error on device.  The transfer is the packed
+            # payload + per-block scales instead of a 16-bit tree.
+            blk = int(getattr(self._offload_cfg, "compression_block", 2048))
+
+            def _blocked(g, resid, coef, inv_scale):
+                c = (g.astype(jnp.float32) * (coef * inv_scale)
+                     + resid.astype(jnp.float32))
+                flat = c.reshape(-1)
+                nb = -(-flat.shape[0] // blk)
+                fp = jnp.pad(flat, (0, nb * blk - flat.shape[0]))
+                return c, flat, fp.reshape(nb, blk)
+
+            def prep_onebit(g, resid, coef, inv_scale):
+                c, flat, cb = _blocked(g, resid, coef, inv_scale)
+                s = jnp.mean(jnp.abs(cb), axis=1)  # L1 scale (1-bit Adam)
+                deq = jnp.where(cb >= 0, 1.0, -1.0) * s[:, None]
+                resid_new = (cb - deq).reshape(-1)[:flat.shape[0]] \
+                    .reshape(c.shape).astype(resid.dtype)
+                bits = (cb >= 0).reshape(-1, 8).astype(jnp.int32)
+                w = (1 << jnp.arange(8, dtype=jnp.int32))  # little-endian
+                packed = jnp.sum(bits * w, axis=1).astype(jnp.uint8)
+                return packed, s, resid_new, jnp.zeros_like(g)
+
+            def prep_int8(g, resid, coef, inv_scale):
+                c, flat, cb = _blocked(g, resid, coef, inv_scale)
+                s = jnp.max(jnp.abs(cb), axis=1) / 127.0
+                safe = jnp.where(s > 0, s, 1.0)
+                q = jnp.clip(jnp.round(cb / safe[:, None]), -127, 127)
+                deq = q * s[:, None]
+                resid_new = (cb - deq).reshape(-1)[:flat.shape[0]] \
+                    .reshape(c.shape).astype(resid.dtype)
+                return (q.astype(jnp.int8).reshape(-1), s, resid_new,
+                        jnp.zeros_like(g))
+
             self._micro_jit = jax.jit(micro, donate_argnums=(1,))
             self._grad_stats_jit = jax.jit(grad_stats)
             self._prep_leaf_jit = jax.jit(prep_leaf, donate_argnums=(0,))
+            self._prep_onebit_jit = jax.jit(prep_onebit, donate_argnums=(0, 1))
+            self._prep_int8_jit = jax.jit(prep_int8, donate_argnums=(0, 1))
             self._zero_leaf_jit = jax.jit(
                 lambda g: jnp.zeros_like(g), donate_argnums=(0,))
             return
@@ -919,6 +990,10 @@ class DeepSpeedEngine:
             "m": [np.zeros(l.size, np.float32) for l in leaves],
             "v": [np.zeros(l.size, np.float32) for l in leaves],
         })
+        if getattr(self, "_offload_compress", "none") != "none":
+            # stale error-feedback residual belongs to the old trajectory
+            self._offload_resid_leaves = [jnp.zeros_like(r)
+                                          for r in self._offload_resid_leaves]
 
     def _group_hyper(self) -> List[Dict[str, float]]:
         """Per-group scalar hyperparams for this step (scheduler-mutated).
@@ -961,19 +1036,52 @@ class DeepSpeedEngine:
 
             if self._offload_multihost:
                 from .zero.offload_engine import local_block
+            comp = getattr(self, "_offload_compress", "none")
             host_grads, zero_leaves = [], []
-            for li, g in enumerate(acc_leaves):
-                transfer, zeroed = self._prep_leaf_jit(g, coef)
-                zero_leaves.append(zeroed)
-                if self._offload_multihost:
-                    host_grads.extend(
-                        np.divide(local_block(transfer, idx), old_scale,
-                                  dtype=np.float32)
-                        for idx, _, _ in self._offload_layout[li])
-                else:
-                    host_grads.append(np.divide(jax.device_get(transfer),
-                                                old_scale, dtype=np.float32))
-                transfer.delete()  # free before the next leaf materializes
+            if comp != "none":
+                # compressed stream: device already unscaled+clipped and
+                # folded in the error-feedback residual; the host pulls a
+                # packed payload + per-block scales (16x / 2x less d2h
+                # than the bf16 tree) and dequantizes to fp32
+                inv_scale = np.float32(1.0 / old_scale)
+                blk = int(getattr(self._offload_cfg, "compression_block",
+                                  2048))
+                fn = self._prep_onebit_jit if comp == "onebit" \
+                    else self._prep_int8_jit
+                for li, g in enumerate(acc_leaves):
+                    shape, size = g.shape, g.size
+                    payload, scales, resid_new, zeroed = fn(
+                        g, self._offload_resid_leaves[li], coef, inv_scale)
+                    self._offload_resid_leaves[li] = resid_new
+                    zero_leaves.append(zeroed)
+                    pb = np.asarray(jax.device_get(payload))
+                    sb = np.asarray(jax.device_get(scales), np.float32)
+                    payload.delete()
+                    scales.delete()
+                    if comp == "onebit":
+                        bits = np.unpackbits(
+                            pb, bitorder="little").astype(np.float32)
+                        vals = (bits * 2.0 - 1.0).reshape(-1, blk) \
+                            * sb[:, None]
+                    else:
+                        vals = pb.astype(np.float32).reshape(-1, blk) \
+                            * sb[:, None]
+                    host_grads.append(np.ascontiguousarray(
+                        vals.reshape(-1)[:size].reshape(shape)))
+            else:
+                for li, g in enumerate(acc_leaves):
+                    transfer, zeroed = self._prep_leaf_jit(g, coef)
+                    zero_leaves.append(zeroed)
+                    if self._offload_multihost:
+                        host_grads.extend(
+                            np.divide(local_block(transfer, idx), old_scale,
+                                      dtype=np.float32)
+                            for idx, _, _ in self._offload_layout[li])
+                    else:
+                        host_grads.append(
+                            np.divide(jax.device_get(transfer), old_scale,
+                                      dtype=np.float32))
+                    transfer.delete()  # free before next leaf materializes
             outs = self._offload_opt.step(host_grads, bf16_out=bf16,
                                           group_hyper=group_hyper)
             del host_grads
