@@ -1034,59 +1034,23 @@ class DeepSpeedEngine:
                     return out.view(jnp.bfloat16).reshape(shape)
                 return np.asarray(out, dtype).reshape(shape)
 
+            comp = getattr(self, "_offload_compress", "none")
+            zero_leaves = []
             if self._offload_multihost:
                 from .zero.offload_engine import local_block
-            comp = getattr(self, "_offload_compress", "none")
-            host_grads, zero_leaves = [], []
-            if comp != "none":
-                # compressed stream: device already unscaled+clipped and
-                # folded in the error-feedback residual; the host pulls a
-                # packed payload + per-block scales (16x / 2x less d2h
-                # than the bf16 tree) and dequantizes to fp32
-                inv_scale = np.float32(1.0 / old_scale)
-                blk = int(getattr(self._offload_cfg, "compression_block",
-                                  2048))
-                fn = self._prep_onebit_jit if comp == "onebit" \
-                    else self._prep_int8_jit
-                for li, g in enumerate(acc_leaves):
-                    shape, size = g.shape, g.size
-                    payload, scales, resid_new, zeroed = fn(
-                        g, self._offload_resid_leaves[li], coef, inv_scale)
-                    self._offload_resid_leaves[li] = resid_new
-                    zero_leaves.append(zeroed)
-                    pb = np.asarray(jax.device_get(payload))
-                    sb = np.asarray(jax.device_get(scales), np.float32)
-                    payload.delete()
-                    scales.delete()
-                    if comp == "onebit":
-                        bits = np.unpackbits(
-                            pb, bitorder="little").astype(np.float32)
-                        vals = (bits * 2.0 - 1.0).reshape(-1, blk) \
-                            * sb[:, None]
-                    else:
-                        vals = pb.astype(np.float32).reshape(-1, blk) \
-                            * sb[:, None]
-                    host_grads.append(np.ascontiguousarray(
-                        vals.reshape(-1)[:size].reshape(shape)))
-            else:
+                host_grads = []
                 for li, g in enumerate(acc_leaves):
                     transfer, zeroed = self._prep_leaf_jit(g, coef)
                     zero_leaves.append(zeroed)
-                    if self._offload_multihost:
-                        host_grads.extend(
-                            np.divide(local_block(transfer, idx), old_scale,
-                                      dtype=np.float32)
-                            for idx, _, _ in self._offload_layout[li])
-                    else:
-                        host_grads.append(
-                            np.divide(jax.device_get(transfer), old_scale,
-                                      dtype=np.float32))
+                    host_grads.extend(
+                        np.divide(local_block(transfer, idx), old_scale,
+                                  dtype=np.float32)
+                        for idx, _, _ in self._offload_layout[li])
                     transfer.delete()  # free before next leaf materializes
-            outs = self._offload_opt.step(host_grads, bf16_out=bf16,
-                                          group_hyper=group_hyper)
-            del host_grads
-            param_leaves = list(jax.tree_util.tree_leaves(s["params"]))
-            if self._offload_multihost:
+                outs = self._offload_opt.step(host_grads, bf16_out=bf16,
+                                              group_hyper=group_hyper)
+                del host_grads
+                param_leaves = list(jax.tree_util.tree_leaves(s["params"]))
                 # rebuild global params: per-shard device_put onto the
                 # master partition, then one jitted reshard (the stage-1
                 # weight-update all-gather) to the param sharding
@@ -1107,19 +1071,105 @@ class DeepSpeedEngine:
                     self._params_treedef, new_leaves)
                 s["params"] = self._reshard_params_jit(master_sharded)
             else:
-                # leaf-by-leaf upload: dropping every reference to the old
-                # leaf (the list slot AND the state trees — s["master"]
-                # aliases s["params"]) before the next device_put keeps the
-                # transient at one leaf; a whole-tree device_put would hold
-                # old + new params concurrently
+                # single-host PIPELINED step: dispatch the prep (and async
+                # host copy) of leaf i+1 BEFORE pulling leaf i, so leaf
+                # i's host Adam + upload overlap leaf i+1's d2h stream —
+                # the reference overlaps its IPG buckets with CUDA copy
+                # streams the same way.  Window of 2 in-flight transfers
+                # (one extra 16-bit leaf of HBM; pipeline_transfers=false
+                # restores the strict one-leaf transient).  Old param
+                # leaves are dropped from the list AND the state trees
+                # (s["master"] aliases s["params"]) before each upload so
+                # the upload transient stays at one leaf.
+                inv_scale = np.float32(1.0 / old_scale)
+                blk = int(getattr(self._offload_cfg, "compression_block",
+                                  2048))
+                comp_fn = None
+                if comp != "none":
+                    comp_fn = self._prep_onebit_jit if comp == "onebit" \
+                        else self._prep_int8_jit
                 param_shardings = jax.tree_util.tree_leaves(
                     self._out_shardings["params"])
+                param_leaves = list(jax.tree_util.tree_leaves(s["params"]))
+                param_meta = [(l.dtype, l.shape) for l in param_leaves]
+                n_leaves = len(param_leaves)
                 s["params"] = s["master"] = None
-                for i, out in enumerate(outs):
-                    dtype, shape = param_leaves[i].dtype, param_leaves[i].shape
-                    param_leaves[i] = None  # old leaf freed here
-                    param_leaves[i] = jax.device_put(
-                        to_arr(out, dtype, shape), param_shardings[i])
+                self._offload_opt.step_begin()
+                window = 2 if getattr(self._offload_cfg,
+                                      "pipeline_transfers", True) else 1
+                inflight: List[tuple] = []
+
+                def drain_one():
+                    pi, arrs, shape, size = inflight.pop(0)
+                    if comp == "none":
+                        hg = np.divide(jax.device_get(arrs[0]), old_scale,
+                                       dtype=np.float32)
+                    else:
+                        pb = np.asarray(jax.device_get(arrs[0]))
+                        sb = np.asarray(jax.device_get(arrs[1]), np.float32)
+                        if comp == "onebit":
+                            vals = np.unpackbits(
+                                pb, bitorder="little").astype(np.float32) \
+                                * 2.0 - 1.0
+                        else:  # int8
+                            vals = pb.astype(np.float32)
+                        hg = np.ascontiguousarray(
+                            (vals.reshape(-1, blk) * sb[:, None])
+                            .reshape(-1)[:size].reshape(shape))
+                    for a in arrs:
+                        a.delete()
+                    out = self._offload_opt.step_one(
+                        pi, hg, bf16_out=bf16, group_hyper=group_hyper)
+                    pdtype, pshape = param_meta[pi]
+                    param_leaves[pi] = None  # old leaf freed here
+                    param_leaves[pi] = jax.device_put(
+                        to_arr(out, pdtype, pshape), param_shardings[pi])
+
+                try:
+                    for li in range(n_leaves):
+                        g = acc_leaves[li]
+                        shape, size = g.shape, g.size
+                        if comp_fn is not None:
+                            payload, scales, resid_new, zeroed = comp_fn(
+                                g, self._offload_resid_leaves[li], coef,
+                                inv_scale)
+                            self._offload_resid_leaves[li] = resid_new
+                            arrs = (payload, scales)
+                        else:
+                            transfer, zeroed = self._prep_leaf_jit(g, coef)
+                            arrs = (transfer,)
+                        zero_leaves.append(zeroed)
+                        for a in arrs:
+                            a.copy_to_host_async()
+                        inflight.append((li, arrs, shape, size))
+                        if len(inflight) >= window:
+                            drain_one()
+                    while inflight:
+                        drain_one()
+                    self._offload_opt.step_end()
+                except Exception:
+                    # leave the engine checkpointable: the host master is
+                    # the authority — rebuild any leaf lost mid-drain
+                    # from it before re-raising (best-effort: if the
+                    # master itself is unreadable, params stay None as
+                    # before this pipeline existed)
+                    try:
+                        masters = None
+                        for pi, leaf in enumerate(param_leaves):
+                            if leaf is None:
+                                if masters is None:
+                                    masters = self._offload_opt.masters()
+                                pdtype, pshape = param_meta[pi]
+                                host = np.asarray(masters[pi], np.float32) \
+                                    .reshape(pshape).astype(np.dtype(pdtype))
+                                param_leaves[pi] = jax.device_put(
+                                    host, param_shardings[pi])
+                        s["params"] = s["master"] = \
+                            jax.tree_util.tree_unflatten(
+                                self._params_treedef, param_leaves)
+                    except Exception:
+                        pass
+                    raise
                 s["params"] = jax.tree_util.tree_unflatten(
                     self._params_treedef, param_leaves)
             s["master"] = s["params"]

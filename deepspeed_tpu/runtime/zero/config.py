@@ -64,6 +64,12 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     #: dtype of the error-feedback residual ("fp32" | "bf16"); bf16
     #: halves the residual's HBM at a small fidelity cost
     compression_residual_dtype: str = "fp32"
+    #: overlap leaf i+1's device->host gradient stream with leaf i's host
+    #: Adam step and param upload (the reference overlaps IPG buckets
+    #: with CUDA copy streams).  Costs one extra in-flight 16-bit leaf of
+    #: HBM; disable to restore the strict one-leaf transient.
+    #: Single-process only — the multi-host step path ignores this flag.
+    pipeline_transfers: bool = True
 
     @property
     def pipeline(self) -> bool:

@@ -155,36 +155,54 @@ class HostOffloadOptimizer:
         ``weight_decay`` args (direct callers; ``weight_decay`` persists as
         the new construction-time value)."""
         assert len(host_grads) == self.num_groups
+        self.step_begin(weight_decay)
+        outs = [self.step_one(i, g, lr=lr, bf16_out=bf16_out,
+                              group_hyper=group_hyper)
+                for i, g in enumerate(host_grads)]
+        self.step_end()
+        return outs
+
+    def step_begin(self, weight_decay: Optional[float] = None) -> None:
+        """Advance the step counter; pair with step_one()/step_end().
+        Split out so the engine can interleave per-array steps with the
+        device<->host transfers of neighbouring arrays (the pipelined
+        offload step)."""
         if weight_decay is not None:
             self.weight_decay = weight_decay
         self.step_count += 1
-        outs: List[np.ndarray] = []
-        for i, g in enumerate(host_grads):
-            if group_hyper is not None and self.group_of is not None:
-                gh = group_hyper[self.group_of[i]]
-                lr_i = float(gh["lr"])
-                wd_i = float(gh.get("weight_decay", self.weight_decay))
-            else:
-                assert lr is not None, "step() needs lr or group_hyper"
-                lr_i, wd_i = lr, self.weight_decay
-            g = np.ascontiguousarray(g, np.float32).ravel()
-            if self._swapper is None:
-                p, m, v = self._master[i], self._m[i], self._v[i]
-            else:
-                nxt = self._key(i + 1) if i + 1 < self.num_groups else None
-                state = self._swapper.get(self._key(i), prefetch_next=nxt)
-                p, m, v = state["master"], state["m"], state["v"]
-            out16 = np.empty(p.size, np.uint16) if bf16_out else None
-            cpu_adam_step(self._lib, p, g, m, v, self.step_count, lr_i,
-                          self.beta1, self.beta2, self.eps, wd_i,
-                          self.adamw_mode, self.bias_correction,
-                          bf16_out=out16, num_threads=self.num_threads)
-            if self._swapper is not None:
-                self._swapper.put(self._key(i), {"master": p, "m": m, "v": v})
-            outs.append(out16 if bf16_out else p.reshape(self._shapes[i]))
+
+    def step_one(self, i: int, g: np.ndarray, lr: Optional[float] = None,
+                 bf16_out: bool = True,
+                 group_hyper: Optional[List[Dict[str, float]]] = None
+                 ) -> np.ndarray:
+        """Adam-step array ``i`` with gradient ``g`` (between step_begin
+        and step_end)."""
+        if group_hyper is not None and self.group_of is not None:
+            gh = group_hyper[self.group_of[i]]
+            lr_i = float(gh["lr"])
+            wd_i = float(gh.get("weight_decay", self.weight_decay))
+        else:
+            assert lr is not None, "step_one() needs lr or group_hyper"
+            lr_i, wd_i = lr, self.weight_decay
+        g = np.ascontiguousarray(g, np.float32).ravel()
+        if self._swapper is None:
+            p, m, v = self._master[i], self._m[i], self._v[i]
+        else:
+            nxt = self._key(i + 1) if i + 1 < self.num_groups else None
+            state = self._swapper.get(self._key(i), prefetch_next=nxt)
+            p, m, v = state["master"], state["m"], state["v"]
+        out16 = np.empty(p.size, np.uint16) if bf16_out else None
+        cpu_adam_step(self._lib, p, g, m, v, self.step_count, lr_i,
+                      self.beta1, self.beta2, self.eps, wd_i,
+                      self.adamw_mode, self.bias_correction,
+                      bf16_out=out16, num_threads=self.num_threads)
+        if self._swapper is not None:
+            self._swapper.put(self._key(i), {"master": p, "m": m, "v": v})
+        return out16 if bf16_out else p.reshape(self._shapes[i])
+
+    def step_end(self) -> None:
         if self._swapper is not None:
             self._swapper.flush_writes()
-        return outs
 
     # ------------------------------------------------------------ checkpoint
 

@@ -580,3 +580,18 @@ def test_offload_grad_compression_rejects_bad_value(field, value):
     with pytest.raises(DeepSpeedConfigError):
         deepspeed_tpu.initialize(model=from_gpt(_tiny_config()), config=cfg,
                                  mesh_manager=mm, rng=jax.random.PRNGKey(0))
+
+
+def test_offload_pipelined_step_matches_unpipelined():
+    """pipeline_transfers=True (default: leaf i+1's d2h overlaps leaf i's
+    host Adam + upload) must be bit-identical to the strict serial path —
+    it only reorders transfers, never the math."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    reset_mesh_manager()
+    _, on_losses = _train(_ds_config(offload_device="cpu"), steps=3)
+    reset_mesh_manager()
+    cfg = _ds_config(offload_device="cpu")
+    cfg["zero_optimization"]["offload_optimizer"]["pipeline_transfers"] = \
+        False
+    _, off_losses = _train(cfg, steps=3)
+    np.testing.assert_array_equal(on_losses, off_losses)
