@@ -22,9 +22,9 @@ import time
 import numpy as np
 
 
-def _emit_error(msg: str) -> None:
+def _emit_error(msg: str, metric: str = "gpt2_train_samples_per_sec_per_chip") -> None:
     print(json.dumps({
-        "metric": "gpt2_train_samples_per_sec_per_chip",
+        "metric": metric,
         "value": 0.0,
         "unit": "samples/s/chip",
         "vs_baseline": 0.0,
@@ -249,7 +249,8 @@ def _bench_offload() -> None:
             if (r.stderr or r.stdout).strip() else f"rc={r.returncode}"
         sys.stderr.write(f"bench offload: {name} mb={mb} failed "
                          f"(rc={r.returncode})\n")
-    _emit_error(f"no offload config fits: {last_err}")
+    _emit_error(f"no offload config fits: {last_err}",
+                metric="gpt_zero_offload_samples_per_sec_per_chip")
 
 
 def _bench_offload_child(devices, tpu_error) -> None:
