@@ -1150,9 +1150,22 @@ class DeepSpeedEngine:
                 except Exception:
                     # leave the engine checkpointable: the host master is
                     # the authority — rebuild any leaf lost mid-drain
-                    # from it before re-raising (best-effort: if the
-                    # master itself is unreadable, params stay None as
-                    # before this pipeline existed)
+                    # from it, and replace the accumulator (its prepped
+                    # leaves were donated, i.e. deleted; this step's
+                    # gradients are lost either way) before re-raising.
+                    # Best-effort: if the master itself is unreadable,
+                    # params stay None as before this pipeline existed.
+                    try:
+                        # independent of the master: the accumulator's
+                        # prepped leaves are gone regardless
+                        while len(zero_leaves) < n_leaves:
+                            zero_leaves.append(self._zero_leaf_jit(
+                                acc_leaves[len(zero_leaves)]))
+                        s["grad_acc"] = jax.tree_util.tree_unflatten(
+                            jax.tree_util.tree_structure(s["grad_acc"]),
+                            zero_leaves)
+                    except Exception:
+                        pass
                     try:
                         masters = None
                         for pi, leaf in enumerate(param_leaves):

@@ -595,3 +595,41 @@ def test_offload_pipelined_step_matches_unpipelined():
         False
     _, off_losses = _train(cfg, steps=3)
     np.testing.assert_array_equal(on_losses, off_losses)
+
+
+def test_offload_step_failure_leaves_engine_checkpointable(monkeypatch,
+                                                            tmp_path):
+    """If the host optimizer dies mid-drain (e.g. an NVMe read error),
+    the engine must re-raise but keep state['params'] a complete tree —
+    rebuilt from the host master where the in-flight leaf was already
+    freed — so a rescue checkpoint can still be saved."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    from deepspeed_tpu.runtime.zero.offload_engine import HostOffloadOptimizer
+    reset_mesh_manager()
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(_tiny_config()), config=_ds_config(offload_device="cpu"),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 256, size=(8, 65)).astype(np.int32)}
+
+    calls = {"n": 0}
+    real_step_one = HostOffloadOptimizer.step_one
+
+    def dying_step_one(self, i, g, **kw):
+        calls["n"] += 1
+        if calls["n"] == 3:  # fail on the third leaf, mid-pipeline
+            raise RuntimeError("injected nvme read error")
+        return real_step_one(self, i, g, **kw)
+
+    monkeypatch.setattr(HostOffloadOptimizer, "step_one", dying_step_one)
+    engine.forward(batch)
+    engine.backward()
+    with pytest.raises(RuntimeError, match="injected nvme read error"):
+        engine.step()
+    leaves = jax.tree_util.tree_leaves(engine.state["params"])
+    assert all(l is not None for l in leaves)
+    assert all(np.isfinite(np.asarray(jax.device_get(l))).all()
+               for l in leaves)
+    # and a rescue checkpoint can actually be written
+    engine.save_checkpoint(str(tmp_path / "rescue_ckpt"), tag="rescue")
