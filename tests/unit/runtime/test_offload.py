@@ -633,3 +633,34 @@ def test_offload_step_failure_leaves_engine_checkpointable(monkeypatch,
                for l in leaves)
     # and a rescue checkpoint can actually be written
     engine.save_checkpoint(str(tmp_path / "rescue_ckpt"), tag="rescue")
+
+
+def test_offload_onebit_with_fp16_loss_scaling():
+    """Compression composes with dynamic loss scaling: the prep unscales
+    on device BEFORE quantize+residual, so the error-feedback residual is
+    in unscaled units and survives scale changes between steps."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    import dataclasses as dc
+    reset_mesh_manager()
+    cfg = _ds_config(offload_device="cpu")
+    od = cfg["zero_optimization"]["offload_optimizer"]
+    od["grad_compression"] = "onebit"
+    od["compression_block"] = 256
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 10,
+                   "loss_scale_window": 4}
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    model_cfg = dc.replace(_tiny_config(), dtype=jnp.float16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(model_cfg), config=cfg, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 256, size=(8, 65)).astype(np.int32)}
+    losses = []
+    for _ in range(10):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(engine.cur_scale) and engine.cur_scale >= 1.0
