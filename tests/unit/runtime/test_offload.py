@@ -664,3 +664,17 @@ def test_offload_onebit_with_fp16_loss_scaling():
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
     assert np.isfinite(engine.cur_scale) and engine.cur_scale >= 1.0
+
+
+def test_offload_onebit_composes_with_zero3():
+    """Compressed offload stream under ZeRO-3 (sharded params/grads): the
+    per-leaf prep jits consume globally-sharded accumulators and the
+    packed payload gathers on pull — the composition must train."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    reset_mesh_manager()
+    cfg = _ds_config(offload_device="cpu", stage=3)
+    od = cfg["zero_optimization"]["offload_optimizer"]
+    od["grad_compression"] = "onebit"
+    od["compression_block"] = 256
+    _, losses = _train_losses(cfg, steps=6)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
