@@ -303,6 +303,12 @@ def _bench_offload_child(devices, tpu_error) -> None:
     if compress:
         ds["zero_optimization"]["offload_optimizer"].update(
             grad_compression=compress, compression_residual_dtype="bf16")
+    if name == "gpt2-2.7b":
+        # 2.7B fits only with the strict one-leaf transient — the
+        # pipelined window's second in-flight leaf (~1.7 GB) would OOM
+        # (memory_model.offload_peak_bytes pins this)
+        ds["zero_optimization"]["offload_optimizer"][
+            "pipeline_transfers"] = False
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=from_gpt(config), config=ds, mesh_manager=mm,
         rng=jax.random.PRNGKey(0))

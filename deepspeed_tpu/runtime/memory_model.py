@@ -33,22 +33,30 @@ def zero_state_bytes(num_params: int, dp: int, stage: int,
 
 def offload_peak_bytes(num_params: int, largest_leaf_params: int,
                        mixed_precision: bool = True,
-                       grad_accum_bytes: int = 4) -> int:
+                       grad_accum_bytes: int = 4,
+                       pipeline_transfers: bool = True,
+                       compression_residual_bytes: int = 0) -> int:
     """Peak device bytes of the streamed ZeRO-offload step
     (``engine._apply_offload_step``), excluding activations.
 
     Persistent: 16-bit params + the gradient accumulator
     (``grad_accum_bytes``/param — 4 for the default fp32, 2 when
-    ``data_types.grad_accum_dtype`` selects a 16-bit accumulator).  The
+    ``data_types.grad_accum_dtype`` selects a 16-bit accumulator) + the
+    error-feedback residual when ``grad_compression`` is on
+    (``compression_residual_bytes``/param: 4 fp32, 2 bf16, 0 off).  The
     prep → transfer → free / upload loops stream one leaf at a time (the
     reference's fixed-size IPG-bucket discipline,
-    ``stage_1_and_2.py:868``), so the only transient is ONE 16-bit leaf
-    — never a gradient- or parameter-sized tree.  Master + Adam moments
-    are host-resident (offload) and cost no HBM.
+    ``stage_1_and_2.py:868``); ``pipeline_transfers`` (the default)
+    keeps a second leaf in flight to overlap the host Adam with the d2h
+    stream, doubling the transient — never a gradient- or
+    parameter-sized tree either way.  Master + Adam moments are
+    host-resident (offload) and cost no HBM.
     """
     p = 2 if mixed_precision else 4
-    return int(num_params) * (p + int(grad_accum_bytes)) \
-        + int(largest_leaf_params) * p
+    inflight = 2 if pipeline_transfers else 1
+    return int(num_params) * (p + int(grad_accum_bytes)
+                              + int(compression_residual_bytes)) \
+        + inflight * int(largest_leaf_params) * p
 
 
 def device_budget(memory_fraction: float = 0.85,
