@@ -488,6 +488,27 @@ class DeepSpeedEngine:
                 out_shardings=tuple(grads_sh_flat))())
         self._offload_compress = comp
 
+        # auto-disable transfer pipelining when the second in-flight leaf
+        # doesn't fit the analytic HBM budget — users shouldn't need to
+        # know the knob to train the biggest model that fits
+        self._offload_pipeline = bool(getattr(
+            self._offload_cfg, "pipeline_transfers", True))
+        if self._offload_pipeline and not multihost:
+            from .memory_model import device_budget, offload_peak_bytes
+            sizes = [int(np.prod(shp)) for shp in leaf_shapes]
+            accum_b = jnp.dtype(self.grad_accum_dtype).itemsize
+            resid_b = 0 if comp == "none" else jnp.dtype(rdt).itemsize
+            budget = device_budget()
+            if budget is not None and offload_peak_bytes(
+                    sum(sizes), max(sizes),
+                    mixed_precision=self.compute_dtype != jnp.float32,
+                    grad_accum_bytes=accum_b, pipeline_transfers=True,
+                    compression_residual_bytes=resid_b) > budget:
+                log_dist("[offload] pipeline_transfers auto-disabled: the "
+                         "second in-flight leaf exceeds the HBM budget",
+                         ranks=[0])
+                self._offload_pipeline = False
+
         # per-leaf param-group assignment (torch decay/no-decay groups by
         # leaf path; reference steps each group with its own hyperparams)
         opt = self.optimizer
@@ -1095,8 +1116,7 @@ class DeepSpeedEngine:
                 n_leaves = len(param_leaves)
                 s["params"] = s["master"] = None
                 self._offload_opt.step_begin()
-                window = 2 if getattr(self._offload_cfg,
-                                      "pipeline_transfers", True) else 1
+                window = 2 if getattr(self, "_offload_pipeline", True) else 1
                 inflight: List[tuple] = []
 
                 def drain_one():

@@ -687,3 +687,27 @@ def test_offload_onebit_composes_with_zero3():
     od["compression_block"] = 256
     _, losses = _train_losses(cfg, steps=6)
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_offload_pipeline_auto_disables_on_tight_budget(monkeypatch):
+    """When the analytic peak with the second in-flight leaf exceeds the
+    device budget, the engine falls back to the strict one-leaf
+    transient on its own (engine.py _init_state_offload)."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    from deepspeed_tpu.runtime import memory_model
+    monkeypatch.setattr(memory_model, "device_budget", lambda **kw: 1024)
+    reset_mesh_manager()
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(_tiny_config()), config=_ds_config(offload_device="cpu"),
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    assert engine._offload_pipeline is False
+    # and with an ample budget it stays on
+    monkeypatch.setattr(memory_model, "device_budget",
+                        lambda **kw: 1 << 40)
+    reset_mesh_manager()
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(_tiny_config()), config=_ds_config(offload_device="cpu"),
+        mesh_manager=mm, rng=jax.random.PRNGKey(1))
+    assert engine2._offload_pipeline is True
