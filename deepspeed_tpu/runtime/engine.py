@@ -168,6 +168,8 @@ class DeepSpeedEngine:
 
         # caches for the forward/backward/step protocol
         self._pending: Optional[Tuple[Any, Any]] = None  # (loss, ready flag)
+        self._training = True   # train()/eval() parity toggle
+        self._zero_tree_jit = None
         self._last_lr_kwargs: Dict[str, float] = {}
 
         if self.global_rank == 0:
@@ -872,6 +874,8 @@ class DeepSpeedEngine:
         if not isinstance(batch, dict) or not (
                 self.module.meta.get("needs_rng") or self._pld is not None):
             return batch
+        if not getattr(self, "_training", True):
+            return batch  # engine.eval(): deterministic forward
         base = jax.random.fold_in(jax.random.PRNGKey(0), self.micro_steps)
         if n is None:
             return {**batch, "_train_rng": base}
@@ -937,6 +941,13 @@ class DeepSpeedEngine:
 
     def forward(self, batch, **kwargs):
         """Compute loss (and, fused, the gradients) for one micro-batch."""
+        if not getattr(self, "_training", True):
+            # engine.eval(): a validation forward must not contaminate the
+            # gradient accumulator (the fused micro step would add the val
+            # batch's grads to the next optimizer update)
+            loss = self.eval_loss(batch)
+            self._pending = loss
+            return loss
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         self.tput_timer.start()
@@ -1433,3 +1444,98 @@ class DeepSpeedEngine:
                     "constructed groups (hyperparams from the checkpoint "
                     "are NOT restored)")
         return load_dir, client_state
+
+    # -------------------------------------------------- module-level parity
+    # (reference engine.py:1631 train / :1637 eval / :1938 zero_grad /
+    #  :409 get_batch_info / :2214 get_mom / :2436 module_state_dict /
+    #  :2503 load_module_state_dict)
+
+    def train(self, mode: bool = True) -> "DeepSpeedEngine":
+        """Toggle training mode: controls whether ``forward`` threads
+        per-micro-step dropout PRNG keys (eval is deterministic by
+        construction — no key, no stochasticity)."""
+        self._training = bool(mode)
+        return self
+
+    def eval(self) -> "DeepSpeedEngine":
+        self._training = False
+        return self
+
+    def zero_grad(self) -> None:
+        """Clear accumulated gradients (donating re-zero of the
+        accumulator tree — no new allocation survives the call)."""
+        if self._zero_tree_jit is None:
+            self._zero_tree_jit = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
+                donate_argnums=(0,))
+        self.state["grad_acc"] = self._zero_tree_jit(self.state["grad_acc"])
+
+    def get_batch_info(self):
+        """(train_batch_size, train_micro_batch_size_per_gpu,
+        gradient_accumulation_steps)."""
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
+
+    def get_mom(self):
+        """Per-group momentum config: the betas tuple for the Adam
+        family, the scalar momentum for SGD/RMSprop (reference get_mom
+        branches on optimizer_name the same way)."""
+        opt = self.optimizer
+        groups = getattr(opt, "param_groups", None) or [{}]
+        fallback = getattr(opt, "betas", None)
+        if fallback is None:
+            fallback = getattr(opt, "momentum", (0.9, 0.999))
+        return [g.get("betas", g.get("momentum", fallback)) for g in groups]
+
+    def module_state_dict(self):
+        """The current parameter pytree (compute-dtype device arrays) —
+        the SPMD stand-in for the reference's torch state_dict."""
+        return self.state["params"]
+
+    def load_module_state_dict(self, state_dict, strict: bool = True):
+        """Replace the parameters from a pytree of arrays (host or
+        device).  ``strict`` requires an exactly matching tree structure;
+        non-strict loads the intersection by flattened position where
+        shapes agree.  Offload engines re-seed the host fp32 master so
+        the next step updates the LOADED weights."""
+        cur_flat, cur_def = jax.tree_util.tree_flatten(self.state["params"])
+        new_flat, new_def = jax.tree_util.tree_flatten(state_dict)
+        if strict and cur_def != new_def:
+            raise ValueError(
+                f"state_dict tree mismatch: {new_def} vs {cur_def}")
+        sh_flat = jax.tree_util.tree_leaves(self._out_shardings["params"])
+        out = list(cur_flat)
+        touched = []
+        for i, (cur, psh) in enumerate(zip(cur_flat, sh_flat)):
+            if i >= len(new_flat):
+                break
+            leaf = new_flat[i]
+            if tuple(leaf.shape) != tuple(cur.shape):
+                if strict:
+                    raise ValueError(
+                        f"leaf {i} shape {leaf.shape} != {cur.shape}")
+                continue
+            out[i] = jax.device_put(
+                jnp.asarray(leaf, dtype=cur.dtype), psh)
+            touched.append(i)
+        params = jax.tree_util.tree_unflatten(cur_def, out)
+        self.state["params"] = params
+        if self._separate_master and self._offload_device is None:
+            # the fp32 master seeds from the SOURCE leaves — casting
+            # through a 16-bit compute dtype first would bake rounding
+            # error into the master every optimizer step evolves from
+            m_flat = list(jax.tree_util.tree_leaves(self.state["master"]))
+            msh_flat = jax.tree_util.tree_leaves(
+                self._out_shardings["master"])
+            for i in touched:
+                m_flat[i] = jax.device_put(
+                    jnp.asarray(new_flat[i], dtype=jnp.float32), msh_flat[i])
+            self.state["master"] = jax.tree_util.tree_unflatten(
+                cur_def, m_flat)
+        else:
+            self.state["master"] = params
+        if self._offload_device is not None:
+            # host master re-seeds from the device params (compute dtype
+            # — the reference's construction, stage_1_and_2.py:98)
+            self._reseed_offload_master()
