@@ -33,8 +33,12 @@ def _emit_error(msg: str, metric: str = "gpt2_train_samples_per_sec_per_chip") -
     sys.exit(1)
 
 
-def _init_devices(attempts: int = 3, probe_timeout_s: float = 120.0,
-                  backoff_s: float = 15.0):
+def _init_devices(attempts: int = 3, probe_timeout_s: float = 100.0,
+                  backoff_s: float = 10.0):
+    # probe budget note: when the tunnel HANGS (attach never returns),
+    # every attempt costs the full probe timeout — 3x100s + backoff
+    # leaves ~230s of a 560s driver budget for the CPU-fallback
+    # measurement (the old 3x120s left only ~50s of slack)
     """Bounded-retry TPU backend init that survives hangs AND errors.
 
     Round-1 bench died at ``jax.devices()`` with "Unable to initialize
