@@ -450,6 +450,80 @@ class DeepSpeedEngine:
                                                       params_flat)
             del params_flat
 
+        # per-leaf param-group assignment (torch decay/no-decay groups by
+        # leaf path; reference steps each group with its own hyperparams)
+        opt = self.optimizer
+        groups = getattr(opt, "param_groups", None) or [{}]
+        leaf_paths = [jax.tree_util.keystr(p) for p, _ in
+                      jax.tree_util.tree_flatten_with_path(params)[0]]
+        self._leaf_group_idx = resolve_param_groups(groups, leaf_paths)
+
+        # Pull the fp32 master to the host BEFORE allocating the grad
+        # accumulator: on the load path the device fp32 transient is
+        # 4 bytes/param, and holding it across the accumulator allocation
+        # gives an 8-10 bytes/param init peak — the same OOM profile the
+        # host-side scratch init eliminated.  Freeing each device leaf as
+        # soon as its host copy lands keeps the load-path peak at
+        # params + one transient fp32 leaf.
+        # Multi-host: each process keeps only its unique addressable master
+        # shards (the reference's per-rank cpu_offload, stage_1_and_2.py:98)
+        # and steps them locally; params are rebuilt from the shards + one
+        # SPMD reshard (all-gather on device).  Scratch init: every process
+        # computes the identical full init (threefry is deterministic),
+        # then slices its own blocks — host-RAM only, no cross-host traffic.
+        self._offload_multihost = multihost
+        if self._offload_multihost:
+            # per leaf: [(global index, normalized key, block shape)] for
+            # the process's unique shards, and the static device->key put
+            # map for rebuilding the master-sharded global array each step
+            self._offload_layout = []
+            self._offload_putmap = []
+            master_leaves, group_of = [], []
+            src_flat = master_dev_flat if master_dev_flat is not None \
+                else master_flat
+            for li in range(len(src_flat)):
+                leaf = src_flat[li]
+                msh = self._master_shardings_flat[li]
+                dev_map = msh.addressable_devices_indices_map(leaf.shape)
+                self._offload_putmap.append(
+                    [(d, index_key(i, leaf.shape))
+                     for d, i in dev_map.items()])
+                if master_dev_flat is not None:
+                    # load path: pull only this process's addressable
+                    # shards of the device master (already msh-sharded)
+                    blocks = unique_local_blocks(leaf)
+                    self._offload_layout.append(
+                        [(idx, index_key(idx, leaf.shape), b.shape)
+                         for idx, b in blocks])
+                    for _, b in blocks:
+                        master_leaves.append(np.asarray(b, np.float32))
+                        group_of.append(self._leaf_group_idx[li])
+                    src_flat[li] = None  # free the device fp32 leaf now
+                else:
+                    # scratch path: slice the host init (host-RAM only)
+                    blocks = {}
+                    for idx in dev_map.values():
+                        blocks.setdefault(index_key(idx, leaf.shape), idx)
+                    self._offload_layout.append(
+                        [(blocks[k], k, leaf[blocks[k]].shape)
+                         for k in sorted(blocks)])
+                    for k in sorted(blocks):
+                        master_leaves.append(
+                            np.ascontiguousarray(leaf[blocks[k]]))
+                        group_of.append(self._leaf_group_idx[li])
+                del leaf
+        elif master_dev_flat is not None:
+            master_leaves = []
+            for li in range(len(master_dev_flat)):
+                master_leaves.append(np.asarray(
+                    jax.device_get(master_dev_flat[li]), np.float32))
+                master_dev_flat[li] = None  # free the device fp32 leaf now
+            group_of = list(self._leaf_group_idx)
+        else:
+            master_leaves = master_flat
+            group_of = list(self._leaf_group_idx)
+        del master_flat, master_dev_flat
+
         leaf_shapes = [l.shape for l in jax.tree_util.tree_leaves(params)]
         grad_acc = jax.jit(
             lambda: jax.tree_util.tree_unflatten(
@@ -510,70 +584,6 @@ class DeepSpeedEngine:
                          "second in-flight leaf exceeds the HBM budget",
                          ranks=[0])
                 self._offload_pipeline = False
-
-        # per-leaf param-group assignment (torch decay/no-decay groups by
-        # leaf path; reference steps each group with its own hyperparams)
-        opt = self.optimizer
-        groups = getattr(opt, "param_groups", None) or [{}]
-        leaf_paths = [jax.tree_util.keystr(p) for p, _ in
-                      jax.tree_util.tree_flatten_with_path(params)[0]]
-        self._leaf_group_idx = resolve_param_groups(groups, leaf_paths)
-
-        # the fp32 master is already host-resident (never was on device).
-        # Multi-host: each process keeps only its unique addressable master
-        # shards (the reference's per-rank cpu_offload, stage_1_and_2.py:98)
-        # and steps them locally; params are rebuilt from the shards + one
-        # SPMD reshard (all-gather on device).  Every process computes the
-        # identical full init (threefry is deterministic), then slices its
-        # own blocks — a host-RAM transient, no cross-host traffic.
-        self._offload_multihost = multihost
-        if self._offload_multihost:
-            # per leaf: [(global index, normalized key, block shape)] for
-            # the process's unique shards, and the static device->key put
-            # map for rebuilding the master-sharded global array each step
-            self._offload_layout = []
-            self._offload_putmap = []
-            master_leaves, group_of = [], []
-            src_flat = master_dev_flat if master_dev_flat is not None \
-                else master_flat
-            for li, leaf in enumerate(src_flat):
-                msh = self._master_shardings_flat[li]
-                dev_map = msh.addressable_devices_indices_map(leaf.shape)
-                self._offload_putmap.append(
-                    [(d, index_key(i, leaf.shape))
-                     for d, i in dev_map.items()])
-                if master_dev_flat is not None:
-                    # load path: pull only this process's addressable
-                    # shards of the device master (already msh-sharded)
-                    blocks = unique_local_blocks(leaf)
-                    self._offload_layout.append(
-                        [(idx, index_key(idx, leaf.shape), b.shape)
-                         for idx, b in blocks])
-                    for _, b in blocks:
-                        master_leaves.append(np.asarray(b, np.float32))
-                        group_of.append(self._leaf_group_idx[li])
-                else:
-                    # scratch path: slice the host init (every process
-                    # computed the identical full tree — threefry is
-                    # deterministic — so this is pure host-RAM slicing)
-                    blocks = {}
-                    for idx in dev_map.values():
-                        blocks.setdefault(index_key(idx, leaf.shape), idx)
-                    self._offload_layout.append(
-                        [(blocks[k], k, leaf[blocks[k]].shape)
-                         for k in sorted(blocks)])
-                    for k in sorted(blocks):
-                        master_leaves.append(
-                            np.ascontiguousarray(leaf[blocks[k]]))
-                        group_of.append(self._leaf_group_idx[li])
-        elif master_dev_flat is not None:
-            master_leaves = [np.asarray(jax.device_get(l), np.float32)
-                             for l in master_dev_flat]
-            group_of = list(self._leaf_group_idx)
-        else:
-            master_leaves = master_flat
-            group_of = list(self._leaf_group_idx)
-        del master_flat, master_dev_flat
 
         self._offload_opt = HostOffloadOptimizer(
             master_leaves,
@@ -974,7 +984,9 @@ class DeepSpeedEngine:
             self.timers(BACKWARD_MICRO_TIMER).stop()
         loss = self._pending
         self._pending = None
-        if self.monitor.enabled and self.is_gradient_accumulation_boundary():
+        if self.monitor.enabled and getattr(self, "_training", True) and \
+                self.is_gradient_accumulation_boundary():
+            # eval-mode losses must not land in the train-loss stream
             self.monitor.write_events([
                 ("Train/Samples/train_loss", float(jax.device_get(loss)),
                  self.global_samples)])
@@ -1002,9 +1014,10 @@ class DeepSpeedEngine:
         return {k: jnp.asarray(v, jnp.float32)
                 for k, v in self.optimizer.current_hyperparams().items()}
 
-    def _reseed_offload_master(self) -> None:
-        """Rebuild the host fp32 master from the current device params
-        (used when a checkpoint has no host optimizer state)."""
+    def _pull_offload_master_leaves(self) -> List[np.ndarray]:
+        """Current device params as host fp32 arrays in the host
+        optimizer's group order (multi-host: this process's unique
+        blocks only)."""
         if self._offload_multihost:
             from .zero.offload_engine import local_block
             leaves = []
@@ -1016,16 +1029,65 @@ class DeepSpeedEngine:
         else:
             leaves = [np.asarray(jax.device_get(l), np.float32)
                       for l in jax.tree_util.tree_leaves(self.state["params"])]
+        return leaves
+
+    def _zero_offload_residual(self) -> None:
+        """Drop the error-feedback compression residual: it carries the
+        quantization error of the PREVIOUS trajectory, which is wrong to
+        inject into whatever state was just loaded."""
+        if getattr(self, "_offload_compress", "none") != "none":
+            self._offload_resid_leaves = [jnp.zeros_like(r)
+                                          for r in self._offload_resid_leaves]
+
+    def _reseed_offload_master(self) -> None:
+        """Rebuild the host fp32 master from the current device params
+        with FRESH moments (used when a checkpoint has no host optimizer
+        state at all — moments are unrecoverable, so restart them)."""
+        leaves = self._pull_offload_master_leaves()
         self._offload_opt.load_state_dict({
             "step": 0,
             "master": [l.ravel() for l in leaves],
             "m": [np.zeros(l.size, np.float32) for l in leaves],
             "v": [np.zeros(l.size, np.float32) for l in leaves],
         })
-        if getattr(self, "_offload_compress", "none") != "none":
-            # stale error-feedback residual belongs to the old trajectory
-            self._offload_resid_leaves = [jnp.zeros_like(r)
-                                          for r in self._offload_resid_leaves]
+        self._zero_offload_residual()
+
+    def _sync_offload_master_weights(self, overrides=None) -> None:
+        """Overwrite the host fp32 master, KEEPING the Adam moments and
+        step count — a mid-training weight swap (EMA/sync via
+        load_module_state_dict) must not restart the optimizer trajectory
+        (the reference's load_module_state_dict, engine.py:2503, leaves
+        optimizer state intact).
+
+        ``overrides`` maps flat param index -> SOURCE array: those leaves
+        seed the master from the source at full precision (reading them
+        back from the compute-dtype device params would bake 16-bit
+        rounding into the master — the same hazard the separate-master
+        branch avoids by seeding from ``touched``)."""
+        overrides = overrides or {}
+        if self._offload_multihost:
+            from .zero.offload_engine import local_block
+            leaves = []
+            for li, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self.state["params"])):
+                src = overrides.get(li)
+                src32 = None if src is None else np.asarray(src, np.float32)
+                for idx, _, _ in self._offload_layout[li]:
+                    if src32 is not None:
+                        leaves.append(np.ascontiguousarray(src32[idx]))
+                    else:
+                        leaves.append(np.asarray(local_block(leaf, idx),
+                                                 np.float32))
+        else:
+            leaves = []
+            for li, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self.state["params"])):
+                src = overrides.get(li)
+                leaves.append(
+                    np.asarray(src, np.float32) if src is not None
+                    else np.asarray(jax.device_get(leaf), np.float32))
+        self._offload_opt.set_masters(leaves)
+        self._zero_offload_residual()
 
     def _group_hyper(self) -> List[Dict[str, float]]:
         """Per-group scalar hyperparams for this step (scheduler-mutated).
@@ -1357,6 +1419,15 @@ class DeepSpeedEngine:
                                 f"offload_optimizer_rank{self.global_rank}.npz")
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._offload_opt.save(path)
+            if getattr(self, "_offload_compress", "none") != "none":
+                # the error-feedback residual is part of the optimizer
+                # trajectory: persisting it makes resume exact (otherwise
+                # the carried quantization error is silently dropped)
+                np.savez(os.path.join(
+                    save_dir, tag,
+                    f"offload_residual_rank{self.global_rank}.npz"),
+                    **{f"r_{i}": np.asarray(jax.device_get(r), np.float32)
+                       for i, r in enumerate(self._offload_resid_leaves)})
         save_engine_checkpoint(save_dir, tag, self.state, client_state,
                                separate_master=self._separate_master and not offload,
                                save_latest=save_latest,
@@ -1413,6 +1484,25 @@ class DeepSpeedEngine:
                 if os.path.exists(path):
                     self._offload_opt.load(path)
                     loaded = True
+                    if getattr(self, "_offload_compress", "none") != "none":
+                        # restore the error-feedback residual for exact
+                        # resume, else zero it — the pre-load residual
+                        # belongs to the trajectory being replaced
+                        rpath = os.path.join(
+                            os.path.dirname(path),
+                            f"offload_residual_rank{self.global_rank}.npz")
+                        if os.path.exists(rpath):
+                            gsh = jax.tree_util.tree_leaves(
+                                self._out_shardings["grads"])
+                            with np.load(rpath) as z:
+                                self._offload_resid_leaves = [
+                                    jax.device_put(
+                                        z[f"r_{i}"].astype(
+                                            np.dtype(r.dtype)), s)
+                                    for i, (r, s) in enumerate(zip(
+                                        self._offload_resid_leaves, gsh))]
+                        else:
+                            self._zero_offload_residual()
                 else:
                     logger.warning(
                         f"no offload optimizer state at {path}; re-seeding "
@@ -1496,29 +1586,53 @@ class DeepSpeedEngine:
     def load_module_state_dict(self, state_dict, strict: bool = True):
         """Replace the parameters from a pytree of arrays (host or
         device).  ``strict`` requires an exactly matching tree structure;
-        non-strict loads the intersection by flattened position where
-        shapes agree.  Offload engines re-seed the host fp32 master so
-        the next step updates the LOADED weights."""
-        cur_flat, cur_def = jax.tree_util.tree_flatten(self.state["params"])
-        new_flat, new_def = jax.tree_util.tree_flatten(state_dict)
+        non-strict matches leaves by tree path (torch load_state_dict
+        matches by name the same way) and loads those whose shapes agree,
+        warning about the rest.  The fp32 master (separate-master or host
+        offload) syncs to the loaded weights from the source leaves;
+        offload engines keep their Adam moments and step count."""
+        cur_kv, cur_def = jax.tree_util.tree_flatten_with_path(
+            self.state["params"])
+        new_kv, new_def = jax.tree_util.tree_flatten_with_path(state_dict)
         if strict and cur_def != new_def:
             raise ValueError(
                 f"state_dict tree mismatch: {new_def} vs {cur_def}")
+        # match by tree PATH, not flattened position: two structurally
+        # different trees whose leaves happen to align in order must not
+        # load wrong weights into wrong slots (torch load_state_dict
+        # matches by name the same way)
+        new_by_path = {jax.tree_util.keystr(p): l for p, l in new_kv}
         sh_flat = jax.tree_util.tree_leaves(self._out_shardings["params"])
-        out = list(cur_flat)
-        touched = []
-        for i, (cur, psh) in enumerate(zip(cur_flat, sh_flat)):
-            if i >= len(new_flat):
-                break
-            leaf = new_flat[i]
+        out = []
+        touched = []   # (flat index, source leaf)
+        skipped = []
+        for i, ((path, cur), psh) in enumerate(zip(cur_kv, sh_flat)):
+            key = jax.tree_util.keystr(path)
+            leaf = new_by_path.pop(key, None)
+            if leaf is None:
+                if strict:
+                    raise ValueError(f"state_dict is missing leaf {key}")
+                out.append(cur)
+                skipped.append(f"{key} (absent)")
+                continue
             if tuple(leaf.shape) != tuple(cur.shape):
                 if strict:
                     raise ValueError(
-                        f"leaf {i} shape {leaf.shape} != {cur.shape}")
+                        f"leaf {key} shape {leaf.shape} != {cur.shape}")
+                out.append(cur)
+                skipped.append(f"{key} ({leaf.shape} != {cur.shape})")
                 continue
-            out[i] = jax.device_put(
-                jnp.asarray(leaf, dtype=cur.dtype), psh)
-            touched.append(i)
+            out.append(jax.device_put(
+                jnp.asarray(leaf, dtype=cur.dtype), psh))
+            touched.append((i, leaf))
+        if not strict and (skipped or new_by_path):
+            extra = list(new_by_path)
+            logger.warning(
+                f"load_module_state_dict (non-strict): loaded "
+                f"{len(touched)}/{len(cur_kv)} leaves"
+                + (f"; skipped {len(skipped)} ({skipped[:8]}...)"
+                   if skipped else "")
+                + (f"; unmatched source leaves {extra[:8]}" if extra else ""))
         params = jax.tree_util.tree_unflatten(cur_def, out)
         self.state["params"] = params
         if self._separate_master and self._offload_device is None:
@@ -1528,14 +1642,17 @@ class DeepSpeedEngine:
             m_flat = list(jax.tree_util.tree_leaves(self.state["master"]))
             msh_flat = jax.tree_util.tree_leaves(
                 self._out_shardings["master"])
-            for i in touched:
+            for i, leaf in touched:
                 m_flat[i] = jax.device_put(
-                    jnp.asarray(new_flat[i], dtype=jnp.float32), msh_flat[i])
+                    jnp.asarray(leaf, dtype=jnp.float32), msh_flat[i])
             self.state["master"] = jax.tree_util.tree_unflatten(
                 cur_def, m_flat)
         else:
             self.state["master"] = params
         if self._offload_device is not None:
-            # host master re-seeds from the device params (compute dtype
-            # — the reference's construction, stage_1_and_2.py:98)
-            self._reseed_offload_master()
+            # host master syncs to the loaded weights (from the SOURCE
+            # leaves, full precision); moments and step count survive (a
+            # weight swap is not a trajectory restart — reference
+            # load_module_state_dict, engine.py:2503)
+            self._sync_offload_master_weights(
+                overrides={i: leaf for i, leaf in touched})

@@ -252,6 +252,27 @@ class HostOffloadOptimizer:
                 "v": [z[f"v_{i}"] for i in range(n)],
             })
 
+    def set_masters(self, leaves: Sequence[np.ndarray]) -> None:
+        """Overwrite the fp32 master arrays ONLY, preserving the Adam
+        moments and step count — the path for a mid-training weight swap
+        (EMA load, cross-replica sync).  The reference's
+        load_module_state_dict (engine.py:2503) loads module weights
+        without touching optimizer state; a full ``load_state_dict``
+        reseed (zeroed m/v, step 0) silently restarts the optimizer
+        trajectory and is reserved for checkpoint loads that carry no
+        host state at all."""
+        masters = [np.ascontiguousarray(l, np.float32).ravel()
+                   for l in leaves]
+        assert len(masters) == self.num_groups
+        if self._swapper is None:
+            self._master = masters
+        else:
+            for i in range(self.num_groups):
+                state = self._swapper.get(self._key(i))
+                self._swapper.put(self._key(i), {
+                    "master": masters[i], "m": state["m"], "v": state["v"]})
+            self._swapper.flush_writes()
+
     def masters(self) -> List[np.ndarray]:
         """Current fp32 master leaves (reshaped); NVMe mode reads them in."""
         if self._swapper is None:

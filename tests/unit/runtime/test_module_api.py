@@ -83,6 +83,34 @@ def test_eval_mode_is_deterministic_train_mode_is_not():
     assert t1 != t2
 
 
+def test_load_module_state_dict_nonstrict_matches_by_path():
+    """Non-strict load matches leaves by tree path (torch matches by
+    name): a partial state dict updates exactly its own leaves, never
+    whatever happens to align positionally."""
+    a, batch = _build(seed=0)
+    b, _ = _build(seed=9)
+    sd_a = a.module_state_dict()
+    sd_b_before = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), b.module_state_dict())
+    assert isinstance(sd_a, dict) and len(sd_a) > 1
+    key = sorted(sd_a.keys())[-1]
+    b.load_module_state_dict({key: sd_a[key]}, strict=False)
+    sd_b_after = b.module_state_dict()
+    # the named subtree took a's values...
+    for la, lb in zip(jax.tree_util.tree_leaves(sd_a[key]),
+                      jax.tree_util.tree_leaves(sd_b_after[key])):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(la)),
+                                      np.asarray(jax.device_get(lb)))
+    # ...and every other subtree is untouched
+    for k in sd_a:
+        if k == key:
+            continue
+        for lb0, lb1 in zip(jax.tree_util.tree_leaves(sd_b_before[k]),
+                            jax.tree_util.tree_leaves(sd_b_after[k])):
+            np.testing.assert_array_equal(
+                lb0, np.asarray(jax.device_get(lb1)))
+
+
 def test_module_state_dict_roundtrip():
     a, batch = _build(seed=0)
     b, _ = _build(seed=9)

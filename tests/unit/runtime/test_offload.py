@@ -132,6 +132,59 @@ def test_offload_bf16_uploads_bf16_params():
     assert np.isfinite(float(jax.device_get(loss)))
 
 
+def test_load_module_state_dict_keeps_offload_moments():
+    """A mid-training weight swap (EMA/sync) on an offload engine must
+    keep the host Adam moments and step count — the reference's
+    load_module_state_dict (engine.py:2503) loads module weights only."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    reset_mesh_manager()
+    engine, _ = _train(_ds_config(offload_device="cpu"), steps=2)
+    before = engine._offload_opt.state_dict()
+    assert before["step"] == 2
+    assert any(float(np.abs(m).max()) > 0 for m in before["m"])
+    swapped = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) * 1.01 + 0.001,
+        engine.module_state_dict())
+    engine.load_module_state_dict(swapped)
+    after = engine._offload_opt.state_dict()
+    assert after["step"] == before["step"]
+    for ma, mb in zip(after["m"], before["m"]):
+        np.testing.assert_array_equal(ma, mb)
+    for va, vb in zip(after["v"], before["v"]):
+        np.testing.assert_array_equal(va, vb)
+    # ...while the master now tracks the loaded weights
+    swapped_flat = jax.tree_util.tree_leaves(swapped)
+    for m, w in zip(engine._offload_opt.masters(), swapped_flat):
+        np.testing.assert_allclose(m, np.asarray(w, np.float32), rtol=1e-6)
+
+
+def test_load_module_state_dict_offload_master_full_precision():
+    """With bf16 compute, the host master must seed from the SOURCE fp32
+    leaves — a round trip through the bf16 device params would bake
+    rounding error into the trajectory."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh_manager
+    import dataclasses
+    reset_mesh_manager()
+    cfg = _ds_config(offload_device="cpu")
+    cfg["bf16"] = {"enabled": True}
+    mm = initialize_mesh(ParallelDims(dp=-1))
+    model_cfg = dataclasses.replace(_tiny_config(), dtype=jnp.bfloat16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=from_gpt(model_cfg), config=cfg, mesh_manager=mm,
+        rng=jax.random.PRNGKey(0))
+    # fp32 values with low-mantissa bits a bf16 round trip would destroy
+    rng = np.random.default_rng(3)
+    src = jax.tree_util.tree_map(
+        lambda x: (rng.standard_normal(x.shape) * (1 + 1e-5))
+        .astype(np.float32),
+        jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                               engine.module_state_dict()))
+    engine.load_module_state_dict(src)
+    for m, s in zip(engine._offload_opt.masters(),
+                    jax.tree_util.tree_leaves(src)):
+        np.testing.assert_array_equal(m, s)  # bit-exact, not bf16-rounded
+
+
 def test_offload_load_without_optimizer_state_reseeds_master(tmp_path):
     """A checkpoint without the host npz must re-seed the master from the
     loaded params — not step from the stale init-time master."""
