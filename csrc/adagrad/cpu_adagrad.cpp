@@ -2,8 +2,8 @@
  *
  * Counterpart of the reference's csrc/adagrad/cpu_adagrad.cpp
  * (adagrad_update/adagrad_update_copy bindings at cpu_adagrad.cpp:221-226).
- * Same structure as cpu_adam.cpp: C ABI, AVX2 + scalar tail, threaded,
- * fused bf16 copy-out for device upload.
+ * Same structure as cpu_adam.cpp: C ABI, AVX-512/AVX2 tiles + scalar
+ * tail, threaded, fused bf16 copy-out for device upload.
  */
 
 #include "../includes/ds_cpu_math.h"
@@ -20,6 +20,26 @@ inline void adagrad_span(float* p, const float* g, float* h, uint16_t* p_bf16,
                          size_t begin, size_t end, float lr, float eps,
                          float wd) {
     size_t i = begin;
+#if defined(__AVX512F__)
+    // 512-bit tiles (the reference's cpu_adagrad.h widest path)
+    const __m512 wlr = _mm512_set1_ps(lr);
+    const __m512 weps = _mm512_set1_ps(eps);
+    const __m512 wwd = _mm512_set1_ps(wd);
+    for (; i + 16 <= end; i += 16) {
+        __m512 gp = _mm512_loadu_ps(g + i);
+        __m512 pp = _mm512_loadu_ps(p + i);
+        gp = _mm512_fmadd_ps(wwd, pp, gp);
+        __m512 hp = _mm512_fmadd_ps(gp, gp, _mm512_loadu_ps(h + i));
+        _mm512_storeu_ps(h + i, hp);
+        __m512 upd = _mm512_div_ps(
+            gp, _mm512_add_ps(_mm512_sqrt_ps(hp), weps));
+        pp = _mm512_fnmadd_ps(wlr, upd, pp);
+        _mm512_storeu_ps(p + i, pp);
+        if (p_bf16)
+            _mm256_storeu_si256((__m256i*)(p_bf16 + i),
+                                ds_tpu::bf16_pack_rne16(pp));
+    }
+#endif
 #if defined(__AVX2__) && defined(__FMA__)
     const __m256 vlr = _mm256_set1_ps(lr);
     const __m256 veps = _mm256_set1_ps(eps);

@@ -30,6 +30,26 @@ inline uint16_t float_to_bf16(float f) {
     return (uint16_t)(bits >> 16);
 }
 
+#if defined(__AVX512F__)
+// 16-lane float32 -> bfloat16 with round-to-nearest-even, bit-identical
+// to float_to_bf16 above (including quiet-NaN payloads).
+inline __m256i bf16_pack_rne16(__m512 x) {
+    const __m512i bits = _mm512_castps_si512(x);
+    const __m512i absb = _mm512_and_epi32(bits, _mm512_set1_epi32(0x7fffffff));
+    const __mmask16 is_nan = _mm512_cmp_epu32_mask(
+        absb, _mm512_set1_epi32(0x7f800000), _MM_CMPINT_GT);
+    const __m512i lsb = _mm512_and_epi32(_mm512_srli_epi32(bits, 16),
+                                         _mm512_set1_epi32(1));
+    const __m512i rounded = _mm512_add_epi32(
+        bits, _mm512_add_epi32(lsb, _mm512_set1_epi32(0x7fff)));
+    const __m512i nan16 = _mm512_or_epi32(_mm512_srli_epi32(bits, 16),
+                                          _mm512_set1_epi32(0x40));
+    const __m512i res = _mm512_mask_blend_epi32(
+        is_nan, _mm512_srli_epi32(rounded, 16), nan16);
+    return _mm512_cvtepi32_epi16(res);
+}
+#endif
+
 // Run fn(begin, end) over [0, n) split across up to max_threads workers.
 template <typename F>
 inline void parallel_for(size_t n, int max_threads, F&& fn) {
