@@ -1,8 +1,10 @@
 from .sparse_self_attention import SparseSelfAttention
 from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
                               DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig,
                               SparsityConfig, VariableSparsityConfig)
 
 __all__ = ["SparseSelfAttention", "SparsityConfig", "DenseSparsityConfig",
            "FixedSparsityConfig", "VariableSparsityConfig",
-           "BigBirdSparsityConfig", "BSLongformerSparsityConfig"]
+           "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+           "LocalSlidingWindowSparsityConfig"]

@@ -235,6 +235,38 @@ class BigBirdSparsityConfig(SparsityConfig):
         return layout
 
 
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Purely-local sliding window attention — each query block sees the
+    ``num_sliding_window_blocks``-wide band around its diagonal and nothing
+    else (reference LocalSlidingWindowSparsityConfig,
+    sparsity_config.py:686).  Unidirectional keeps only the trailing half
+    of the band (the causal prefix)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 num_sliding_window_blocks: int = 3,
+                 attention: str = "unidirectional"):
+        super().__init__(num_heads, block)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(f"bad attention type {attention!r}")
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.attention = attention
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        n = self.check_seq(seq_len)
+        if n < self.num_sliding_window_blocks:
+            raise ValueError(
+                f"num_sliding_window_blocks {self.num_sliding_window_blocks} "
+                f"exceeds the {n} blocks in a row")
+        H = self.num_layout_heads
+        layout = np.zeros((H, n, n), np.int64)
+        w = self.num_sliding_window_blocks // 2
+        for r in range(n):
+            end = min(r + w + 1, n) if self.attention == "bidirectional" \
+                else r + 1
+            layout[:, r, max(0, r - w):end] = 1
+        return layout
+
+
 class BSLongformerSparsityConfig(SparsityConfig):
     """Block-sparse Longformer: sliding window + designated global blocks
     (reference BSLongformerSparsityConfig)."""

@@ -76,6 +76,34 @@ def test_longformer_layout():
     assert not lay[0, 3, 10]  # far off-window, non-global
 
 
+def test_local_sliding_window_layout():
+    from deepspeed_tpu.ops.sparse_attention import \
+        LocalSlidingWindowSparsityConfig
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=16,
+                                           num_sliding_window_blocks=5,
+                                           attention="bidirectional")
+    lay = cfg.make_layout(16 * 10)
+    n = 10
+    assert lay.shape == (2, n, n)
+    for r in range(n):
+        lo, hi = max(0, r - 2), min(n, r + 3)
+        assert lay[0, r, lo:hi].all()          # band present
+        assert lay[0, r].sum() == hi - lo      # and NOTHING else
+    # unidirectional drops the leading half of the band
+    uni = LocalSlidingWindowSparsityConfig(
+        num_heads=1, block=16, num_sliding_window_blocks=5,
+        attention="unidirectional").make_layout(16 * 10)
+    assert not np.triu(uni[0], k=1).any()
+    for r in range(n):
+        lo = max(0, r - 2)
+        assert uni[0, r].sum() == r + 1 - lo
+    # band wider than the sequence is rejected
+    with pytest.raises(ValueError):
+        LocalSlidingWindowSparsityConfig(
+            num_heads=1, block=16,
+            num_sliding_window_blocks=9).make_layout(16 * 4)
+
+
 def test_variable_layout_windows_and_globals():
     cfg = VariableSparsityConfig(num_heads=1, block=16,
                                  local_window_blocks=[2, 4],
