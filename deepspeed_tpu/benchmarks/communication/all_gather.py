@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: all_gather (reference benchmarks/communication/all_gather.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.all_gather [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("all_gather", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: all_reduce (reference benchmarks/communication/all_reduce.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.all_reduce [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("all_reduce", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

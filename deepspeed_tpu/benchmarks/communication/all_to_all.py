@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: all_to_all (reference benchmarks/communication/all_to_all.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.all_to_all [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("all_to_all", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: broadcast (reference benchmarks/communication/broadcast.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.broadcast [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("broadcast", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: pt2pt (reference benchmarks/communication/pt2pt.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.pt2pt [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("pt2pt", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

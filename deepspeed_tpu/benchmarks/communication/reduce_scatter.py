@@ -1,0 +1,14 @@
+"""Per-op benchmark entry: reduce_scatter (reference benchmarks/communication/reduce_scatter.py).
+
+Usage: python -m deepspeed_tpu.benchmarks.communication.reduce_scatter [--scan] ...
+"""
+from .utils import per_op_main
+
+
+def main(argv=None) -> int:
+    return per_op_main("reduce_scatter", argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

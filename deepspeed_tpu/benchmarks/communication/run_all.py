@@ -7,16 +7,15 @@ latency + algorithmic/bus bandwidth via the same ``get_bw`` accounting
 (utils/comms_logging.py).  Collectives run inside ``shard_map`` over the
 global mesh's flattened axis — on hardware they lower to ICI
 all-reduce/all-gather/collective-permute, exactly the ops training issues.
+
+Per-op entry points (``python -m ...communication.all_reduce --scan``)
+mirror the reference's per-op files; this module is the aggregate runner.
 """
 
 from __future__ import annotations
 
 import argparse
-import time
-from functools import partial
 from typing import Callable, Dict, List
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -24,82 +23,48 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ...utils.comms_logging import get_bw
 from ...utils.logging import logger
-
-AXIS = "bench"
-
-
-def _mesh() -> Mesh:
-    return Mesh(np.array(jax.devices()), (AXIS,))
+from .utils import (AXIS, DTYPES, bench_mesh, benchmark_parser, measure,
+                    print_results, sizes_from_args)
 
 
-def _timed(fn: Callable, x, iters: int, warmup: int) -> float:
-    for _ in range(max(warmup, 1)):  # at least once: compile outside timing
-        out = fn(x)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(x)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
-def _build(op: str, mesh: Mesh) -> Callable:
+def build_op(op: str, mesh: Mesh) -> Callable:
     n = mesh.devices.size
 
     if op == "all_reduce":
         body = lambda x: lax.psum(x, AXIS)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     elif op == "all_gather":
         body = lambda x: lax.all_gather(x, AXIS, tiled=True)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     elif op == "reduce_scatter":
         body = lambda x: lax.psum_scatter(x, AXIS, tiled=True)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     elif op == "all_to_all":
         def body(x):
             s = x.reshape(n, -1)
             return lax.all_to_all(s, AXIS, 0, 0, tiled=False).reshape(-1)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     elif op == "broadcast":
         def body(x):
             # root's data to everyone: psum of masked input
             idx = lax.axis_index(AXIS)
             return lax.psum(jnp.where(idx == 0, x, jnp.zeros_like(x)), AXIS)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     elif op == "pt2pt":
         def body(x):
             # neighbor exchange ring: the ICI point-to-point path
             perm = [(i, (i + 1) % n) for i in range(n)]
             return lax.ppermute(x, AXIS, perm)
-        in_spec, out_spec = P(AXIS), P(AXIS)
     else:
         raise ValueError(f"unknown op {op}")
 
-    f = shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+    f = shard_map(body, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
                   check_vma=False)
     return jax.jit(f)
 
 
 def run_op(op: str, sizes_bytes: List[int], dtype=jnp.bfloat16,
            iters: int = 20, warmup: int = 5) -> List[Dict]:
-    mesh = _mesh()
-    n = mesh.devices.size
-    fn = _build(op, mesh)
-    itemsize = jnp.zeros((), dtype).dtype.itemsize
-    results = []
-    for size in sizes_bytes:
-        elems = max(n, size // itemsize)
-        elems = (elems // n) * n  # divisible for sharding
-        x = jnp.ones((elems,), dtype)
-        dt = _timed(fn, x, iters, warmup)
-        msg_bytes = elems * itemsize
-        algbw, busbw = get_bw("ppermute" if op == "pt2pt" else op,
-                              msg_bytes, dt, n)
-        results.append({"op": op, "bytes": msg_bytes, "latency_us": dt * 1e6,
-                        "algbw_gbps": algbw, "busbw_gbps": busbw})
-    return results
+    """Programmatic entry (kept for tests and external callers)."""
+    mesh = bench_mesh()
+    return measure(op, build_op(op, mesh), sizes_bytes, dtype, iters,
+                   warmup, mesh.devices.size)
 
 
 DEFAULT_OPS = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
@@ -107,6 +72,7 @@ DEFAULT_OPS = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
 
 
 def print_table(results: List[Dict]) -> None:
+    """Back-compat plain table (Gbps)."""
     print(f"{'op':16} {'size':>12} {'latency(us)':>12} "
           f"{'algbw(Gbps)':>12} {'busbw(Gbps)':>12}")
     for r in results:
@@ -115,27 +81,32 @@ def print_table(results: List[Dict]) -> None:
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description="deepspeed_tpu comm bench")
+    parser = benchmark_parser()
     parser.add_argument("--ops", nargs="*", default=DEFAULT_OPS,
                         choices=DEFAULT_OPS)
-    parser.add_argument("--minsize", type=int, default=1 << 16)
-    parser.add_argument("--maxsize", type=int, default=1 << 26)
-    parser.add_argument("--iters", type=int, default=20)
-    parser.add_argument("--warmup", type=int, default=5)
-    parser.add_argument("--dtype", default="bfloat16",
-                        choices=["bfloat16", "float32"])
+    # back-compat aliases for the old runner's flag names
+    parser.add_argument("--iters", type=int, default=None,
+                        help="alias for --trials")
+    parser.add_argument("--warmup", type=int, default=None,
+                        help="alias for --warmups")
+    parser.set_defaults(mem_size=None)  # so an explicit value is visible
     args = parser.parse_args(argv)
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    sizes = []
-    s = args.minsize
-    while s <= args.maxsize:
-        sizes.append(s)
-        s *= 4
+    if args.iters is not None:
+        args.trials = args.iters
+    if args.warmup is not None:
+        args.warmups = args.warmup
+    if not args.scan and args.elements is None and args.mem_size is None:
+        # the aggregate runner defaults to a scan (the old behavior)
+        args.scan = True
+    if args.mem_size is None:
+        args.mem_size = "64MB"
+    dtype = DTYPES[args.dtype]
+    sizes = sizes_from_args(args)
     logger.info(f"devices: {len(jax.devices())} ({jax.default_backend()})")
     all_results = []
     for op in args.ops:
-        all_results += run_op(op, sizes, dtype, args.iters, args.warmup)
-    print_table(all_results)
+        all_results += run_op(op, sizes, dtype, args.trials, args.warmups)
+    print_results(all_results, args)
     return 0
 
 

@@ -1,0 +1,57 @@
+"""Collective benchmark suite (``ds_bench``): every op builds, runs on the
+8-device CPU mesh, and reports sane bandwidth accounting (reference
+``benchmarks/communication/`` + ``bin/ds_bench``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.benchmarks.communication.run_all import (DEFAULT_OPS,
+                                                            main, run_op)
+from deepspeed_tpu.benchmarks.communication.utils import parse_mem_size
+
+
+@pytest.mark.parametrize("op", DEFAULT_OPS)
+def test_each_op_runs_and_reports(op):
+    rows = run_op(op, [1 << 12], iters=2, warmup=1)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["op"] == op and r["bytes"] >= 1 << 12
+    assert r["latency_us"] > 0 and r["algbw_gbps"] > 0
+    # busbw correction never exceeds 2x algbw (all-reduce's factor)
+    assert r["busbw_gbps"] <= 2 * r["algbw_gbps"] + 1e-9
+
+
+def test_scan_mode_ladder(capsys):
+    rc = main(["--ops", "all_reduce", "--scan", "--minsize", "4096",
+               "--maxsize", "16384", "--step-factor", "2",
+               "--trials", "1", "--warmups", "1", "--raw"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    # header + 3 ladder rungs (4k, 8k, 16k)
+    assert out[0].startswith("op,bytes")
+    assert len(out) == 4
+
+
+def test_single_size_and_units(capsys):
+    rc = main(["--ops", "broadcast", "--mem-size", "1MB",
+               "--trials", "1", "--warmups", "1", "--bw-unit", "GBps"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "GBps" in out and "broadcast" in out
+
+
+def test_per_op_entry_point(capsys):
+    from deepspeed_tpu.benchmarks.communication.all_gather import main as m
+    rc = m(["--elements", "4096", "--trials", "1", "--warmups", "1",
+            "--raw"])
+    assert rc == 0
+    assert "all_gather" in capsys.readouterr().out
+
+
+def test_parse_mem_size():
+    assert parse_mem_size("64MB") == 64 << 20
+    assert parse_mem_size("512KB") == 512 << 10
+    assert parse_mem_size("1GB") == 1 << 30
+    assert parse_mem_size("4096") == 4096
+    with pytest.raises(ValueError):
+        parse_mem_size("lots")
