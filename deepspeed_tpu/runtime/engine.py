@@ -124,6 +124,7 @@ class DeepSpeedEngine:
         self._configure_lr_scheduler(lr_scheduler)
         self._init_state(rng)
         self._build_steps()
+        self._init_param_spill()
 
         # progressive layer drop + curriculum (reference engine.py:1554/1559
         # construction, :1698-1710 per-forward injection)
@@ -343,6 +344,62 @@ class DeepSpeedEngine:
                 lambda _: NamedSharding(self.mesh, P()), self.state["scale"]),
         }
         self._last_global_norm: Optional[float] = None
+
+    def _init_param_spill(self) -> None:
+        """ZeRO-Infinity parameter NVMe spill: with
+        ``offload_param.device="nvme"`` (stage 3), the stored params live
+        in per-leaf swap files BETWEEN optimizer steps — restored with
+        async read-ahead before the gas window, re-spilled after each
+        boundary step (reference AsyncPartitionedParameterSwapper,
+        partitioned_param_swapper.py:35).  Host-RAM peak for the swap
+        path is bounded by ``buffer_count`` block buffers
+        (``max_in_cpu`` enforces the cap), so params need fit neither
+        HBM-between-steps nor host RAM."""
+        self._param_spill = None
+        pcfg = self._config.zero_config.offload_param_config
+        if pcfg.device != "nvme":
+            return
+        if self._config.zero_optimization_stage < 3:
+            # partitioner already warned (reference config semantics)
+            return
+        from .swap_tensor.partitioned_param_swapper import \
+            PartitionedParamSwapper
+        if not pcfg.nvme_path:
+            raise DeepSpeedConfigError(
+                "offload_param.device='nvme' requires offload_param.nvme_path")
+        self._param_spill = PartitionedParamSwapper(
+            os.path.join(pcfg.nvme_path, f"param_rank{self.global_rank}"),
+            aio_config=self._config.aio_config,
+            buffer_count=pcfg.buffer_count,
+            ram_cap_bytes=int(pcfg.max_in_cpu) if pcfg.max_in_cpu else None)
+        self._spill_params()
+        log_dist(
+            f"[offload] params spilled to NVMe at {pcfg.nvme_path} "
+            f"({self._param_spill.swapped_bytes() / 1e6:.1f} MB, "
+            f"buffer_count={pcfg.buffer_count})", ranks=[0])
+
+    def _spill_params(self) -> None:
+        if self._param_spill is None or self._param_spill.spilled:
+            return
+        flat, self._spill_treedef = jax.tree_util.tree_flatten(
+            self.state["params"])
+        master_is_params = self.state["master"] is self.state["params"]
+        self._param_spill.spill(flat)
+        del flat
+        self.state["params"] = None  # device copies dropped
+        if master_is_params:
+            self.state["master"] = None
+
+    def _ensure_params_resident(self) -> None:
+        """Restore spilled params before any consumer touches them."""
+        if self._param_spill is None or not self._param_spill.spilled:
+            return
+        sh_flat = jax.tree_util.tree_leaves(self._out_shardings["params"])
+        flat = self._param_spill.restore(sh_flat)
+        params = jax.tree_util.tree_unflatten(self._spill_treedef, flat)
+        self.state["params"] = params
+        if self.state["master"] is None:
+            self.state["master"] = params
 
     def _resolve_grad_accum_dtype(self):
         """``data_types.grad_accum_dtype`` (reference engine.py:809
@@ -951,6 +1008,7 @@ class DeepSpeedEngine:
 
     def forward(self, batch, **kwargs):
         """Compute loss (and, fused, the gradients) for one micro-batch."""
+        self._ensure_params_resident()
         if not getattr(self, "_training", True):
             # engine.eval(): a validation forward must not contaminate the
             # gradient accumulator (the fused micro step would add the val
@@ -1018,6 +1076,7 @@ class DeepSpeedEngine:
         """Current device params as host fp32 arrays in the host
         optimizer's group order (multi-host: this process's unique
         blocks only)."""
+        self._ensure_params_resident()
         if self._offload_multihost:
             from .zero.offload_engine import local_block
             leaves = []
@@ -1288,6 +1347,7 @@ class DeepSpeedEngine:
     def _take_model_step(self, lr_kwargs=None) -> None:
         if self._offload_device is not None:
             overflow_host = self._apply_offload_step()
+            self._spill_params()
             self._finish_model_step(overflow_host, lr_kwargs)
             return
         s = self.state
@@ -1306,6 +1366,7 @@ class DeepSpeedEngine:
         s["grad_acc"] = zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm  # device scalar; float() lazily
+        self._spill_params()
         self._finish_model_step(bool(overflow), lr_kwargs)
 
     def _finish_model_step(self, overflow_host: bool, lr_kwargs=None) -> None:
@@ -1347,6 +1408,7 @@ class DeepSpeedEngine:
                 self.backward()
                 self.step()
             return jnp.mean(jnp.stack(losses))
+        self._ensure_params_resident()
         s = self.state
         batches = self._apply_curriculum(batches)
         batches = jax.tree_util.tree_map(
@@ -1382,6 +1444,7 @@ class DeepSpeedEngine:
         s["grad_acc"] = zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm
+        self._spill_params()
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
         self._finish_model_step(bool(overflow))
@@ -1389,6 +1452,7 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ eval
     def eval_loss(self, batch):
+        self._ensure_params_resident()
         batch = self._inject_compression_step(batch)
         batch = self._shard_batch(batch)
         if not hasattr(self, "_eval_jit"):
@@ -1399,6 +1463,7 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True) -> bool:
         from .checkpoint_engine.native_checkpoint_engine import save_engine_checkpoint
+        self._ensure_params_resident()
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -1456,6 +1521,7 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from .checkpoint_engine.native_checkpoint_engine import load_engine_checkpoint
+        self._ensure_params_resident()  # state acts as the load template
         if self._checkpoint_engine is not None:
             # never read our own in-flight async writes (also re-raises a
             # background write failure here instead of losing it)
@@ -1581,6 +1647,7 @@ class DeepSpeedEngine:
     def module_state_dict(self):
         """The current parameter pytree (compute-dtype device arrays) —
         the SPMD stand-in for the reference's torch state_dict."""
+        self._ensure_params_resident()
         return self.state["params"]
 
     def load_module_state_dict(self, state_dict, strict: bool = True):
@@ -1591,6 +1658,7 @@ class DeepSpeedEngine:
         warning about the rest.  The fp32 master (separate-master or host
         offload) syncs to the loaded weights from the source leaves;
         offload engines keep their Adam moments and step count."""
+        self._ensure_params_resident()
         cur_kv, cur_def = jax.tree_util.tree_flatten_with_path(
             self.state["params"])
         new_kv, new_def = jax.tree_util.tree_flatten_with_path(state_dict)

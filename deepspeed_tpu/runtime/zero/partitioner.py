@@ -158,11 +158,11 @@ class ZeroPartitioner:
                 "(memory_kind='pinned_host'); backend %r keeps params in "
                 "device memory", jax.default_backend())
             return None
-        if device == "nvme":
-            logger.warning(
-                "offload_param.device='nvme' has no NVMe spill path on "
-                "TPU yet; params pin to host RAM instead (nvme_path and "
-                "buffer knobs ignored) — ensure host RAM holds the shards")
+        # device == "nvme" composes: between steps the engine's
+        # PartitionedParamSwapper holds the shards in swap files
+        # (swap_tensor/partitioned_param_swapper.py); during the step
+        # window they restore to pinned_host and XLA streams layers to
+        # HBM — ZeRO-Infinity parameter offload end to end.
         return "pinned_host"
 
     def plan(self) -> ZeroShardings:
