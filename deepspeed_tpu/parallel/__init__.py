@@ -1,7 +1,7 @@
 from .mesh import (MeshManager, ParallelDims, build_mesh, get_mesh_manager,  # noqa: F401
                    initialize_mesh, reset_mesh_manager, set_mesh_manager,
-                   DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
-                   DP_GROUP, EDP_GROUP, EP_GROUP, TP_GROUP, PP_GROUP, SP_GROUP)
+                   DATA_AXIS, DCN_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   DP_GROUP, DCN_GROUP, EDP_GROUP, EP_GROUP, TP_GROUP, PP_GROUP, SP_GROUP)
 from .topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,  # noqa: F401
                        ProcessTopology)
 from .sequence import (ring_attention, sp_attention, ulysses_attention)  # noqa: F401

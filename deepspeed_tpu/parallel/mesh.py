@@ -29,13 +29,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils.logging import logger
 
 # Canonical mesh axis names.
+DCN_AXIS = "dcn"
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+#: ``dcn`` is the slow inter-slice axis (multi-slice/multi-pod data
+#: parallelism over the data-center network, the reference's multi-NODE
+#: dimension); it is outermost so its collectives cross the slow links
+#: as rarely as possible.  Size 1 on a single slice — harmless.
+MESH_AXES = (DCN_AXIS, PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,23 +52,26 @@ class ParallelDims:
     pp: int = 1
     sp: int = 1
     ep: int = 1
+    dcn: int = 1
 
     def resolve(self, n_devices: int) -> "ParallelDims":
         dp = self.dp
-        fixed = self.tp * self.pp * self.sp
+        fixed = self.tp * self.pp * self.sp * self.dcn
         if dp == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"device count {n_devices} not divisible by tp*pp*sp={fixed}")
+                    f"device count {n_devices} not divisible by "
+                    f"tp*pp*sp*dcn={fixed}")
             dp = n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"dp*tp*pp*sp = {dp * fixed} != device count {n_devices}")
+                f"dp*tp*pp*sp*dcn = {dp * fixed} != device count {n_devices}")
         if self.ep > dp:
             raise ValueError(f"expert parallel degree {self.ep} > data degree {dp}")
         if dp % self.ep != 0:
             raise ValueError(f"dp={dp} not divisible by ep={self.ep}")
-        return ParallelDims(dp=dp, tp=self.tp, pp=self.pp, sp=self.sp, ep=self.ep)
+        return ParallelDims(dp=dp, tp=self.tp, pp=self.pp, sp=self.sp,
+                            ep=self.ep, dcn=self.dcn)
 
 
 def build_mesh(dims: ParallelDims, devices: Optional[Sequence] = None) -> Mesh:
@@ -81,7 +89,7 @@ def build_mesh(dims: ParallelDims, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices)
     dims = dims.resolve(len(devices))
     edp = dims.dp // dims.ep
-    shape = (dims.pp, edp, dims.ep, dims.sp, dims.tp)
+    shape = (dims.dcn, dims.pp, edp, dims.ep, dims.sp, dims.tp)
 
     try:
         from jax.experimental import mesh_utils
@@ -95,11 +103,13 @@ def build_mesh(dims: ParallelDims, devices: Optional[Sequence] = None) -> Mesh:
 
 # Axis-name aliases for common "groups": any collective over these names is
 # the TPU equivalent of the reference's corresponding process group.
-DP_GROUP: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS)  # full data-parallel world
+#: full data-parallel world: slices (dcn) x intra-slice dp (data, expert)
+DP_GROUP: Tuple[str, ...] = (DCN_AXIS, DATA_AXIS, EXPERT_AXIS)
 EDP_GROUP: Tuple[str, ...] = (DATA_AXIS,)             # expert-data parallel
 EP_GROUP: Tuple[str, ...] = (EXPERT_AXIS,)            # expert parallel
 TP_GROUP: Tuple[str, ...] = (MODEL_AXIS,)             # tensor/model parallel
 PP_GROUP: Tuple[str, ...] = (PIPE_AXIS,)              # pipeline parallel
+DCN_GROUP: Tuple[str, ...] = (DCN_AXIS,)              # inter-slice (slow) data parallel
 SP_GROUP: Tuple[str, ...] = (SEQ_AXIS,)               # sequence/context parallel
 
 
@@ -145,6 +155,10 @@ class MeshManager:
     @property
     def ep_world_size(self) -> int:
         return self.axis_size(*EP_GROUP)
+
+    @property
+    def dcn_world_size(self) -> int:
+        return self.axis_size(*DCN_GROUP)
 
     # --- sharding helpers -------------------------------------------------
     def sharding(self, *spec) -> NamedSharding:

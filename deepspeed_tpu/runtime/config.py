@@ -302,6 +302,17 @@ class DeepSpeedConfig:
         self.sequence_parallel_size = sp.get("size", 1) if sp.get("enabled", bool(sp)) else 1
         self.sequence_parallel_mode = sp.get("mode", "ring")
         self.mesh_dims = pd.get(C.MESH, None)
+        # inter-slice (DCN) gradient reduction compression: "none" |
+        # "onebit" — routes the gas-boundary reduction over the slow
+        # 'dcn' mesh axis through the error-feedback 1-bit collective
+        # (the reference's 1-bit comm backends, runtime/comm/nccl.py:51)
+        dcn = pd.get("dcn", {}) or {}
+        self.dcn_grad_compression = str(
+            dcn.get("grad_compression", "none")).lower()
+        if self.dcn_grad_compression not in ("none", "onebit"):
+            raise DeepSpeedConfigError(
+                f"dcn.grad_compression={self.dcn_grad_compression!r} "
+                "(want 'none' or 'onebit')")
 
         pipe = pd.get(C.PIPELINE, {})
         self.pipeline = pipe

@@ -32,14 +32,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm import comm as dist
 from ..models.partitioning import FSDP_RULES, TP_RULES, tree_specs, validate_specs
 from ..ops.optimizer import (TpuOptimizer, get_optimizer_class,
                              resolve_param_groups)
-from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MeshManager, ParallelDims,
-                             get_mesh_manager, initialize_mesh)
+from ..parallel.mesh import (DATA_AXIS, DCN_AXIS, EXPERT_AXIS, MeshManager,
+                             ParallelDims, get_mesh_manager, initialize_mesh)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                            FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
@@ -118,6 +119,26 @@ class DeepSpeedEngine:
         _ocfg = self._config.zero_config.offload_optimizer_config
         self._offload_device = _ocfg.device if _ocfg.device != "none" else None
         self._offload_cfg = _ocfg
+
+        # inter-slice (DCN) data parallelism: grads accumulate PER SLICE
+        # (leading [n_dcn] dim) and cross the slow axis only once per
+        # boundary step — full-precision mean, or the error-feedback
+        # 1-bit collective (reference runtime/comm/nccl.py:51) under
+        # dcn.grad_compression="onebit"
+        self._dcn_n = int(self.mesh.shape.get(DCN_AXIS, 1))
+        self._dcn_mode = self._dcn_n > 1
+        self._dcn_compress = self._config.dcn_grad_compression
+        if self._dcn_compress != "none" and not self._dcn_mode:
+            raise DeepSpeedConfigError(
+                "dcn.grad_compression needs a multi-slice mesh "
+                "(ParallelDims(dcn=...) > 1)")
+        if self._dcn_mode and self._offload_device is not None:
+            raise DeepSpeedConfigError(
+                "dcn>1 does not compose with offload_optimizer yet")
+        if self._dcn_mode and self.module.meta.get("pipeline"):
+            raise DeepSpeedConfigError(
+                "dcn>1 does not compose with the pipeline engine yet")
+        self._dcn_reduce = None
 
         self._configure_sharding()
         self._configure_optimizer(optimizer, model_parameters)
@@ -308,23 +329,36 @@ class DeepSpeedEngine:
                 master = self.module.init_fn(rng)
             master = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), master)
             opt_state = self.optimizer.init(master)
-            grad_acc = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), master)
+            if self._dcn_mode:
+                # per-slice partial sums: leading [n_dcn] dim, collapsed
+                # across the slow axis only at the boundary step
+                grad_acc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((self._dcn_n,) + p.shape,
+                                        self.grad_accum_dtype), master)
+            else:
+                grad_acc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, self.grad_accum_dtype), master)
             if separate:
                 params = jax.tree_util.tree_map(
                     lambda p: p.astype(self.compute_dtype), master)
                 return params, master, opt_state, grad_acc
             return master, opt_state, grad_acc
 
+        grads_sh = sh.grads
+        if self._dcn_mode:
+            grads_sh = jax.tree_util.tree_map(
+                lambda ns: NamedSharding(self.mesh,
+                                         P(DCN_AXIS, *tuple(ns.spec))),
+                sh.grads)
         shapes = jax.eval_shape(init_all, rng)
         if separate:
             opt_sh = sh.opt_state_fn(shapes[2])
-            out_sh = (sh.params, sh.master, opt_sh, sh.grads)
+            out_sh = (sh.params, sh.master, opt_sh, grads_sh)
             params, master, opt_state, grad_acc = jax.jit(
                 init_all, out_shardings=out_sh)(rng)
         else:
             opt_sh = sh.opt_state_fn(shapes[1])
-            out_sh = (sh.params, opt_sh, sh.grads)
+            out_sh = (sh.params, opt_sh, grads_sh)
             params, opt_state, grad_acc = jax.jit(
                 init_all, out_shardings=out_sh)(rng)
             master = params  # same tree; no duplicate memory
@@ -339,11 +373,74 @@ class DeepSpeedEngine:
         }
         self._out_shardings = {
             "params": sh.params, "master": sh.master, "opt_state": opt_sh,
-            "grads": sh.grads,
+            "grads": grads_sh,
             "scale": jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), self.state["scale"]),
         }
         self._last_global_norm: Optional[float] = None
+        if self._dcn_mode:
+            self._init_dcn_reduce(grad_acc, grads_sh)
+
+    def _init_dcn_reduce(self, grad_acc, grads_sh) -> None:
+        """Boundary-step collapse of the per-slice gradient partials
+        across the slow axis: full-precision mean, or the error-feedback
+        1-bit collective (reference NcclBackend.compressed_allreduce,
+        runtime/comm/nccl.py:51) with per-slice worker error and
+        slice-owned server-chunk error, both device-resident.
+
+        Each collapse jit donates the stacked accumulator and returns its
+        zeroed alias next to the collapsed grads, so the boundary never
+        holds two stacked trees (the non-dcn path gets the same property
+        from apply_core's zero_acc aliasing)."""
+        mesh = self.mesh
+        grad_specs = self.zero_partitioner.grad_specs()
+
+        def constrain_grads(tree):
+            return jax.tree_util.tree_map(
+                lambda x, sp: lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, sp)), tree, grad_specs)
+
+        def mean_of(stacked):
+            return constrain_grads(jax.tree_util.tree_map(
+                lambda a: jnp.mean(a.astype(jnp.float32), axis=0)
+                .astype(a.dtype), stacked))
+
+        def zeroed(stacked):
+            return jax.tree_util.tree_map(jnp.zeros_like, stacked)
+
+        self._dcn_mean_jit = jax.jit(
+            lambda acc: (mean_of(acc), zeroed(acc)),
+            donate_argnums=(0,), out_shardings=(None, grads_sh))
+        if self._dcn_compress == "onebit":
+            from .comm.compressed import compressed_grad_reduce_tree
+            self._dcn_reduce = compressed_grad_reduce_tree(mesh, DCN_AXIS)
+            we_shape, se_shape = self._dcn_reduce.ef_shapes(grad_acc)
+            ef_sh = NamedSharding(mesh, P(DCN_AXIS))
+            self._dcn_we = jax.device_put(
+                jnp.zeros(we_shape, jnp.float32), ef_sh)
+            self._dcn_se = jax.device_put(
+                jnp.zeros(se_shape, jnp.float32), ef_sh)
+            #: loss scale the EF residual is denominated in (the acc is
+            #: loss-scaled; a scale change rescales the residual exactly)
+            self._dcn_ef_scale = float(jax.device_get(
+                self.state["scale"]["loss_scale"])) \
+                if "scale" in getattr(self, "state", {}) else 1.0
+            reduce = self._dcn_reduce
+
+            def onebit_collapse(acc, we, se):
+                collapsed, we2, se2 = reduce(acc, we, se)
+                return constrain_grads(collapsed), zeroed(acc), we2, se2
+
+            self._dcn_onebit_jit = jax.jit(
+                onebit_collapse, donate_argnums=(0, 1, 2),
+                out_shardings=(None, grads_sh, ef_sh, ef_sh))
+            self._dcn_rescale_ef_jit = jax.jit(
+                lambda we, se, r: (we * r, se * r),
+                donate_argnums=(0, 1))
+            self._dcn_finite_jit = jax.jit(
+                lambda acc: jnp.isfinite(jnp.asarray(
+                    [jnp.sum(jnp.abs(l.astype(jnp.float32)))
+                     for l in jax.tree_util.tree_leaves(acc)])).all())
 
     def _init_param_spill(self) -> None:
         """ZeRO-Infinity parameter NVMe spill: with
@@ -860,7 +957,59 @@ class DeepSpeedEngine:
             new_scale = ls.update_state(scale_state, overflow, scaler_config)
             return new_params, new_master, new_opt, zero_acc, new_scale, norm, overflow
 
-        self._micro_jit = jax.jit(micro, donate_argnums=(1,))
+        if self._dcn_mode:
+            # per-slice gradient accumulation: the micro step runs manual
+            # over the slow 'dcn' axis (every other mesh axis stays
+            # compiler-managed), so the backward's gradient psum covers
+            # only the fast intra-slice axes — nothing crosses DCN until
+            # the boundary collapse in _take_model_step
+            dcn_n = self._dcn_n
+            shifted_grad_specs = jax.tree_util.tree_map(
+                lambda sp: P(None, *tuple(sp)), grad_specs,
+                is_leaf=lambda x: isinstance(x, P))
+
+            def micro_slice(params, acc, scale_state, b):
+                scale = scale_state["loss_scale"]
+                if isinstance(b, dict) and "_train_rng" in b:
+                    # distinct dropout masks per slice: dcn=1 draws one
+                    # mask over the full batch, so replicating the key
+                    # across slices would correlate the gradient noise
+                    b = {**b, "_train_rng": jax.random.fold_in(
+                        b["_train_rng"], lax.axis_index(DCN_AXIS))}
+
+                def scaled_loss(p):
+                    loss = loss_fn(p, b)
+                    return loss * scale / gas, loss
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(accum_dtype), grads)
+                new_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g[None], acc, grads)
+                new_acc = constrain(new_acc, shifted_grad_specs)
+                return new_acc, lax.pmean(loss, DCN_AXIS)
+
+            def micro_dcn(params, grad_acc, scale_state, batch):
+                leaves = jax.tree_util.tree_leaves(batch)
+                rows = max((x.shape[0] for x in leaves
+                            if getattr(x, "ndim", 0) >= 1), default=0)
+                pspec = jax.tree_util.tree_map(lambda _: P(), params)
+                aspec = jax.tree_util.tree_map(lambda _: P(DCN_AXIS),
+                                               grad_acc)
+                sspec = jax.tree_util.tree_map(lambda _: P(), scale_state)
+                bspec = jax.tree_util.tree_map(
+                    lambda x: P(DCN_AXIS)
+                    if getattr(x, "ndim", 0) >= 1 and x.shape[0] == rows
+                    and rows % dcn_n == 0 else P(), batch)
+                fn = shard_map(micro_slice, mesh=mesh,
+                               in_specs=(pspec, aspec, sspec, bspec),
+                               out_specs=(aspec, P()),
+                               axis_names={DCN_AXIS}, check_vma=False)
+                return fn(params, grad_acc, scale_state, batch)
+
+            self._micro_jit = jax.jit(micro_dcn, donate_argnums=(1,))
+        else:
+            self._micro_jit = jax.jit(micro, donate_argnums=(1,))
 
         # offload_param (ZeRO-3 parameter offload): the stored-param
         # placement is host memory — the step outputs must land back there
@@ -918,7 +1067,8 @@ class DeepSpeedEngine:
         """Place a host batch as a global array sharded over dp."""
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            spec = P((DATA_AXIS, EXPERT_AXIS)) if x.ndim >= 1 else P()
+            spec = P((DCN_AXIS, DATA_AXIS, EXPERT_AXIS)) if x.ndim >= 1 \
+                else P()
             try:
                 return jax.device_put(x, NamedSharding(self.mesh, spec))
             except ValueError:
@@ -1351,19 +1501,47 @@ class DeepSpeedEngine:
             self._finish_model_step(overflow_host, lr_kwargs)
             return
         s = self.state
+        grad_in = s["grad_acc"]
+        zeroed_stacked = None
+        if self._dcn_mode:
+            # collapse the per-slice partials across the slow axis: one
+            # crossing per boundary step, 1-bit compressed when configured.
+            # Compression preflight: an overflowed accumulator must NOT
+            # touch the EF state (inf - inf = NaN would poison every later
+            # step; the uncompressed mean carries the inf to apply_core,
+            # which skips the step and backs the scale off as usual), and
+            # a loss-scale change re-denominates the carried residual —
+            # EF is linear in the gradient scale, so the rescale is exact.
+            use_onebit = self._dcn_reduce is not None
+            if use_onebit and self.scaler_config.enabled:
+                use_onebit = bool(jax.device_get(
+                    self._dcn_finite_jit(s["grad_acc"])))
+            if use_onebit:
+                cur_scale = float(jax.device_get(s["scale"]["loss_scale"]))
+                if cur_scale != self._dcn_ef_scale:
+                    ratio = cur_scale / self._dcn_ef_scale
+                    self._dcn_we, self._dcn_se = self._dcn_rescale_ef_jit(
+                        self._dcn_we, self._dcn_se,
+                        jnp.float32(ratio))
+                    self._dcn_ef_scale = cur_scale
+                (grad_in, zeroed_stacked, self._dcn_we,
+                 self._dcn_se) = self._dcn_onebit_jit(
+                    s["grad_acc"], self._dcn_we, self._dcn_se)
+            else:
+                grad_in, zeroed_stacked = self._dcn_mean_jit(s["grad_acc"])
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm,
              overflow) = self._apply_jit(
-                s["params"], s["master"], s["opt_state"], s["grad_acc"],
+                s["params"], s["master"], s["opt_state"], grad_in,
                 s["scale"], self._hyper())
         else:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm,
              overflow) = self._apply_jit_single(
-                s["params"], s["opt_state"], s["grad_acc"], s["scale"], self._hyper())
+                s["params"], s["opt_state"], grad_in, s["scale"], self._hyper())
         s["params"] = new_params
         s["master"] = new_master if self._separate_master else new_params
         s["opt_state"] = new_opt
-        s["grad_acc"] = zero_acc
+        s["grad_acc"] = zeroed_stacked if self._dcn_mode else zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm  # device scalar; float() lazily
         self._spill_params()
@@ -1394,9 +1572,9 @@ class DeepSpeedEngine:
     # fused whole-batch path -------------------------------------------------
     def train_batch_fused(self, batches):
         """Run a full train batch (gas stacked on dim 0) in one jit call."""
-        if self._offload_device is not None:
-            # host step can't live inside jit: run the micro loop on device,
-            # then the boundary step through the offload path
+        if self._offload_device is not None or self._dcn_mode:
+            # host step (offload) / boundary collapse (dcn) can't live
+            # inside one jit: run the micro loop, step at the boundary
             gas = self.gradient_accumulation_steps()
             chunks = jax.tree_util.tree_map(
                 lambda x: np.reshape(np.asarray(x),
@@ -1416,7 +1594,8 @@ class DeepSpeedEngine:
                 (self.gradient_accumulation_steps(), -1) + np.shape(x)[1:]), batches)
         batches = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, NamedSharding(
-                self.mesh, P(None, (DATA_AXIS, EXPERT_AXIS)))), batches)
+                self.mesh, P(None, (DCN_AXIS, DATA_AXIS, EXPERT_AXIS)))),
+            batches)
         if self._compression_scheduler is not None and isinstance(batches, dict):
             from ..compression.compress import STEP_KEY
             # one step scalar per gas micro-step (same global step for all)
@@ -1493,11 +1672,31 @@ class DeepSpeedEngine:
                     f"offload_residual_rank{self.global_rank}.npz"),
                     **{f"r_{i}": np.asarray(jax.device_get(r), np.float32)
                        for i, r in enumerate(self._offload_resid_leaves)})
+        if self._dcn_reduce is not None:
+            # DCN error-feedback state is part of the trajectory: persist
+            # for exact resume (like the offload compression residual).
+            # Only this process's addressable shards are pulled — the EF
+            # arrays are dcn-sharded and NOT fully addressable when the
+            # slices span hosts (the deployment case)
+            from .zero.offload_engine import index_key, unique_local_blocks
+            os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+            arrays = {"ef_scale": np.asarray(self._dcn_ef_scale)}
+            for name, arr in (("we", self._dcn_we), ("se", self._dcn_se)):
+                for bi, (idx, blk) in enumerate(unique_local_blocks(arr)):
+                    key = index_key(idx, arr.shape)
+                    arrays[f"{name}_{bi}_key"] = np.asarray(key, np.int64)
+                    arrays[f"{name}_{bi}_data"] = blk
+            np.savez(os.path.join(save_dir, tag,
+                                  f"dcn_ef_rank{self.global_rank}.npz"),
+                     **arrays)
         save_engine_checkpoint(save_dir, tag, self.state, client_state,
                                separate_master=self._separate_master and not offload,
                                save_latest=save_latest,
                                engine=self._checkpoint_engine)
         self._copy_recovery_script(save_dir)
+        # spilled-param engines return to the between-steps memory bound
+        # (nothing big resident) as soon as the checkpoint is written
+        self._spill_params()
         return True
 
     @staticmethod
@@ -1577,6 +1776,42 @@ class DeepSpeedEngine:
                 # the host master must always track the loaded params or the
                 # first step would overwrite them with the init-time master
                 self._reseed_offload_master()
+        if self._dcn_reduce is not None:
+            resolved = tag
+            if resolved is None:
+                lp = os.path.join(load_dir, "latest")
+                if os.path.exists(lp):
+                    with open(lp) as f:
+                        resolved = f.read().strip()
+            ef_path = os.path.join(load_dir, resolved or "",
+                                   f"dcn_ef_rank{self.global_rank}.npz")
+            if os.path.exists(ef_path):
+                with np.load(ef_path) as z:
+                    self._dcn_ef_scale = float(z["ef_scale"])
+                    for name in ("we", "se"):
+                        cur = getattr(self, f"_dcn_{name}")
+                        blocks = {}
+                        bi = 0
+                        while f"{name}_{bi}_key" in z:
+                            key = tuple(map(tuple, z[f"{name}_{bi}_key"]))
+                            blocks[key] = z[f"{name}_{bi}_data"]
+                            bi += 1
+                        from .zero.offload_engine import index_key
+                        arrs = []
+                        for shard in cur.addressable_shards:
+                            k = index_key(shard.index, cur.shape)
+                            arrs.append(jax.device_put(blocks[k],
+                                                       shard.device))
+                        setattr(self, f"_dcn_{name}",
+                                jax.make_array_from_single_device_arrays(
+                                    cur.shape, cur.sharding, arrs))
+            else:
+                # a checkpoint without EF state: the carried quantization
+                # error belongs to the replaced trajectory
+                self._dcn_we = jnp.zeros_like(self._dcn_we)
+                self._dcn_se = jnp.zeros_like(self._dcn_se)
+                self._dcn_ef_scale = float(jax.device_get(
+                    self.state["scale"]["loss_scale"]))
         self.micro_steps = client_state.get("micro_steps", 0)
         self.global_steps = client_state.get("global_steps", 0)
         self.global_samples = client_state.get("global_samples", 0)
@@ -1584,6 +1819,7 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self._lr_scheduler is not None and \
                 "lr_scheduler" in client_state:
             self._lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        self._spill_params()  # restore the between-steps memory bound
         if "optimizer_param_groups" in client_state and load_optimizer_states:
             restored = client_state["optimizer_param_groups"]
             if len(restored) == len(self.optimizer.param_groups):

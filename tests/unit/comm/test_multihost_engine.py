@@ -18,6 +18,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),

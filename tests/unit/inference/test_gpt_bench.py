@@ -1,3 +1,6 @@
+import pytest
+
+pytestmark = pytest.mark.slow
 """Inference latency harness (reference benchmarks/inference/gpt-bench.py
 p50/p90/p99 methodology): runs end-to-end on a tiny preset and returns a
 complete, internally consistent report."""

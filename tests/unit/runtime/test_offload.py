@@ -20,9 +20,9 @@ from deepspeed_tpu.parallel.mesh import ParallelDims, initialize_mesh
 from deepspeed_tpu.runtime.model import from_gpt
 from deepspeed_tpu.ops.op_builder import get_builder
 
-pytestmark = pytest.mark.skipif(
+pytestmark = [pytest.mark.slow] + [pytest.mark.skipif(
     not get_builder("cpu_adam").is_compatible(),
-    reason="no C++ toolchain for native ops")
+    reason="no C++ toolchain for native ops")]
 
 
 def _tiny_config():

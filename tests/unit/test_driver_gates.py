@@ -1,3 +1,6 @@
+import pytest
+
+pytestmark = pytest.mark.slow
 """The driver's round gates, as tests (round 1 failed on exactly these
 being unexercised): bench.py must emit one valid JSON line on a
 CPU-only host, and __graft_entry__ must expose a compilable entry() and a
