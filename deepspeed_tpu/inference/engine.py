@@ -285,17 +285,16 @@ class InferenceEngine:
         is speculative SAMPLING (rejection rule) — tokens distributed
         exactly as target sampling at that temperature, seeded by
         ``key``.  ``draft`` is a ``(GPTConfig, params)`` tuple or another
-        :class:`InferenceEngine` over the same vocabulary.  Returns
-        ``(tokens [1, N], n_target_forwards)``.  ``draft_k + 1`` should
-        be a multiple of 8 so the verify pass rides the chunk kernel
-        (default 7).
+        :class:`InferenceEngine` over the same vocabulary.  The TARGET
+        may be dense GPT or MoE (the verify pass rides each family's
+        chunked ``extend``); the draft must be dense — its whole point
+        is being small.  Returns ``(tokens [1, N], n_target_forwards)``.
+        ``draft_k + 1`` should be a multiple of 8 so the verify pass
+        rides the chunk kernel (default 7).
         """
         from ..models import gpt_inference
         from ..models.gpt_moe import GPTMoEConfig
         from .speculative import speculative_generate
-        if self._family is not gpt_inference:
-            raise NotImplementedError(
-                "speculative decode serves the dense GPT family")
         if temperature <= 0 and (top_k > 0 or top_p < 1.0):
             raise ValueError(
                 "top_k/top_p only apply to speculative SAMPLING — set "

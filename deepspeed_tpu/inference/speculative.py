@@ -109,6 +109,18 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
         raise ValueError("draft and target must share a vocabulary "
                          f"({draft_cfg.vocab_size} vs {target_cfg.vocab_size})")
     from .engine import _tile_cache_len
+    from ..models.gpt_moe import GPTMoEConfig
+    # family dispatch: the TARGET may be MoE (verify rides its extend);
+    # the draft stays dense (a draft's whole point is being small)
+    if isinstance(target_cfg, GPTMoEConfig):
+        from ..models import gpt_moe_inference as tfam
+        if kv_dtype is not None:
+            raise NotImplementedError(
+                "MoE targets cache in the compute dtype (no int8 KV)")
+        t_cache_kw = {}
+    else:
+        tfam = gpt_inference
+        t_cache_kw = {"kv_dtype": kv_dtype}
     N, K = int(max_new_tokens), int(draft_k)
     V = target_cfg.vocab_size
     S = prompt.shape[1]
@@ -123,9 +135,8 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
             f"prompt ({S}) + max_new_tokens ({N}) + speculative overshoot "
             f"({K + 1}) exceeds max_seq_len ({ctx}); reduce draft_k or the "
             "token budget")
-    tcache = gpt_inference.init_cache(target_cfg, 1,
-                                      _tile_cache_len(need, ctx),
-                                      kv_dtype=kv_dtype)
+    tcache = tfam.init_cache(target_cfg, 1, _tile_cache_len(need, ctx),
+                             **t_cache_kw)
     dcache = gpt_inference.init_cache(draft_cfg, 1, _tile_cache_len(need, ctx))
 
     sample = float(temperature) > 0.0
@@ -137,8 +148,8 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     def flt(lg):
         return filter_logits(lg, temp, top_k=top_k, top_p=top_p)
 
-    tlogits, tcache = gpt_inference.prefill(target_params, prompt,
-                                            target_cfg, tcache)
+    tlogits, tcache = tfam.prefill(target_params, prompt,
+                                   target_cfg, tcache)
     _, dcache = gpt_inference.prefill(draft_params, prompt, draft_cfg, dcache)
     last_t = tlogits[:, -1, :V].astype(jnp.float32)
     if sample:
@@ -183,8 +194,8 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
 
         # ---- verify: ONE target pass over [cur, d1..dK]
         chunk = jnp.concatenate([cur, drafts])[None, :]          # [1, K+1]
-        vlogits, tcache = gpt_inference.extend(target_params, chunk,
-                                               target_cfg, tcache)
+        vlogits, tcache = tfam.extend(target_params, chunk,
+                                      target_cfg, tcache)
         vlg = vlogits[0, :, :V].astype(jnp.float32)              # [K+1, V]
 
         if sample:

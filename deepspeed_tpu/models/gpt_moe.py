@@ -54,13 +54,14 @@ GPT_MOE_350M_64E = GPTMoEConfig(n_layer=24, n_head=16, d_model=1024,
                                 num_experts=64, moe_top_k=1)
 
 
-def _moe_obj(config: GPTMoEConfig) -> MoE:
+def _moe_obj(config: GPTMoEConfig, drop_tokens: bool = True) -> MoE:
     return MoE(hidden_size=config.d_model, num_experts=config.num_experts,
                ep_size=config.ep_size, k=config.moe_top_k,
                capacity_factor=config.capacity_factor,
                eval_capacity_factor=config.eval_capacity_factor,
                min_capacity=config.min_capacity,
                use_residual=config.use_residual,
+               drop_tokens=drop_tokens,
                # deterministic gating by default: rng plumbing through scan is
                # opt-in (use_rts needs a per-layer key)
                use_rts=False)

@@ -4,7 +4,7 @@ Counterpart of the reference's MoE inference stack
 (``ops/transformer/inference/moe_inference.py`` ``DeepSpeedMoEInference``
 and the expert-group creation in ``inference/engine.py:190``): prefill and
 single-token decode over the (dense, MoE) pair stack, with the gate running
-in eval mode (eval capacity factor, no RTS/aux loss) and experts sharded
+in eval mode (dropless — see ``_moe_infer_obj``; no RTS/aux loss) and experts sharded
 over the ``expert`` mesh axis declaratively — the all-to-all the reference
 issues by hand falls out of XLA's dispatch/combine einsums.
 
@@ -25,6 +25,21 @@ from . import gpt
 from .gpt_moe import GPTMoEConfig, _moe_obj
 
 PyTree = Any
+
+
+def _moe_infer_obj(config: GPTMoEConfig):
+    """Dropless gate for serving: eval capacity gating can mask tokens
+    when routing skews (capacity = max(int(t·k·cf/E), min_capacity)),
+    which at inference silently corrupts served logits and — because
+    capacity depends on the per-call token count — makes a K+1-token
+    verify chunk route differently from K+1 single-token decodes.  The
+    inference family therefore reserves worst-case capacity (= tokens per
+    call; calls are small chunks, so the [t,E,C=t] dispatch stays cheap),
+    making decode/extend/prefill exact and mutually consistent — the
+    contract speculative verification rides.  Training/eval ``apply``
+    keeps capacity gating for throughput, as the reference does
+    (sharded_moe.py:278)."""
+    return _moe_obj(config, drop_tokens=False)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -74,12 +89,34 @@ def _attend_decode(x, p, config, ck, cv, pos, positions):
     return x + gpt.attn_project(attn, p, config), ck, cv
 
 
+# dropless gating reserves capacity = tokens-per-call, so the dispatch/
+# combine tensors are [t, E, t] — fine for decode/verify chunks, quadratic
+# for a whole long prompt.  Prefill therefore processes at most this many
+# tokens per gate call, walking longer prompts through `extend` (which
+# composes exactly with prefill — tested contract).
+_PREFILL_CHUNK = 128
+
+
 def prefill(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
             cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
-    """Prompt pass filling both cache banks; returns (logits, cache)."""
+    """Prompt pass filling both cache banks; returns (logits, cache).
+
+    Long prompts (> ``_PREFILL_CHUNK`` gated tokens) run as a chain of
+    ``extend`` chunks to keep the dropless dispatch tensors bounded at
+    [B·chunk, E, B·chunk] instead of [B·S, E, B·S]."""
     B, S = tokens.shape
+    if B * S > _PREFILL_CHUNK:
+        # chunk bounds depend only on the static shape, so this also
+        # unrolls under an outer jit (the engine's whole-generate program)
+        step = max(_PREFILL_CHUNK // B, 1)
+        outs = []
+        for s0 in range(0, S, step):
+            lg, cache = extend(params, tokens[:, s0:s0 + step], config,
+                               cache)
+            outs.append(lg)
+        return jnp.concatenate(outs, axis=1), cache
     positions = jnp.arange(S)
-    moe = _moe_obj(config)
+    moe = _moe_infer_obj(config)
     x = gpt.embed(params, tokens, config, positions=positions)
 
     def pair(x, xs):
@@ -103,20 +140,32 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
                               length=jnp.asarray(S, jnp.int32))
 
 
-def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
-                cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
-    """One-token decode through both banks; token [B] int32."""
-    pos = cache.length
-    positions = pos[None]
-    moe = _moe_obj(config)
-    x = gpt.embed(params, token[:, None], config, positions=positions)
+def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
+           cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+    """Chunked prefill continuation (the MoE counterpart of
+    ``gpt_inference.extend``): append ``tokens`` [B, S_c] at positions
+    ``cache.length..``, attending causally over prefix + chunk through
+    both cache banks, expert FFN in eval gating.  ``prefill(t[:, :c]) ;
+    extend(t[:, c:])`` equals one full ``prefill`` — the contract the
+    speculative verify pass rides."""
+    B, Sc = tokens.shape
+    pos0 = cache.length
+    max_len = cache.dense_k.shape[2]
+    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + Sc > max_len:
+        raise ValueError(
+            f"extend of {Sc} tokens at length {int(pos0)} overflows the "
+            f"cache (max_len {max_len}); dynamic_update_slice would clamp "
+            "and corrupt the cached prefix")
+    positions = pos0 + jnp.arange(Sc)
+    moe = _moe_infer_obj(config)
+    x = gpt.embed(params, tokens, config, positions=positions)
 
     def pair(x, xs):
         dense_p, attn_p, moe_p, dck, dcv, mck, mcv = xs
-        x, dck, dcv = _attend_decode(x, dense_p, config, dck, dcv, pos,
+        x, dck, dcv = _attend_decode(x, dense_p, config, dck, dcv, pos0,
                                      positions)
         x = gpt.mlp_residual(x, dense_p, config)
-        x, mck, mcv = _attend_decode(x, attn_p, config, mck, mcv, pos,
+        x, mck, mcv = _attend_decode(x, attn_p, config, mck, mcv, pos0,
                                      positions)
         x = _moe_ffn(x, attn_p, moe_p, moe, config)
         return x, (dck, dcv, mck, mcv)
@@ -125,6 +174,14 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
         pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
                   params["moe_blocks"], cache.dense_k, cache.dense_v,
                   cache.moe_k, cache.moe_v))
-    logits = gpt.lm_logits(params, x[:, 0], config)
+    logits = gpt.lm_logits(params, x, config)
     return logits, MoEKVCache(dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
-                              length=pos + 1)
+                              length=pos0 + Sc)
+
+
+def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
+                cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+    """One-token decode through both banks; token [B] int32 — a 1-token
+    ``extend`` with the chunk axis squeezed."""
+    logits, cache = extend(params, token[:, None], config, cache)
+    return logits[:, 0], cache

@@ -104,10 +104,20 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
 
 
 def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
-               min_capacity: int = 4) -> Tuple:
-    """Top-2 gating (reference sharded_moe.py:278)."""
+               min_capacity: int = 4, drop_tokens: bool = True) -> Tuple:
+    """Top-2 gating (reference sharded_moe.py:278).
+
+    ``drop_tokens=False`` reserves the worst case (every token's top-1 on
+    one expert: capacity = tokens) so no assignment is ever masked — the
+    same no-drop guarantee as :func:`top1gating`'s, used by the inference
+    family where silently dropping tokens would corrupt served logits.
+    """
     tokens, num_experts = logits.shape
-    capacity = _capacity(tokens, num_experts, 2 * capacity_factor, min_capacity)
+    if drop_tokens:
+        capacity = _capacity(tokens, num_experts, 2 * capacity_factor,
+                             min_capacity)
+    else:
+        capacity = tokens
 
     gates = jax.nn.softmax(logits, axis=-1)
     indices1 = jnp.argmax(gates, axis=-1)
@@ -174,7 +184,8 @@ class TopKGate:
                               noisy_gate_policy=self.noisy_gate_policy if train else None,
                               rng=rng, drop_tokens=self.drop_tokens,
                               use_rts=self.use_rts and train)
-        return top2gating(logits, cf, self.min_capacity)
+        return top2gating(logits, cf, self.min_capacity,
+                          drop_tokens=self.drop_tokens)
 
 
 def moe_layer_forward(gate: TopKGate, gate_params, expert_fn, expert_params,

@@ -120,3 +120,67 @@ def test_moe_int8_weight_only_serving():
     assert abs(np.exp(l_int8) / np.exp(l_bf16) - 1.0) < 0.02, (l_bf16, l_int8)
     out = int8.generate(tokens[:, :8], max_new_tokens=4)
     assert out.shape == (2, 4) and (np.asarray(out) < cfg.vocab_size).all()
+
+
+def test_moe_inference_dropless_under_skewed_routing():
+    """Inference gating is dropless (``_moe_infer_obj``): with a config
+    whose EVAL capacity would drop tokens (cf=0.25, min_capacity=1 → a
+    capacity-gated 8-token chunk gets 1 slot/expert), a multi-token
+    ``extend`` must still match token-by-token ``decode_step`` exactly —
+    the contract the speculative verify pass rides.  Capacity-gated
+    inference would make the two paths route (and answer) differently."""
+    cfg = gpt_moe.GPTMoEConfig(
+        vocab_size=128, max_seq_len=64, n_layer=2, n_head=2, d_model=32,
+        dtype=jnp.float32, vocab_round_to=128, num_experts=8, moe_top_k=2,
+        capacity_factor=0.25, eval_capacity_factor=0.25, min_capacity=1)
+    params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 128, size=(1, 6)), jnp.int32)
+    chunk = jnp.asarray(rng.integers(0, 128, size=(1, 8)), jnp.int32)
+
+    _, c_ext = gpt_moe_inference.prefill(
+        params, prompt, cfg, gpt_moe_inference.init_cache(cfg, 1, 32))
+    ext_logits, c_ext = gpt_moe_inference.extend(params, chunk, cfg, c_ext)
+
+    _, c_dec = gpt_moe_inference.prefill(
+        params, prompt, cfg, gpt_moe_inference.init_cache(cfg, 1, 32))
+    dec = []
+    for i in range(8):
+        lg, c_dec = gpt_moe_inference.decode_step(params, chunk[:, i],
+                                                  cfg, c_dec)
+        dec.append(np.asarray(lg))
+    np.testing.assert_allclose(np.asarray(ext_logits)[0],
+                               np.stack(dec)[:, 0], rtol=2e-5, atol=2e-5)
+
+
+def test_moe_extend_overflow_raises():
+    params = _params()
+    cache = gpt_moe_inference.init_cache(CFG, 1, 16)
+    _, cache = gpt_moe_inference.prefill(
+        params, jnp.zeros((1, 12), jnp.int32), CFG, cache)
+    with pytest.raises(ValueError, match="overflows the cache"):
+        gpt_moe_inference.extend(params, jnp.zeros((1, 8), jnp.int32),
+                                 CFG, cache)
+
+
+def test_moe_long_prompt_prefill_chunks_match_single_shot(monkeypatch):
+    """Prompts above _PREFILL_CHUNK gated tokens walk through extend();
+    the logits must equal the single-shot gated pass (dropless gating is
+    per-token independent, so chunking cannot change routing)."""
+    cfg = gpt_moe.GPTMoEConfig(
+        vocab_size=128, max_seq_len=256, n_layer=2, n_head=2, d_model=32,
+        dtype=jnp.float32, vocab_round_to=128, num_experts=4, moe_top_k=2)
+    params = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(1).integers(0, 128, (1, 150)),
+                         jnp.int32)
+    chunked, c1 = gpt_moe_inference.prefill(
+        params, tokens, cfg, gpt_moe_inference.init_cache(cfg, 1, 160))
+    monkeypatch.setattr(gpt_moe_inference, "_PREFILL_CHUNK", 10_000)
+    single, c2 = gpt_moe_inference.prefill(
+        params, tokens, cfg, gpt_moe_inference.init_cache(cfg, 1, 160))
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(single),
+                               rtol=2e-5, atol=2e-5)
+    assert int(c1.length) == int(c2.length) == 150
+    np.testing.assert_allclose(np.asarray(c1.moe_k[:, :, :150]),
+                               np.asarray(c2.moe_k[:, :, :150]),
+                               rtol=2e-5, atol=2e-5)
