@@ -123,16 +123,6 @@ class InferenceEngine:
         cfg = self.model_config
         self._kv_dtype = ("int8" if config.kv_cache_dtype == "int8"
                           else None)
-        if self._kv_dtype is not None and not isinstance(cfg, GPTMoEConfig) \
-                and (getattr(cfg, "pos_embed", "") == "alibi"
-                     or getattr(cfg, "local_attention_window", 0) > 0):
-            # those decode paths are dense over the padded cache: an int8
-            # cache would be dequantized IN FULL every layer of every step
-            # — strictly worse than 'auto'; refuse rather than degrade
-            raise NotImplementedError(
-                "kv_cache_dtype='int8' rides the streaming decode kernel; "
-                "alibi/windowed-attention models decode through the dense "
-                "cache path — serve them with kv_cache_dtype='auto'")
         if isinstance(cfg, GPTMoEConfig):
             if self._kv_dtype is not None:
                 raise NotImplementedError(

@@ -89,35 +89,31 @@ def _cached_attention(q, cache_k, cache_v, pos, config: gpt.GPTConfig,
 
     ``pos`` is the number of tokens already in the cache before this call;
     query i sits at absolute position pos+i and sees cache slots ≤ pos+i.
-    ``window`` (traced per-layer scalar) routes through the banded path —
-    the same ``gpt._windowed_attention`` that serves training/prefill.
-    ``k_scale``/``v_scale`` mark an int8 cache: the streaming kernel
-    dequantizes in VMEM; the windowed/alibi dense paths dequantize up
-    front.
+    ``window`` (traced per-layer scalar) bands visibility; with
+    ``pos_embed == "alibi"`` the per-head ``-slope·dist`` bias is added.
+    Both now ride the streaming kernels (window-skipping cache blocks /
+    biasing in VMEM) with the dense reference as the non-tiling fallback
+    — so an int8 cache (``k_scale``/``v_scale``) composes with
+    alibi/windowed models and still dequantizes block-by-block in VMEM.
     """
-    from ..ops.pallas.decode_attention import cached_attention, dequantize_kv
-    if (window is not None or config.pos_embed == "alibi") \
-            and k_scale is not None:
-        cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
-        cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
-        k_scale = v_scale = None
-    if window is not None:
-        return gpt._windowed_attention(q, cache_k, cache_v, config, window,
-                                       pos=pos)
-    if config.pos_embed == "alibi":
-        # dense path with the alibi bias; cache slots beyond the query's
-        # position fall out of the dist >= 0 mask.  pos: scalar or [B].
-        pos_arr = jnp.asarray(pos)
-        steps = jnp.arange(q.shape[1])
-        q_positions = pos_arr[:, None] + steps if pos_arr.ndim \
-            else pos_arr + steps
-        return gpt._alibi_attention(q, cache_k, cache_v, config,
-                                    q_positions=q_positions)
+    from ..ops.pallas.decode_attention import cached_attention
     scale = config.attn_softmax_scale
+    slopes = None
+    if config.pos_embed == "alibi":
+        # train/prefill's _alibi_attention fixes the scale at 1/sqrt(D)
+        # (gpt.py) — decode must agree or generation diverges from the
+        # cache the prefill filled
+        scale = None
+        if window is None:
+            # banded layers in train/prefill run _windowed_attention,
+            # which carries NO alibi bias — window takes precedence here
+            # too, for the same prefill/decode consistency
+            slopes = gpt.alibi_slopes(config.n_head)
     if scale is None:
         scale = 1.0 / math.sqrt(config.head_dim)
     return cached_attention(q, cache_k, cache_v, pos, sm_scale=scale,
-                            k_scale=k_scale, v_scale=v_scale)
+                            k_scale=k_scale, v_scale=v_scale,
+                            window=window, slopes=slopes)
 
 
 def _block_tail(x, attn, p, config: gpt.GPTConfig):

@@ -46,10 +46,13 @@ def quantize_kv(x):
 
 
 def cached_attention_reference(q, cache_k, cache_v, pos,
-                               sm_scale: Optional[float] = None):
+                               sm_scale: Optional[float] = None,
+                               window=None, slopes=None):
     """Ground truth: q [B,Sq,H,D] over cache [B,Smax,H,D]; query i (at
     absolute position pos+i) sees cache slots ≤ pos+i.  ``pos`` may be a
-    scalar or a per-row [B] vector (ragged decode)."""
+    scalar or a per-row [B] vector (ragged decode).  ``window`` (scalar,
+    may be traced) bands visibility to ``0 <= dist < window``; ``slopes``
+    ([H] fp32) adds the ALiBi bias ``-slope·dist``."""
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
@@ -58,22 +61,67 @@ def cached_attention_reference(q, cache_k, cache_v, pos,
     q_abs = (pos.reshape(-1, 1) if pos.ndim else pos) + jnp.arange(Sq)
     k_pos = jnp.arange(Smax)
     # [B or 1, Sq, Smax]
-    mask = k_pos[None, None, :] <= jnp.atleast_2d(q_abs)[:, :, None]
+    dist = jnp.atleast_2d(q_abs)[:, :, None] - k_pos[None, None, :]
+    mask = dist >= 0
+    if window is not None:
+        mask = jnp.logical_and(mask, dist < window)
+    if slopes is not None:
+        s = s - slopes[None, :, None, None] * dist[:, None].astype(jnp.float32)
     s = jnp.where(mask[:, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), cache_v)
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
-                   sm_scale, block_k, H, quantized):
-    """One online-softmax decode kernel serving both cache layouts: with
+# finite floor for the running max: with a banded window a streamed block
+# can be fully masked for every row it executes for; a -inf running max
+# would then turn exp(m_prev - m_new) into nan.  Scores never approach
+# this, so the recurrence is unchanged on visible keys.
+M_FLOOR = -1e30
+
+
+def _optional_operands(window, slopes):
+    """(extra_args, extra_specs) for the optional SMEM operands — the
+    single source of the operand ordering the kernels unpack."""
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    args, specs = (), []
+    if window is not None:
+        args += (jnp.asarray(window, jnp.int32).reshape(1),)
+        specs.append(smem)
+    if slopes is not None:
+        args += (jnp.asarray(slopes, jnp.float32),)
+        specs.append(smem)
+    return args, specs
+
+
+def _unpack_rest(rest, quantized, windowed, alibi):
+    """Positional unpack mirroring :func:`_optional_operands`: [window?,
+    slopes?, q, k, v, kscale?, vscale?, o, acc, m, l]."""
+    i = 0
+    window_ref = slopes_ref = kscale_ref = vscale_ref = None
+    if windowed:
+        window_ref = rest[i]; i += 1
+    if alibi:
+        slopes_ref = rest[i]; i += 1
+    q_ref, k_ref, v_ref = rest[i:i + 3]; i += 3
+    if quantized:
+        kscale_ref, vscale_ref = rest[i:i + 2]; i += 2
+    o_ref, acc_ref, m_ref, l_ref = rest[i:i + 4]
+    return (window_ref, slopes_ref, q_ref, k_ref, v_ref, kscale_ref,
+            vscale_ref, o_ref, acc_ref, m_ref, l_ref)
+
+
+def _decode_kernel(pos_ref, *rest, sm_scale, block_k, H, quantized,
+                   windowed, alibi):
+    """One online-softmax decode kernel serving every cache layout: with
     ``quantized`` the k/v blocks arrive as int8 codes plus per-vector fp32
     scale columns (two extra refs) and dequantize in VMEM — half the HBM
-    bytes on the memory-bound decode path."""
-    if quantized:
-        kscale_ref, vscale_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+    bytes on the memory-bound decode path.  ``windowed`` bands visibility
+    to the trailing ``window`` slots (SMEM scalar — it may alternate
+    per layer) and skips blocks wholly below the band; ``alibi`` adds the
+    per-head ``-slope·dist`` bias from an SMEM slope table."""
+    (window_ref, slopes_ref, q_ref, k_ref, v_ref, kscale_ref, vscale_ref,
+     o_ref, acc_ref, m_ref, l_ref) = _unpack_rest(rest, quantized,
+                                                  windowed, alibi)
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -82,10 +130,16 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
     @pl.when(ki == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        m_ref[...] = jnp.full_like(m_ref, M_FLOOR)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(ki * block_k <= pos)
+    live = ki * block_k <= pos
+    if windowed:
+        # skip blocks wholly below the band [pos-window+1, pos]
+        live = jnp.logical_and(
+            live, (ki + 1) * block_k - 1 >= pos - window_ref[0] + 1)
+
+    @pl.when(live)
     def _update():
         q = q_ref[0].astype(jnp.float32) * sm_scale    # (1, D)
         ks = k_ref[0].astype(jnp.float32)              # (BK, D)
@@ -96,7 +150,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         s = jax.lax.dot_general(q, ks, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (1, BK)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        if alibi:
+            s = s - slopes_ref[bh % H] * (pos - k_pos).astype(jnp.float32)
+        visible = k_pos <= pos
+        if windowed:
+            visible = jnp.logical_and(visible, k_pos > pos - window_ref[0])
+        s = jnp.where(visible, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -111,22 +170,26 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
-def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None):
+def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None,
+            window=None, slopes=None):
     BH, _, D = q3.shape
     Smax = k3.shape[1]
     B = BH // H
     quantized = ks3 is not None
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
-                               block_k=block_k, H=H, quantized=quantized)
+                               block_k=block_k, H=H, quantized=quantized,
+                               windowed=window is not None,
+                               alibi=slopes is not None)
     kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0))
     scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, ki: (bh, ki, 0))
-    in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),
+    extra_args, extra_specs = _optional_operands(window, slopes)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + [
         pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
         kv_spec, kv_spec,
     ] + ([scale_spec, scale_spec] if quantized else [])
-    args = (pos_arr, q3, k3, v3) + ((ks3, vs3) if quantized else ())
+    args = (pos_arr,) + extra_args + (q3, k3, v3) + \
+        ((ks3, vs3) if quantized else ())
     return pl.pallas_call(
         kernel,
         grid=(BH, Smax // block_k),
@@ -142,18 +205,20 @@ def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None):
     )(*args)
 
 
-def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
-                  sm_scale, block_q, block_k, H, quantized):
+def _chunk_kernel(pos_ref, *rest, sm_scale, block_q, block_k, H, quantized,
+                  windowed, alibi):
     """Chunked-prefill attention over the padded cache: queries are a
     whole chunk at absolute positions ``pos .. pos+Sq-1`` (online softmax
     per row, cache blocks streamed through VMEM, blocks beyond the
-    chunk's causal frontier skipped).  Memory-linear counterpart of the
-    dense fallback ``extend`` would otherwise take — O(block) VMEM
-    instead of an [Sq, Smax] score tensor."""
-    if quantized:
-        kscale_ref, vscale_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+    chunk's causal frontier — and, when windowed, wholly below every
+    row's band — skipped).  Memory-linear counterpart of the dense
+    fallback ``extend`` would otherwise take — O(block) VMEM instead of
+    an [Sq, Smax] score tensor.  The running max is floored at
+    ``M_FLOOR`` (not -inf): a windowed block can be fully masked for
+    SOME of its q rows, and those rows' recurrences must stay nan-free."""
+    (window_ref, slopes_ref, q_ref, k_ref, v_ref, kscale_ref, vscale_ref,
+     o_ref, acc_ref, m_ref, l_ref) = _unpack_rest(rest, quantized,
+                                                  windowed, alibi)
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -163,11 +228,19 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
     @pl.when(ki == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        m_ref[...] = jnp.full_like(m_ref, M_FLOOR)
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # highest key this q block may see: pos + (qi+1)*block_q - 1
-    @pl.when(ki * block_k <= pos + (qi + 1) * block_q - 1)
+    live = ki * block_k <= pos + (qi + 1) * block_q - 1
+    if windowed:
+        # lowest q row is pos + qi*block_q; a block wholly below ITS
+        # band is invisible to every row in the block
+        live = jnp.logical_and(
+            live,
+            (ki + 1) * block_k - 1 >= pos + qi * block_q - window_ref[0] + 1)
+
+    @pl.when(live)
     def _update():
         q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
         ks = k_ref[0].astype(jnp.float32)                  # (BK, D)
@@ -181,10 +254,13 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
             jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + \
             jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # block 0 always executes and every row's q_pos >= 0 sees key 0,
-        # so m turns finite on the first block — the plain online-softmax
-        # recurrence needs no -inf guards (same as the decode kernel)
-        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        dist = q_pos - k_pos
+        if alibi:
+            s = s - slopes_ref[bh % H] * dist.astype(jnp.float32)
+        visible = dist >= 0
+        if windowed:
+            visible = jnp.logical_and(visible, dist < window_ref[0])
+        s = jnp.where(visible, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -200,7 +276,7 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
-           vs3=None):
+           vs3=None, window=None, slopes=None):
     BH, Sq, D = q3.shape
     Smax = k3.shape[1]
     B = BH // H
@@ -208,14 +284,18 @@ def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_chunk_kernel, sm_scale=sm_scale,
                                block_q=block_q, block_k=block_k, H=H,
-                               quantized=quantized)
+                               quantized=quantized,
+                               windowed=window is not None,
+                               alibi=slopes is not None)
     q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
     kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0))
     scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, qi, ki: (bh, ki, 0))
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), q_spec,
-                kv_spec, kv_spec] + \
+    extra_args, extra_specs = _optional_operands(window, slopes)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + \
+        [q_spec, kv_spec, kv_spec] + \
         ([scale_spec, scale_spec] if quantized else [])
-    args = (pos_arr, q3, k3, v3) + ((ks3, vs3) if quantized else ())
+    args = (pos_arr,) + extra_args + (q3, k3, v3) + \
+        ((ks3, vs3) if quantized else ())
     return pl.pallas_call(
         kernel,
         grid=(BH, Sq // block_q, Smax // block_k),
@@ -234,7 +314,8 @@ def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
 
 def cached_attention(q, cache_k, cache_v, pos,
                      sm_scale: Optional[float] = None,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None,
+                     window=None, slopes=None):
     """q [B,Sq,H,D] over a padded cache [B,Smax,H,D], visibility ≤ pos+i.
 
     ``pos``: scalar, or a per-row [B] vector for ragged decode (each row's
@@ -247,6 +328,16 @@ def cached_attention(q, cache_k, cache_v, pos,
     With ``k_scale``/``v_scale`` ([B,Smax,H,1] fp32) the cache holds int8
     codes; the kernels dequantize in VMEM (halving the HBM stream), and
     the non-kernel fallbacks dequantize before the dense math.
+
+    ``window`` (scalar, possibly traced — GPT-Neo's alternating stack
+    carries it through a layer scan) bands visibility to the trailing
+    ``window`` slots; the kernels additionally skip the attention
+    COMPUTE for cache blocks wholly below the band (the DMA stream still
+    walks the padded cache — cutting HBM traffic too needs a
+    scalar-prefetch index map that clamps dead block indices, a planned
+    follow-up).  ``slopes`` ([H] fp32) adds the ALiBi ``-slope·dist``
+    bias (BLOOM family) inside the kernel.  Both compose with the int8
+    cache.
     """
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]
@@ -267,14 +358,17 @@ def cached_attention(q, cache_k, cache_v, pos,
         vs3 = to3(v_scale, 1) if int8_cache else None
         if Sq == 1:
             o3 = _decode(to3(q), to3(cache_k), to3(cache_v), pos, scale,
-                         block_k, H, ks3=ks3, vs3=vs3)
+                         block_k, H, ks3=ks3, vs3=vs3, window=window,
+                         slopes=slopes)
             return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)
         if block_q is not None and pos_is_scalar:
             o3 = _chunk(to3(q), to3(cache_k), to3(cache_v), pos, scale,
-                        block_q, block_k, H, ks3=ks3, vs3=vs3)
+                        block_q, block_k, H, ks3=ks3, vs3=vs3,
+                        window=window, slopes=slopes)
             return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 
     if int8_cache:
         cache_k = dequantize_kv(cache_k, k_scale, q.dtype)
         cache_v = dequantize_kv(cache_v, v_scale, q.dtype)
-    return cached_attention_reference(q, cache_k, cache_v, pos, scale)
+    return cached_attention_reference(q, cache_k, cache_v, pos, scale,
+                                      window=window, slopes=slopes)
