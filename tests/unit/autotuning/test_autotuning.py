@@ -169,3 +169,8 @@ def test_model_based_tuner_outperforms_random_search(tmp_path):
     assert np.mean([peak - v for v in model]) < \
         np.mean([peak - v for v in rand]) / 2, (model, rand)
     assert np.median(model) == peak, model
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

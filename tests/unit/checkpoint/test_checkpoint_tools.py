@@ -273,3 +273,8 @@ def test_sd_factory_json_descriptor(tmp_path):
     loader = SDLoaderFactory.get_sd_loader_json(str(jpath))
     merged = loader.load(mp_world_size=1)
     assert merged["attention.query_key_value.weight"].shape == (24, 8)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

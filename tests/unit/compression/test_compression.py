@@ -239,3 +239,8 @@ def test_binary_and_ternary_quantizers():
     f = jax.jit(lambda w, bits: fake_quantize_ste(w, bits))
     for bits in (8.0, 4.0, 2.0, 1.0):
         assert bool(jnp.all(jnp.isfinite(f(w, jnp.float32(bits)))))
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

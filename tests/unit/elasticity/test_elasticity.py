@@ -212,3 +212,8 @@ def test_ds_elastic_cli(tmp_path, capsys):
     assert out["micro_batch_per_rank"] in (2, 4)
     assert out["final_batch_size"] == out["micro_batch_per_rank"] * 4 * \
         out["gradient_accumulation_steps"]
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -114,3 +114,8 @@ def test_bert_int8_serving():
     p_bf16 = jax.nn.log_softmax(lg_bf16[..., :256], axis=-1)
     p_int8 = jax.nn.log_softmax(lg_int8[..., :256], axis=-1)
     assert float(jnp.mean(jnp.abs(p_bf16 - p_int8))) < 0.05
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

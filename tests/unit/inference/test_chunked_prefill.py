@@ -236,3 +236,8 @@ def test_session_fork_prefix_caching():
     assert base.length == 12
     base.append(turn_a)
     np.testing.assert_array_equal(np.asarray(base.generate(6)), ra)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

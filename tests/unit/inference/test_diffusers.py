@@ -400,3 +400,8 @@ def test_full_sd15_shaped_conversion_and_denoise():
     np.testing.assert_allclose(
         [a.mean(), a.std(), a[0, 0, 0, 0]],
         [0.036340, 0.521816, -0.157169], atol=5e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

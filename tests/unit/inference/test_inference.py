@@ -170,3 +170,8 @@ def test_tp_sharded_inference_matches_unsharded():
         config={"dtype": "float32", "tensor_parallel": {"tp_size": 2}})
     got = np.asarray(sharded(prompt))
     np.testing.assert_allclose(got, base, atol=1e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

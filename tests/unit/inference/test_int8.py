@@ -397,3 +397,8 @@ def test_int8_on_trained_weights():
 
 
 
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -305,3 +305,8 @@ def test_extend_rides_chunk_kernel(pallas_interpret, monkeypatch):
     np.testing.assert_allclose(np.asarray(ext),
                                np.asarray(full[:, 8:]),
                                atol=3e-4, rtol=3e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

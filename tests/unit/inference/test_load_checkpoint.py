@@ -76,3 +76,8 @@ def test_module_quantize_grids_weights():
     b = np.asarray(gpt.apply(qparams, tokens, cfg))
     assert np.isfinite(b).all()
     assert np.abs(a - b).max() < 1.0
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -251,3 +251,8 @@ def test_alibi_slopes_match_hf():
         hf_slopes = (hf[:, 0, -1] / 7.0).numpy()  # slope * distance(=7)
         ours = np.asarray(gpt.alibi_slopes(H))
         np.testing.assert_allclose(ours, hf_slopes, rtol=1e-6)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -361,3 +361,8 @@ def test_speculative_moe_target_matches_plain_greedy():
                                       prompt, max_new_tokens=N, draft_k=4)
     assert np.asarray(spec)[0, :N].tolist() == plain
     assert int(fwds) <= N + 1  # never worse than plain + prefill
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

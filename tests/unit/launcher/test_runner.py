@@ -114,3 +114,8 @@ def test_ds_report_runs():
     assert out.returncode == 0, out.stderr
     assert "C++ op report" in out.stdout
     assert "cpu_adam" in out.stdout
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

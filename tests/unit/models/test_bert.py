@@ -165,3 +165,8 @@ def test_bert_tp_sharded_training_parity():
     ref = run(initialize_mesh(ParallelDims(dp=8)), 0)
     got = run(initialize_mesh(ParallelDims(dp=4, tp=2)), 0)
     np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -117,3 +117,8 @@ def test_neo_global_layers_keep_flash_path_parity():
                                np.asarray(gpt.apply(params, tokens,
                                                     cfg_noflash)),
                                atol=1e-4, rtol=1e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -113,3 +113,8 @@ def test_moe_param_split():
     assert dense["wte"] is not None and expert["wte"] is None
     assert dense["moe_blocks"]["experts"]["wi"] is None
     assert expert["moe_blocks"]["experts"]["wi"] is not None
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -184,3 +184,8 @@ def test_moe_long_prompt_prefill_chunks_match_single_shot(monkeypatch):
     np.testing.assert_allclose(np.asarray(c1.moe_k[:, :, :150]),
                                np.asarray(c2.moe_k[:, :, :150]),
                                rtol=2e-5, atol=2e-5)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

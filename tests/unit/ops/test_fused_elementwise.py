@@ -83,3 +83,8 @@ def test_nhwc_spatial_family(pallas_interpret):
     np.testing.assert_allclose(
         np.asarray(nhwc_bias_add_bias_add(x, b1, other, b2)),
         np.asarray(x + b1 + other + b2), atol=1e-6)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

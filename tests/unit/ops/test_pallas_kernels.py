@@ -394,3 +394,8 @@ def test_block_sparse_bf16_operands_match_reference(pallas_interpret):
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(ref_g), atol=6e-2, rtol=6e-2,
                                    err_msg=f"d{name}")
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -236,3 +236,8 @@ def test_fallback_on_untiled_shapes():
     out = block_sparse_attention(q, k, v, lay, block=16, causal=True)
     ref = sparse_mha_reference(q, k, v, lay, block=16, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

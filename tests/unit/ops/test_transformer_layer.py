@@ -90,3 +90,8 @@ def test_dropout_train_mode_is_stochastic_but_seeded():
     assert not np.allclose(np.asarray(y1), np.asarray(y3))
     # eval mode (no rng) is deterministic and different from train draw
     np.testing.assert_array_equal(np.asarray(layer(x)), np.asarray(layer(x)))
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

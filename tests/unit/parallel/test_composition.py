@@ -75,3 +75,8 @@ def test_moe_composes_with_zero3():
                           stage=0, batch=batch,
                           extra={"moe": {"ep_size": 2}})
     np.testing.assert_allclose(z3_losses, z0_losses, rtol=2e-5, atol=2e-5)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

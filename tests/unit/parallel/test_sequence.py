@@ -77,3 +77,8 @@ def test_gpt_train_with_sequence_parallel(impl):
 
     assert np.isfinite(loss_sp)
     np.testing.assert_allclose(loss_sp, loss_dense, rtol=1e-4)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

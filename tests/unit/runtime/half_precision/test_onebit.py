@@ -107,6 +107,7 @@ def test_onebit_adam_compressed_phase_converges():
 
 
 @pytest.mark.parametrize("name", ["OnebitAdam", "OnebitLamb", "ZeroOneAdam"])
+@pytest.mark.slow
 def test_onebit_engine_training(name):
     """Engine-level: each 1-bit optimizer trains tiny GPT, loss decreases."""
     reset_mesh_manager()

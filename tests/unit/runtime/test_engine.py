@@ -268,3 +268,8 @@ def test_grad_accum_dtype_config():
     from deepspeed_tpu.runtime.config import DeepSpeedConfigError
     with pytest.raises(DeepSpeedConfigError, match="grad_accum_dtype"):
         run("int7")
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

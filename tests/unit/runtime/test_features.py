@@ -376,3 +376,8 @@ def test_gathered_parameters_engine_default_is_read_only():
     after = np.asarray(jax.device_get(
         jax.tree_util.tree_leaves(engine.state["master"])[0]))
     np.testing.assert_array_equal(after, before)
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -158,3 +158,8 @@ def test_top_level_reference_exports():
         assert hasattr(d, name), name
     # replace is a pure conversion, so revert is the identity
     assert d.revert_transformer_layer(model="m") == "m"
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

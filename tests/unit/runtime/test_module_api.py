@@ -122,3 +122,8 @@ def test_module_state_dict_roundtrip():
     # strict rejects a mismatched tree
     with pytest.raises(ValueError):
         b.load_module_state_dict({"nope": np.zeros((2, 2), np.float32)})
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow

@@ -123,3 +123,8 @@ def test_stage3_keeps_params_sharded():
     assert n_gathers < n_leaves // 2, (
         f"stage 3 apply gathers {n_gathers}/{n_leaves} params — params "
         f"should stay dp-sharded")
+
+
+# compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
+import pytest as _pytest_tier
+pytestmark = _pytest_tier.mark.slow
