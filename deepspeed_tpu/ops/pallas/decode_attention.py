@@ -181,9 +181,54 @@ def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None,
                                block_k=block_k, H=H, quantized=quantized,
                                windowed=window is not None,
                                alibi=slopes is not None)
+    scratch = [
+        pltpu.VMEM((1, D), jnp.float32),
+        pltpu.VMEM((1, 1), jnp.float32),
+        pltpu.VMEM((1, 1), jnp.float32),
+    ]
+    out_shape = jax.ShapeDtypeStruct((BH, 1, D), q3.dtype)
+    if window is not None:
+        # scalar-prefetch build: pos and window are available BEFORE the
+        # body, so the k/v index maps clamp dead block indices into each
+        # row's live range [first band block, causal frontier block].
+        # Pallas only re-issues a DMA when the mapped block index
+        # changes, so a banded (or short ragged) row streams O(window)
+        # cache bytes instead of O(Smax) — the skip that pl.when alone
+        # (compute elision) cannot provide.
+        def kv_idx(bh, ki, pos_ref, win_ref):
+            p = pos_ref[bh // H]
+            lo = jnp.maximum((p - win_ref[0] + 1) // block_k, 0)
+            hi = p // block_k
+            return (bh, jnp.clip(ki, lo, hi), 0)
+
+        kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
+        scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
+        slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+            if slopes is not None else []
+        slope_args = (jnp.asarray(slopes, jnp.float32),) \
+            if slopes is not None else ()
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # pos_arr, window
+            grid=(BH, Smax // block_k),
+            in_specs=slope_specs + [
+                pl.BlockSpec((1, 1, D), lambda bh, ki, *_: (bh, 0, 0)),
+                kv_spec, kv_spec,
+            ] + ([scale_spec, scale_spec] if quantized else []),
+            out_specs=pl.BlockSpec((1, 1, D),
+                                   lambda bh, ki, *_: (bh, 0, 0)),
+            scratch_shapes=scratch,
+        )
+        # the kernel unpacks [window, slopes?] after pos either way —
+        # prefetch refs arrive in arg order, matching _unpack_rest
+        win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+        args = (pos_arr, win_arr) + slope_args + (q3, k3, v3) + \
+            ((ks3, vs3) if quantized else ())
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape,
+                              interpret=interpret_mode())(*args)
     kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0))
     scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, ki: (bh, ki, 0))
-    extra_args, extra_specs = _optional_operands(window, slopes)
+    extra_args, extra_specs = _optional_operands(None, slopes)
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + [
         pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
         kv_spec, kv_spec,
@@ -195,12 +240,8 @@ def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None,
         grid=(BH, Smax // block_k),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, 1, D), q3.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((1, D), jnp.float32),
-            pltpu.VMEM((1, 1), jnp.float32),
-            pltpu.VMEM((1, 1), jnp.float32),
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret_mode(),
     )(*args)
 
@@ -287,10 +328,51 @@ def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
                                quantized=quantized,
                                windowed=window is not None,
                                alibi=slopes is not None)
+    scratch = [
+        pltpu.VMEM((block_q, D), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+    out_shape = jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype)
+    if window is not None:
+        # scalar-prefetch build (see _decode): clamp dead k-block indices
+        # into this q block's live range so their DMAs collapse into
+        # re-reads of an already-fetched block
+        def kv_idx(bh, qi, ki, pos_ref, win_ref):
+            p = pos_ref[bh // H]
+            lo = jnp.maximum(
+                (p + qi * block_q - win_ref[0] + 1) // block_k, 0)
+            hi = (p + (qi + 1) * block_q - 1) // block_k
+            return (bh, jnp.clip(ki, lo, hi), 0)
+
+        kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
+        scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
+        slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+            if slopes is not None else []
+        slope_args = (jnp.asarray(slopes, jnp.float32),) \
+            if slopes is not None else ()
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, Sq // block_q, Smax // block_k),
+            in_specs=slope_specs + [
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, qi, ki, *_: (bh, qi, 0)),
+                kv_spec, kv_spec,
+            ] + ([scale_spec, scale_spec] if quantized else []),
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda bh, qi, ki, *_: (bh, qi, 0)),
+            scratch_shapes=scratch,
+        )
+        win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+        args = (pos_arr, win_arr) + slope_args + (q3, k3, v3) + \
+            ((ks3, vs3) if quantized else ())
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape,
+                              interpret=interpret_mode())(*args)
     q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
     kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0))
     scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, qi, ki: (bh, ki, 0))
-    extra_args, extra_specs = _optional_operands(window, slopes)
+    extra_args, extra_specs = _optional_operands(None, slopes)
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + \
         [q_spec, kv_spec, kv_spec] + \
         ([scale_spec, scale_spec] if quantized else [])
@@ -302,12 +384,8 @@ def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D),
                                lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret_mode(),
     )(*args)
 
@@ -331,13 +409,14 @@ def cached_attention(q, cache_k, cache_v, pos,
 
     ``window`` (scalar, possibly traced — GPT-Neo's alternating stack
     carries it through a layer scan) bands visibility to the trailing
-    ``window`` slots; the kernels additionally skip the attention
-    COMPUTE for cache blocks wholly below the band (the DMA stream still
-    walks the padded cache — cutting HBM traffic too needs a
-    scalar-prefetch index map that clamps dead block indices, a planned
-    follow-up).  ``slopes`` ([H] fp32) adds the ALiBi ``-slope·dist``
-    bias (BLOOM family) inside the kernel.  Both compose with the int8
-    cache.
+    ``window`` slots.  Windowed calls build with a scalar-prefetch grid
+    spec: ``pos``/``window`` feed the k/v index maps, which clamp dead
+    block indices into each row's live range, so out-of-band blocks are
+    neither computed (``pl.when``) nor re-DMA'd — banded decode streams
+    O(window) HBM bytes per step instead of O(Smax), and short rows of a
+    ragged batch stop at their own frontier.  ``slopes`` ([H] fp32) adds
+    the ALiBi ``-slope·dist`` bias (BLOOM family) inside the kernel.
+    Both compose with the int8 cache.
     """
     B, Sq, H, D = q.shape
     Smax = cache_k.shape[1]

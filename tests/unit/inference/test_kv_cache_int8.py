@@ -310,3 +310,40 @@ def test_extend_rides_chunk_kernel(pallas_interpret, monkeypatch):
 # compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
 import pytest as _pytest_tier
 pytestmark = _pytest_tier.mark.slow
+
+
+@pytest.mark.parametrize("variant", [dict(pos_embed="alibi"),
+                                     dict(local_attention_window=32),
+                                     dict(local_attention_window=32,
+                                          local_attention_alternating=True)])
+def test_streaming_decode_traced_window_under_jit(pallas_interpret, variant):
+    """Integration: decode_step through the model stack with the kernels
+    ON (interpret mode) — the window arrives as a TRACED per-layer scalar
+    from gpt.layer_window inside the layer scan, and the whole step runs
+    under jit, exercising the scalar-prefetch build end-to-end.  Must
+    match the no-kernel (dense fallback) decode bit-for-bit in fp32."""
+    import dataclasses
+    import os
+    cfg = dataclasses.replace(CFG, **variant)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0, 256)
+
+    def run():
+        cache = gpt_inference.init_cache(cfg, 2, 256)
+        _, cache = gpt_inference.prefill(params, tokens[:, :8], cfg, cache)
+        step = jax.jit(lambda t, c: gpt_inference.decode_step(
+            params, t, cfg, c))
+        outs = []
+        for i in range(8, 12):
+            lg, cache = step(tokens[:, i], cache)
+            outs.append(np.asarray(lg))
+        return np.stack(outs)
+
+    with_kernel = run()
+    os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
+    try:
+        dense = run()
+    finally:
+        os.environ["DS_TPU_PALLAS_INTERPRET"] = "1"
+    assert np.isfinite(with_kernel).all()
+    np.testing.assert_allclose(with_kernel, dense, atol=2e-5, rtol=2e-5)
