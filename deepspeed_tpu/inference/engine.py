@@ -124,10 +124,6 @@ class InferenceEngine:
         self._kv_dtype = ("int8" if config.kv_cache_dtype == "int8"
                           else None)
         if isinstance(cfg, GPTMoEConfig):
-            if self._kv_dtype is not None:
-                raise NotImplementedError(
-                    "kv_cache_dtype='int8' serves the dense GPT family; "
-                    "MoE decode caches in the compute dtype")
             from ..models import gpt_moe, gpt_moe_inference as fam
             self._apply_fn = lambda p, t: gpt_moe.apply(p, t, cfg,
                                                         train=False)[0]

@@ -114,13 +114,9 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     # the draft stays dense (a draft's whole point is being small)
     if isinstance(target_cfg, GPTMoEConfig):
         from ..models import gpt_moe_inference as tfam
-        if kv_dtype is not None:
-            raise NotImplementedError(
-                "MoE targets cache in the compute dtype (no int8 KV)")
-        t_cache_kw = {}
     else:
         tfam = gpt_inference
-        t_cache_kw = {"kv_dtype": kv_dtype}
+    t_cache_kw = {"kv_dtype": kv_dtype}
     N, K = int(max_new_tokens), int(draft_k)
     V = target_cfg.vocab_size
     S = prompt.shape[1]

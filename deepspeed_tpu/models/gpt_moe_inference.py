@@ -45,23 +45,47 @@ def _moe_infer_obj(config: GPTMoEConfig):
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class MoEKVCache:
+    """Scale banks are ``None`` for a full-precision cache; for int8
+    (``kv_cache_dtype: "int8"``) the k/v banks hold codes and the scales
+    are per-vector fp32 [P, B, S_max, H, 1] — same layout contract as the
+    dense family's :class:`gpt_inference.KVCache`."""
+
     dense_k: jnp.ndarray   # [P, B, S_max, H, D]
     dense_v: jnp.ndarray
     moe_k: jnp.ndarray
     moe_v: jnp.ndarray
     length: jnp.ndarray    # [] int32
+    dense_k_scale: Any = None
+    dense_v_scale: Any = None
+    moe_k_scale: Any = None
+    moe_v_scale: Any = None
 
     def tree_flatten(self):
         return (self.dense_k, self.dense_v, self.moe_k, self.moe_v,
-                self.length), None
+                self.length, self.dense_k_scale, self.dense_v_scale,
+                self.moe_k_scale, self.moe_v_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
 
+    @property
+    def int8(self) -> bool:
+        return self.dense_k_scale is not None
 
-def init_cache(config: GPTMoEConfig, batch: int, max_len: int) -> MoEKVCache:
+
+def init_cache(config: GPTMoEConfig, batch: int, max_len: int,
+               kv_dtype=None) -> MoEKVCache:
     shape = (config.n_pairs, batch, max_len, config.n_head, config.head_dim)
+    if kv_dtype in ("int8", jnp.int8):
+        zc = lambda: jnp.zeros(shape, jnp.int8)
+        zs = lambda: jnp.zeros(shape[:-1] + (1,), jnp.float32)
+        return MoEKVCache(dense_k=zc(), dense_v=zc(), moe_k=zc(),
+                          moe_v=zc(), length=jnp.zeros((), jnp.int32),
+                          dense_k_scale=zs(), dense_v_scale=zs(),
+                          moe_k_scale=zs(), moe_v_scale=zs())
+    if kv_dtype is not None:
+        raise ValueError(f"unsupported MoE kv_dtype {kv_dtype!r}")
     z = lambda: jnp.zeros(shape, config.dtype)
     return MoEKVCache(dense_k=z(), dense_v=z(), moe_k=z(), moe_v=z(),
                       length=jnp.zeros((), jnp.int32))
@@ -80,13 +104,30 @@ def _attend_prefill(x, p, config, positions):
     return x + gpt.attn_project(attn, p, config), k, v
 
 
-def _attend_decode(x, p, config, ck, cv, pos, positions):
+def _append_kv(ck, cv, ksc, vsc, k, v, pos):
+    """Append fresh K/V at ``pos`` — THE quantize-on-append contract:
+    with scale banks (int8 cache) each head vector quantizes per vector
+    and codes + scales write together; without, the values land in the
+    cache dtype.  Shared by prefill and the decode/extend path so the
+    two can never diverge."""
+    wr = lambda buf, val: lax.dynamic_update_slice(buf, val, (0, pos, 0, 0))
+    if ksc is not None:
+        from ..ops.pallas.decode_attention import quantize_kv
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        return wr(ck, kq), wr(cv, vq), wr(ksc, ks), wr(vsc, vs)
+    return wr(ck, k.astype(ck.dtype)), wr(cv, v.astype(cv.dtype)), None, None
+
+
+def _attend_decode(x, p, config, ck, cv, pos, positions, ksc=None, vsc=None):
+    """Cache-append + cached attention for one sublayer; int8 caches
+    dequantize inside the kernel's VMEM stream (dense-family contract)."""
     from .gpt_inference import _cached_attention
     q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-    attn = _cached_attention(q, ck, cv, pos, config)
-    return x + gpt.attn_project(attn, p, config), ck, cv
+    ck, cv, ksc, vsc = _append_kv(ck, cv, ksc, vsc, k, v, pos)
+    attn = _cached_attention(q, ck, cv, pos, config, k_scale=ksc,
+                             v_scale=vsc)
+    return x + gpt.attn_project(attn, p, config), ck, cv, ksc, vsc
 
 
 # dropless gating reserves capacity = tokens-per-call, so the dispatch/
@@ -120,24 +161,29 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
     x = gpt.embed(params, tokens, config, positions=positions)
 
     def pair(x, xs):
-        dense_p, attn_p, moe_p, dck, dcv, mck, mcv = xs
+        dense_p, attn_p, moe_p, dck, dcv, mck, mcv, dks, dvs, mks, mvs = xs
         x, k, v = _attend_prefill(x, dense_p, config, positions)
-        dck = lax.dynamic_update_slice(dck, k.astype(dck.dtype), (0, 0, 0, 0))
-        dcv = lax.dynamic_update_slice(dcv, v.astype(dcv.dtype), (0, 0, 0, 0))
+        dck, dcv, dks, dvs = _append_kv(dck, dcv, dks, dvs, k, v, 0)
         x = gpt.mlp_residual(x, dense_p, config)
         x, k, v = _attend_prefill(x, attn_p, config, positions)
-        mck = lax.dynamic_update_slice(mck, k.astype(mck.dtype), (0, 0, 0, 0))
-        mcv = lax.dynamic_update_slice(mcv, v.astype(mcv.dtype), (0, 0, 0, 0))
+        mck, mcv, mks, mvs = _append_kv(mck, mcv, mks, mvs, k, v, 0)
         x = _moe_ffn(x, attn_p, moe_p, moe, config)
-        return x, (dck, dcv, mck, mcv)
+        return x, (dck, dcv, mck, mcv, dks, dvs, mks, mvs)
 
-    x, (dk, dv, mk, mv) = lax.scan(
+    # scale banks are None for fp caches — lax.scan threads None through
+    # xs/ys as an empty pytree, so one scan serves both layouts
+    x, (dk, dv, mk, mv, dks, dvs, mks, mvs) = lax.scan(
         pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
                   params["moe_blocks"], cache.dense_k, cache.dense_v,
-                  cache.moe_k, cache.moe_v))
+                  cache.moe_k, cache.moe_v, cache.dense_k_scale,
+                  cache.dense_v_scale, cache.moe_k_scale,
+                  cache.moe_v_scale))
     logits = gpt.lm_logits(params, x, config)
-    return logits, MoEKVCache(dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
-                              length=jnp.asarray(S, jnp.int32))
+    return logits, MoEKVCache(
+        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
+        length=jnp.asarray(S, jnp.int32),
+        dense_k_scale=dks, dense_v_scale=dvs,
+        moe_k_scale=mks, moe_v_scale=mvs)
 
 
 def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
@@ -161,22 +207,27 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
     x = gpt.embed(params, tokens, config, positions=positions)
 
     def pair(x, xs):
-        dense_p, attn_p, moe_p, dck, dcv, mck, mcv = xs
-        x, dck, dcv = _attend_decode(x, dense_p, config, dck, dcv, pos0,
-                                     positions)
+        dense_p, attn_p, moe_p, dck, dcv, mck, mcv, dks, dvs, mks, mvs = xs
+        x, dck, dcv, dks, dvs = _attend_decode(
+            x, dense_p, config, dck, dcv, pos0, positions, dks, dvs)
         x = gpt.mlp_residual(x, dense_p, config)
-        x, mck, mcv = _attend_decode(x, attn_p, config, mck, mcv, pos0,
-                                     positions)
+        x, mck, mcv, mks, mvs = _attend_decode(
+            x, attn_p, config, mck, mcv, pos0, positions, mks, mvs)
         x = _moe_ffn(x, attn_p, moe_p, moe, config)
-        return x, (dck, dcv, mck, mcv)
+        return x, (dck, dcv, mck, mcv, dks, dvs, mks, mvs)
 
-    x, (dk, dv, mk, mv) = lax.scan(
+    # scale banks are None for fp caches (see prefill)
+    x, (dk, dv, mk, mv, dks, dvs, mks, mvs) = lax.scan(
         pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
                   params["moe_blocks"], cache.dense_k, cache.dense_v,
-                  cache.moe_k, cache.moe_v))
+                  cache.moe_k, cache.moe_v, cache.dense_k_scale,
+                  cache.dense_v_scale, cache.moe_k_scale,
+                  cache.moe_v_scale))
     logits = gpt.lm_logits(params, x, config)
-    return logits, MoEKVCache(dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
-                              length=pos0 + Sc)
+    return logits, MoEKVCache(
+        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv, length=pos0 + Sc,
+        dense_k_scale=dks, dense_v_scale=dvs,
+        moe_k_scale=mks, moe_v_scale=mvs)
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,

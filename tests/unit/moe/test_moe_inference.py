@@ -189,3 +189,31 @@ def test_moe_long_prompt_prefill_chunks_match_single_shot(monkeypatch):
 # compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
 import pytest as _pytest_tier
 pytestmark = _pytest_tier.mark.slow
+
+
+def test_moe_int8_cache_decode_tracks_fp_cache():
+    """MoE int8 KV: prefill + decode through quantized banks tracks the
+    fp cache within per-vector int8 error, and the scale banks advance
+    with the cache (same contract as the dense family's int8 cache)."""
+    params = _params()
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, 128, size=(2, 12)), jnp.int32)
+    c_fp = gpt_moe_inference.init_cache(CFG, 2, 32)
+    c_q = gpt_moe_inference.init_cache(CFG, 2, 32, kv_dtype="int8")
+    assert c_q.int8 and c_q.moe_k.dtype == jnp.int8
+    assert c_q.moe_k_scale.shape == (CFG.n_pairs, 2, 32, CFG.n_head, 1)
+
+    lg_fp, c_fp = gpt_moe_inference.prefill(params, tokens[:, :8], CFG, c_fp)
+    lg_q, c_q = gpt_moe_inference.prefill(params, tokens[:, :8], CFG, c_q)
+    # prefill attends to the fresh unpadded fp k/v — logits identical
+    np.testing.assert_allclose(np.asarray(lg_q), np.asarray(lg_fp),
+                               atol=1e-5, rtol=1e-5)
+    for i in range(8, 12):
+        lfp, c_fp = gpt_moe_inference.decode_step(params, tokens[:, i],
+                                                  CFG, c_fp)
+        lq, c_q = gpt_moe_inference.decode_step(params, tokens[:, i],
+                                                CFG, c_q)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(lfp),
+                                   atol=0.05, rtol=0.05,
+                                   err_msg=f"step {i}")
+    assert int(c_q.length) == 12
