@@ -216,7 +216,8 @@ class InferenceEngine:
         tokens: [B, S] prompt.  Unequal-length prompts: RIGHT-pad to S and
         pass the true lengths as ``prompt_lens`` [B] — each row continues
         from its own last real token, with per-row visibility masking in
-        the decode kernel (GPT families; MoE serving is uniform-only).
+        the decode kernel (all served families, MoE included — dropless
+        gating keeps ragged rows' routing independent).
         ``eos_token_id`` stops early once every row has emitted it
         (finished rows keep emitting eos); ``top_k``/``top_p`` shape the
         sampling distribution.  Returns [B, max_new_tokens].
@@ -225,11 +226,6 @@ class InferenceEngine:
         B, S = tokens.shape
         is_ragged = prompt_lens is not None
         if is_ragged:
-            from ..models import gpt_inference
-            if self._family is not gpt_inference:
-                raise NotImplementedError(
-                    "ragged prompt_lens is supported for the dense GPT "
-                    "family only (MoE serving decodes uniform batches)")
             lens_np = np.asarray(prompt_lens)
             if lens_np.shape != (B,):
                 raise ValueError(f"prompt_lens shape {lens_np.shape} != ({B},)")

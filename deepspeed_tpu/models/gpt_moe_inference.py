@@ -104,13 +104,19 @@ def _attend_prefill(x, p, config, positions):
     return x + gpt.attn_project(attn, p, config), k, v
 
 
-def _append_kv(ck, cv, ksc, vsc, k, v, pos):
+def _append_kv(ck, cv, ksc, vsc, k, v, pos, ragged=False):
     """Append fresh K/V at ``pos`` — THE quantize-on-append contract:
     with scale banks (int8 cache) each head vector quantizes per vector
     and codes + scales write together; without, the values land in the
     cache dtype.  Shared by prefill and the decode/extend path so the
-    two can never diverge."""
-    wr = lambda buf, val: lax.dynamic_update_slice(buf, val, (0, pos, 0, 0))
+    two can never diverge.  ``ragged``: pos is [B] and each row's single
+    new column lands on ITS next slot (dense-family decode contract)."""
+    if ragged:
+        B = k.shape[0]
+        wr = lambda buf, val: buf.at[jnp.arange(B), pos].set(val[:, 0])
+    else:
+        wr = lambda buf, val: lax.dynamic_update_slice(buf, val,
+                                                       (0, pos, 0, 0))
     if ksc is not None:
         from ..ops.pallas.decode_attention import quantize_kv
         kq, ks = quantize_kv(k)
@@ -119,12 +125,15 @@ def _append_kv(ck, cv, ksc, vsc, k, v, pos):
     return wr(ck, k.astype(ck.dtype)), wr(cv, v.astype(cv.dtype)), None, None
 
 
-def _attend_decode(x, p, config, ck, cv, pos, positions, ksc=None, vsc=None):
+def _attend_decode(x, p, config, ck, cv, pos, positions, ksc=None,
+                   vsc=None, ragged=False):
     """Cache-append + cached attention for one sublayer; int8 caches
-    dequantize inside the kernel's VMEM stream (dense-family contract)."""
+    dequantize inside the kernel's VMEM stream (dense-family contract).
+    ``ragged``: pos is [B] — per-row append and per-row visibility."""
     from .gpt_inference import _cached_attention
     q, k, v = gpt.qkv_proj(x, p, config, positions=positions)
-    ck, cv, ksc, vsc = _append_kv(ck, cv, ksc, vsc, k, v, pos)
+    ck, cv, ksc, vsc = _append_kv(ck, cv, ksc, vsc, k, v, pos,
+                                  ragged=ragged)
     attn = _cached_attention(q, ck, cv, pos, config, k_scale=ksc,
                              v_scale=vsc)
     return x + gpt.attn_project(attn, p, config), ck, cv, ksc, vsc
@@ -231,8 +240,44 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
-                cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+                cache: MoEKVCache,
+                lengths=None) -> Tuple[jnp.ndarray, MoEKVCache]:
     """One-token decode through both banks; token [B] int32 — a 1-token
-    ``extend`` with the chunk axis squeezed."""
-    logits, cache = extend(params, token[:, None], config, cache)
-    return logits[:, 0], cache
+    ``extend`` with the chunk axis squeezed.  With ``lengths`` [B]
+    (ragged right-padded prompts, dense-family contract) each row's
+    token lands on ITS next slot and sees only ITS live prefix; dropless
+    gating keeps rows independent, so ragged batching cannot perturb a
+    row's routing."""
+    if lengths is None:
+        logits, cache = extend(params, token[:, None], config, cache)
+        return logits[:, 0], cache
+    B = token.shape[0]
+    pos = lengths
+    positions = pos[:, None]
+    moe = _moe_infer_obj(config)
+    x = gpt.embed(params, token[:, None], config, positions=positions)
+
+    def pair(x, xs):
+        dense_p, attn_p, moe_p, dck, dcv, mck, mcv, dks, dvs, mks, mvs = xs
+        x, dck, dcv, dks, dvs = _attend_decode(
+            x, dense_p, config, dck, dcv, pos, positions, dks, dvs,
+            ragged=True)
+        x = gpt.mlp_residual(x, dense_p, config)
+        x, mck, mcv, mks, mvs = _attend_decode(
+            x, attn_p, config, mck, mcv, pos, positions, mks, mvs,
+            ragged=True)
+        x = _moe_ffn(x, attn_p, moe_p, moe, config)
+        return x, (dck, dcv, mck, mcv, dks, dvs, mks, mvs)
+
+    x, (dk, dv, mk, mv, dks, dvs, mks, mvs) = lax.scan(
+        pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
+                  params["moe_blocks"], cache.dense_k, cache.dense_v,
+                  cache.moe_k, cache.moe_v, cache.dense_k_scale,
+                  cache.dense_v_scale, cache.moe_k_scale,
+                  cache.moe_v_scale))
+    logits = gpt.lm_logits(params, x[:, 0], config)
+    return logits, MoEKVCache(
+        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
+        length=jnp.max(pos) + 1,
+        dense_k_scale=dks, dense_v_scale=dvs,
+        moe_k_scale=mks, moe_v_scale=mvs)

@@ -141,6 +141,9 @@ def test_kv_cache_int8_serves_moe():
     assert out_q.shape == (2, 8)
     agree = float(np.mean(out_q == out_b))
     assert agree >= 0.5, (agree, out_q, out_b)
+    # ragged prompts compose with the int8 MoE cache too
+    out_r = q.generate(prompt, max_new_tokens=4, prompt_lens=[6, 10])
+    assert np.asarray(out_r).shape == (2, 4)
 
 
 @pytest.mark.parametrize("variant", [dict(pos_embed="alibi"),
@@ -164,6 +167,9 @@ def test_kv_cache_int8_serves_alibi_and_windowed(variant):
     assert out_q.shape == (2, 8)
     agree = float(np.mean(out_q == out_b))
     assert agree >= 0.5, (agree, out_q, out_b)
+    # ragged prompts compose with the int8 MoE cache too
+    out_r = q.generate(prompt, max_new_tokens=4, prompt_lens=[6, 10])
+    assert np.asarray(out_r).shape == (2, 4)
 
 
 # ------------------------------------------------ window/alibi kernel parity

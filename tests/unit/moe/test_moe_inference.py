@@ -217,3 +217,60 @@ def test_moe_int8_cache_decode_tracks_fp_cache():
                                    atol=0.05, rtol=0.05,
                                    err_msg=f"step {i}")
     assert int(c_q.length) == 12
+
+
+def test_moe_ragged_decode_matches_per_row():
+    """Ragged MoE decode: right-padded rows with per-row lengths must
+    produce the same logits as decoding each row alone (dropless gating
+    keeps routing per-token, so batching cannot perturb a row)."""
+    params = _params()
+    rng = np.random.default_rng(4)
+    full = jnp.asarray(rng.integers(0, 128, size=(2, 10)), jnp.int32)
+    lens = np.asarray([6, 10])
+    padded = np.array(full)  # writable copy
+    padded[0, 6:] = 0
+    padded = jnp.asarray(padded)
+
+    # batched ragged: prefill the padded batch, then 3 ragged steps
+    cache = gpt_moe_inference.init_cache(CFG, 2, 32)
+    lg, cache = gpt_moe_inference.prefill(params, padded, CFG, cache)
+    pos = jnp.asarray(lens, jnp.int32)
+    nxt = jnp.argmax(lg[jnp.arange(2), pos - 1, :128], -1).astype(jnp.int32)
+    ragged_logits = []
+    for _ in range(3):
+        lgs, cache = gpt_moe_inference.decode_step(params, nxt, CFG, cache,
+                                                   lengths=pos)
+        ragged_logits.append(np.asarray(lgs))
+        nxt = jnp.argmax(lgs[:, :128], -1).astype(jnp.int32)
+        pos = pos + 1
+
+    # per-row solo runs
+    for row in range(2):
+        L = int(lens[row])
+        c1 = gpt_moe_inference.init_cache(CFG, 1, 32)
+        lg1, c1 = gpt_moe_inference.prefill(params, full[row:row + 1, :L],
+                                            CFG, c1)
+        n1 = jnp.argmax(lg1[:, -1, :128], -1).astype(jnp.int32)
+        for s in range(3):
+            l1, c1 = gpt_moe_inference.decode_step(params, n1, CFG, c1)
+            np.testing.assert_allclose(ragged_logits[s][row],
+                                       np.asarray(l1)[0],
+                                       rtol=2e-5, atol=2e-5,
+                                       err_msg=f"row {row} step {s}")
+            n1 = jnp.argmax(l1[:, :128], -1).astype(jnp.int32)
+
+
+def test_moe_engine_ragged_generate():
+    """Engine-level ragged MoE serving (refusal removed): right-padded
+    prompts with prompt_lens decode per-row."""
+    import deepspeed_tpu
+    params = _params()
+    eng = deepspeed_tpu.init_inference(model=(CFG, params),
+                                       config={"dtype": "float32"})
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 128, (2, 10)), jnp.int32)
+    out = eng.generate(prompt, max_new_tokens=4, prompt_lens=[6, 10])
+    assert np.asarray(out).shape == (2, 4)
+    # row 1 (full-length) must match the uniform path
+    solo = eng.generate(prompt[1:], max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out)[1], np.asarray(solo)[0])
