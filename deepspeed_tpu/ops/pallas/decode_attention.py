@@ -79,23 +79,11 @@ def cached_attention_reference(q, cache_k, cache_v, pos,
 M_FLOOR = -1e30
 
 
-def _optional_operands(window, slopes):
-    """(extra_args, extra_specs) for the optional SMEM operands — the
-    single source of the operand ordering the kernels unpack."""
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    args, specs = (), []
-    if window is not None:
-        args += (jnp.asarray(window, jnp.int32).reshape(1),)
-        specs.append(smem)
-    if slopes is not None:
-        args += (jnp.asarray(slopes, jnp.float32),)
-        specs.append(smem)
-    return args, specs
-
-
 def _unpack_rest(rest, quantized, windowed, alibi):
-    """Positional unpack mirroring :func:`_optional_operands`: [window?,
-    slopes?, q, k, v, kscale?, vscale?, o, acc, m, l]."""
+    """Positional unpack of everything after ``pos_ref``, mirroring the
+    wrappers' argument order: [window?, slopes?, q, k, v, kscale?,
+    vscale?, o, acc, m, l] (pos and window are scalar-prefetch operands,
+    so they lead)."""
     i = 0
     window_ref = slopes_ref = kscale_ref = vscale_ref = None
     if windowed:
@@ -172,78 +160,59 @@ def _decode_kernel(pos_ref, *rest, sm_scale, block_k, H, quantized,
 
 def _decode(q3, k3, v3, pos, sm_scale, block_k, H, ks3=None, vs3=None,
             window=None, slopes=None):
+    """Single scalar-prefetch build for every decode variant: pos (and
+    window, when banded) are available BEFORE the body, so the k/v index
+    maps clamp dead block indices into each row's live range
+    [band start, causal frontier].  Pallas only re-issues a DMA when the
+    mapped block index changes, so decode streams the live prefix — and
+    a banded or short ragged row only ITS band — instead of O(Smax)
+    cache bytes; ``pl.when`` still elides the dead blocks' compute."""
     BH, _, D = q3.shape
     Smax = k3.shape[1]
     B = BH // H
     quantized = ks3 is not None
+    windowed = window is not None
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
                                block_k=block_k, H=H, quantized=quantized,
-                               windowed=window is not None,
-                               alibi=slopes is not None)
-    scratch = [
-        pltpu.VMEM((1, D), jnp.float32),
-        pltpu.VMEM((1, 1), jnp.float32),
-        pltpu.VMEM((1, 1), jnp.float32),
-    ]
-    out_shape = jax.ShapeDtypeStruct((BH, 1, D), q3.dtype)
-    if window is not None:
-        # scalar-prefetch build: pos and window are available BEFORE the
-        # body, so the k/v index maps clamp dead block indices into each
-        # row's live range [first band block, causal frontier block].
-        # Pallas only re-issues a DMA when the mapped block index
-        # changes, so a banded (or short ragged) row streams O(window)
-        # cache bytes instead of O(Smax) — the skip that pl.when alone
-        # (compute elision) cannot provide.
-        def kv_idx(bh, ki, pos_ref, win_ref):
-            p = pos_ref[bh // H]
-            lo = jnp.maximum((p - win_ref[0] + 1) // block_k, 0)
-            hi = p // block_k
-            return (bh, jnp.clip(ki, lo, hi), 0)
+                               windowed=windowed, alibi=slopes is not None)
 
-        kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
-        scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
-        slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
-            if slopes is not None else []
-        slope_args = (jnp.asarray(slopes, jnp.float32),) \
-            if slopes is not None else ()
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # pos_arr, window
-            grid=(BH, Smax // block_k),
-            in_specs=slope_specs + [
-                pl.BlockSpec((1, 1, D), lambda bh, ki, *_: (bh, 0, 0)),
-                kv_spec, kv_spec,
-            ] + ([scale_spec, scale_spec] if quantized else []),
-            out_specs=pl.BlockSpec((1, 1, D),
-                                   lambda bh, ki, *_: (bh, 0, 0)),
-            scratch_shapes=scratch,
-        )
-        # the kernel unpacks [window, slopes?] after pos either way —
-        # prefetch refs arrive in arg order, matching _unpack_rest
-        win_arr = jnp.asarray(window, jnp.int32).reshape(1)
-        args = (pos_arr, win_arr) + slope_args + (q3, k3, v3) + \
-            ((ks3, vs3) if quantized else ())
-        return pl.pallas_call(kernel, grid_spec=grid_spec,
-                              out_shape=out_shape,
-                              interpret=interpret_mode())(*args)
-    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0))
-    scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, ki: (bh, ki, 0))
-    extra_args, extra_specs = _optional_operands(None, slopes)
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + [
-        pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
-        kv_spec, kv_spec,
-    ] + ([scale_spec, scale_spec] if quantized else [])
-    args = (pos_arr,) + extra_args + (q3, k3, v3) + \
-        ((ks3, vs3) if quantized else ())
-    return pl.pallas_call(
-        kernel,
+    def kv_idx(bh, ki, pos_ref, *maybe_win):
+        p = pos_ref[bh // H]
+        lo = jnp.maximum((p - maybe_win[0][0] + 1) // block_k, 0) \
+            if windowed else 0
+        return (bh, jnp.clip(ki, lo, p // block_k), 0)
+
+    kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
+    scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
+    slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+        if slopes is not None else []
+    slope_args = (jnp.asarray(slopes, jnp.float32),) \
+        if slopes is not None else ()
+    win_args = (jnp.asarray(window, jnp.int32).reshape(1),) \
+        if windowed else ()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1 + len(win_args),  # pos_arr [, window]
         grid=(BH, Smax // block_k),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, D), lambda bh, ki: (bh, 0, 0)),
-        out_shape=out_shape,
-        scratch_shapes=scratch,
-        interpret=interpret_mode(),
-    )(*args)
+        in_specs=slope_specs + [
+            pl.BlockSpec((1, 1, D), lambda bh, ki, *_: (bh, 0, 0)),
+            kv_spec, kv_spec,
+        ] + ([scale_spec, scale_spec] if quantized else []),
+        out_specs=pl.BlockSpec((1, 1, D), lambda bh, ki, *_: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    # prefetch refs arrive in arg order — [pos, window?] then slopes? —
+    # matching _unpack_rest's ordering contract
+    args = (pos_arr,) + win_args + slope_args + (q3, k3, v3) + \
+        ((ks3, vs3) if quantized else ())
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct((BH, 1, D),
+                                                         q3.dtype),
+                          interpret=interpret_mode())(*args)
 
 
 def _chunk_kernel(pos_ref, *rest, sm_scale, block_q, block_k, H, quantized,
@@ -322,72 +291,53 @@ def _chunk(q3, k3, v3, pos, sm_scale, block_q, block_k, H, ks3=None,
     Smax = k3.shape[1]
     B = BH // H
     quantized = ks3 is not None
+    windowed = window is not None
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
     kernel = functools.partial(_chunk_kernel, sm_scale=sm_scale,
                                block_q=block_q, block_k=block_k, H=H,
-                               quantized=quantized,
-                               windowed=window is not None,
+                               quantized=quantized, windowed=windowed,
                                alibi=slopes is not None)
-    scratch = [
-        pltpu.VMEM((block_q, D), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
-    ]
-    out_shape = jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype)
-    if window is not None:
-        # scalar-prefetch build (see _decode): clamp dead k-block indices
-        # into this q block's live range so their DMAs collapse into
-        # re-reads of an already-fetched block
-        def kv_idx(bh, qi, ki, pos_ref, win_ref):
-            p = pos_ref[bh // H]
-            lo = jnp.maximum(
-                (p + qi * block_q - win_ref[0] + 1) // block_k, 0)
-            hi = (p + (qi + 1) * block_q - 1) // block_k
-            return (bh, jnp.clip(ki, lo, hi), 0)
+    # single scalar-prefetch build (see _decode): dead k-block indices
+    # clamp into this q block's live range [band start, causal frontier],
+    # so chunked prefill/extend streams only the blocks its rows can see
+    def kv_idx(bh, qi, ki, pos_ref, *maybe_win):
+        p = pos_ref[bh // H]
+        lo = jnp.maximum(
+            (p + qi * block_q - maybe_win[0][0] + 1) // block_k, 0) \
+            if windowed else 0
+        hi = (p + (qi + 1) * block_q - 1) // block_k
+        return (bh, jnp.clip(ki, lo, hi), 0)
 
-        kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
-        scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
-        slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
-            if slopes is not None else []
-        slope_args = (jnp.asarray(slopes, jnp.float32),) \
-            if slopes is not None else ()
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(BH, Sq // block_q, Smax // block_k),
-            in_specs=slope_specs + [
-                pl.BlockSpec((1, block_q, D),
-                             lambda bh, qi, ki, *_: (bh, qi, 0)),
-                kv_spec, kv_spec,
-            ] + ([scale_spec, scale_spec] if quantized else []),
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda bh, qi, ki, *_: (bh, qi, 0)),
-            scratch_shapes=scratch,
-        )
-        win_arr = jnp.asarray(window, jnp.int32).reshape(1)
-        args = (pos_arr, win_arr) + slope_args + (q3, k3, v3) + \
-            ((ks3, vs3) if quantized else ())
-        return pl.pallas_call(kernel, grid_spec=grid_spec,
-                              out_shape=out_shape,
-                              interpret=interpret_mode())(*args)
-    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0))
-    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0))
-    scale_spec = pl.BlockSpec((1, block_k, 1), lambda bh, qi, ki: (bh, ki, 0))
-    extra_args, extra_specs = _optional_operands(None, slopes)
-    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + extra_specs + \
-        [q_spec, kv_spec, kv_spec] + \
-        ([scale_spec, scale_spec] if quantized else [])
-    args = (pos_arr,) + extra_args + (q3, k3, v3) + \
-        ((ks3, vs3) if quantized else ())
-    return pl.pallas_call(
-        kernel,
+    kv_spec = pl.BlockSpec((1, block_k, D), kv_idx)
+    scale_spec = pl.BlockSpec((1, block_k, 1), kv_idx)
+    slope_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] \
+        if slopes is not None else []
+    slope_args = (jnp.asarray(slopes, jnp.float32),) \
+        if slopes is not None else ()
+    win_args = (jnp.asarray(window, jnp.int32).reshape(1),) \
+        if windowed else ()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1 + len(win_args),
         grid=(BH, Sq // block_q, Smax // block_k),
-        in_specs=in_specs,
+        in_specs=slope_specs + [
+            pl.BlockSpec((1, block_q, D),
+                         lambda bh, qi, ki, *_: (bh, qi, 0)),
+            kv_spec, kv_spec,
+        ] + ([scale_spec, scale_spec] if quantized else []),
         out_specs=pl.BlockSpec((1, block_q, D),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=out_shape,
-        scratch_shapes=scratch,
-        interpret=interpret_mode(),
-    )(*args)
+                               lambda bh, qi, ki, *_: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+    )
+    args = (pos_arr,) + win_args + slope_args + (q3, k3, v3) + \
+        ((ks3, vs3) if quantized else ())
+    return pl.pallas_call(kernel, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct((BH, Sq, D),
+                                                         q3.dtype),
+                          interpret=interpret_mode())(*args)
 
 
 def cached_attention(q, cache_k, cache_v, pos,
