@@ -319,13 +319,9 @@ class InferenceEngine:
         """A stateful multi-turn session over one persistent KV cache:
         ``append`` prefills/extends with each turn's tokens (chunked
         prefill — the conversation is never re-prefilled), ``generate``
-        decodes a reply that stays in the cache.  Dense GPT family only.
+        decodes a reply that stays in the cache.  Serves every family —
+        MoE sessions ride ``gpt_moe_inference.extend`` the same way.
         """
-        from ..models import gpt_inference
-        if self._family is not gpt_inference:
-            raise NotImplementedError(
-                "sessions ride the dense GPT family's chunked prefill; "
-                "MoE serving decodes stateless batches")
         return InferenceSession(self, batch,
                                 max_len or self.model_config.max_seq_len)
 
@@ -334,7 +330,7 @@ class InferenceEngine:
         sessions (jit caches key on the wrapped function object, so fresh
         per-session lambdas would recompile per conversation)."""
         if not hasattr(self, "_session_progs"):
-            from ..models import gpt_inference as fam
+            fam = self._family
             cfg = self.model_config
             self._session_progs = {
                 "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
@@ -362,7 +358,7 @@ class InferenceSession:
     """
 
     def __init__(self, engine: InferenceEngine, batch: int, max_len: int):
-        from ..models import gpt_inference as fam
+        fam = engine._family
         cfg = engine.model_config
         self._engine = engine
         self._progs = engine._session_programs()
@@ -413,7 +409,7 @@ class InferenceSession:
         sig = (n, sample, top_k, top_p)
         if sig not in self._progs["reply"]:
             cfg = self._engine.model_config
-            from ..models import gpt_inference as fam
+            fam = self._engine._family
             from .sampling import filter_logits
 
             def reply(params, last, cache, key, temperature):
@@ -449,7 +445,7 @@ class InferenceSession:
             raise ValueError(
                 "top_k/top_p only apply with do_sample=True (greedy "
                 "would silently ignore the filters)")
-        B = self.cache.k.shape[1]
+        B = self.cache.batch
         if max_new_tokens <= 0:
             return jnp.zeros((B, 0), jnp.int32)
         self._check_room(max_new_tokens)

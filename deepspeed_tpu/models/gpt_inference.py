@@ -57,6 +57,10 @@ class KVCache:
         return cls(*children)
 
     @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
     def max_len(self) -> int:
         return self.k.shape[2]
 

@@ -70,6 +70,14 @@ class MoEKVCache:
         return cls(*children)
 
     @property
+    def batch(self) -> int:
+        return self.dense_k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.dense_k.shape[2]
+
+    @property
     def int8(self) -> bool:
         return self.dense_k_scale is not None
 

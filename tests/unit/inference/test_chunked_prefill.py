@@ -143,17 +143,48 @@ def test_inference_session_int8_cache():
     assert out.shape == (2, 4) and s.length == 13
 
 
-def test_session_moe_refuses():
+def test_session_moe_multi_turn():
+    """MoE sessions (refusal removed): turns + replies over one
+    persistent dual-bank cache match the stateless MoE engine run on the
+    concatenated history."""
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt_moe
     mcfg = gpt_moe.GPTMoEConfig(vocab_size=128, max_seq_len=64, n_layer=2,
                                 n_head=2, d_model=32, dtype=jnp.float32,
                                 vocab_round_to=128, num_experts=2)
-    eng = deepspeed_tpu.init_inference(
-        model=(mcfg, gpt_moe.init(mcfg, jax.random.PRNGKey(0))),
-        config={"dtype": "float32"})
-    with pytest.raises(NotImplementedError, match="session"):
-        eng.start_session()
+    mparams = gpt_moe.init(mcfg, jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model=(mcfg, mparams),
+                                       config={"dtype": "float32"})
+    rng = np.random.default_rng(3)
+    t1 = jnp.asarray(rng.integers(0, 128, (1, 8)), jnp.int32)
+    t2 = jnp.asarray(rng.integers(0, 128, (1, 5)), jnp.int32)
+
+    s = eng.start_session(batch=1, max_len=64)
+    s.append(t1)
+    r1 = s.generate(max_new_tokens=4)
+    assert s.length == 12
+    s.append(t2)
+    r2 = s.generate(max_new_tokens=4)
+    assert s.length == 21
+
+    ref1 = eng.generate(t1, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(ref1))
+    hist2 = jnp.concatenate([t1, r1, t2], axis=1)
+    ref2 = eng.generate(hist2, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(ref2))
+
+    # fork shares the prefix state zero-copy
+    f = s.fork()
+    assert f.cache is s.cache and f.length == s.length
+
+    # int8 MoE session composes
+    q = deepspeed_tpu.init_inference(
+        model=(mcfg, mparams),
+        config={"dtype": "float32", "kv_cache_dtype": "int8"})
+    sq = q.start_session(batch=1, max_len=64)
+    assert sq.cache.int8
+    sq.append(t1)
+    assert sq.generate(max_new_tokens=4).shape == (1, 4)
 
 
 def test_sessions_share_compiled_programs():
