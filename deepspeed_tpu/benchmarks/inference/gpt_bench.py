@@ -29,7 +29,8 @@ import numpy as np
 
 def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
               new_tokens: int = 64, dtype: str = "bfloat16",
-              warmup: int = 3, kv_cache_dtype: str = "auto") -> Dict[str, Any]:
+              warmup: int = 3, kv_cache_dtype: str = "auto",
+              variant: str = "learned") -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
 
@@ -44,6 +45,17 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     config = dataclasses.replace(
         gpt.PRESETS[model],
         dtype=jnp.float32 if dtype == "float32" else jnp.bfloat16)
+    # variant rows measure the attention-architecture kernels: 'alibi'
+    # = in-kernel bias (BLOOM shape), 'windowed:N' = banded decode whose
+    # dead cache blocks are neither computed nor DMA'd (GPT-Neo shape —
+    # the decode row should approach O(window) as prompt grows)
+    if variant == "alibi":
+        config = dataclasses.replace(config, pos_embed="alibi")
+    elif variant.startswith("windowed"):
+        w = int(variant.split(":", 1)[1]) if ":" in variant else 256
+        config = dataclasses.replace(config, local_attention_window=w)
+    elif variant != "learned":
+        raise ValueError(f"unknown variant {variant!r}")
     params = gpt.init(config, jax.random.PRNGKey(0))
     eng_cfg = ({"dtype": "int8", "quant": {"int8_compute": True}}
                if dtype == "int8-compute" else {"dtype": dtype})
@@ -111,7 +123,7 @@ def run_bench(model: str = "gpt2-125m", batch: int = 1, prompt: int = 128,
     return {
         "model": model, "batch": batch, "prompt": prompt,
         "new_tokens": new_tokens, "dtype": dtype,
-        "kv_cache_dtype": kv_cache_dtype,
+        "kv_cache_dtype": kv_cache_dtype, "variant": variant,
         "prefill_ms": round(prefill_ms, 2),
         "token_latency_ms": {
             "p50": round(float(np.percentile(lat, 50)), 3),
@@ -137,12 +149,17 @@ def main() -> None:
                     choices=["auto", "int8"],
                     help="int8 stores the KV cache as codes + per-vector "
                     "scales (half the HBM footprint/stream)")
+    ap.add_argument("--variant", default="learned",
+                    help="attention architecture row: learned (default), "
+                    "alibi (in-kernel bias), or windowed[:N] (banded "
+                    "decode with dead-block DMA skip)")
     ap.add_argument("--warmup", type=int, default=3)
     args = ap.parse_args()
     result = run_bench(model=args.model, batch=args.batch,
                        prompt=args.prompt, new_tokens=args.new_tokens,
                        dtype=args.dtype, warmup=args.warmup,
-                       kv_cache_dtype=args.kv_cache_dtype)
+                       kv_cache_dtype=args.kv_cache_dtype,
+                       variant=args.variant)
     print(json.dumps(result))
 
 

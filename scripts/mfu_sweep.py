@@ -56,6 +56,14 @@ CONFIGS = [
     ("prefill-int8-compute", {}, _GPT_BENCH + ["--dtype", "int8-compute"]),
     ("decode-int8-kv", {}, _GPT_BENCH + ["--dtype", "bfloat16",
                                          "--kv-cache-dtype", "int8"]),
+    # round-5 kernel rows: in-kernel alibi bias and banded decode with
+    # dead-block DMA skip (long prompt so the O(window) stream shows)
+    ("decode-alibi-int8-kv", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--kv-cache-dtype", "int8",
+        "--variant", "alibi"]),
+    ("decode-windowed256", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--prompt", "896",   # + 32 new < 1024 ctx
+        "--variant", "windowed:256"]),
 ]
 
 RUN_TIMEOUT_S = 1200
