@@ -366,3 +366,23 @@ def test_speculative_moe_target_matches_plain_greedy():
 # compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
 import pytest as _pytest_tier
 pytestmark = _pytest_tier.mark.slow
+
+
+@pytest.mark.parametrize("variant", [dict(pos_embed="alibi"),
+                                     dict(local_attention_window=16)])
+def test_speculative_alibi_windowed_target_matches_plain(variant):
+    """Alibi/windowed TARGETS (verify rides the variant-aware extend,
+    whose kernels carry the bias/band): greedy speculative output is
+    bit-identical to the target decoding alone."""
+    cfg = dataclasses.replace(TARGET, **variant)
+    tparams = gpt.init(cfg, jax.random.PRNGKey(0))
+    dparams = gpt.init(DRAFT, jax.random.PRNGKey(1))
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(0, 256, (1, 9)), jnp.int32)
+    eng = deepspeed_tpu.init_inference(model=(cfg, tparams),
+                                       config={"dtype": "float32"})
+    want = np.asarray(eng.generate(prompt, max_new_tokens=12))
+    got, fwds = speculative_generate(tparams, cfg, dparams, DRAFT,
+                                     prompt, 12, draft_k=3)
+    np.testing.assert_array_equal(np.asarray(got)[:, :12], want)
+    assert 1 <= int(fwds) <= 12 + 1
