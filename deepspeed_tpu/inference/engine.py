@@ -270,9 +270,13 @@ class InferenceEngine:
         :class:`InferenceEngine` over the same vocabulary.  The TARGET
         may be dense GPT or MoE (the verify pass rides each family's
         chunked ``extend``); the draft must be dense — its whole point
-        is being small.  Returns ``(tokens [1, N], n_target_forwards)``.
-        ``draft_k + 1`` should be a multiple of 8 so the verify pass
-        rides the chunk kernel (default 7).
+        is being small.  Greedy speculation is BATCHED: ``tokens`` may be
+        [B, S]; rows accept different draft counts per round, so their
+        frontiers diverge and the draft/verify steps run ragged
+        (sampling and MoE targets serve batch 1).  Returns
+        ``(tokens [B, N], n_target_forwards)``.  ``draft_k + 1`` should
+        be a multiple of 8 so the verify pass rides the chunk kernel
+        (default 7).
         """
         from ..models import gpt_inference
         from ..models.gpt_moe import GPTMoEConfig

@@ -79,32 +79,35 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
                          kv_dtype=None, temperature: float = 0.0,
                          top_k: int = 0, top_p: float = 1.0,
                          key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Speculative decode.  prompt [1, S] → (tokens [1, N],
-    n_target_forwards []).
+    """Speculative decode.  prompt [B, S] → (tokens [B, N],
+    n_target_forwards [] = verify rounds + the prefill).
 
     ``temperature == 0`` (default): greedy draft-and-verify — output
-    bit-identical to the target decoding alone.  ``temperature > 0``:
-    speculative SAMPLING (:func:`spec_accept` rejection rule) — the
-    emitted tokens are distributed exactly as sampling from the target
-    at that temperature (with ``top_k``/``top_p`` applied to draft AND
-    target through the shared :func:`sampling.filter_logits`, so the
-    theorem holds against the filtered target), with the draft only
-    changing the number of target passes.
+    bit-identical to the target decoding alone, for ANY batch size: rows
+    accept different draft counts per round, so their frontiers diverge
+    and every subsequent draft step / verify chunk runs RAGGED (per-row
+    cache append + per-row visibility); a round advances each unfinished
+    row by its own 1 + accepted count.  ``temperature > 0``: speculative
+    SAMPLING (:func:`spec_accept` rejection rule) — the emitted tokens
+    are distributed exactly as sampling from the target at that
+    temperature (with ``top_k``/``top_p`` applied to draft AND target
+    through the shared :func:`sampling.filter_logits`, so the theorem
+    holds against the filtered target), with the draft only changing the
+    number of target passes; sampling serves batch 1.
 
     ``n_target_forwards`` counts the verify passes (plus the prefill) the
     run needed — the quantity speculation reduces; plain decode needs N.
-    Batch 1 (the latency-bound serving shape; per-row accept counts would
-    need ragged caches).
 
     The verify chunk is ``draft_k + 1`` tokens; keep it a multiple of the
     8-row sublane tile (the default, 7+1=8) so the verify ``extend``
     rides the chunked-prefill Pallas kernel instead of the dense
     fallback.
     """
-    if prompt.shape[0] != 1:
+    B = prompt.shape[0]
+    if float(temperature) > 0.0 and B != 1:
         raise NotImplementedError(
-            "speculative decode serves batch 1 (the latency-bound shape); "
-            "per-row accept counts need ragged caches")
+            "speculative SAMPLING serves batch 1 (per-row rejection "
+            "resampling); batched speculation is greedy")
     if not (target_cfg.vocab_size == draft_cfg.vocab_size):
         raise ValueError("draft and target must share a vocabulary "
                          f"({draft_cfg.vocab_size} vs {target_cfg.vocab_size})")
@@ -114,6 +117,10 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     # the draft stays dense (a draft's whole point is being small)
     if isinstance(target_cfg, GPTMoEConfig):
         from ..models import gpt_moe_inference as tfam
+        if B != 1:
+            raise NotImplementedError(
+                "batched speculation needs the ragged verify extend; the "
+                "MoE family serves speculative batch 1")
     else:
         tfam = gpt_inference
     t_cache_kw = {"kv_dtype": kv_dtype}
@@ -131,9 +138,9 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
             f"prompt ({S}) + max_new_tokens ({N}) + speculative overshoot "
             f"({K + 1}) exceeds max_seq_len ({ctx}); reduce draft_k or the "
             "token budget")
-    tcache = tfam.init_cache(target_cfg, 1, _tile_cache_len(need, ctx),
+    tcache = tfam.init_cache(target_cfg, B, _tile_cache_len(need, ctx),
                              **t_cache_kw)
-    dcache = gpt_inference.init_cache(draft_cfg, 1, _tile_cache_len(need, ctx))
+    dcache = gpt_inference.init_cache(draft_cfg, B, _tile_cache_len(need, ctx))
 
     sample = float(temperature) > 0.0
     temp = jnp.float32(max(float(temperature), 1e-6))
@@ -152,25 +159,35 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
         key0, sub = jax.random.split(key0)
         cur = jax.random.categorical(sub, flt(last_t)).astype(jnp.int32)
     else:
-        cur = jnp.argmax(last_t, -1).astype(jnp.int32)   # pending
+        cur = jnp.argmax(last_t, -1).astype(jnp.int32)   # pending [B]
 
-    out0 = jnp.zeros((N + K + 1,), jnp.int32)
+    out0 = jnp.zeros((B, N + K + 1), jnp.int32)
+    lens0 = jnp.full((B,), S, jnp.int32)   # per-row emitted-prefix frontier
+    done0 = jnp.zeros((B,), jnp.int32)
+    rows = jnp.arange(B)
 
     def cond(st):
-        n, *_ = st
-        return n < N
+        done, *_ = st
+        return jnp.any(done < N)
 
     def body(st):
-        n, cur, out, tcache, dcache, fwds, rng = st
-        base = tcache.length           # == dcache.length == emitted prefix
+        done, cur, out, tcache, dcache, lens, fwds, rng = st
         rng, dkey, akey = jax.random.split(rng, 3)
+        # FINISHED rows keep running (SPMD: every row computes every
+        # round) but their frontier is clamped to the highest any ACTIVE
+        # row can hold (identity for active rows, since done <= N-1 ⇒
+        # lens <= S+N-1): their draft/verify writes then land in-bounds
+        # at slots their dead prefix no longer needs, instead of relying
+        # on out-of-bounds scatter-drop past the `need`-sized cache
+        l_eff = jnp.minimum(lens, S + N - 1)
 
-        # ---- draft: K tokens from [cur, d1..d_{K-1}] (greedy, or sampled
-        # at the SAME temperature so acceptance rates stay high)
+        # ---- draft: K tokens per row from [cur, d1..d_{K-1}] (greedy, or
+        # sampled at the SAME temperature so acceptance rates stay high);
+        # every step appends at each row's OWN frontier (ragged decode)
         def dstep(carry, dk):
-            tok, dc = carry
+            tok, dc, l = carry
             lg, dc = gpt_inference.decode_step(draft_params, tok,
-                                               draft_cfg, dc)
+                                               draft_cfg, dc, lengths=l)
             lg = lg[:, :V].astype(jnp.float32)
             if sample:
                 f = flt(lg)
@@ -180,44 +197,53 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
             else:
                 probs = jnp.zeros((V,), jnp.float32)
                 nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-            return (nxt, dc), (nxt[0], probs)
+            return (nxt, dc, l + 1), (nxt, probs)
 
-        (last_d, dcache), (drafts, d_probs) = lax.scan(
-            dstep, (cur, dcache), jax.random.split(dkey, K))
-        # feed d_K too so the draft cache covers a full acceptance
+        (last_d, dcache, _), (drafts, d_probs) = lax.scan(
+            dstep, (cur, dcache, l_eff), jax.random.split(dkey, K))
+        # drafts: [K, B].  Feed d_K too so the draft cache covers a full
+        # acceptance
         _, dcache = gpt_inference.decode_step(draft_params, last_d,
-                                              draft_cfg, dcache)
+                                              draft_cfg, dcache,
+                                              lengths=l_eff + K)
 
-        # ---- verify: ONE target pass over [cur, d1..dK]
-        chunk = jnp.concatenate([cur, drafts])[None, :]          # [1, K+1]
-        vlogits, tcache = tfam.extend(target_params, chunk,
-                                      target_cfg, tcache)
-        vlg = vlogits[0, :, :V].astype(jnp.float32)              # [K+1, V]
+        # ---- verify: ONE target pass over [cur, d1..dK] per row, each
+        # row's chunk at ITS frontier (ragged extend)
+        window = jnp.concatenate([cur[:, None], drafts.T], axis=1)  # [B,K+1]
+        vlogits, tcache = tfam.extend(target_params, window,
+                                      target_cfg, tcache, lengths=l_eff)
+        vlg = vlogits[..., :V].astype(jnp.float32)            # [B, K+1, V]
 
         if sample:
-            # rejection rule: emitted tokens are distributed exactly as
-            # target sampling (of the filtered distribution); the window
-            # is [cur, accepted drafts] with nxt the pending
+            # rejection rule (B == 1): emitted tokens are distributed
+            # exactly as target sampling (of the filtered distribution);
+            # the window is [cur, accepted drafts] with nxt the pending
             # resample/bonus token
-            t_probs = jax.nn.softmax(flt(vlg), -1)
-            a, nxt = spec_accept(akey, drafts, d_probs, t_probs)
-            nxt = nxt[None]
+            t_probs = jax.nn.softmax(flt(vlg[0]), -1)
+            a1, nxt1 = spec_accept(akey, drafts[:, 0], d_probs, t_probs)
+            a, nxt = a1[None], nxt1[None]
         else:
             # accepted drafts are exactly the target's own greedy tokens
-            g = jnp.argmax(vlg, -1).astype(jnp.int32)            # [K+1]
-            agree = (drafts == g[:K]).astype(jnp.int32)
-            a = jnp.sum(jnp.cumprod(agree))                      # 0..K
-            nxt = g[a][None]
+            g = jnp.argmax(vlg, -1).astype(jnp.int32)         # [B, K+1]
+            agree = (drafts.T == g[:, :K]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)   # [B] 0..K
+            nxt = g[rows, a]                                  # [B]
         # writing the full K+1 window is safe: slots past a+1 are
-        # provisional and overwritten by the next round's window at n+a+1
-        out = lax.dynamic_update_slice(
-            out, jnp.concatenate([cur, drafts]), (n,))
-        new_len = base + 1 + a
-        tcache = dataclasses.replace(tcache, length=new_len)     # O(1) undo
-        dcache = dataclasses.replace(dcache, length=new_len)
-        return (n + a + 1, nxt, out, tcache, dcache, fwds + 1, rng)
+        # provisional and overwritten by the next round's window; finished
+        # rows park their writes in the [N, N+K] slack (outside the
+        # returned [:, :N] slice)
+        col0 = jnp.minimum(done, N)
+        out = out.at[rows[:, None],
+                     col0[:, None] + jnp.arange(K + 1)[None]].set(window)
+        active = done < N
+        adv = jnp.where(active, a + 1, 0)
+        lens = lens + adv            # per-row O(1) undo: frontier reset
+        tcache = dataclasses.replace(tcache, length=jnp.max(lens))
+        dcache = dataclasses.replace(dcache, length=jnp.max(lens))
+        cur = jnp.where(active, nxt, cur)
+        return (done + adv, cur, out, tcache, dcache, lens, fwds + 1, rng)
 
-    n, _, out, _, _, fwds, _ = lax.while_loop(
+    done, _, out, _, _, _, fwds, _ = lax.while_loop(
         cond, body,
-        (jnp.int32(0), cur, out0, tcache, dcache, jnp.int32(1), key0))
-    return out[:N][None, :], fwds
+        (done0, cur, out0, tcache, dcache, lens0, jnp.int32(1), key0))
+    return out[:, :N], fwds

@@ -199,7 +199,7 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
 
 
 def extend(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
-           cache: KVCache) -> Tuple[jnp.ndarray, KVCache]:
+           cache: KVCache, lengths=None) -> Tuple[jnp.ndarray, KVCache]:
     """Chunked prefill: append ``tokens`` [B, S_c] at positions
     ``cache.length .. cache.length+S_c-1``, attending causally over the
     cached prefix + the chunk.
@@ -218,20 +218,36 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
     with a concrete ``cache.length``); under an outer jit the length is
     traced and the caller must size the cache — a clamped write would
     silently corrupt the cached prefix.
+
+    ``lengths`` [B] makes the chunk RAGGED (batched speculative verify:
+    each row's S_c tokens sit at ITS frontier): row b's chunk lands at
+    slots ``lengths[b] .. lengths[b]+S_c-1`` and attends through its own
+    live prefix; ``cache.length`` advances to ``max(lengths) + S_c`` and
+    the caller tracks per-row lengths.
     """
     B, Sc = tokens.shape
-    pos0 = cache.length
+    ragged = lengths is not None
+    pos0 = lengths if ragged else cache.length
     if not isinstance(pos0, jax.core.Tracer) and \
-            int(pos0) + Sc > cache.max_len:
+            int(jnp.max(pos0)) + Sc > cache.max_len:
         raise ValueError(
-            f"extend of {Sc} tokens at length {int(pos0)} overflows the "
-            f"cache (max_len {cache.max_len}); dynamic_update_slice would "
-            "clamp and corrupt the cached prefix")
-    positions = pos0 + jnp.arange(Sc)   # [S_c], shared across rows
-    x = gpt.embed(params, tokens, config, positions=positions)
+            f"extend of {Sc} tokens at length {int(jnp.max(pos0))} "
+            f"overflows the cache (max_len {cache.max_len}); the write "
+            "would clamp and corrupt the cached prefix")
+    if ragged:
+        positions = pos0[:, None] + jnp.arange(Sc)          # [B, S_c]
+        rows = jnp.arange(B)[:, None]
+        cols = positions
 
-    def write(buf, val):
-        return lax.dynamic_update_slice(buf, val, (0, pos0, 0, 0))
+        def write(buf, val):
+            return buf.at[rows, cols].set(val)
+    else:
+        positions = pos0 + jnp.arange(Sc)   # [S_c], shared across rows
+
+        def write(buf, val):
+            return lax.dynamic_update_slice(buf, val, (0, pos0, 0, 0))
+
+    x = gpt.embed(params, tokens, config, positions=positions)
 
     def attn(q, k, v, new_ck, new_cv, ksc, vsc, idx):
         return _cached_attention(
@@ -241,7 +257,8 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
 
     x, cache = _layer_scan(x, params, cache, config, positions, write, attn)
     logits = gpt.lm_logits(params, x, config)
-    return logits, dataclasses.replace(cache, length=pos0 + Sc)
+    return logits, dataclasses.replace(cache,
+                                       length=jnp.max(pos0) + Sc)
 
 
 def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,

@@ -204,14 +204,24 @@ def prefill(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
 
 
 def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
-           cache: MoEKVCache) -> Tuple[jnp.ndarray, MoEKVCache]:
+           cache: MoEKVCache,
+           lengths=None) -> Tuple[jnp.ndarray, MoEKVCache]:
     """Chunked prefill continuation (the MoE counterpart of
     ``gpt_inference.extend``): append ``tokens`` [B, S_c] at positions
     ``cache.length..``, attending causally over prefix + chunk through
     both cache banks, expert FFN in eval gating.  ``prefill(t[:, :c]) ;
     extend(t[:, c:])`` equals one full ``prefill`` — the contract the
-    speculative verify pass rides."""
+    speculative verify pass rides.  ``lengths`` accepts the batched-
+    speculation calling convention for B == 1 only (a single row's
+    per-row frontier IS the scalar frontier)."""
     B, Sc = tokens.shape
+    if lengths is not None:
+        if B != 1:
+            raise NotImplementedError(
+                "MoE extend is scalar-frontier; ragged chunks serve the "
+                "dense family (batched speculation guards on this)")
+        cache = dataclasses.replace(cache,
+                                    length=lengths.reshape(-1)[0])
     pos0 = cache.length
     max_len = cache.dense_k.shape[2]
     if not isinstance(pos0, jax.core.Tracer) and int(pos0) + Sc > max_len:

@@ -373,9 +373,10 @@ def cached_attention(q, cache_k, cache_v, pos,
     int8_cache = k_scale is not None
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     block_k = next((b for b in (256, 128) if Smax % b == 0), None)
-    # chunk path: scalar pos only (ragged chunks would need per-row
-    # frontiers), and the chunk must tile in the q (sublane) dimension
-    pos_is_scalar = jnp.ndim(pos) == 0
+    # chunk path: pos may be scalar OR per-row [B] (ragged chunks — the
+    # kernel reads its row's frontier from pos_ref[bh // H] everywhere:
+    # mask, live range, and DMA clamp); the chunk must tile in the q
+    # (sublane) dimension
     block_q = next((b for b in (256, 128, 8) if Sq % b == 0), None) \
         if Sq > 1 else None
 
@@ -390,7 +391,7 @@ def cached_attention(q, cache_k, cache_v, pos,
                          block_k, H, ks3=ks3, vs3=vs3, window=window,
                          slopes=slopes)
             return o3.reshape(B, H, 1, D).transpose(0, 2, 1, 3)
-        if block_q is not None and pos_is_scalar:
+        if block_q is not None:
             o3 = _chunk(to3(q), to3(cache_k), to3(cache_v), pos, scale,
                         block_q, block_k, H, ks3=ks3, vs3=vs3,
                         window=window, slopes=slopes)

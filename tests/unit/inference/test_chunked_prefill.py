@@ -272,3 +272,37 @@ def test_session_fork_prefix_caching():
 # compile-heavy: full-suite / slow tier only (fast tier = pytest -m "not slow")
 import pytest as _pytest_tier
 pytestmark = _pytest_tier.mark.slow
+
+
+@pytest.mark.parametrize("variant", [{}, {"pos_embed": "rotary"}])
+def test_ragged_extend_matches_per_row(variant):
+    """Ragged extend (each row's chunk at ITS frontier — the batched
+    speculative verify shape): logits and cache state must equal each
+    row extended alone."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, **variant)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    B, Sc = 2, 4
+    lens = np.asarray([5, 9])
+    prompts = jnp.asarray(rng.integers(0, 256, (B, 9)), jnp.int32)
+    chunk = jnp.asarray(rng.integers(0, 256, (B, Sc)), jnp.int32)
+
+    # batched: prefill the right-padded batch, ragged-extend the chunk
+    cache = gpt_inference.init_cache(cfg, B, 32)
+    _, cache = gpt_inference.prefill(params, prompts, cfg, cache)
+    lg, cache = gpt_inference.extend(params, chunk, cfg, cache,
+                                     lengths=jnp.asarray(lens, jnp.int32))
+    assert int(cache.length) == 9 + Sc
+
+    for b in range(B):
+        L = int(lens[b])
+        c1 = gpt_inference.init_cache(cfg, 1, 32)
+        _, c1 = gpt_inference.prefill(params, prompts[b:b + 1, :L], cfg, c1)
+        lg1, c1 = gpt_inference.extend(params, chunk[b:b + 1], cfg, c1)
+        np.testing.assert_allclose(np.asarray(lg)[b], np.asarray(lg1)[0],
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"row {b} ({variant})")
+        np.testing.assert_allclose(
+            np.asarray(cache.k[:, b, L:L + Sc]),
+            np.asarray(c1.k[:, 0, L:L + Sc]), rtol=2e-5, atol=2e-5)

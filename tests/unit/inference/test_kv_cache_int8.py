@@ -367,3 +367,32 @@ def test_streaming_decode_traced_window_under_jit(pallas_interpret, variant):
         os.environ["DS_TPU_PALLAS_INTERPRET"] = "1"
     assert np.isfinite(with_kernel).all()
     np.testing.assert_allclose(with_kernel, dense, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("window", [None, 32])
+def test_ragged_chunk_kernel_matches_reference(pallas_interpret, int8,
+                                               window):
+    """Per-row-pos CHUNKS (batched speculative verify: each row's K+1
+    tokens sit at ITS frontier): the chunk kernel reads its row's pos
+    from SMEM everywhere, so ragged chunks must match the dense
+    reference exactly."""
+    B, Sq, Smax, H, D = 3, 8, 512, 4, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(kq, (B, Sq, H, D), jnp.float32)
+    ck = jax.random.normal(kk, (B, Smax, H, D), jnp.float32)
+    cv = jax.random.normal(kv, (B, Smax, H, D), jnp.float32)
+    pos = jnp.asarray([7, 130, 301], jnp.int32)   # rows straddle blocks
+    win = None if window is None else jnp.int32(window)
+    if int8:
+        (ck_s, ck_sc), (cv_s, cv_sc) = quantize_kv(ck), quantize_kv(cv)
+        got = cached_attention(q, ck_s, cv_s, pos, k_scale=ck_sc,
+                               v_scale=cv_sc, window=win)
+        ck = dequantize_kv(ck_s, ck_sc, jnp.float32)
+        cv = dequantize_kv(cv_s, cv_sc, jnp.float32)
+    else:
+        got = cached_attention(q, ck, cv, pos, window=win)
+    want = cached_attention_reference(q, ck, cv, pos, window=win)
+    assert not np.isnan(np.asarray(got)).any()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

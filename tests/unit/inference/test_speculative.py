@@ -134,9 +134,11 @@ def test_engine_generate_speculative():
 
 def test_speculative_validation():
     tparams, dparams = _models()
+    # batched GREEDY is supported; batched SAMPLING refuses clearly
     with pytest.raises(NotImplementedError, match="batch 1"):
         speculative_generate(tparams, TARGET, dparams, DRAFT,
-                             jnp.zeros((2, 4), jnp.int32), 4)
+                             jnp.zeros((2, 4), jnp.int32), 4,
+                             temperature=0.8)
     other = dataclasses.replace(DRAFT, vocab_size=128)
     with pytest.raises(ValueError, match="vocabulary"):
         speculative_generate(tparams, TARGET, dparams, other,
@@ -386,3 +388,50 @@ def test_speculative_alibi_windowed_target_matches_plain(variant):
                                      prompt, 12, draft_k=3)
     np.testing.assert_array_equal(np.asarray(got)[:, :12], want)
     assert 1 <= int(fwds) <= 12 + 1
+
+
+def test_batched_speculative_matches_per_row_greedy():
+    """BATCHED greedy speculation (beyond-reference: rows accept
+    different draft counts per round, so frontiers diverge and every
+    draft/verify step runs ragged): each row's output must be
+    bit-identical to that row decoded alone — trained target, so the
+    continuations are shift-sensitive and rows genuinely disagree."""
+    tparams = _train(TARGET)
+    _, dparams = _models()
+    # three different prompts on the affine rule → three different
+    # continuations (and different accept counts vs the random draft)
+    starts = [3, 11, 40]
+    prompts = []
+    for s in starts:
+        seq = [s]
+        for _ in range(3):
+            seq.append((3 * seq[-1] + 7) % 256)
+        prompts.append(seq)
+    prompt = jnp.asarray(prompts, jnp.int32)            # [3, 4]
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    N = 14
+    got, fwds = speculative_generate(tparams, TARGET, dparams, DRAFT,
+                                     prompt, N, draft_k=3)
+    assert got.shape == (3, N)
+    for b in range(3):
+        want = np.asarray(eng.generate(prompt[b:b + 1], max_new_tokens=N))
+        np.testing.assert_array_equal(np.asarray(got)[b], want[0],
+                                      err_msg=f"row {b}")
+    # a round advances every active row ≥ 1 token
+    assert 1 <= int(fwds) <= N + 1
+
+
+def test_engine_batched_speculative():
+    """Engine surface for batched greedy speculation."""
+    tparams = _train(TARGET)
+    _, dparams = _models()
+    eng = deepspeed_tpu.init_inference(model=(TARGET, tparams),
+                                       config={"dtype": "float32"})
+    prompt = jnp.asarray([[3, 16, 55], [8, 31, 100]], jnp.int32)
+    toks, fwds = eng.generate_speculative(prompt, (DRAFT, dparams),
+                                          max_new_tokens=10, draft_k=3)
+    assert np.asarray(toks).shape == (2, 10)
+    for b in range(2):
+        want = np.asarray(eng.generate(prompt[b:b + 1], max_new_tokens=10))
+        np.testing.assert_array_equal(np.asarray(toks)[b], want[0])
