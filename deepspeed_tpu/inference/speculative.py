@@ -117,10 +117,6 @@ def speculative_generate(target_params: PyTree, target_cfg: gpt.GPTConfig,
     # the draft stays dense (a draft's whole point is being small)
     if isinstance(target_cfg, GPTMoEConfig):
         from ..models import gpt_moe_inference as tfam
-        if B != 1:
-            raise NotImplementedError(
-                "batched speculation needs the ragged verify extend; the "
-                "MoE family serves speculative batch 1")
     else:
         tfam = gpt_inference
     t_cache_kw = {"kv_dtype": kv_dtype}

@@ -117,11 +117,17 @@ def _append_kv(ck, cv, ksc, vsc, k, v, pos, ragged=False):
     with scale banks (int8 cache) each head vector quantizes per vector
     and codes + scales write together; without, the values land in the
     cache dtype.  Shared by prefill and the decode/extend path so the
-    two can never diverge.  ``ragged``: pos is [B] and each row's single
-    new column lands on ITS next slot (dense-family decode contract)."""
+    two can never diverge.  ``ragged``: pos is [B] and each row's S_c
+    new columns land at ITS frontier (dense-family ragged contract —
+    single-token decode and the batched speculative verify chunk are the
+    S_c = 1 and S_c = K+1 cases of the same write)."""
     if ragged:
-        B = k.shape[0]
-        wr = lambda buf, val: buf.at[jnp.arange(B), pos].set(val[:, 0])
+        B, Sc = k.shape[:2]
+        rows = jnp.arange(B)[:, None]
+        cols = pos[:, None] + jnp.arange(Sc)[None]
+
+        def wr(buf, val):
+            return buf.at[rows, cols].set(val)
     else:
         wr = lambda buf, val: lax.dynamic_update_slice(buf, val,
                                                        (0, pos, 0, 0))
@@ -211,35 +217,33 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
     ``cache.length..``, attending causally over prefix + chunk through
     both cache banks, expert FFN in eval gating.  ``prefill(t[:, :c]) ;
     extend(t[:, c:])`` equals one full ``prefill`` — the contract the
-    speculative verify pass rides.  ``lengths`` accepts the batched-
-    speculation calling convention for B == 1 only (a single row's
-    per-row frontier IS the scalar frontier)."""
+    speculative verify pass rides.  ``lengths`` [B] makes the chunk
+    RAGGED (batched speculative verify): row b's S_c tokens land at ITS
+    frontier with per-row visibility; ``cache.length`` advances to
+    ``max(lengths) + S_c`` and the caller tracks per-row lengths."""
     B, Sc = tokens.shape
-    if lengths is not None:
-        if B != 1:
-            raise NotImplementedError(
-                "MoE extend is scalar-frontier; ragged chunks serve the "
-                "dense family (batched speculation guards on this)")
-        cache = dataclasses.replace(cache,
-                                    length=lengths.reshape(-1)[0])
-    pos0 = cache.length
+    ragged = lengths is not None
+    pos0 = lengths if ragged else cache.length
     max_len = cache.dense_k.shape[2]
-    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + Sc > max_len:
+    if not isinstance(pos0, jax.core.Tracer) and \
+            int(jnp.max(pos0)) + Sc > max_len:
         raise ValueError(
-            f"extend of {Sc} tokens at length {int(pos0)} overflows the "
-            f"cache (max_len {max_len}); dynamic_update_slice would clamp "
-            "and corrupt the cached prefix")
-    positions = pos0 + jnp.arange(Sc)
+            f"extend of {Sc} tokens at length {int(jnp.max(pos0))} "
+            f"overflows the cache (max_len {max_len}); the write would "
+            "clamp and corrupt the cached prefix")
+    positions = (pos0[:, None] if ragged else pos0) + jnp.arange(Sc)
     moe = _moe_infer_obj(config)
     x = gpt.embed(params, tokens, config, positions=positions)
 
     def pair(x, xs):
         dense_p, attn_p, moe_p, dck, dcv, mck, mcv, dks, dvs, mks, mvs = xs
         x, dck, dcv, dks, dvs = _attend_decode(
-            x, dense_p, config, dck, dcv, pos0, positions, dks, dvs)
+            x, dense_p, config, dck, dcv, pos0, positions, dks, dvs,
+            ragged=ragged)
         x = gpt.mlp_residual(x, dense_p, config)
         x, mck, mcv, mks, mvs = _attend_decode(
-            x, attn_p, config, mck, mcv, pos0, positions, mks, mvs)
+            x, attn_p, config, mck, mcv, pos0, positions, mks, mvs,
+            ragged=ragged)
         x = _moe_ffn(x, attn_p, moe_p, moe, config)
         return x, (dck, dcv, mck, mcv, dks, dvs, mks, mvs)
 
@@ -252,7 +256,8 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
                   cache.moe_v_scale))
     logits = gpt.lm_logits(params, x, config)
     return logits, MoEKVCache(
-        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv, length=pos0 + Sc,
+        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
+        length=jnp.max(pos0) + Sc,
         dense_k_scale=dks, dense_v_scale=dvs,
         moe_k_scale=mks, moe_v_scale=mvs)
 
@@ -266,36 +271,6 @@ def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
     token lands on ITS next slot and sees only ITS live prefix; dropless
     gating keeps rows independent, so ragged batching cannot perturb a
     row's routing."""
-    if lengths is None:
-        logits, cache = extend(params, token[:, None], config, cache)
-        return logits[:, 0], cache
-    B = token.shape[0]
-    pos = lengths
-    positions = pos[:, None]
-    moe = _moe_infer_obj(config)
-    x = gpt.embed(params, token[:, None], config, positions=positions)
-
-    def pair(x, xs):
-        dense_p, attn_p, moe_p, dck, dcv, mck, mcv, dks, dvs, mks, mvs = xs
-        x, dck, dcv, dks, dvs = _attend_decode(
-            x, dense_p, config, dck, dcv, pos, positions, dks, dvs,
-            ragged=True)
-        x = gpt.mlp_residual(x, dense_p, config)
-        x, mck, mcv, mks, mvs = _attend_decode(
-            x, attn_p, config, mck, mcv, pos, positions, mks, mvs,
-            ragged=True)
-        x = _moe_ffn(x, attn_p, moe_p, moe, config)
-        return x, (dck, dcv, mck, mcv, dks, dvs, mks, mvs)
-
-    x, (dk, dv, mk, mv, dks, dvs, mks, mvs) = lax.scan(
-        pair, x, (params["dense_blocks"], params["moe_attn_blocks"],
-                  params["moe_blocks"], cache.dense_k, cache.dense_v,
-                  cache.moe_k, cache.moe_v, cache.dense_k_scale,
-                  cache.dense_v_scale, cache.moe_k_scale,
-                  cache.moe_v_scale))
-    logits = gpt.lm_logits(params, x[:, 0], config)
-    return logits, MoEKVCache(
-        dense_k=dk, dense_v=dv, moe_k=mk, moe_v=mv,
-        length=jnp.max(pos) + 1,
-        dense_k_scale=dks, dense_v_scale=dvs,
-        moe_k_scale=mks, moe_v_scale=mvs)
+    logits, cache = extend(params, token[:, None], config, cache,
+                           lengths=lengths)
+    return logits[:, 0], cache

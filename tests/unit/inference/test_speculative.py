@@ -435,3 +435,28 @@ def test_engine_batched_speculative():
     for b in range(2):
         want = np.asarray(eng.generate(prompt[b:b + 1], max_new_tokens=10))
         np.testing.assert_array_equal(np.asarray(toks)[b], want[0])
+
+
+def test_batched_speculative_moe_target_matches_per_row():
+    """Batched greedy speculation with a MoE TARGET: the ragged verify
+    rides the MoE dual-bank extend; each row bit-matches its solo run
+    (dropless gating keeps ragged rows' routing independent)."""
+    from deepspeed_tpu.models import gpt_moe
+    cfg = gpt_moe.GPTMoEConfig(
+        vocab_size=256, max_seq_len=256, n_layer=2, n_head=4, d_model=64,
+        dtype=jnp.float32, vocab_round_to=128,
+        num_experts=4, moe_top_k=2, ep_size=1)
+    tparams = gpt_moe.init(cfg, jax.random.PRNGKey(0))
+    dparams = gpt.init(DRAFT, jax.random.PRNGKey(1))
+    prompt = jnp.asarray(np.random.default_rng(8).integers(0, 256, (2, 7)),
+                         jnp.int32)
+    N = 10
+    got, fwds = speculative_generate(tparams, cfg, dparams, DRAFT,
+                                     prompt, N, draft_k=3)
+    assert got.shape == (2, N)
+    eng = deepspeed_tpu.init_inference(model=(cfg, tparams),
+                                       config={"dtype": "float32"})
+    for b in range(2):
+        want = np.asarray(eng.generate(prompt[b:b + 1], max_new_tokens=N))
+        np.testing.assert_array_equal(np.asarray(got)[b], want[0],
+                                      err_msg=f"row {b}")
