@@ -506,6 +506,21 @@ def main() -> None:
             config = dataclasses.replace(gpt.GPT2_350M, max_seq_len=1024,
                                          dtype=jnp.bfloat16, remat=True)
             mb_candidates, gas, steps, warmup = (32, 24, 16), 1, 10, 2
+            if os.environ.get("BENCH_DENSE_ATTN") == "1":
+                # sweep knob: XLA's dense attention path — at head_dim 64
+                # the flash kernel is VPU-bound (mask/exp swamp the K=64
+                # matmuls), so MXU-friendly dense scores can win even at
+                # seq 1024 when remat keeps the S^2 buffer transient
+                config = dataclasses.replace(config,
+                                             use_flash_attention=False)
+            if os.environ.get("BENCH_REMAT_POLICY"):
+                # sweep knob: "attn_out" saves each block's attention
+                # output (64 MB/layer at mb32) so the backward remat skips
+                # re-running the VPU-bound attention forward; "dots" saves
+                # matmul outputs (bigger memory, less recompute)
+                config = dataclasses.replace(
+                    config,
+                    remat_policy=os.environ["BENCH_REMAT_POLICY"])
         else:
             config = gpt.GPTConfig(vocab_size=512, max_seq_len=128, n_layer=2,
                                    n_head=4, d_model=128, dtype=jnp.float32)

@@ -128,15 +128,15 @@ def preflight() -> bool:
     return False
 
 
-def main():
-    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mfu_sweep.jsonl"
+def main(configs=CONFIGS, default_path="/tmp/mfu_sweep.jsonl", tag="sweep"):
+    path = sys.argv[1] if len(sys.argv) > 1 else default_path
     if not preflight() and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
         sys.exit(1)
     with open(path, "a") as log:
-        for label, env_over, argv in CONFIGS:
+        for label, env_over, argv in configs:
             if not run_one(label, env_over, log, argv):
                 break
-    sys.stderr.write(f"[sweep] results in {path}\n")
+    sys.stderr.write(f"[{tag}] results in {path}\n")
 
 
 if __name__ == "__main__":

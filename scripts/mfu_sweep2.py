@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Phase-2 MFU sweep: remat-policy and attention-impl rows.
+
+Round-5 phase-1 findings (bench_artifacts/r5_onchip.jsonl): micro-batch
+(32→48) and flash block sizes are FLAT at ~39-40% MFU — the stall is
+not batch geometry, it is the backward's rematerialized attention
+forward (VPU-bound at head_dim 64).  These rows attack exactly that:
+
+- ``remat_policy=attn_out`` saves each block's attention output
+  (64 MB/layer at mb32) so the remat backward skips re-running the
+  attention forward entirely;
+- ``remat_policy=dots`` additionally saves matmul outputs;
+- ``BENCH_DENSE_ATTN=1`` swaps the Pallas flash kernel for XLA's dense
+  scores path (MXU-friendly; the S^2 buffer is transient under remat).
+
+Usage:  python scripts/mfu_sweep2.py [logfile]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mfu_sweep import main as sweep_main  # noqa: E402
+
+CONFIGS = [
+    ("attn-out-mb32", {"BENCH_REMAT_POLICY": "attn_out"}, None),
+    ("attn-out-mb48", {"BENCH_REMAT_POLICY": "attn_out",
+                       "BENCH_MB": "48,40,32"}, None),
+    ("attn-out-bf16acc-mb64", {"BENCH_REMAT_POLICY": "attn_out",
+                               "BENCH_ACCUM_DTYPE": "bf16",
+                               "BENCH_MB": "64,48,32"}, None),
+    ("dots-mb32", {"BENCH_REMAT_POLICY": "dots",
+                   "BENCH_MB": "32,24,16"}, None),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+    ("dense-attn-out-mb32", {"BENCH_DENSE_ATTN": "1",
+                             "BENCH_REMAT_POLICY": "attn_out",
+                             "BENCH_MB": "32,24"}, None),
+]
+
+
+if __name__ == "__main__":
+    sweep_main(CONFIGS, "/tmp/mfu_sweep2.jsonl", tag="sweep2")
