@@ -177,6 +177,19 @@ def _is_oom(e: Exception) -> bool:
         or "resourceexhausted" in msg
 
 
+def _is_transient_compile(e: Exception) -> bool:
+    """True for remote-compile infrastructure failures that are NOT a
+    verdict on this config: the tunneled dev TPU's compile helper can
+    500 under memory pressure or mid-restart (seen as "INTERNAL:
+    http://127.0.0.1:.../remote_compile: HTTP 500: tpu_compile_helper
+    subprocess exit code 1" on a config that compiles fine minutes
+    later).  These get one same-config retry, then an OOM-style
+    backoff — never a bench-killing raise."""
+    msg = str(e).lower()
+    return ("remote_compile" in msg or "compile_helper" in msg
+            or "deadline_exceeded" in msg or "http 5" in msg)
+
+
 # ZeRO-offload capability ladder: largest first.  Each rung runs in its
 # own subprocess because one RESOURCE_EXHAUSTED poisons the TPU client
 # for every later allocation in the same process (measured: after a 2.7B
@@ -513,6 +526,13 @@ def main() -> None:
                 # seq 1024 when remat keeps the S^2 buffer transient
                 config = dataclasses.replace(config,
                                              use_flash_attention=False)
+            if os.environ.get("BENCH_LOSS_CHUNK"):
+                # sweep knob: chunked loss head — the full fp32 logits
+                # tensor is 6.6 GB at mb32 (write fwd + read bwd); scanning
+                # the head in seq chunks trades that HBM traffic for
+                # recompute inside the chunk scan
+                config = dataclasses.replace(
+                    config, loss_chunk=int(os.environ["BENCH_LOSS_CHUNK"]))
             if os.environ.get("BENCH_REMAT_POLICY"):
                 # sweep knob: "attn_out" saves each block's attention
                 # output (64 MB/layer at mb32) so the backward remat skips
@@ -580,13 +600,29 @@ def main() -> None:
     # return early on some experimental PJRT transports, but device_get
     # cannot lie — it needs the real bytes of the final state.
     last_oom = None
+    retried_transient = False
     for mi, micro_batch in enumerate(mb_candidates):
         try:
             engine, batch, global_batch, ds_config, loss = \
                 build_and_warm(micro_batch)
             break
         except Exception as e:  # XlaRuntimeError has no stable module path
-            if not _is_oom(e):
+            if not _is_oom(e) and _is_transient_compile(e) \
+                    and not retried_transient:
+                # one same-config retry: the compile helper 500s under
+                # pressure and succeeds minutes later (r5 mb64 row)
+                retried_transient = True
+                sys.stderr.write(
+                    f"bench: transient compile failure at mb={micro_batch}, "
+                    f"retrying once in 20s: {str(e).splitlines()[0][:200]}\n")
+                time.sleep(20)
+                try:
+                    engine, batch, global_batch, ds_config, loss = \
+                        build_and_warm(micro_batch)
+                    break
+                except Exception as e2:
+                    e = e2  # fall through to OOM-style handling
+            if not _is_oom(e) and not _is_transient_compile(e):
                 raise
             last_oom = str(e).splitlines()[0][:300]
             remaining = mb_candidates[mi + 1:]
@@ -618,7 +654,8 @@ def main() -> None:
             sys.stderr.write(f"bench: micro_batch={micro_batch} OOM, "
                              "backing off\n")
     else:
-        raise RuntimeError(f"all micro-batches OOM: {last_oom}")
+        raise RuntimeError(
+            f"all micro-batches failed (OOM/transient): {last_oom}")
 
     def fence():
         # host-transfer the SMALLEST current param leaf: device_get cannot

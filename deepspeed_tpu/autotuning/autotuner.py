@@ -75,9 +75,26 @@ class Autotuner:
         self._model_info: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- model info
-    def _model_spec(self, remat: Optional[bool] = None) -> ModelSpec:
+    def _factory_accepts_policy(self) -> bool:
+        """True when the factory declares a NAMED ``remat_policy`` param.
+        Signature inspection, not try/except: a TypeError raised INSIDE
+        the factory must propagate, never silently rebuild the spec with
+        the policy dropped (mislabeled measurements); and a bare
+        ``**kwargs`` sink does not count — a wrapper that swallows the
+        kwarg would multiply the search space with identical candidates."""
+        import inspect
+        try:
+            sig = inspect.signature(self._model)
+        except (TypeError, ValueError):
+            return False
+        return "remat_policy" in sig.parameters
+
+    def _model_spec(self, remat: Optional[bool] = None,
+                    remat_policy: Optional[str] = None) -> ModelSpec:
         if isinstance(self._model, ModelSpec):
             return self._model
+        if remat_policy is not None and self._factory_accepts_policy():
+            return self._model(remat=remat, remat_policy=remat_policy)
         try:
             return self._model(remat=remat)
         except TypeError:
@@ -86,6 +103,11 @@ class Autotuner:
     @property
     def _supports_remat_tuning(self) -> bool:
         return self.config.tune_remat and not isinstance(self._model, ModelSpec)
+
+    @property
+    def _supports_policy_tuning(self) -> bool:
+        """The policy axis needs a factory with a named ``remat_policy``."""
+        return self._supports_remat_tuning and self._factory_accepts_policy()
 
     def model_info(self) -> Dict[str, Any]:
         """Parameter count + per-candidate state-byte model (reference's
@@ -129,9 +151,15 @@ class Autotuner:
         stages = self.config.zero_stages
         if stages is None:
             stages = [0, 1, 2, 3]
-        remats = [None]
+        # each entry is (remat, remat_policy); the policy axis only
+        # multiplies the remat=True half of the space
+        remats = [(None, None)]
         if self._supports_remat_tuning:
-            remats = [False, True]
+            remats = [(False, None)]
+            if self._supports_policy_tuning:
+                remats += [(True, p) for p in self.config.remat_policies]
+            else:
+                remats += [(True, None)]
         offloads = [False, True] if self.config.tune_offload else [False]
         dp = self.mesh_manager.dp_world_size
         train_batch = self.base_config.get("train_batch_size")
@@ -144,7 +172,7 @@ class Autotuner:
             else:
                 gas = self.base_config.get("gradient_accumulation_steps", 1)
             for st in stages:
-                for rm in remats:
+                for rm, pol in remats:
                     for off in offloads:
                         if off and st < 1:
                             continue
@@ -156,6 +184,8 @@ class Autotuner:
                         }
                         if rm is not None:
                             c["remat"] = rm
+                        if pol is not None:
+                            c["remat_policy"] = pol
                         cands.append(c)
         budget = self._device_budget()
         if budget is not None:
@@ -196,7 +226,8 @@ class Autotuner:
         import deepspeed_tpu
 
         cfg = self._candidate_config(cand)
-        model = self._model_spec(remat=cand.get("remat"))
+        model = self._model_spec(remat=cand.get("remat"),
+                                 remat_policy=cand.get("remat_policy"))
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, config=cfg, mesh_manager=self.mesh_manager,
             rng=self._rng)
@@ -256,6 +287,18 @@ class Autotuner:
             return None
         best_cand, best_value = best
         tuned = self._candidate_config(best_cand)
+        if any(k in best_cand for k in ("remat", "remat_policy")):
+            # the winning model axes are not ds_config keys (the engine
+            # cannot rebuild the user's model) — surface them in the
+            # returned/saved config where they flow harmlessly through
+            # initialize (an explicit enabled=false autotuning section is
+            # ignored), so the user can rebuild the factory model with
+            # the values the search actually measured best
+            tuned["autotuning"] = {
+                "enabled": False,
+                "best_model_axes": {k: best_cand[k]
+                                    for k in ("remat", "remat_policy")
+                                    if k in best_cand}}
         os.makedirs(self.config.results_dir, exist_ok=True)
         with open(os.path.join(self.config.results_dir, "best_config.json"), "w") as f:
             json.dump(tuned, f, indent=2)

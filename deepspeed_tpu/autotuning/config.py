@@ -49,6 +49,14 @@ AUTOTUNING_ZERO_STAGES = "zero_stages"
 AUTOTUNING_ZERO_STAGES_DEFAULT = None  # None -> [0, 1, 2, 3]
 AUTOTUNING_TUNE_REMAT = "tune_remat"
 AUTOTUNING_TUNE_REMAT_DEFAULT = True
+# remat checkpoint policies tried for remat=True candidates when the model
+# factory accepts a ``remat_policy`` kwarg ("nothing" recomputes the whole
+# block; "attn_out" saves attention outputs so the backward skips
+# re-running the attention forward — the measured r5 lever; "dots" saves
+# matmul outputs).  The policy axis only multiplies the remat=True half of
+# the space.
+AUTOTUNING_REMAT_POLICIES = "remat_policies"
+AUTOTUNING_REMAT_POLICIES_DEFAULT = ("nothing", "attn_out")
 AUTOTUNING_TUNE_OFFLOAD = "tune_offload"
 AUTOTUNING_TUNE_OFFLOAD_DEFAULT = False
 
@@ -91,6 +99,8 @@ class DeepSpeedAutotuningConfig:
         self.zero_stages: Optional[List[int]] = g(
             AUTOTUNING_ZERO_STAGES, AUTOTUNING_ZERO_STAGES_DEFAULT)
         self.tune_remat: bool = g(AUTOTUNING_TUNE_REMAT, AUTOTUNING_TUNE_REMAT_DEFAULT)
+        self.remat_policies: List[str] = list(g(
+            AUTOTUNING_REMAT_POLICIES, AUTOTUNING_REMAT_POLICIES_DEFAULT))
         self.tune_offload: bool = g(AUTOTUNING_TUNE_OFFLOAD, AUTOTUNING_TUNE_OFFLOAD_DEFAULT)
         self.warmup_steps: int = g(AUTOTUNING_WARMUP_STEPS, AUTOTUNING_WARMUP_STEPS_DEFAULT)
         self.timed_steps: int = g(AUTOTUNING_TIMED_STEPS, AUTOTUNING_TIMED_STEPS_DEFAULT)

@@ -25,7 +25,10 @@ def _exp_name(c: Candidate) -> str:
     parts = [f"z{c.get('zero_stage', 0)}",
              f"mbs{c.get('train_micro_batch_size_per_gpu', 1)}"]
     if c.get("remat"):
-        parts.append("remat")
+        # the policy is part of the experiment identity: two candidates
+        # differing only in checkpoint policy must not share a journal
+        parts.append("remat" if not c.get("remat_policy")
+                     else f"remat-{c['remat_policy']}")
     if c.get("offload"):
         parts.append("offload")
     return "_".join(parts)

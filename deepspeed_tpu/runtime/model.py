@@ -71,3 +71,21 @@ def from_gpt(config, dtype=None) -> ModelSpec:
         # into training batches (dropout); eval paths never inject
         meta={"config": config, "needs_rng": config.dropout > 0},
     )
+
+
+def gpt_factory(config, dtype=None):
+    """A ModelSpec factory for the Autotuner's remat axes: calling it with
+    ``remat``/``remat_policy`` rebuilds the spec with those fields
+    overridden (absent/None kwargs keep the config's values), so
+    ``Autotuner(model=gpt_factory(cfg), ...)`` tunes micro-batch × ZeRO
+    stage × remat × checkpoint policy in one search."""
+
+    def build(remat=None, remat_policy=None) -> ModelSpec:
+        cfg = config
+        if remat is not None:
+            cfg = dataclasses.replace(cfg, remat=bool(remat))
+        if remat_policy is not None:
+            cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
+        return from_gpt(cfg, dtype=dtype)
+
+    return build

@@ -99,6 +99,86 @@ def test_candidate_space_respects_global_batch():
         assert c["gradient_accumulation_steps"] * c["train_micro_batch_size_per_gpu"] * 8 == 16
 
 
+def test_candidate_space_remat_policy_axis():
+    """A factory accepting ``remat_policy`` expands the remat=True half of
+    the space over the configured policies; remat=False rows carry none."""
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.model import gpt_factory
+    mm = make_mesh(dp=8)
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq_len=32, n_layer=1, n_head=2,
+                        d_model=32)
+    at = Autotuner(gpt_factory(cfg),
+                   {"autotuning": {"enabled": True, "micro_batch_sizes": [1],
+                                   "zero_stages": [0],
+                                   "remat_policies": ["nothing", "attn_out"]}},
+                   mesh_manager=mm)
+    assert at._supports_policy_tuning
+    cands = at.candidates()
+    rows = {(c.get("remat"), c.get("remat_policy")) for c in cands}
+    assert rows == {(False, None), (True, "nothing"), (True, "attn_out")}
+    # the factory honors the tuned fields
+    spec = at._model_spec(remat=True, remat_policy="attn_out")
+    assert spec.meta["config"].remat and \
+        spec.meta["config"].remat_policy == "attn_out"
+    # journal identity: policies must not share an experiment file
+    from deepspeed_tpu.autotuning.scheduler import _exp_name
+    names = {_exp_name(c) for c in cands}
+    assert len(names) == len(cands), names
+    # a legacy remat-only factory keeps the old two-point axis, and a
+    # **kwargs sink does NOT count as policy support (identical-candidate
+    # space blowup)
+    for factory in (lambda remat=None: tiny_model(),
+                    lambda remat=None, **kw: tiny_model()):
+        legacy = Autotuner(factory, {"autotuning": {"enabled": True}},
+                           mesh_manager=mm)
+        assert not legacy._supports_policy_tuning
+        lrows = {(c.get("remat"), c.get("remat_policy"))
+                 for c in legacy.candidates()}
+        assert lrows == {(False, None), (True, None)}
+    # a factory whose BODY raises TypeError must propagate, not silently
+    # rebuild without the policy
+    def broken(remat=None, remat_policy=None):
+        raise TypeError("inside factory")
+    at_broken = Autotuner(broken, {"autotuning": {"enabled": True}},
+                          mesh_manager=mm)
+    with pytest.raises(TypeError, match="inside factory"):
+        at_broken._model_spec(remat=True, remat_policy="attn_out")
+
+
+def test_tune_reports_best_model_axes(tmp_path):
+    """The winning remat/remat_policy must survive into the returned
+    config and best_config.json (the engine cannot rebuild the user's
+    model, so the axes ride the disabled autotuning section)."""
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.model import gpt_factory
+    mm = make_mesh(dp=8)
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq_len=32, n_layer=1, n_head=2,
+                        d_model=32)
+
+    def surface(cand):  # attn_out wins
+        return {"attn_out": 3.0, "nothing": 2.0}.get(
+            cand.get("remat_policy"), 1.0)
+
+    at = Autotuner(gpt_factory(cfg),
+                   {"autotuning": {"enabled": True, "micro_batch_sizes": [1],
+                                   "zero_stages": [0],
+                                   "results_dir": str(tmp_path)}},
+                   mesh_manager=mm, measure_fn=surface)
+    tuned = at.tune()
+    assert tuned["autotuning"]["enabled"] is False
+    assert tuned["autotuning"]["best_model_axes"] == {
+        "remat": True, "remat_policy": "attn_out"}
+    saved = json.load(open(tmp_path / "best_config.json"))
+    assert saved["autotuning"]["best_model_axes"]["remat_policy"] == "attn_out"
+    # the tuned config (with its disabled autotuning section) boots
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt_factory(cfg)(remat=True, remat_policy="attn_out"),
+        config={**tuned, "optimizer": {"type": "Adam",
+                                       "params": {"lr": 1e-3}}},
+        mesh_manager=mm, rng=jax.random.PRNGKey(0))
+    assert engine is not None
+
+
 def test_state_bytes_model_shrinks_with_stage():
     mm = make_mesh(dp=8)
     at = Autotuner(tiny_model(), {"bf16": {"enabled": True}}, mesh_manager=mm)
