@@ -1211,7 +1211,6 @@ class DeepSpeedEngine:
         boundary = self.is_gradient_accumulation_boundary()
         if boundary:
             self._take_model_step(lr_kwargs)
-        report = boundary
         self.tput_timer.stop(global_step=boundary)
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu() * self.dp_world_size

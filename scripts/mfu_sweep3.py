@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Round-5 remaining on-chip rows, priority-ordered for a flaky tunnel.
+
+The phase-1 sweep banked the GPT-2 350M baseline (39.9% MFU), the flash
+block/micro-batch ladder (flat), and the BERT headline (43.8% MFU,
+1.21x the reference anchor) before the tunnel wedged mid-list.  This
+list holds everything still unmeasured, most valuable first, so a short
+tunnel window still captures the rows that matter:
+
+1. the remat-policy / dense-attention rows (the 40% → 45% MFU levers),
+2. the ZeRO-offload capability ladder (BASELINE config #3 — never yet
+   demonstrated on hardware),
+3. the gpt_bench inference rows (prefill/decode, int8 variants),
+4. the stall-anatomy component table.
+
+Run it under the tunnel watchdog (scripts/tunnel_watchdog.sh), which
+probes until attach succeeds and then launches this sweep.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mfu_sweep import main as sweep_main  # noqa: E402
+
+_GPT_BENCH = ["-m", "deepspeed_tpu.benchmarks.inference.gpt_bench",
+              "--model", "gpt2-125m", "--batch", "8", "--prompt", "512",
+              "--new-tokens", "32"]
+
+CONFIGS = [
+    # --- MFU levers (highest value) ---
+    ("attn-out-mb32", {"BENCH_REMAT_POLICY": "attn_out"}, None),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+    ("dense-attn-out-mb32", {"BENCH_DENSE_ATTN": "1",
+                             "BENCH_REMAT_POLICY": "attn_out",
+                             "BENCH_MB": "32,24"}, None),
+    ("attn-out-mb48", {"BENCH_REMAT_POLICY": "attn_out",
+                       "BENCH_MB": "48,40"}, None),
+    ("dots-mb24", {"BENCH_REMAT_POLICY": "dots",
+                   "BENCH_MB": "24,16"}, None),
+    ("attn-out-losschunk256", {"BENCH_REMAT_POLICY": "attn_out",
+                               "BENCH_LOSS_CHUNK": "256"}, None),
+    # --- capability (BASELINE #3) ---
+    ("offload-capability", {}, ["bench.py", "offload"]),
+    # --- inference rows ---
+    ("prefill-bf16", {}, _GPT_BENCH + ["--dtype", "bfloat16"]),
+    ("prefill-int8", {}, _GPT_BENCH + ["--dtype", "int8"]),
+    ("prefill-int8-compute", {}, _GPT_BENCH + ["--dtype", "int8-compute"]),
+    ("decode-int8-kv", {}, _GPT_BENCH + ["--dtype", "bfloat16",
+                                         "--kv-cache-dtype", "int8"]),
+    ("decode-alibi-int8-kv", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--kv-cache-dtype", "int8",
+        "--variant", "alibi"]),
+    ("decode-windowed256", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--prompt", "896",
+        "--variant", "windowed:256"]),
+    # --- stall anatomy (own artifact log) ---
+    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
+     ["scripts/stall_anatomy.py"]),
+]
+
+
+if __name__ == "__main__":
+    sweep_main(CONFIGS, "/tmp/mfu_sweep3.jsonl", tag="sweep3")

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Tunnel watchdog: probe the TPU attach until it succeeds, then launch
+# the given sweep script.  A wedged axon relay can recover once every
+# client disconnects — this waits with ZERO clients attached (each probe
+# is a short-lived subprocess with a kernel-level signal.alarm kill, so
+# a hung attach never lingers holding a client).
+#
+# Usage: tunnel_watchdog.sh <sweep_script> <logfile> [max_wait_s]
+set -u
+SWEEP=${1:?sweep script}
+LOG=${2:?logfile}
+MAX_WAIT=${3:-14400}
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+t0=$(date +%s)
+attempt=0
+while :; do
+    now=$(date +%s)
+    if [ $((now - t0)) -gt "$MAX_WAIT" ]; then
+        echo "[watchdog] tunnel still down after ${MAX_WAIT}s; giving up"
+        exit 1
+    fi
+    attempt=$((attempt + 1))
+    out=$(timeout 100 python -c \
+        "import signal; signal.alarm(90); import jax; d=jax.devices()[0]; print('WD_UP', d.platform)" 2>&1 | tail -1)
+    if echo "$out" | grep -q "WD_UP"; then
+        echo "[watchdog] tunnel up on attempt $attempt; launching $SWEEP"
+        cd "$REPO" && exec python "$SWEEP" "$LOG"
+    fi
+    echo "[watchdog] probe $attempt down ($(echo "$out" | cut -c1-80)); sleeping 120s"
+    sleep 120
+done
