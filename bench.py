@@ -669,11 +669,20 @@ def main() -> None:
 
     fence()
 
+    # BENCH_TRACE=<dir>: capture an xplane profile of the timed steps
+    # (stall attribution evidence); tracing adds overhead, so the trace
+    # run's own number should not be compared against untraced rows
+    trace_dir = os.environ.get("BENCH_TRACE")
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch_fused(batch)
     fence()
     dt = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
+        sys.stderr.write(f"bench: xplane trace in {trace_dir}\n")
 
     samples_per_sec = steps * global_batch / dt
     tokens_per_sec = samples_per_sec * seq

@@ -59,6 +59,9 @@ CONFIGS = [
     # --- stall anatomy (own artifact log) ---
     ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
      ["scripts/stall_anatomy.py"]),
+    # --- xplane trace of the winning-config step (timing not comparable;
+    # runs last so a wedge here costs nothing) ---
+    ("trace-baseline", {"BENCH_TRACE": "bench_artifacts/xplane_r5"}, None),
 ]
 
 
