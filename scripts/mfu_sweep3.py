@@ -36,6 +36,10 @@ CONFIGS = [
     ("dense-attn-out-mb32", {"BENCH_DENSE_ATTN": "1",
                              "BENCH_REMAT_POLICY": "attn_out",
                              "BENCH_MB": "32,24"}, None),
+    # anatomy early: ~2 min, and its per-component table decides where
+    # any remaining tuning effort goes
+    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
+     ["scripts/stall_anatomy.py"]),
     ("attn-out-mb48", {"BENCH_REMAT_POLICY": "attn_out",
                        "BENCH_MB": "48,40"}, None),
     ("dots-mb24", {"BENCH_REMAT_POLICY": "dots",
@@ -56,9 +60,6 @@ CONFIGS = [
     ("decode-windowed256", {}, _GPT_BENCH + [
         "--dtype", "bfloat16", "--prompt", "896",
         "--variant", "windowed:256"]),
-    # --- stall anatomy (own artifact log) ---
-    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
-     ["scripts/stall_anatomy.py"]),
     # --- xplane trace of the winning-config step (timing not comparable;
     # runs last so a wedge here costs nothing) ---
     ("trace-baseline", {"BENCH_TRACE": "bench_artifacts/xplane_r5"}, None),

@@ -179,6 +179,20 @@ def rows():
     t = _bench(_chain(lambda x, w, l: jax.grad(xent_loss)(x, w, l)),
                x, wh, labels)
     out.append(("head_xent_fwd_bwd", t, 3 * 2 * B * S * DM * VOCAB))
+
+    # embedding gather fwd + scatter-add bwd: not matmul flops at all —
+    # reported against the HBM-traffic-equivalent "flops" of the head
+    # matmul row would be meaningless, so count 1 flop/elem-touched and
+    # read the row by its ms column (a slow sort-based scatter onto the
+    # 50304-row table is a classic TPU stall)
+    tok = jax.random.randint(k0, (B * S,), 0, VOCAB)
+    wte = jax.random.normal(k0, (VOCAB, DM), bf) * 0.02
+
+    def embed_loss(wte, tok):
+        return jnp.sum(wte[tok].astype(jnp.float32))
+
+    t = _bench(_chain(lambda w, tk: jax.grad(embed_loss)(w, tk)), wte, tok)
+    out.append(("embed_gather_scatter", t, 2 * B * S * DM))
     return out
 
 
