@@ -615,8 +615,11 @@ def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
             if config.remat_policy == "dots":
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             elif config.remat_policy == "attn_out":
+                # "ds_attn_lse" rides along (tagged inside the flash
+                # custom_vjp's fwd rule): saving o WITHOUT lse would
+                # leave the backward re-running the fwd kernel for it
                 policy = jax.checkpoint_policies.save_only_these_names(
-                    "ds_attn_out")
+                    "ds_attn_out", "ds_attn_lse")
             else:
                 policy = jax.checkpoint_policies.nothing_saveable
             block_fn = jax.checkpoint(block_fn, policy=policy)

@@ -516,6 +516,15 @@ def _flash(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H):
 def _flash_fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k, H):
     o, lse = _fwd(q3, k3, v3, lens, win, causal, sm_scale, block_q, block_k,
                   H)
+    # name-tag the backward's residuals so a remat policy can SAVE them:
+    # without the lse tag, ``remat_policy="attn_out"`` (which saves the
+    # "ds_attn_out"-tagged o) still re-runs this whole forward kernel in
+    # the backward just to regenerate lse — tagging both makes the policy
+    # actually eliminate the kernel re-run.  checkpoint_name is a no-op
+    # outside jax.checkpoint, so the non-remat path is unchanged.
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "ds_attn_out")
+    lse = checkpoint_name(lse, "ds_attn_lse")
     return o, (q3, k3, v3, o, lse, lens, win)
 
 

@@ -1,0 +1,54 @@
+"""remat_policy="attn_out" must actually eliminate the backward's
+re-run of the flash forward kernel — which requires the kernel's BOTH
+custom-vjp residuals (o AND lse) to be checkpoint_name-tagged.  With
+only o saved, the backward re-runs the whole fwd kernel to regenerate
+lse and the policy is a silent no-op (caught via HLO: the re-run adds
+exp sites to the backward).
+
+Also pins loss parity: the policy changes scheduling, never math.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt
+
+# flash engages at S >= FLASH_MIN_SEQ (1024) in interpret mode on CPU —
+# compile-heavy: slow tier only
+pytestmark = pytest.mark.slow
+
+
+def _base(monkeypatch):
+    monkeypatch.setenv("DS_TPU_PALLAS_INTERPRET", "1")
+    return gpt.GPTConfig(vocab_size=256, max_seq_len=1024, n_layer=1,
+                         n_head=2, d_model=128, remat=True)
+
+
+def _grad_hlo(cfg, params, tok):
+    f = jax.jit(jax.grad(lambda p, b: gpt.loss_fn(p, b, cfg)))
+    return f, f.lower(params, {"tokens": tok}).compile().as_text()
+
+
+def test_attn_out_policy_drops_fwd_kernel_rerun(monkeypatch):
+    base = _base(monkeypatch)
+    tok = np.zeros((1, 1025), np.int32)
+    counts, grads = {}, {}
+    for pol in ("nothing", "attn_out"):
+        cfg = dataclasses.replace(base, remat_policy=pol)
+        params = gpt.init(cfg, jax.random.PRNGKey(0))
+        f, txt = _grad_hlo(cfg, params, tok)
+        counts[pol] = txt.count("exponential(")
+        g = f(params, {"tokens": tok})
+        grads[pol] = np.asarray(
+            jax.device_get(g["blocks"]["wqkv"]), np.float32)
+    # the re-run fwd kernel contributes extra exp sites to the backward;
+    # saving o+lse must remove them
+    assert counts["attn_out"] < counts["nothing"], counts
+    # identical math: same gradients either way
+    np.testing.assert_allclose(grads["attn_out"], grads["nothing"],
+                               rtol=1e-5, atol=1e-5)
