@@ -613,7 +613,13 @@ def backbone(params: PyTree, tokens: jnp.ndarray, config: GPTConfig,
             block_fn = ckpt.wrap(block_fn)
         else:
             if config.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                # saving matmul outputs alone still re-runs the flash fwd
+                # kernel in the backward (lse is a custom_vjp residual,
+                # not a dot output) — save the tagged pair as well
+                policy = jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "ds_attn_out", "ds_attn_lse"))
             elif config.remat_policy == "attn_out":
                 # "ds_attn_lse" rides along (tagged inside the flash
                 # custom_vjp's fwd rule): saving o WITHOUT lse would

@@ -38,7 +38,7 @@ def test_attn_out_policy_drops_fwd_kernel_rerun(monkeypatch):
     base = _base(monkeypatch)
     tok = np.zeros((1, 1025), np.int32)
     counts, grads = {}, {}
-    for pol in ("nothing", "attn_out"):
+    for pol in ("nothing", "attn_out", "dots"):
         cfg = dataclasses.replace(base, remat_policy=pol)
         params = gpt.init(cfg, jax.random.PRNGKey(0))
         f, txt = _grad_hlo(cfg, params, tok)
@@ -47,8 +47,10 @@ def test_attn_out_policy_drops_fwd_kernel_rerun(monkeypatch):
         grads[pol] = np.asarray(
             jax.device_get(g["blocks"]["wqkv"]), np.float32)
     # the re-run fwd kernel contributes extra exp sites to the backward;
-    # saving o+lse must remove them
+    # saving o+lse must remove them (dots composes the pair in too)
     assert counts["attn_out"] < counts["nothing"], counts
-    # identical math: same gradients either way
-    np.testing.assert_allclose(grads["attn_out"], grads["nothing"],
-                               rtol=1e-5, atol=1e-5)
+    assert counts["dots"] < counts["nothing"], counts
+    # identical math: same gradients under every policy
+    for pol in ("attn_out", "dots"):
+        np.testing.assert_allclose(grads[pol], grads["nothing"],
+                                   rtol=1e-5, atol=1e-5)
