@@ -516,8 +516,14 @@ def main() -> None:
             # 125M (41% vs 38%: d_model 1024 feeds the MXU better) and is
             # closer to the 1.3B-13B class the driver metric names.
             # remat + large micro-batch beats no-remat small batches.
+            # remat_policy attn_out: saves each block's flash o+lse
+            # (1.6 GB at mb32 — mb48 compiled, so the HBM is there) and
+            # provably removes the backward's fwd-kernel re-run
+            # (tests/unit/models/test_remat_policy.py pins the HLO);
+            # override with BENCH_REMAT_POLICY=nothing for A/B rows
             config = dataclasses.replace(gpt.GPT2_350M, max_seq_len=1024,
-                                         dtype=jnp.bfloat16, remat=True)
+                                         dtype=jnp.bfloat16, remat=True,
+                                         remat_policy="attn_out")
             mb_candidates, gas, steps, warmup = (32, 24, 16), 1, 10, 2
             if os.environ.get("BENCH_DENSE_ATTN") == "1":
                 # sweep knob: XLA's dense attention path — at head_dim 64
