@@ -500,6 +500,8 @@ def main() -> None:
             # v5e = 1.24x the reference's 272/V100 headline at 45% MFU
             config = dataclasses.replace(bert.BERT_LARGE, max_seq_len=128,
                                          dtype=jnp.bfloat16, remat=True)
+            if os.environ.get("BENCH_NO_REMAT") == "1":
+                config = dataclasses.replace(config, remat=False)
             mb_candidates, gas, steps, warmup = (384, 256, 128), 1, 10, 2
         else:
             config = bert.BertConfig(vocab_size=512, max_seq_len=64, n_layer=2,
@@ -532,6 +534,14 @@ def main() -> None:
                 # seq 1024 when remat keeps the S^2 buffer transient
                 config = dataclasses.replace(config,
                                              use_flash_attention=False)
+            if os.environ.get("BENCH_NO_REMAT") == "1":
+                # sweep knob: drop remat entirely — removes the extra
+                # forward (~25% of executed flops) if the no-remat
+                # activations fit at a micro-batch that still feeds MXU
+                config = dataclasses.replace(config, remat=False,
+                                             remat_policy="nothing")
+            if os.environ.get("BENCH_GAS"):
+                gas = int(os.environ["BENCH_GAS"])
             if os.environ.get("BENCH_LOSS_CHUNK"):
                 # sweep knob: chunked loss head — the full fp32 logits
                 # tensor is 6.6 GB at mb32 (write fwd + read bwd); scanning

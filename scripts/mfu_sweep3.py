@@ -50,6 +50,13 @@ CONFIGS = [
                    "BENCH_MB": "24,16"}, None),
     ("attn-out-losschunk256", {"BENCH_REMAT_POLICY": "attn_out",
                                "BENCH_LOSS_CHUNK": "256"}, None),
+    # no-remat rows: the extra forward is ~25% of executed flops — wins
+    # if no-remat activations fit at a micro-batch that still feeds MXU
+    ("gpt-noremat-mb12", {"BENCH_NO_REMAT": "1", "BENCH_MB": "12,8",
+                          "BENCH_GAS": "3"}, None),
+    ("bert-noremat-mb128", {"BENCH_NO_REMAT": "1",
+                            "BENCH_MB": "128,96,64"},
+     ["bench.py", "bert"]),
     # --- capability (BASELINE #3) ---
     ("offload-capability", {}, ["bench.py", "offload"]),
     # --- inference rows ---
