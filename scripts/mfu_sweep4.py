@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""End-of-round short sweep: only the four highest-value rows, for a
+late tunnel-recovery window (the full list is scripts/mfu_sweep3.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mfu_sweep import main as sweep_main  # noqa: E402
+
+CONFIGS = [
+    ("attn-out-mb32", {}, None),                       # new bench default
+    ("nothing-mb32", {"BENCH_REMAT_POLICY": "nothing"}, None),  # A/B
+    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
+     ["scripts/stall_anatomy.py"]),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+]
+
+
+if __name__ == "__main__":
+    sweep_main(CONFIGS, "/tmp/mfu_sweep4.jsonl", tag="sweep4")
