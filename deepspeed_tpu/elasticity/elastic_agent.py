@@ -16,11 +16,13 @@ here is a train-loop runner that
 
 from __future__ import annotations
 
+import math
 import os
 import signal
 import sys
 from typing import Any, Callable, Dict, Iterable, Optional
 
+from ..utils import fault_injection
 from ..utils.logging import log_dist, logger
 from .elasticity import compute_elastic_config, elasticity_enabled
 
@@ -35,15 +37,23 @@ class ElasticTrainRunner:
       save_interval: steps between periodic checkpoints.
       ds_config: when it carries an enabled "elasticity" section, the
         current dp world size is validated against the admissible set.
+      nan_abort_threshold: abort (RuntimeError) after this many CONSECUTIVE
+        non-finite losses — a diverged run must stop burning preemptible
+        capacity, and must NOT checkpoint the poisoned state over a good
+        tag.  0 disables the guard; isolated non-finite losses (fp16
+        overflow skips) reset the streak.
     """
 
     def __init__(self, engine, save_dir: str, save_interval: int = 100,
                  ds_config: Optional[Dict[str, Any]] = None,
-                 tag_prefix: str = "elastic"):
+                 tag_prefix: str = "elastic",
+                 nan_abort_threshold: int = 5):
         self.engine = engine
         self.save_dir = save_dir
         self.save_interval = max(1, save_interval)
         self.tag_prefix = tag_prefix
+        self.nan_abort_threshold = max(0, nan_abort_threshold)
+        self._nan_streak = 0
         self._preempted = False
         self._prev_handlers = {}
 
@@ -77,12 +87,21 @@ class ElasticTrainRunner:
 
     # ------------------------------------------------------------------ run
     def resume(self) -> int:
-        """Load the newest checkpoint if present; returns the step resumed at."""
-        if os.path.isdir(self.save_dir) and \
-                os.path.exists(os.path.join(self.save_dir, "latest")):
-            self.engine.load_checkpoint(self.save_dir)
+        """Load the newest VERIFIED checkpoint if any; returns the step
+        resumed at.  The engine's load walks the verified-fallback chain, so
+        a corrupt newest tag or a stale ``latest`` marker resumes from the
+        newest surviving tag; only an actual load is logged/counted as a
+        resume — otherwise warn and start fresh."""
+        if not os.path.isdir(self.save_dir):
+            return self.engine.global_steps
+        loaded, _ = self.engine.load_checkpoint(self.save_dir)
+        if loaded is not None:
             log_dist(f"[elastic] resumed from step {self.engine.global_steps}",
                      ranks=[0])
+        else:
+            logger.warning(f"[elastic] no loadable checkpoint under "
+                           f"{self.save_dir}; starting fresh from step "
+                           f"{self.engine.global_steps}")
         return self.engine.global_steps
 
     def _save(self):
@@ -111,11 +130,40 @@ class ElasticTrainRunner:
                     loss = self.engine.train_batch(batch=batch)
                 else:
                     loss = self.engine.train_batch_fused(batch)
-                losses.append(float(loss))
-                if self.engine.global_steps % self.save_interval == 0:
+                loss = float(loss)
+                losses.append(loss)
+                # consecutive-NaN abort BEFORE any checkpointing: never
+                # publish a tag whose trajectory has already diverged
+                if not math.isfinite(loss):
+                    self._nan_streak += 1
+                    if self.nan_abort_threshold and \
+                            self._nan_streak >= self.nan_abort_threshold:
+                        raise RuntimeError(
+                            f"[elastic] loss was non-finite for "
+                            f"{self._nan_streak} consecutive steps (last="
+                            f"{loss}) — aborting without checkpointing the "
+                            f"poisoned state")
+                    logger.warning(
+                        f"[elastic] non-finite loss at step "
+                        f"{self.engine.global_steps} "
+                        f"({self._nan_streak}/{self.nan_abort_threshold or '∞'} "
+                        f"consecutive before abort)")
+                else:
+                    self._nan_streak = 0
+                fault_injection.fire("train.step",
+                                     step=self.engine.global_steps)
+                # a step inside a non-finite streak is never published —
+                # resume-from-poisoned-state is worse than losing the window
+                if self._nan_streak == 0 and \
+                        self.engine.global_steps % self.save_interval == 0:
                     self._save()
             if self._preempted:
-                self._save()
+                if self._nan_streak == 0:
+                    self._save()
+                else:
+                    logger.warning(
+                        "[elastic] preempted mid NaN-streak: NOT writing a "
+                        "preemption checkpoint (state may be poisoned)")
         finally:
             self._restore()
         return {"steps": self.engine.global_steps - start_step,

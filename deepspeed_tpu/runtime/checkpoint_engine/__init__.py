@@ -1,0 +1,19 @@
+"""Checkpoint backends + the durability subsystem.
+
+- ``checkpoint_engine``: the pluggable backend ABC
+- ``native_checkpoint_engine``: sync numpy engine + engine-state save/load
+  (with the verified-fallback resume chain)
+- ``async_checkpoint_engine``: background writers + deferred atomic publish
+- ``integrity``: per-tag manifests, verification, retention
+- ``storage``: retrying atomic writers (the only place bytes hit disk)
+- ``config``: the validated ``"checkpoint"`` config section
+"""
+
+from .checkpoint_engine import CheckpointEngine  # noqa: F401
+from .config import CheckpointRetryConfig, DeepSpeedCheckpointConfig  # noqa: F401
+from .integrity import (CheckpointCorruptionError, list_tags,  # noqa: F401
+                        newest_verified_tag, prune_checkpoints, verify_tag,
+                        write_manifest)
+from .native_checkpoint_engine import (NativeCheckpointEngine,  # noqa: F401
+                                       load_engine_checkpoint, resolve_tag,
+                                       save_engine_checkpoint)

@@ -11,42 +11,43 @@ marker — so a crash mid-write never leaves a half-checkpoint advertised.
 
 from __future__ import annotations
 
-import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-import jax
-
 from ...utils.logging import logger
 from .checkpoint_engine import CheckpointEngine
-from .native_checkpoint_engine import NativeCheckpointEngine, snapshot_host
+from .native_checkpoint_engine import (NativeCheckpointEngine, _ckpt_config,
+                                       snapshot_host)
+from .storage import atomic_write_npz
 
 PyTree = Any
 
 
 class AsyncCheckpointEngine(CheckpointEngine):
-    def __init__(self, config_params=None, max_workers: int = 2):
+    def __init__(self, config_params=None, max_workers: Optional[int] = None):
         super().__init__(config_params)
-        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+        self.ckpt_config = _ckpt_config(config_params)
+        workers = max_workers or self.ckpt_config.writers
+        self._pool = ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="ckpt-writer")
         self._pending: List[Future] = []
-        self._sync = NativeCheckpointEngine()
+        self._sync = NativeCheckpointEngine(self.ckpt_config)
         self._lock = threading.Lock()
         self._last_error: Optional[BaseException] = None
 
     # ----------------------------------------------------------------- save
     def save(self, state_dict: PyTree, path: str) -> None:
-        """Snapshot to host now; write in the background."""
+        """Snapshot to host now; write in the background.  The write is the
+        retrying atomic writer, so a transient I/O error retries inside the
+        writer thread instead of permanently poisoning the pool."""
         arrays = snapshot_host(state_dict)
+        retry = self.ckpt_config.retry
 
         def write():
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".tmp.npz"
-            np.savez(tmp, **arrays)
-            os.replace(tmp, path if path.endswith(".npz") else path + ".npz")
+            atomic_write_npz(path, arrays, retry)
 
         with self._lock:
             self._pending.append(self._pool.submit(write))

@@ -15,7 +15,9 @@ from typing import Any, Optional
 
 class CheckpointEngine:
     def __init__(self, config_params=None):
-        pass
+        #: raw or typed "checkpoint" section; implementations parse it into
+        #: a DeepSpeedCheckpointConfig (retry policy, integrity, retention)
+        self.config_params = config_params
 
     def create(self, tag: str) -> None:
         """Log/prepare for a checkpoint under ``tag``."""

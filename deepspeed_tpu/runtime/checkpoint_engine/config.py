@@ -1,0 +1,105 @@
+"""The ``"checkpoint"`` config section, typed.
+
+Counterpart of the reference's checkpoint knobs scattered through
+``runtime/config.py`` (tag validation, nebula engine selection), grown into
+one validated section covering the durability subsystem:
+
+.. code-block:: json
+
+    {"checkpoint": {
+        "async_save": false,
+        "integrity": true,
+        "verify_on_load": true,
+        "keep_last": null,
+        "writers": 2,
+        "retries": {"max_attempts": 3, "backoff_base": 0.05,
+                    "backoff_max": 2.0, "jitter": 0.25},
+        "tag_validation": "Warn",
+        "load_universal_checkpoint": false
+    }}
+
+Validated dataclass-model style like ``zero/config.py``
+(``DeepSpeedZeroConfig``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..config_utils import DeepSpeedConfigModel
+
+CHECKPOINT = "checkpoint"
+
+TAG_VALIDATION_MODES = ("ignore", "warn", "fail")
+
+
+@dataclasses.dataclass
+class CheckpointRetryConfig(DeepSpeedConfigModel):
+    """Retry policy for checkpoint storage writes: exponential backoff with
+    multiplicative jitter, bounded attempts.  Attempt ``i`` (0-based) sleeps
+    ``min(backoff_max, backoff_base * 2**i) * (1 + jitter*U[0,1))`` before
+    retrying; after ``max_attempts`` total attempts the error propagates."""
+
+    max_attempts: int = 3
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+    jitter: float = 0.25
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"checkpoint retries.max_attempts must be >= 1, got "
+                f"{self.max_attempts}")
+        if self.backoff_base < 0 or self.backoff_max < 0:
+            raise ValueError("checkpoint retry backoff must be >= 0")
+        if self.jitter < 0:
+            raise ValueError(
+                f"checkpoint retries.jitter must be >= 0, got {self.jitter}")
+
+
+@dataclasses.dataclass
+class DeepSpeedCheckpointConfig(DeepSpeedConfigModel):
+    """Durability + backend selection for the checkpoint path.
+
+    ``integrity`` writes a per-tag ``manifest.json`` (sizes + SHA-256) at
+    publish time; ``verify_on_load`` makes resume walk tags newest→oldest
+    until one verifies AND deserializes (the verified-fallback chain);
+    ``keep_last`` prunes old tags after each successful publish, never
+    deleting the newest *verified* tag.
+    """
+
+    #: background writer threads + deferred publish (nebula role)
+    async_save: bool = False
+    #: writer-pool size for async_save
+    writers: int = 2
+    #: write manifest.json (file list, byte sizes, sha256) at publish
+    integrity: bool = True
+    #: resume walks the verified-fallback chain instead of dying on the
+    #: first corrupt/missing tag
+    verify_on_load: bool = True
+    #: retention: keep this many newest tags (None/0 = keep everything)
+    keep_last: Optional[int] = None
+    #: raw "retries" subsection (typed view: ``retry``)
+    retries: Optional[Dict] = None
+    #: reference parity knobs (parsed in runtime/config.py as well)
+    tag_validation: str = "Warn"
+    load_universal_checkpoint: bool = False
+
+    retry: CheckpointRetryConfig = dataclasses.field(
+        default_factory=CheckpointRetryConfig)
+
+    def __post_init__(self):
+        if isinstance(self.retries, dict):
+            self.retry = CheckpointRetryConfig.from_dict(self.retries)
+        if self.keep_last is not None:
+            self.keep_last = int(self.keep_last)
+            if self.keep_last <= 0:
+                self.keep_last = None
+        if self.writers < 1:
+            raise ValueError(
+                f"checkpoint writers must be >= 1, got {self.writers}")
+        if str(self.tag_validation).lower() not in TAG_VALIDATION_MODES:
+            raise ValueError(
+                f"checkpoint tag_validation must be one of "
+                f"{TAG_VALIDATION_MODES} (any case), got {self.tag_validation!r}")

@@ -7,6 +7,7 @@ Fills the role of the reference's ``TorchCheckpointEngine``
     <dir>/<tag>/model_states.npz        # params (+ scale/counters meta json)
     <dir>/<tag>/optim_states.npz        # master + optimizer state
     <dir>/<tag>/client_state.json
+    <dir>/<tag>/manifest.json           # sizes + sha256 of every tag file
     <dir>/latest                        # text file naming the newest tag
 
 Arrays are stored full (gathered); ZeRO-sharded state re-shards on load via
@@ -26,12 +27,37 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...utils import fault_injection
 from ...utils.logging import logger
 from .checkpoint_engine import CheckpointEngine
+from .config import DeepSpeedCheckpointConfig
+from .integrity import (MANIFEST, CheckpointCorruptionError,
+                        fallback_candidates, has_manifest, prune_checkpoints,
+                        verify_tag, write_manifest)
+from .storage import atomic_write_npz, atomic_write_text
 
 PyTree = Any
 
 SEP = "/"
+
+
+def _ckpt_config(config_params) -> DeepSpeedCheckpointConfig:
+    if isinstance(config_params, DeepSpeedCheckpointConfig):
+        return config_params
+    return DeepSpeedCheckpointConfig.from_dict(config_params or {})
+
+
+def resolve_tag(load_dir: str, tag: Optional[str]) -> Optional[str]:
+    """The tag a load should target: the explicit ``tag`` when given, else
+    the contents of ``<load_dir>/latest``, else None (nothing advertised)."""
+    if tag is not None:
+        return tag
+    try:
+        with open(os.path.join(load_dir, "latest")) as f:
+            t = f.read().strip()
+        return t or None
+    except OSError:
+        return None
 
 
 def flatten_tree(tree: PyTree, prefix: str = "") -> Dict[str, Any]:
@@ -86,10 +112,16 @@ def snapshot_host(state_dict: PyTree) -> Dict[str, np.ndarray]:
 
 
 class NativeCheckpointEngine(CheckpointEngine):
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self.ckpt_config = _ckpt_config(config_params)
+
     def save(self, state_dict: PyTree, path: str) -> None:
         arrays = snapshot_host(state_dict)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        np.savez(path, **arrays)
+        # tmp + os.replace (like the async engine): a crash mid-write never
+        # leaves a half-file at the final path; transient I/O errors retry
+        # under the configured backoff policy
+        atomic_write_npz(path, arrays, self.ckpt_config.retry)
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
         if not path.endswith(".npz"):
@@ -101,8 +133,13 @@ class NativeCheckpointEngine(CheckpointEngine):
 def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
                            client_state: Dict[str, Any], separate_master: bool,
                            save_latest: bool = True,
-                           engine: Optional[CheckpointEngine] = None) -> None:
-    eng = engine or NativeCheckpointEngine()
+                           engine: Optional[CheckpointEngine] = None,
+                           config: Optional[DeepSpeedCheckpointConfig] = None,
+                           manifest_meta: Optional[Dict[str, Any]] = None) -> None:
+    if config is None:
+        config = getattr(engine, "ckpt_config", None) or \
+            DeepSpeedCheckpointConfig()
+    eng = engine or NativeCheckpointEngine(config)
     ckpt_dir = os.path.join(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
     model_state = {"params": state["params"], "scale": state["scale"]}
@@ -113,14 +150,25 @@ def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
         optim_state["master"] = state["master"]
     eng.save(model_state, os.path.join(ckpt_dir, "model_states.npz"))
     eng.save(optim_state, os.path.join(ckpt_dir, "optim_states.npz"))
-    with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
-        json.dump(client_state, f, default=str)
+    atomic_write_text(os.path.join(ckpt_dir, "client_state.json"),
+                      json.dumps(client_state, default=str), config.retry)
 
     def publish():
+        # manifest first (it hashes every file of the tag, so all writes
+        # must have landed), then the latest marker, then retention — the
+        # marker never advertises an unhashed tag and retention never runs
+        # before the new tag is fully durable
+        if config.integrity:
+            meta = {"step": client_state.get("global_steps")}
+            meta.update(manifest_meta or {})
+            write_manifest(save_dir, tag, meta, config.retry)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+            fault_injection.fire("ckpt.publish", tag=tag)
+            atomic_write_text(os.path.join(save_dir, "latest"), tag,
+                              config.retry)
         logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
+        if config.keep_last:
+            prune_checkpoints(save_dir, config.keep_last, protect=(tag,))
 
     # the latest marker publishes only after every write of the tag lands
     # (nebula semantics).  An async engine chains publication behind its
@@ -147,21 +195,99 @@ def _put_like(template: PyTree, loaded: PyTree, shardings: Optional[PyTree] = No
 def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, Any],
                            shardings: Optional[Dict[str, Any]] = None,
                            load_optimizer_states: bool = True,
-                           separate_master: bool = True
+                           separate_master: bool = True,
+                           config: Optional[DeepSpeedCheckpointConfig] = None
                            ) -> Tuple[Optional[Dict], Dict]:
-    eng = NativeCheckpointEngine()
-    if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest_path):
-            logger.warning(f"no 'latest' file under {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest_path) as f:
-            tag = f.read().strip()
-    ckpt_dir = os.path.join(load_dir, tag)
-    if not os.path.isdir(ckpt_dir):
-        logger.warning(f"checkpoint dir {ckpt_dir} missing; nothing loaded")
+    """Load the newest checkpoint that verifies AND deserializes.
+
+    With an explicit ``tag`` the chain is that single tag (verification
+    failure raises — a pinned tag silently swapped for another would be
+    worse than a crash).  With ``tag=None`` the candidates are the
+    ``latest``-marker tag followed by every other tag newest→oldest; each
+    rejection (failed manifest verification, failed deserialization,
+    missing dir) is loudly logged and the walk continues, so a truncated
+    newest tag or a stale ``latest`` marker degrades to resuming from the
+    newest surviving checkpoint instead of a hard failure or a silent
+    non-resume.  The tag actually loaded is reported to callers as
+    ``client_state["_ckpt_tag"]``.
+    """
+    cfg = config if config is not None else DeepSpeedCheckpointConfig()
+    eng = NativeCheckpointEngine(cfg)
+    explicit = tag is not None
+    requested = resolve_tag(load_dir, tag)
+
+    if explicit:
+        candidates = [requested]
+    elif cfg.verify_on_load:
+        candidates = fallback_candidates(load_dir, requested)
+    else:
+        candidates = [requested] if requested is not None else []
+    if not candidates:
+        logger.warning(f"no 'latest' file and no tag dirs under {load_dir}; "
+                       "nothing loaded")
         return None, {}
 
+    # a directory where NO candidate carries a manifest predates the
+    # integrity subsystem: its tags load unverified (back-compat).  Once any
+    # tag has a manifest, a manifest-less tag is an unpublished or tampered
+    # one and is rejected by the fallback walk.
+    any_manifest = any(has_manifest(load_dir, t) for t in candidates)
+
+    for cand in candidates:
+        ckpt_dir = os.path.join(load_dir, cand)
+        if not os.path.isdir(ckpt_dir):
+            logger.warning(f"checkpoint dir {ckpt_dir} missing; "
+                           + ("nothing loaded" if explicit else "skipping"))
+            if explicit:
+                return None, {}
+            continue
+        if cfg.verify_on_load:
+            if has_manifest(load_dir, cand):
+                ok, problems = verify_tag(load_dir, cand)
+                if not ok:
+                    if explicit:
+                        raise CheckpointCorruptionError(
+                            f"checkpoint tag {cand!r} under {load_dir} failed "
+                            f"integrity verification: {'; '.join(problems)}")
+                    logger.error(f"[ckpt-integrity] REJECTED tag {cand}: "
+                                 + "; ".join(problems))
+                    continue
+            elif any_manifest and not explicit:
+                logger.error(
+                    f"[ckpt-integrity] REJECTED tag {cand}: no {MANIFEST} "
+                    "while sibling tags have one (unpublished or tampered)")
+                continue
+            else:
+                logger.warning(f"tag {cand} has no {MANIFEST} "
+                               "(pre-integrity checkpoint); loading unverified")
+        try:
+            new_state, client_state = _load_tag(
+                eng, ckpt_dir, state, shardings, load_optimizer_states,
+                separate_master)
+        except Exception as e:
+            if explicit:
+                raise
+            logger.error(f"[ckpt-integrity] REJECTED tag {cand}: "
+                         f"failed to deserialize: {e!r}")
+            continue
+        if requested is not None and cand != requested:
+            logger.warning(
+                f"[ckpt-integrity] FELL BACK to tag {cand} — requested/"
+                f"advertised tag {requested!r} was missing or corrupt")
+        client_state = dict(client_state)
+        client_state["_ckpt_tag"] = cand
+        logger.info(f"loaded checkpoint {cand} from {ckpt_dir}")
+        return new_state, client_state
+
+    logger.error(f"[ckpt-integrity] no loadable checkpoint under {load_dir} "
+                 f"(walked {candidates}); nothing loaded")
+    return None, {}
+
+
+def _load_tag(eng: CheckpointEngine, ckpt_dir: str, state: Dict[str, Any],
+              shardings: Optional[Dict[str, Any]],
+              load_optimizer_states: bool,
+              separate_master: bool) -> Tuple[Dict, Dict]:
     sh = shardings or {}
     model_flat = eng.load(os.path.join(ckpt_dir, "model_states.npz"))
     params = unflatten_into(state["params"], model_flat, "params" + SEP)
@@ -217,5 +343,4 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
     if os.path.exists(client_path):
         with open(client_path) as f:
             client_state = json.load(f)
-    logger.info(f"loaded checkpoint {tag} from {ckpt_dir}")
     return new_state, client_state

@@ -240,8 +240,14 @@ class DeepSpeedConfig:
         self.comms_logger = CommsLoggerConfig(pd.get(C.COMMS_LOGGER, {}))
         self.comms_logger_enabled = self.comms_logger.enabled
 
-        # checkpoint section
+        # checkpoint section (typed durability config: integrity manifests,
+        # write retries, retention, async backend selection)
         ckpt_dict = pd.get(C.CHECKPOINT, {})
+        from .checkpoint_engine.config import DeepSpeedCheckpointConfig
+        try:
+            self.checkpoint_config = DeepSpeedCheckpointConfig.from_dict(ckpt_dict)
+        except (TypeError, ValueError) as e:
+            raise DeepSpeedConfigError(f"invalid 'checkpoint' section: {e}") from e
         self.checkpoint_tag_validation_mode = get_scalar_param(
             ckpt_dict, C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT).lower().capitalize()
         self.checkpoint_tag_validation_enabled = self.checkpoint_tag_validation_mode != "Ignore"
@@ -286,8 +292,8 @@ class DeepSpeedConfig:
         self.flops_profiler_config_dict = pd.get(C.FLOPS_PROFILER, {})
         self.autotuning_config_dict = pd.get(C.AUTOTUNING, {})
         self.elasticity_config_dict = pd.get(C.ELASTICITY, {})
-        # checkpoint backend selection (reference "nebula"/engine choice;
-        # async_save -> AsyncCheckpointEngine)
+        # raw checkpoint section kept for dict-level consumers; the typed
+        # view (self.checkpoint_config) is what the engine reads
         self.checkpoint_config_dict = pd.get("checkpoint", {})
         # raw "compression_training" section (typed parse in
         # deepspeed_tpu.compression.config); engine steps its scheduler

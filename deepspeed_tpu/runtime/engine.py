@@ -166,10 +166,11 @@ class DeepSpeedEngine:
         # nebula): async_save runs writers in the background, committing
         # before the latest marker publishes
         self._checkpoint_engine = None
-        if self._config.checkpoint_config_dict.get("async_save"):
+        if self._config.checkpoint_config.async_save:
             from .checkpoint_engine.async_checkpoint_engine import (
                 AsyncCheckpointEngine)
-            self._checkpoint_engine = AsyncCheckpointEngine()
+            self._checkpoint_engine = AsyncCheckpointEngine(
+                self._config.checkpoint_config)
 
         # compression scheduler (reference engine.py:2002 steps it at every
         # optimizer step); the in-graph gating reads the step scalar the
@@ -1691,7 +1692,12 @@ class DeepSpeedEngine:
         save_engine_checkpoint(save_dir, tag, self.state, client_state,
                                separate_master=self._separate_master and not offload,
                                save_latest=save_latest,
-                               engine=self._checkpoint_engine)
+                               engine=self._checkpoint_engine,
+                               config=self._config.checkpoint_config,
+                               manifest_meta={
+                                   "world_size": self.dp_world_size,
+                                   "writer": {"rank": self.global_rank},
+                               })
         self._copy_recovery_script(save_dir)
         # spilled-param engines return to the between-steps memory bound
         # (nothing big resident) as soon as the checkpoint is written
@@ -1718,7 +1724,8 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
-        from .checkpoint_engine.native_checkpoint_engine import load_engine_checkpoint
+        from .checkpoint_engine.native_checkpoint_engine import (
+            load_engine_checkpoint, resolve_tag)
         self._ensure_params_resident()  # state acts as the load template
         if self._checkpoint_engine is not None:
             # never read our own in-flight async writes (also re-raises a
@@ -1729,21 +1736,21 @@ class DeepSpeedEngine:
             load_dir, tag, self.state,
             shardings=self._out_shardings,
             load_optimizer_states=load_optimizer_states and not load_module_only,
-            separate_master=self._separate_master and not offload)
+            separate_master=self._separate_master and not offload,
+            config=self._config.checkpoint_config)
         if state is None:
             return None, {}
+        # the tag the fallback chain actually loaded (may be older than the
+        # latest marker when that tag was corrupt) — the per-rank offload /
+        # DCN files must come from the SAME tag as the model state
+        loaded_tag = client_state.pop("_ckpt_tag", None) or \
+            resolve_tag(load_dir, tag)
         self.state = state
         if offload:
             loaded = False
             if load_optimizer_states and not load_module_only:
-                resolved_tag = tag
-                if resolved_tag is None:
-                    latest_path = os.path.join(load_dir, "latest")
-                    if os.path.exists(latest_path):
-                        with open(latest_path) as f:
-                            resolved_tag = f.read().strip()
                 path = os.path.join(
-                    load_dir, resolved_tag or "",
+                    load_dir, loaded_tag or "",
                     f"offload_optimizer_rank{self.global_rank}.npz")
                 if os.path.exists(path):
                     self._offload_opt.load(path)
@@ -1776,13 +1783,7 @@ class DeepSpeedEngine:
                 # first step would overwrite them with the init-time master
                 self._reseed_offload_master()
         if self._dcn_reduce is not None:
-            resolved = tag
-            if resolved is None:
-                lp = os.path.join(load_dir, "latest")
-                if os.path.exists(lp):
-                    with open(lp) as f:
-                        resolved = f.read().strip()
-            ef_path = os.path.join(load_dir, resolved or "",
+            ef_path = os.path.join(load_dir, loaded_tag or "",
                                    f"dcn_ef_rank{self.global_rank}.npz")
             if os.path.exists(ef_path):
                 with np.load(ef_path) as z:

@@ -1,0 +1,197 @@
+"""Fault-injection (chaos) layer for durability testing.
+
+Named failure points are compiled into the checkpoint storage and train-loop
+paths; with no fault installed, ``fire()`` is a dict lookup that finds
+nothing, so production pays one branch per point.  Tests install faults
+(directly or via the :func:`inject` context manager) and drive the real code
+paths — no monkeypatching of internals required, though every fault object
+is also a plain attribute bag a test may patch.
+
+Points currently wired:
+
+========================  =====================================================
+``ckpt.write``            start of every npz/text write attempt (inside the
+                          retry loop — raising here exercises backoff);
+                          ctx: ``path``
+``ckpt.post_write``       after the atomic replace landed the final file;
+                          ctx: ``path`` (truncate/corrupt faults model torn
+                          writes and bitrot)
+``ckpt.publish``          just before the ``latest`` marker is written;
+                          ctx: ``tag``
+``train.step``            once per completed runner step; ctx: ``step``
+                          (SIGTERM-at-step models a preemption notice)
+========================  =====================================================
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+# points with faults installed; guarded by _lock for install/clear, read
+# without it in fire() (list snapshot semantics are enough for tests)
+_faults: Dict[str, List["Fault"]] = {}
+_lock = threading.Lock()
+
+
+class FaultError(OSError):
+    """The exception injected write-failure faults raise by default."""
+
+
+class Fault:
+    """Base fault: subclasses implement ``fire(point, **ctx)``."""
+
+    def fire(self, point: str, **ctx) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @staticmethod
+    def _matches(match: Optional[str], path: Optional[str]) -> bool:
+        return match is None or (path is not None and match in str(path))
+
+
+class FailNTimes(Fault):
+    """Raise on the first ``n`` matching fires, then pass (transient error).
+
+    ``n=None`` fails forever (permanent error).  ``match`` restricts the
+    fault to paths containing the substring.  ``fired`` counts injections so
+    tests can assert the retry loop actually exercised them.
+    """
+
+    def __init__(self, n: Optional[int] = 1, match: Optional[str] = None,
+                 exc_type=FaultError):
+        self.remaining = n
+        self.match = match
+        self.exc_type = exc_type
+        self.fired = 0
+
+    def fire(self, point: str, path: Optional[str] = None, **ctx) -> None:
+        if not self._matches(self.match, path):
+            return
+        if self.remaining is None or self.remaining > 0:
+            if self.remaining is not None:
+                self.remaining -= 1
+            self.fired += 1
+            raise self.exc_type(
+                f"injected failure #{self.fired} at {point} ({path})")
+
+
+class TruncateAfterBytes(Fault):
+    """Truncate the just-written file to ``nbytes`` (a torn/partial write
+    that still made it to the final path).  Fires once per matching path
+    unless ``once=False``."""
+
+    def __init__(self, nbytes: int, match: Optional[str] = None,
+                 once: bool = True):
+        self.nbytes = nbytes
+        self.match = match
+        self.once = once
+        self.fired = 0
+
+    def fire(self, point: str, path: Optional[str] = None, **ctx) -> None:
+        if path is None or not self._matches(self.match, path):
+            return
+        if self.once and self.fired:
+            return
+        if os.path.exists(path) and os.path.getsize(path) > self.nbytes:
+            with open(path, "r+b") as f:
+                f.truncate(self.nbytes)
+            self.fired += 1
+
+
+class CorruptRandomBytes(Fault):
+    """Flip ``nbytes`` bytes at deterministic pseudo-random offsets (bitrot
+    past the npz header so sizes still match but digests don't)."""
+
+    def __init__(self, nbytes: int = 8, seed: int = 0,
+                 match: Optional[str] = None, once: bool = True):
+        self.nbytes = nbytes
+        self.seed = seed
+        self.match = match
+        self.once = once
+        self.fired = 0
+
+    def fire(self, point: str, path: Optional[str] = None, **ctx) -> None:
+        if path is None or not self._matches(self.match, path):
+            return
+        if self.once and self.fired:
+            return
+        corrupt_file(path, nbytes=self.nbytes, seed=self.seed)
+        self.fired += 1
+
+
+class SignalAtStep(Fault):
+    """Deliver ``sig`` to this process when the train loop reaches ``step``
+    (the cloud preemption notice, scripted)."""
+
+    def __init__(self, step: int, sig: int = signal.SIGTERM):
+        self.step = step
+        self.sig = sig
+        self.fired = 0
+
+    def fire(self, point: str, step: Optional[int] = None, **ctx) -> None:
+        if step == self.step:
+            self.fired += 1
+            os.kill(os.getpid(), self.sig)
+
+
+def corrupt_file(path: str, nbytes: int = 8, seed: int = 0) -> None:
+    """Flip ``nbytes`` bytes of ``path`` in place (size-preserving)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    rng = random.Random(seed)
+    with open(path, "r+b") as f:
+        for _ in range(nbytes):
+            off = rng.randrange(size)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------- registry
+def install(point: str, fault: Fault) -> Fault:
+    with _lock:
+        _faults.setdefault(point, []).append(fault)
+    return fault
+
+
+def remove(point: str, fault: Fault) -> None:
+    with _lock:
+        lst = _faults.get(point, [])
+        if fault in lst:
+            lst.remove(fault)
+        if not lst:
+            _faults.pop(point, None)
+
+
+def clear(point: Optional[str] = None) -> None:
+    with _lock:
+        if point is None:
+            _faults.clear()
+        else:
+            _faults.pop(point, None)
+
+
+def fire(point: str, **ctx) -> None:
+    """Trip every fault installed at ``point`` (no-op when none are)."""
+    lst = _faults.get(point)
+    if not lst:
+        return
+    for fault in list(lst):
+        fault.fire(point, **ctx)
+
+
+@contextmanager
+def inject(point: str, fault: Fault):
+    """``with inject("ckpt.write", FailNTimes(2)) as f: ...`` — installed on
+    entry, removed on exit no matter how the body ends."""
+    install(point, fault)
+    try:
+        yield fault
+    finally:
+        remove(point, fault)

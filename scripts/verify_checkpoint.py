@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Verify checkpoint integrity manifests from the command line.
+
+Runs ``verify_tag`` over every tag of a checkpoint directory (or one
+``--tag``) and exits nonzero when anything is corrupt — drop it in a
+preflight/cron job so bitrot is found before the resume that needs the
+checkpoint, not during it.
+
+Usage:
+    python scripts/verify_checkpoint.py CKPT_DIR [--tag TAG] [--quiet]
+
+Exit codes: 0 all verified; 1 corruption found; 2 nothing to verify.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.runtime.checkpoint_engine.integrity import (  # noqa: E402
+    has_manifest, list_tags, verify_tag)
+from deepspeed_tpu.runtime.checkpoint_engine.native_checkpoint_engine import (  # noqa: E402
+    resolve_tag)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("ckpt_dir", help="checkpoint directory (holds tag dirs + latest)")
+    ap.add_argument("--tag", default=None,
+                    help="verify only this tag (default: every tag found)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-file problem listings")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.ckpt_dir):
+        print(f"error: {args.ckpt_dir} is not a directory", file=sys.stderr)
+        return 2
+    tags = [args.tag] if args.tag else list_tags(args.ckpt_dir)
+    if not tags:
+        print(f"error: no checkpoint tags under {args.ckpt_dir}",
+              file=sys.stderr)
+        return 2
+
+    advertised = resolve_tag(args.ckpt_dir, None)
+    bad = 0
+    for tag in tags:
+        if not has_manifest(args.ckpt_dir, tag):
+            bad += 1
+            print(f"CORRUPT  {tag}: no manifest.json")
+            continue
+        ok, problems = verify_tag(args.ckpt_dir, tag)
+        mark = " (latest)" if tag == advertised else ""
+        if ok:
+            print(f"OK       {tag}{mark}")
+        else:
+            bad += 1
+            print(f"CORRUPT  {tag}{mark}: {len(problems)} problem(s)")
+            if not args.quiet:
+                for p in problems:
+                    print(f"         - {p}")
+    if advertised is not None and advertised not in tags and not args.tag:
+        bad += 1
+        print(f"CORRUPT  latest marker names {advertised!r} but no such tag "
+              f"exists (stale marker)")
+    print(f"checked {len(tags)} tag(s): {bad} corrupt")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

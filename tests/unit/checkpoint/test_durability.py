@@ -1,0 +1,296 @@
+"""Checkpoint durability: integrity manifests, verified-fallback resume,
+retrying storage, retention — driven through the fault-injection harness
+(utils/fault_injection.py).  Pure storage-layer tests on toy state trees
+(no engine compile), so the whole module stays in tier-1.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.checkpoint_engine import (
+    CheckpointCorruptionError, DeepSpeedCheckpointConfig,
+    NativeCheckpointEngine, list_tags, load_engine_checkpoint,
+    newest_verified_tag, prune_checkpoints, resolve_tag,
+    save_engine_checkpoint, verify_tag)
+from deepspeed_tpu.runtime.checkpoint_engine.async_checkpoint_engine import (
+    AsyncCheckpointEngine)
+from deepspeed_tpu.runtime.checkpoint_engine.integrity import MANIFEST
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def tree(v, acc=0.0):
+    """A minimal engine-shaped state tree whose params encode ``v``."""
+    a = jnp.asarray(float(v), jnp.float32)
+    return {"params": {"w": a, "b": jnp.full((4,), float(v))},
+            "master": {"w": a, "b": jnp.full((4,), float(v))},
+            "opt_state": {"m": {"w": a * 0.1}, "v": {"w": a * 0.2}},
+            "grad_acc": {"w": jnp.asarray(float(acc))},
+            "scale": {"loss_scale": jnp.asarray(1024.0)}}
+
+
+def save_steps(d, steps, config=None, **kw):
+    for s in steps:
+        save_engine_checkpoint(str(d), f"global_step{s}", tree(s),
+                               {"global_steps": s}, separate_master=True,
+                               config=config, **kw)
+
+
+def loaded_step(d, tag=None, config=None):
+    st, cs = load_engine_checkpoint(str(d), tag, tree(-1), config=config)
+    if st is None:
+        return None
+    # the restored params must match the step the tag was written at
+    np.testing.assert_allclose(np.asarray(st["params"]["w"]),
+                               cs["global_steps"])
+    return cs["global_steps"]
+
+
+# ------------------------------------------------------------- manifests
+
+def test_manifest_written_at_publish_and_verifies(tmp_path):
+    save_steps(tmp_path, [7])
+    mpath = tmp_path / "global_step7" / MANIFEST
+    assert mpath.exists()
+    doc = json.loads(mpath.read_text())
+    assert doc["version"] == 1 and doc["tag"] == "global_step7"
+    assert doc["step"] == 7
+    for f in ("model_states.npz", "optim_states.npz", "client_state.json"):
+        assert f in doc["files"]
+        assert doc["files"][f]["bytes"] == os.path.getsize(
+            tmp_path / "global_step7" / f)
+        assert len(doc["files"][f]["sha256"]) == 64
+    ok, problems = verify_tag(str(tmp_path), "global_step7")
+    assert ok and not problems
+
+
+def test_resolve_tag_helper(tmp_path):
+    assert resolve_tag(str(tmp_path), None) is None
+    assert resolve_tag(str(tmp_path), "pinned") == "pinned"
+    (tmp_path / "latest").write_text("global_step3")
+    assert resolve_tag(str(tmp_path), None) == "global_step3"
+    assert resolve_tag(str(tmp_path), "pinned") == "pinned"
+
+
+# ----------------------------------------------------- corruption matrix
+
+def _truncate_newest(d):
+    p = d / "global_step3" / "model_states.npz"
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+
+
+def _flip_bytes_newest(d):
+    fi.corrupt_file(str(d / "global_step3" / "optim_states.npz"))
+
+
+def _drop_manifest_newest(d):
+    os.remove(d / "global_step3" / MANIFEST)
+
+
+def _stale_latest(d):
+    import shutil
+    shutil.rmtree(d / "global_step3")
+    # latest still names global_step3
+
+
+@pytest.mark.parametrize("corrupt", [_truncate_newest, _flip_bytes_newest,
+                                     _drop_manifest_newest, _stale_latest],
+                         ids=["truncated-npz", "flipped-bytes",
+                              "missing-manifest", "stale-latest"])
+def test_corruption_matrix_falls_back_to_newest_verified(tmp_path, corrupt):
+    """Every corruption mode is caught and resume lands on the newest tag
+    that still verifies — never a hard failure, never a silent non-resume."""
+    save_steps(tmp_path, [1, 2, 3])
+    corrupt(tmp_path)
+    assert loaded_step(tmp_path) == 2
+
+
+def test_two_corrupt_tags_fall_back_twice(tmp_path):
+    save_steps(tmp_path, [1, 2, 3])
+    fi.corrupt_file(str(tmp_path / "global_step3" / "model_states.npz"))
+    fi.corrupt_file(str(tmp_path / "global_step2" / "optim_states.npz"))
+    assert loaded_step(tmp_path) == 1
+
+
+def test_all_tags_corrupt_returns_none(tmp_path):
+    save_steps(tmp_path, [1, 2])
+    for t in ("global_step1", "global_step2"):
+        fi.corrupt_file(str(tmp_path / t / "model_states.npz"))
+    st, cs = load_engine_checkpoint(str(tmp_path), None, tree(-1))
+    assert st is None and cs == {}
+
+
+def test_explicit_tag_corruption_raises(tmp_path):
+    """A pinned tag that fails verification must raise, not silently swap."""
+    save_steps(tmp_path, [1, 2])
+    fi.corrupt_file(str(tmp_path / "global_step2" / "model_states.npz"))
+    with pytest.raises(CheckpointCorruptionError, match="sha256"):
+        load_engine_checkpoint(str(tmp_path), "global_step2", tree(-1))
+    # the intact pinned tag still loads
+    assert loaded_step(tmp_path, tag="global_step1") == 1
+
+
+def test_preintegrity_checkpoint_still_loads(tmp_path):
+    """A checkpoint dir written before the integrity subsystem (no manifest
+    anywhere) must keep loading (back-compat)."""
+    save_steps(tmp_path, [5])
+    os.remove(tmp_path / "global_step5" / MANIFEST)
+    assert loaded_step(tmp_path) == 5
+
+
+def test_empty_dir_returns_none(tmp_path):
+    st, cs = load_engine_checkpoint(str(tmp_path), None, tree(-1))
+    assert st is None and cs == {}
+
+
+# ------------------------------------------------------ retrying storage
+
+def test_sync_writer_retries_transient_failure(tmp_path):
+    with fi.inject("ckpt.write", fi.FailNTimes(2, match="model_states")) as f:
+        save_steps(tmp_path, [1])
+    assert f.fired == 2
+    assert verify_tag(str(tmp_path), "global_step1")[0]
+    assert loaded_step(tmp_path) == 1
+
+
+def test_sync_writer_permanent_failure_raises_and_leaves_no_half_file(tmp_path):
+    cfg = DeepSpeedCheckpointConfig.from_dict(
+        {"retries": {"max_attempts": 2, "backoff_base": 0.001}})
+    with fi.inject("ckpt.write", fi.FailNTimes(None, match="model_states")):
+        with pytest.raises(fi.FaultError):
+            save_steps(tmp_path, [1], config=cfg)
+    d = tmp_path / "global_step1"
+    assert not (d / "model_states.npz").exists()
+    assert not list(d.glob("*.tmp"))
+    # nothing was published
+    assert not (tmp_path / "latest").exists()
+
+
+def test_sync_save_atomic_and_bare_filename(tmp_path, monkeypatch):
+    """Satellite: sync save goes tmp→replace and a bare filename (empty
+    dirname) must not crash on os.makedirs('')."""
+    monkeypatch.chdir(tmp_path)
+    eng = NativeCheckpointEngine()
+    eng.save({"w": jnp.ones((2,))}, "bare_file")
+    assert os.path.exists("bare_file.npz")
+    got = eng.load("bare_file")
+    np.testing.assert_allclose(got["w"], np.ones((2,)))
+
+
+def test_async_writer_transient_failure_retries_then_publishes(tmp_path):
+    eng = AsyncCheckpointEngine({"retries": {"backoff_base": 0.001}})
+    with fi.inject("ckpt.write", fi.FailNTimes(2, match="optim_states")) as f:
+        save_steps(tmp_path, [4], engine=eng)
+        eng.wait()  # joins writers + the publish chain; must NOT raise
+    assert f.fired == 2
+    assert (tmp_path / "latest").read_text() == "global_step4"
+    assert verify_tag(str(tmp_path), "global_step4")[0]
+    assert loaded_step(tmp_path) == 4
+
+
+def test_async_writer_permanent_failure_blocks_publication(tmp_path):
+    eng = AsyncCheckpointEngine(
+        {"retries": {"max_attempts": 2, "backoff_base": 0.001}})
+    with fi.inject("ckpt.write", fi.FailNTimes(None, match="model_states")):
+        save_steps(tmp_path, [4], engine=eng)
+        with pytest.raises(RuntimeError, match="async checkpoint write"):
+            eng.wait()
+    # the tag whose bytes never landed must not look saved
+    assert not (tmp_path / "latest").exists()
+    assert not verify_tag(str(tmp_path), "global_step4")[0]
+    # ...and the pool is NOT poisoned: the next save succeeds end to end
+    save_steps(tmp_path, [5], engine=eng)
+    eng.wait()
+    assert (tmp_path / "latest").read_text() == "global_step5"
+    assert loaded_step(tmp_path) == 5
+
+
+# ------------------------------------------------------------- retention
+
+def test_keep_last_prunes_after_publish(tmp_path):
+    cfg = DeepSpeedCheckpointConfig.from_dict({"keep_last": 2})
+    save_steps(tmp_path, [1, 2, 3, 4], config=cfg)
+    assert list_tags(str(tmp_path)) == ["global_step4", "global_step3"]
+    assert loaded_step(tmp_path) == 4
+
+
+def test_retention_never_deletes_newest_verified_tag(tmp_path):
+    save_steps(tmp_path, [1, 2, 3])
+    fi.corrupt_file(str(tmp_path / "global_step3" / "model_states.npz"))
+    assert newest_verified_tag(str(tmp_path)) == "global_step2"
+    removed = prune_checkpoints(str(tmp_path), keep_last=1)
+    # step3 survives as the keep_last newest, step2 as the newest verified;
+    # only step1 is prunable
+    assert removed == ["global_step1"]
+    assert loaded_step(tmp_path) == 2
+
+
+def test_keep_last_zero_or_none_keeps_everything(tmp_path):
+    save_steps(tmp_path, [1, 2, 3])
+    assert prune_checkpoints(str(tmp_path), keep_last=None) == []
+    assert prune_checkpoints(str(tmp_path), keep_last=0) == []
+    assert len(list_tags(str(tmp_path))) == 3
+
+
+# ------------------------------------------------------------ config + CLI
+
+def test_checkpoint_config_validation():
+    cfg = DeepSpeedCheckpointConfig.from_dict({})
+    assert cfg.integrity and cfg.verify_on_load and not cfg.async_save
+    assert cfg.retry.max_attempts == 3
+    cfg = DeepSpeedCheckpointConfig.from_dict(
+        {"keep_last": 4, "retries": {"max_attempts": 7, "jitter": 0.5}})
+    assert cfg.keep_last == 4 and cfg.retry.max_attempts == 7
+    with pytest.raises(ValueError):
+        DeepSpeedCheckpointConfig.from_dict({"retries": {"max_attempts": 0}})
+    with pytest.raises(ValueError):
+        DeepSpeedCheckpointConfig.from_dict({"tag_validation": "explode"})
+    with pytest.raises(ValueError):
+        DeepSpeedCheckpointConfig.from_dict({"writers": 0})
+
+
+def test_config_section_parses_through_deepspeed_config():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "checkpoint": {"keep_last": 3, "async_save": False,
+                       "retries": {"max_attempts": 5}},
+    })
+    assert cfg.checkpoint_config.keep_last == 3
+    assert cfg.checkpoint_config.retry.max_attempts == 5
+    with pytest.raises(DeepSpeedConfigError, match="checkpoint"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "checkpoint": {"retries": {"max_attempts": -1}}})
+
+
+def test_verify_checkpoint_cli(tmp_path, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                     "scripts", "verify_checkpoint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    save_steps(tmp_path, [1, 2])
+    assert mod.main([str(tmp_path)]) == 0
+    fi.corrupt_file(str(tmp_path / "global_step2" / "optim_states.npz"))
+    assert mod.main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "global_step2" in out
+    assert mod.main([str(tmp_path), "--tag", "global_step1"]) == 0
+    assert mod.main([str(tmp_path / "nope")]) == 2

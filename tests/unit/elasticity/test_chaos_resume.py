@@ -1,0 +1,185 @@
+"""Chaos tests for the preemption-resume loop: SIGTERM-mid-run, corrupt
+newest tag on restart, NaN-loss abort, resume-logging honesty.
+
+Uses a duck-typed fake engine over the REAL checkpoint stack
+(save_engine_checkpoint / load_engine_checkpoint with manifests and the
+verified-fallback chain) so the runner is exercised end to end without a
+single jit compile — fast enough for tier-1.
+"""
+
+import math
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.elasticity import ElasticTrainRunner
+from deepspeed_tpu.runtime.checkpoint_engine import (load_engine_checkpoint,
+                                                     resolve_tag,
+                                                     save_engine_checkpoint,
+                                                     verify_tag)
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+@pytest.fixture
+def ds_caplog(caplog):
+    """caplog wired to the non-propagating deepspeed_tpu logger."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    ds_logger.propagate = True
+    try:
+        yield caplog
+    finally:
+        ds_logger.propagate = False
+
+
+class FakeEngine:
+    """Duck-typed engine: each 'step' adds the batch value into a scalar
+    weight, losses come from a scripted list.  Checkpoints go through the
+    real engine-checkpoint save/load helpers (manifests, fallback, retry)."""
+
+    dp_world_size = 1
+    global_rank = 0
+
+    def __init__(self, losses=None):
+        self.global_steps = 0
+        self.weight = 0.0
+        self._losses = list(losses or [])
+
+    # ------------------------------------------------------------- train
+    def train_batch_fused(self, batch):
+        self.global_steps += 1
+        self.weight += float(batch)
+        if self._losses:
+            return self._losses.pop(0)
+        return 1.0 / self.global_steps
+
+    # -------------------------------------------------------- checkpoint
+    def _tree(self):
+        w = jnp.asarray(self.weight, jnp.float32)
+        return {"params": {"w": w}, "master": {"w": w},
+                "opt_state": {"m": {"w": w}}, "grad_acc": {"w": jnp.zeros(())},
+                "scale": {"loss_scale": jnp.asarray(1.0)}}
+
+    def save_checkpoint(self, save_dir, tag=None, **kw):
+        tag = tag or f"fake_step{self.global_steps}"
+        save_engine_checkpoint(save_dir, tag, self._tree(),
+                               {"global_steps": self.global_steps,
+                                "weight": self.weight},
+                               separate_master=True)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **kw):
+        state, cs = load_engine_checkpoint(load_dir, tag, self._tree())
+        if state is None:
+            return None, {}
+        self.global_steps = cs["global_steps"]
+        self.weight = float(np.asarray(state["params"]["w"]))
+        return load_dir, cs
+
+
+def test_resume_logs_only_on_actual_load(tmp_path, ds_caplog):
+    """Satellite: no 'resumed from' claim unless state actually loaded."""
+    save = str(tmp_path / "ck")
+    os.makedirs(save)  # dir exists but holds no checkpoint
+    runner = ElasticTrainRunner(FakeEngine(), save, save_interval=100)
+    with ds_caplog.at_level("INFO"):
+        step = runner.resume()
+    assert step == 0
+    assert not any("resumed from" in r.message for r in ds_caplog.records)
+    assert any("starting fresh" in r.message for r in ds_caplog.records)
+
+    # after a real checkpoint the resume IS logged
+    eng = FakeEngine()
+    eng.train_batch_fused(2.0)
+    eng.save_checkpoint(save, tag="fake_step1")
+    ds_caplog.clear()
+    runner2 = ElasticTrainRunner(FakeEngine(), save, save_interval=100)
+    with ds_caplog.at_level("INFO"):
+        assert runner2.resume() == 1
+    assert any("resumed from step 1" in r.message
+               for r in ds_caplog.records)
+
+
+def test_sigterm_mid_run_checkpoint_verifies_and_resumes(tmp_path):
+    """SIGTERM (the preemption notice) injected at step 3: the runner must
+    checkpoint at the step boundary, the preemption tag must VERIFY, and a
+    fresh runner must resume exactly where the victim stopped."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine()
+    runner = ElasticTrainRunner(eng, save, save_interval=100)
+    with fi.inject("train.step", fi.SignalAtStep(3, signal.SIGTERM)):
+        res = runner.run([1.0] * 8)
+    assert res["preempted"] and res["steps"] == 3
+    tag = resolve_tag(save, None)
+    assert tag == "elastic_step3"
+    ok, problems = verify_tag(save, tag)
+    assert ok, problems
+
+    eng2 = FakeEngine()
+    runner2 = ElasticTrainRunner(eng2, save, save_interval=100)
+    res2 = runner2.run([1.0] * 5)
+    assert eng2.global_steps == 8
+    assert eng2.weight == pytest.approx(8.0)
+    assert not res2["preempted"]
+
+
+def test_restart_with_corrupt_newest_tag_falls_back(tmp_path):
+    """save→crash→resume with the newest tag truncated: the fallback chain
+    restores the newest VERIFIED tag without manual intervention."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine()
+    runner = ElasticTrainRunner(eng, save, save_interval=2)
+    runner.run([1.0] * 6, max_steps=6)
+    # periodic saves at steps 2, 4, 6
+    assert resolve_tag(save, None) == "elastic_step6"
+    # the crash tore the newest tag's model file mid-write
+    p = os.path.join(save, "elastic_step6", "model_states.npz")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+
+    eng2 = FakeEngine()
+    runner2 = ElasticTrainRunner(eng2, save, save_interval=100)
+    assert runner2.resume() == 4
+    assert eng2.weight == pytest.approx(4.0)
+
+
+def test_nan_streak_aborts_without_checkpointing(tmp_path):
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[1.0, float("nan"), float("nan"), float("nan")])
+    runner = ElasticTrainRunner(eng, save, save_interval=1,
+                                nan_abort_threshold=3)
+    with pytest.raises(RuntimeError, match="non-finite"):
+        runner.run([1.0] * 10, resume=False)
+    # the poisoned steps were never published: newest tag predates the streak
+    tag = resolve_tag(save, None)
+    assert tag == "elastic_step1"
+
+
+def test_transient_nan_resets_streak(tmp_path):
+    save = str(tmp_path / "ck")
+    losses = [1.0, float("nan"), 0.5, float("nan"), 0.4, float("nan"), 0.3]
+    eng = FakeEngine(losses=losses)
+    runner = ElasticTrainRunner(eng, save, save_interval=100,
+                                nan_abort_threshold=2)
+    res = runner.run([1.0] * len(losses), resume=False)
+    assert res["steps"] == len(losses)
+    assert sum(1 for l in res["losses"] if math.isnan(l)) == 3
+
+
+def test_nan_guard_disabled_with_zero_threshold(tmp_path):
+    eng = FakeEngine(losses=[float("nan")] * 6)
+    runner = ElasticTrainRunner(eng, str(tmp_path / "ck"), save_interval=100,
+                                nan_abort_threshold=0)
+    res = runner.run([1.0] * 6, resume=False)
+    assert res["steps"] == 6
