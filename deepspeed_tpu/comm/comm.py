@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import fault_injection
 from ..utils.comms_logging import CommsLogger
 from ..utils.logging import logger
 from ..parallel import mesh as mesh_lib
@@ -58,6 +59,9 @@ class ReduceOp(enum.Enum):
 comms_logger = CommsLogger()
 
 _INITIALIZED = False
+#: whether init actually called jax.distributed.initialize — only then does
+#: destroy_process_group owe a jax.distributed.shutdown()
+_MULTIHOST = False
 
 
 def init_distributed(dist_backend: str = "xla",
@@ -77,7 +81,7 @@ def init_distributed(dist_backend: str = "xla",
     ``MASTER_ADDR`` — exported by ``deepspeed_tpu.launcher``) or explicit
     args, and routed to ``jax.distributed.initialize``.
     """
-    global _INITIALIZED
+    global _INITIALIZED, _MULTIHOST
     if _INITIALIZED:
         return
     env_world = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
@@ -95,6 +99,7 @@ def init_distributed(dist_backend: str = "xla",
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=env_world,
                                    process_id=env_rank)
+        _MULTIHOST = True
     _INITIALIZED = True
 
 
@@ -103,7 +108,20 @@ def is_initialized() -> bool:
 
 
 def destroy_process_group(group=None) -> None:
-    global _INITIALIZED
+    """Tear down what ``init_distributed`` set up.
+
+    When multi-host init actually ran, the distributed client is shut down
+    (releasing the coordinator connection) — not just the local flag.  A
+    failed shutdown is logged, not raised: teardown runs on exit paths
+    where a secondary error would mask the primary one.
+    """
+    global _INITIALIZED, _MULTIHOST
+    if _MULTIHOST:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:
+            logger.warning(f"jax.distributed.shutdown() failed: {e}")
+        _MULTIHOST = False
     _INITIALIZED = False
 
 
@@ -127,10 +145,22 @@ def get_local_rank() -> int:
 
 
 def barrier(group=None) -> None:
-    """Cross-host barrier: tiny psum over all devices, blocked on."""
-    x = _timed("barrier", lambda: jax.block_until_ready(
-        jnp.sum(jnp.zeros((jax.device_count(),)))), 0, jax.device_count())
-    return x
+    """Cross-host barrier: tiny reduction over the group, blocked on.
+
+    ``group`` (a mesh-axis name / tuple, like every other op here) scopes
+    the participant count; the timed value is never leaked — a barrier
+    returns ``None`` like its torch.distributed counterpart.
+    """
+    n = _group_size(_resolve_group(group))
+
+    def compute():
+        # inside _timed so the injected hang lands under the watchdog guard,
+        # exactly where a real wedged barrier would
+        fault_injection.fire("comm.barrier", group=group)
+        return jax.block_until_ready(jnp.sum(jnp.zeros((n,))))
+
+    _timed("barrier", compute, 0, n)
+    return None
 
 
 # --------------------------------------------------------------------------
@@ -158,16 +188,21 @@ def _group_size(resolved) -> int:
 
 
 def _timed(name: str, fn, msg_bytes: int, n_participants: int, record_name=None):
-    should_log = comms_logger.enabled and (
-        comms_logger.prof_all or name in comms_logger.prof_ops)
-    if not should_log:
-        return fn()
-    t0 = time.time()
-    out = fn()
-    out = jax.block_until_ready(out) if hasattr(out, "block_until_ready") or isinstance(out, jax.Array) else out
-    comms_logger.append(name, record_name or name, time.time() - t0, msg_bytes,
-                        n_participants)
-    return out
+    # every host-plane collective runs under the supervision watchdog when
+    # the runner registered one: a hang here becomes a stack dump + bounded
+    # restart instead of a silently burning slice
+    from ..runtime.supervision.watchdog import comm_guard
+    with comm_guard(f"comm.{name}"):
+        should_log = comms_logger.enabled and (
+            comms_logger.prof_all or name in comms_logger.prof_ops)
+        if not should_log:
+            return fn()
+        t0 = time.time()
+        out = fn()
+        out = jax.block_until_ready(out) if hasattr(out, "block_until_ready") or isinstance(out, jax.Array) else out
+        comms_logger.append(name, record_name or name, time.time() - t0, msg_bytes,
+                            n_participants)
+        return out
 
 
 def _nbytes(x) -> int:

@@ -9,9 +9,17 @@ here is a train-loop runner that
 
 - resumes from the newest checkpoint at startup (dp-resharding on resize is
   native: checkpoints are global logical arrays),
-- checkpoints on SIGTERM/SIGINT (the preemption notice) before exiting,
+- checkpoints on SIGTERM/SIGINT (the preemption notice) before exiting;
+  a SECOND signal during the drain escalates to immediate exit (the first
+  signal restores the previous handlers, so a stuck step can't make the
+  drain unkillable),
 - checkpoints every ``save_interval`` steps as a bound on lost work,
-- validates the world size against the elastic admission algebra.
+- validates the world size against the elastic admission algebra,
+- under a ``"supervision"`` config section, closes the detect→decide→
+  recover loop: a step watchdog converts hangs into stack-dumped aborts, a
+  heartbeat thread marks this host live, and the consecutive-NaN guard is
+  upgraded from abort-always to bounded rollback-and-retry
+  (``runtime/supervision/``, documented in ``docs/run-supervision.md``).
 """
 
 from __future__ import annotations
@@ -19,9 +27,12 @@ from __future__ import annotations
 import math
 import os
 import signal
-import sys
-from typing import Any, Callable, Dict, Iterable, Optional
+from contextlib import nullcontext
+from typing import Any, Dict, Iterable, Optional, Union
 
+from ..runtime.supervision import (DeepSpeedSupervisionConfig, EventJournal,
+                                   HeartbeatWriter, RunSupervisor,
+                                   StepWatchdog, set_global_watchdog)
 from ..utils import fault_injection
 from ..utils.logging import log_dist, logger
 from .elasticity import compute_elastic_config, elasticity_enabled
@@ -36,18 +47,26 @@ class ElasticTrainRunner:
       save_dir: checkpoint directory shared across restarts.
       save_interval: steps between periodic checkpoints.
       ds_config: when it carries an enabled "elasticity" section, the
-        current dp world size is validated against the admissible set.
-      nan_abort_threshold: abort (RuntimeError) after this many CONSECUTIVE
-        non-finite losses — a diverged run must stop burning preemptible
-        capacity, and must NOT checkpoint the poisoned state over a good
-        tag.  0 disables the guard; isolated non-finite losses (fp16
-        overflow skips) reset the streak.
+        current dp world size is validated against the admissible set; its
+        "supervision" section (if any) configures the watchdog/heartbeat/
+        rollback machinery.
+      nan_abort_threshold: a divergence is declared after this many
+        CONSECUTIVE non-finite losses.  Without supervision (or with
+        ``rollback.max_rollbacks=0``) the run aborts (RuntimeError) and
+        never checkpoints the poisoned state; with supervision it rolls
+        back to the newest verified tag and retries, bounded by
+        ``max_rollbacks``.  0 disables the guard; isolated non-finite
+        losses (fp16 overflow skips) reset the streak.
+      supervision: explicit supervision config (dict or typed), overriding
+        ``ds_config["supervision"]``.
     """
 
     def __init__(self, engine, save_dir: str, save_interval: int = 100,
                  ds_config: Optional[Dict[str, Any]] = None,
                  tag_prefix: str = "elastic",
-                 nan_abort_threshold: int = 5):
+                 nan_abort_threshold: int = 5,
+                 supervision: Optional[Union[Dict[str, Any],
+                                             DeepSpeedSupervisionConfig]] = None):
         self.engine = engine
         self.save_dir = save_dir
         self.save_interval = max(1, save_interval)
@@ -67,11 +86,60 @@ class ElasticTrainRunner:
                 ds_config, world_size=engine.dp_world_size)
             ensure_immutable_elastic_config(ds_config["elasticity"])
 
+        self._configure_supervision(supervision, ds_config)
+
+    # -------------------------------------------------------- supervision
+    def _configure_supervision(self, supervision, ds_config) -> None:
+        cfg = supervision
+        if cfg is None and isinstance(ds_config, dict):
+            cfg = ds_config.get("supervision")
+        if isinstance(cfg, dict):
+            cfg = DeepSpeedSupervisionConfig.from_dict(cfg)
+        self.supervision = cfg if (cfg is not None and cfg.enabled) else None
+        self.journal: Optional[EventJournal] = None
+        self.watchdog: Optional[StepWatchdog] = None
+        self.supervisor: Optional[RunSupervisor] = None
+        self.heartbeat: Optional[HeartbeatWriter] = None
+        if self.supervision is None:
+            return
+        rank = int(getattr(self.engine, "global_rank", 0))
+        jpath = self.supervision.event_journal or os.path.join(
+            self.save_dir, "events.jsonl")
+        self.journal = EventJournal(jpath, rank=rank)
+        wd_deadline = self.supervision.step_deadline_s or \
+            self.supervision.collective_deadline_s
+        if wd_deadline:
+            self.watchdog = StepWatchdog(wd_deadline, journal=self.journal)
+        self.supervisor = RunSupervisor(self.engine, self.save_dir,
+                                        self.supervision, journal=self.journal)
+        hb = self.supervision.heartbeat_config
+        if hb.enabled:
+            hb_dir = hb.dir or os.path.join(self.save_dir, "heartbeats")
+            self.heartbeat = HeartbeatWriter(hb_dir, rank,
+                                             interval_s=hb.interval_s,
+                                             journal=self.journal)
+
+    def _step_guard(self):
+        if self.watchdog is not None and \
+                self.supervision.step_deadline_s is not None:
+            return self.watchdog.guard("train.step",
+                                       self.supervision.step_deadline_s)
+        return nullcontext()
+
     # -------------------------------------------------------------- signals
     def _on_signal(self, signum, frame):
         logger.warning(f"[elastic] received signal {signum}: will checkpoint "
-                       "and exit at the next step boundary")
+                       "and exit at the next step boundary (a repeat signal "
+                       "exits immediately)")
         self._preempted = True
+        if self.journal is not None:
+            self.journal.emit("preempt.signal", signum=int(signum),
+                              step=self.engine.global_steps)
+        # escalation: hand the signals back to the pre-install handlers NOW,
+        # so a second SIGTERM/SIGINT during a stuck drain terminates the
+        # process instead of being swallowed until a step boundary that may
+        # never come
+        self._restore()
 
     def _install(self):
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -107,18 +175,30 @@ class ElasticTrainRunner:
     def _save(self):
         tag = f"{self.tag_prefix}_step{self.engine.global_steps}"
         self.engine.save_checkpoint(self.save_dir, tag=tag)
+        if self.supervisor is not None:
+            # a published tag is forward progress: resets the consecutive
+            # rollback budget once it passes the last divergence point
+            self.supervisor.on_checkpoint(self.engine.global_steps)
 
     def run(self, batches: Iterable[Any], max_steps: Optional[int] = None,
             resume: bool = True) -> Dict[str, Any]:
         """Train until batches run out, ``max_steps``, or preemption.
 
-        Returns {"steps": n, "preempted": bool, "losses": [...]}.
+        Returns {"steps": n, "preempted": bool, "losses": [...],
+        "rollbacks": n}.
         """
         if resume:
             self.resume()
         start_step = self.engine.global_steps
         losses = []
+        skip_remaining = 0
         self._install()
+        if self.heartbeat is not None:
+            self.heartbeat.start()
+        if self.watchdog is not None and \
+                self.supervision.collective_deadline_s is not None:
+            set_global_watchdog(self.watchdog,
+                                self.supervision.collective_deadline_s)
         try:
             for batch in batches:
                 if max_steps is not None and \
@@ -126,23 +206,44 @@ class ElasticTrainRunner:
                     break
                 if self._preempted:
                     break
-                if hasattr(self.engine, "train_batch"):  # PipelineEngine
-                    loss = self.engine.train_batch(batch=batch)
-                else:
-                    loss = self.engine.train_batch_fused(batch)
-                loss = float(loss)
+                if skip_remaining > 0:
+                    # post-rollback: consume without training, stepping past
+                    # the data window that fed the divergence
+                    skip_remaining -= 1
+                    continue
+                with self._step_guard():
+                    fault_injection.fire("train.step_begin",
+                                         step=self.engine.global_steps + 1)
+                    if hasattr(self.engine, "train_batch"):  # PipelineEngine
+                        loss = self.engine.train_batch(batch=batch)
+                    else:
+                        loss = self.engine.train_batch_fused(batch)
+                    loss = float(loss)
                 losses.append(loss)
-                # consecutive-NaN abort BEFORE any checkpointing: never
-                # publish a tag whose trajectory has already diverged
+                if self.heartbeat is not None:
+                    self.heartbeat.note_step(self.engine.global_steps)
+                # consecutive-NaN divergence handling BEFORE any
+                # checkpointing: never publish a tag whose trajectory has
+                # already diverged
                 if not math.isfinite(loss):
                     self._nan_streak += 1
                     if self.nan_abort_threshold and \
                             self._nan_streak >= self.nan_abort_threshold:
-                        raise RuntimeError(
-                            f"[elastic] loss was non-finite for "
-                            f"{self._nan_streak} consecutive steps (last="
-                            f"{loss}) — aborting without checkpointing the "
-                            f"poisoned state")
+                        directive = None
+                        if self.supervisor is not None:
+                            directive = self.supervisor.on_divergence(
+                                self.engine.global_steps, loss)
+                        if directive is None:
+                            raise RuntimeError(
+                                f"[elastic] loss was non-finite for "
+                                f"{self._nan_streak} consecutive steps "
+                                f"(last={loss}) — aborting without "
+                                f"checkpointing the poisoned state")
+                        # engine state already rolled back to the newest
+                        # verified tag; restart the streak and skip ahead
+                        self._nan_streak = 0
+                        skip_remaining = int(directive.get("skip_batches", 0))
+                        continue
                     logger.warning(
                         f"[elastic] non-finite loss at step "
                         f"{self.engine.global_steps} "
@@ -166,6 +267,13 @@ class ElasticTrainRunner:
                         "preemption checkpoint (state may be poisoned)")
         finally:
             self._restore()
+            if self.watchdog is not None:
+                set_global_watchdog(None)
+                self.watchdog.stop()
+            if self.heartbeat is not None:
+                self.heartbeat.stop()
         return {"steps": self.engine.global_steps - start_step,
                 "preempted": self._preempted,
-                "losses": losses}
+                "losses": losses,
+                "rollbacks": (self.supervisor.total_rollbacks
+                              if self.supervisor is not None else 0)}

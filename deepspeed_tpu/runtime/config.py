@@ -255,6 +255,16 @@ class DeepSpeedConfig:
         self.load_universal_checkpoint = get_scalar_param(
             ckpt_dict, C.LOAD_UNIVERSAL_CHECKPOINT, C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
 
+        # supervision section (typed: step watchdog deadlines, heartbeats,
+        # divergence rollback policy — consumed by ElasticTrainRunner)
+        sup_dict = pd.get(C.SUPERVISION, {})
+        from .supervision.config import DeepSpeedSupervisionConfig
+        try:
+            self.supervision_config = DeepSpeedSupervisionConfig.from_dict(sup_dict)
+        except (TypeError, ValueError) as e:
+            raise DeepSpeedConfigError(f"invalid 'supervision' section: {e}") from e
+        self.supervision_config_dict = sup_dict
+
         # pld
         pld_dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
         self.pld_enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)

@@ -206,6 +206,11 @@ GRAD_ACCUM_DTYPE_DEFAULT = None
 ELASTICITY = "elasticity"
 
 #############################################
+# Run supervision (watchdog / heartbeats / rollback-and-retry)
+#############################################
+SUPERVISION = "supervision"
+
+#############################################
 # Flops profiler / monitor / autotuning keys live in their own modules
 #############################################
 FLOPS_PROFILER = "flops_profiler"

@@ -259,6 +259,13 @@ class DeepSpeedEngine:
     def get_global_grad_norm(self) -> Optional[float]:
         return self._last_global_norm
 
+    def reset_loss_scale(self) -> None:
+        """Reinitialize the dynamic loss-scale state (scale, good-step
+        counter, hysteresis).  Used by the supervision rollback policy: the
+        carried scaler trajectory belongs to the diverged run and would
+        otherwise re-enter the step that overflowed at the same scale."""
+        self.state["scale"] = ls.init_state(self.scaler_config)
+
     # ------------------------------------------------------------------ setup
     def _configure_sharding(self) -> None:
         axes = self.module.logical_axes

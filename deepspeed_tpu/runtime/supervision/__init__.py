@@ -1,0 +1,28 @@
+"""Run supervision: detect → decide → recover for long preemptible runs.
+
+PR 1's durability subsystem made failure *safe* (no torn checkpoints, no
+resume-from-corruption).  This package makes failure *bounded*: the silent
+modes that actually burn preemptible capacity — a hung collective, a wedged
+input pipeline, a diverged trajectory — are detected, journaled, and either
+recovered in place or converted into a clean restart the launcher can see.
+
+- ``events``: append-only JSONL event journal (rollbacks, hangs,
+  preemptions, heartbeat gaps) — the run's black box
+- ``watchdog``: daemon-thread deadline timer armed around train steps and
+  host-plane collectives; on expiry it dumps every thread's stack, emits a
+  structured event, and aborts so the launcher restarts
+- ``heartbeat``: per-process heartbeat files + a rank-0 monitor so dead
+  hosts are *reported* instead of discovered by hanging in a barrier
+- ``supervisor``: the RunSupervisor rollback-and-retry policy (divergence →
+  reload newest verified tag → shrink LR / reset loss scale → skip the
+  poisoned window → retry, bounded by ``max_rollbacks``)
+- ``config``: the validated ``"supervision"`` config section
+"""
+
+from .config import (DeepSpeedSupervisionConfig, HeartbeatConfig,  # noqa: F401
+                     RollbackConfig, SUPERVISION)
+from .events import EventJournal, read_events  # noqa: F401
+from .heartbeat import HeartbeatMonitor, HeartbeatWriter  # noqa: F401
+from .supervisor import RunSupervisor  # noqa: F401
+from .watchdog import (StepWatchdog, comm_guard, dump_all_stacks,  # noqa: F401
+                       get_global_watchdog, set_global_watchdog)

@@ -1,0 +1,96 @@
+"""The run's event journal: one JSON object per line, append-only.
+
+Everything the supervision subsystem decides or observes lands here —
+rollbacks, watchdog expiries, preemption signals, heartbeat gaps — so a
+post-mortem (or ``scripts/dump_run_events.py``) can reconstruct *why* a run
+restarted without grepping interleaved worker logs.  JSONL because partial
+final lines from a killed process must not poison the rest of the file:
+:func:`read_events` skips torn trailing records instead of raising.
+
+Schema (every record):
+
+.. code-block:: json
+
+    {"ts": 1723.4, "seq": 7, "rank": 0, "kind": "rollback", ...}
+
+``kind`` namespaces the rest of the fields; the per-kind fields are
+documented in ``docs/run-supervision.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import logger
+
+
+class EventJournal:
+    """Append-only JSONL journal, safe to call from any thread (the
+    watchdog thread and signal handlers both emit).
+
+    Each :meth:`emit` opens/append/flush/closes — a crashed process loses at
+    most the record being written, never earlier ones, and the file is
+    readable while the run is live.
+    """
+
+    def __init__(self, path: str, rank: int = 0):
+        self.path = str(path)
+        self.rank = int(rank)
+        # RLock: emit() may be re-entered by a signal handler that fires
+        # while the main thread is itself mid-emit — a plain Lock deadlocks
+        self._lock = threading.RLock()
+        self._seq = 0
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def emit(self, kind: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event; returns the record written."""
+        with self._lock:
+            self._seq += 1
+            rec = {"ts": time.time(), "seq": self._seq, "rank": self.rank,
+                   "kind": str(kind)}
+            rec.update(fields)
+            try:
+                line = json.dumps(rec, default=str)
+            except (TypeError, ValueError):
+                # never let an odd payload take down the run being journaled
+                rec = {"ts": rec["ts"], "seq": rec["seq"], "rank": rec["rank"],
+                       "kind": rec["kind"], "repr": repr(fields)}
+                line = json.dumps(rec, default=str)
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+            except OSError as e:  # journal loss must not kill the run
+                logger.warning(f"[supervision] event journal write failed: {e}")
+            return rec
+
+    def read(self) -> List[Dict[str, Any]]:
+        return read_events(self.path)
+
+
+def read_events(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse a journal; torn/garbage lines are skipped, not fatal.
+
+    ``kind`` filters to one event kind.
+    """
+    out: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and (kind is None or rec.get("kind") == kind):
+                out.append(rec)
+    return out

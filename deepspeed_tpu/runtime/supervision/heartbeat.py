@@ -1,0 +1,167 @@
+"""Host heartbeats: dead hosts get *reported*, not discovered by hanging.
+
+On a multi-host slice the first symptom of a dead host is every other host
+blocking in the next collective — exactly the failure the watchdog then has
+to kill blind.  Heartbeats give rank 0 the missing signal: each process
+atomically rewrites a tiny ``rank<N>.json`` in a shared directory every
+``interval_s``; the monitor (rank 0, or an external babysitter) reads them
+all and reports any rank whose beat is older than ``gap_s`` — so the
+restart decision can *name* the dead host instead of guessing.
+
+The write path routes through the ``supervision.heartbeat`` fault point, so
+chaos tests inject stalls (``DelaySeconds``/``HangFor``) and write failures
+without touching a real clock or filesystem fault.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ...utils import fault_injection
+from ...utils.logging import logger
+
+_FILE_FMT = "rank{rank}.json"
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, _FILE_FMT.format(rank=rank))
+
+
+class HeartbeatWriter:
+    """Per-process beat: atomic tmp+replace of ``rank<N>.json``.
+
+    ``beat()`` may be called manually (e.g. per train step); ``start()``
+    runs a daemon thread beating every ``interval_s`` so a step that hangs
+    for minutes still shows a *live* host (the watchdog owns hung-step
+    detection; heartbeats own dead-process detection — a beating host with
+    a hung step must not look dead).
+    """
+
+    def __init__(self, directory: str, rank: int, interval_s: float = 15.0,
+                 journal=None):
+        self.directory = str(directory)
+        self.rank = int(rank)
+        self.interval_s = float(interval_s)
+        self.journal = journal
+        self.beats = 0
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(self.directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return heartbeat_path(self.directory, self.rank)
+
+    def note_step(self, step: int) -> None:
+        """Record the current step without writing — the next beat carries
+        it (per-step writes would put a file op on the train hot path)."""
+        self._step = int(step)
+
+    def beat(self, step: Optional[int] = None) -> None:
+        """Write one heartbeat now (failures are logged, never fatal —
+        losing a beat is strictly better than killing the host over it)."""
+        if step is not None:
+            self._step = int(step)
+        try:
+            fault_injection.fire("supervision.heartbeat", path=self.path,
+                                 rank=self.rank)
+            payload = {"rank": self.rank, "pid": os.getpid(),
+                       "step": self._step, "ts": time.time()}
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+            self.beats += 1
+        except OSError as e:
+            logger.warning(f"[supervision] heartbeat write failed: {e}")
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"heartbeat-rank{self.rank}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        self.beat()
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+
+class HeartbeatMonitor:
+    """Rank 0's view: which ranks are beating, which have gone quiet.
+
+    ``check()`` is pull-based (call it at step boundaries or from a cron) —
+    a monitor thread that itself blocks in a collective would be useless.
+    Every newly-stale rank is journaled once as ``heartbeat.gap``; a rank
+    that resumes beating is journaled as ``heartbeat.recovered``.
+    """
+
+    def __init__(self, directory: str, gap_s: float = 60.0, journal=None,
+                 expected_ranks: Optional[int] = None):
+        self.directory = str(directory)
+        self.gap_s = float(gap_s)
+        self.journal = journal
+        self.expected_ranks = expected_ranks
+        self._stale_ranks: set = set()
+
+    def read_beats(self) -> Dict[int, Dict[str, Any]]:
+        beats: Dict[int, Dict[str, Any]] = {}
+        if not os.path.isdir(self.directory):
+            return beats
+        for name in os.listdir(self.directory):
+            if not (name.startswith("rank") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    rec = json.load(f)
+                beats[int(rec["rank"])] = rec
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn beat: treated as missing, not fatal
+        return beats
+
+    def check(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Classify ranks as alive/stale/missing against ``gap_s``.
+
+        ``now`` is injectable so tests age beats without sleeping.
+        """
+        now = time.time() if now is None else now
+        beats = self.read_beats()
+        alive: List[int] = []
+        stale: List[Dict[str, Any]] = []
+        for rank, rec in sorted(beats.items()):
+            age = now - float(rec.get("ts", 0.0))
+            if age > self.gap_s:
+                stale.append({"rank": rank, "age_s": age,
+                              "last_step": rec.get("step")})
+            else:
+                alive.append(rank)
+        missing: List[int] = []
+        if self.expected_ranks is not None:
+            missing = [r for r in range(self.expected_ranks) if r not in beats]
+        for rec in stale:
+            if rec["rank"] not in self._stale_ranks:
+                self._stale_ranks.add(rec["rank"])
+                logger.warning(
+                    f"[supervision] heartbeat gap: rank {rec['rank']} last "
+                    f"beat {rec['age_s']:.1f}s ago (gap_s={self.gap_s})")
+                if self.journal is not None:
+                    self.journal.emit("heartbeat.gap", **rec)
+        for rank in sorted(self._stale_ranks - {s["rank"] for s in stale}):
+            self._stale_ranks.discard(rank)
+            if self.journal is not None:
+                self.journal.emit("heartbeat.recovered", rank=rank)
+        return {"alive": alive, "stale": stale, "missing": missing}

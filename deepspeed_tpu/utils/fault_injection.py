@@ -20,6 +20,13 @@ Points currently wired:
                           ctx: ``tag``
 ``train.step``            once per completed runner step; ctx: ``step``
                           (SIGTERM-at-step models a preemption notice)
+``train.step_begin``      inside the runner's watchdog guard, before the
+                          train call; ctx: ``step`` (``HangFor`` here models
+                          a hung collective / wedged input pipeline)
+``comm.barrier``          start of every host-plane barrier; ctx: ``group``
+                          (``HangFor`` models a barrier that never clears)
+``supervision.heartbeat`` start of every heartbeat write; ctx: ``path``,
+                          ``rank`` (delays/failures model a wedged host)
 ========================  =====================================================
 """
 
@@ -29,6 +36,7 @@ import os
 import random
 import signal
 import threading
+import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
@@ -136,6 +144,59 @@ class SignalAtStep(Fault):
         if step == self.step:
             self.fired += 1
             os.kill(os.getpid(), self.sig)
+
+
+class HangFor(Fault):
+    """Block at the fault point for up to ``seconds`` — the injected hang.
+
+    The block is an interruptible :class:`threading.Event` wait, so a
+    watchdog test can observe expiry and then :meth:`release` the hung
+    "step" instead of sleeping out the full duration.  Fires once per
+    install unless ``once=False``.
+    """
+
+    def __init__(self, seconds: float, match: Optional[str] = None,
+                 once: bool = True):
+        self.seconds = float(seconds)
+        self.match = match
+        self.once = once
+        self.fired = 0
+        self._release = threading.Event()
+
+    def fire(self, point: str, path: Optional[str] = None, **ctx) -> None:
+        if not self._matches(self.match, path):
+            return
+        if self.once and self.fired:
+            return
+        self.fired += 1
+        self._release.wait(self.seconds)
+
+    def release(self) -> None:
+        """Un-hang every current and future fire of this fault."""
+        self._release.set()
+
+
+class DelaySeconds(Fault):
+    """Sleep ``seconds`` on each of the first ``n`` matching fires (a slow
+    host / degraded storage, as opposed to :class:`HangFor`'s dead one).
+    ``n=None`` delays every fire."""
+
+    def __init__(self, seconds: float, n: Optional[int] = None,
+                 match: Optional[str] = None):
+        self.seconds = float(seconds)
+        self.remaining = n
+        self.match = match
+        self.fired = 0
+
+    def fire(self, point: str, path: Optional[str] = None, **ctx) -> None:
+        if not self._matches(self.match, path):
+            return
+        if self.remaining is not None:
+            if self.remaining <= 0:
+                return
+            self.remaining -= 1
+        self.fired += 1
+        time.sleep(self.seconds)
 
 
 def corrupt_file(path: str, nbytes: int = 8, seed: int = 0) -> None:

@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Pretty-print a run's supervision event journal (events.jsonl).
+
+The journal is the run's black box — rollbacks, watchdog expiries,
+preemption signals, heartbeat gaps — one JSON object per line
+(schema: ``docs/run-supervision.md``).  This renders it human-first:
+timestamped one-liners, ``--kind`` filtering, and ``--stacks`` to expand
+the thread dumps a watchdog expiry captured.
+
+Usage:
+    python scripts/dump_run_events.py CKPT_DIR_OR_JOURNAL [--kind KIND]
+                                      [--stacks] [--json]
+
+Exit codes: 0 events printed; 1 abort-class events present (rollback
+exhaustion / watchdog expiry — useful in postmortem automation); 2 no
+journal / no events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.runtime.supervision.events import read_events  # noqa: E402
+
+#: events that mean the run stopped abnormally
+ABORT_KINDS = ("divergence.abort", "watchdog.expired")
+
+#: kind → the fields worth a one-liner (everything else via --json)
+_SUMMARY_FIELDS = {
+    "rollback": ("from_step", "to_step", "index", "max_rollbacks",
+                 "lr_factor", "skip_batches"),
+    "rollback.recovered": ("step", "rollbacks"),
+    "divergence.abort": ("step", "rollbacks", "reason"),
+    "watchdog.expired": ("label", "deadline_s"),
+    "preempt.signal": ("signum", "step"),
+    "heartbeat.gap": ("rank", "age_s", "last_step"),
+    "heartbeat.recovered": ("rank",),
+}
+
+
+def _fmt(ev: dict, show_stacks: bool) -> str:
+    ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                       time.localtime(float(ev.get("ts", 0))))
+    kind = ev.get("kind", "?")
+    fields = _SUMMARY_FIELDS.get(kind)
+    if fields is None:
+        fields = tuple(k for k in ev
+                       if k not in ("ts", "seq", "rank", "kind", "stacks"))
+    body = " ".join(f"{k}={ev[k]}" for k in fields if k in ev)
+    line = f"{ts}  r{ev.get('rank', '?')}  {kind:<20s} {body}"
+    if show_stacks and "stacks" in ev:
+        line += "\n" + "\n".join("    " + l
+                                 for l in str(ev["stacks"]).splitlines())
+    return line
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events.jsonl, or a checkpoint dir holding one")
+    ap.add_argument("--kind", default=None, help="only this event kind")
+    ap.add_argument("--stacks", action="store_true",
+                    help="expand watchdog stack dumps")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="re-emit matching events as JSONL (machine use)")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        print(f"error: no event journal at {path}", file=sys.stderr)
+        return 2
+    events = read_events(path, kind=args.kind)
+    if not events:
+        print(f"error: no events in {path}"
+              + (f" with kind={args.kind}" if args.kind else ""),
+              file=sys.stderr)
+        return 2
+
+    for ev in events:
+        if args.as_json:
+            print(json.dumps(ev, default=str))
+        else:
+            print(_fmt(ev, args.stacks))
+    aborts = sum(1 for e in events if e.get("kind") in ABORT_KINDS)
+    if aborts:
+        print(f"\n{len(events)} event(s), {aborts} abort-class",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,3 +29,34 @@ def reset_global_mesh():
     yield
     from deepspeed_tpu.parallel.mesh import reset_mesh_manager
     reset_mesh_manager()
+
+
+CHAOS_TEST_DEADLINE_S = 120.0
+
+
+@pytest.fixture(autouse=True)
+def chaos_test_deadline(request):
+    """Per-test deadline for chaos tests: the suite injects hangs on
+    purpose (HangFor at train/comm/heartbeat points), so a bug in the
+    detection path must fail the one test, not wedge the whole tier-1 run.
+    SIGALRM-based — main thread only, and a no-op where unavailable."""
+    import signal as _signal
+    import threading as _threading
+    if request.node.get_closest_marker("chaos") is None or \
+            not hasattr(_signal, "SIGALRM") or \
+            _threading.current_thread() is not _threading.main_thread():
+        yield
+        return
+
+    def _expire(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded its {CHAOS_TEST_DEADLINE_S:.0f}s deadline "
+            f"(an injected hang leaked past the code under test)")
+
+    prev = _signal.signal(_signal.SIGALRM, _expire)
+    _signal.setitimer(_signal.ITIMER_REAL, CHAOS_TEST_DEADLINE_S)
+    try:
+        yield
+    finally:
+        _signal.setitimer(_signal.ITIMER_REAL, 0.0)
+        _signal.signal(_signal.SIGALRM, prev)

@@ -1,0 +1,69 @@
+"""Shared fakes for the supervision chaos tests.
+
+Same duck-typed-engine-over-real-checkpoint-stack pattern as
+``tests/unit/elasticity/test_chaos_resume.py``: the runner, supervisor,
+watchdog, and journal are all real; only the jit-compiled train step is
+faked, so the whole detect→decide→recover loop runs in milliseconds.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.checkpoint_engine import (load_engine_checkpoint,
+                                                     save_engine_checkpoint)
+
+
+class FakeOptimizer:
+    def __init__(self, lr=0.1):
+        self.param_groups = [{"lr": lr}]
+
+
+class FakeEngine:
+    """Each 'step' adds the batch value into a scalar weight; losses come
+    from a scripted list (then default to 1/step).  Checkpoints go through
+    the real engine-checkpoint helpers (manifests, fallback, retry)."""
+
+    dp_world_size = 1
+    global_rank = 0
+
+    def __init__(self, losses=None, lr=0.1):
+        self.global_steps = 0
+        self.weight = 0.0
+        self.optimizer = FakeOptimizer(lr)
+        self.loss_scale_resets = 0
+        self._losses = list(losses or [])
+
+    # ------------------------------------------------------------- train
+    def train_batch_fused(self, batch):
+        self.global_steps += 1
+        self.weight += float(batch)
+        if self._losses:
+            return self._losses.pop(0)
+        return 1.0 / self.global_steps
+
+    def reset_loss_scale(self):
+        self.loss_scale_resets += 1
+
+    # -------------------------------------------------------- checkpoint
+    def _tree(self):
+        w = jnp.asarray(self.weight, jnp.float32)
+        return {"params": {"w": w}, "master": {"w": w},
+                "opt_state": {"m": {"w": w}}, "grad_acc": {"w": jnp.zeros(())},
+                "scale": {"loss_scale": jnp.asarray(1.0)}}
+
+    def save_checkpoint(self, save_dir, tag=None, **kw):
+        tag = tag or f"fake_step{self.global_steps}"
+        save_engine_checkpoint(save_dir, tag, self._tree(),
+                               {"global_steps": self.global_steps,
+                                "weight": self.weight},
+                               separate_master=True)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **kw):
+        state, cs = load_engine_checkpoint(load_dir, tag, self._tree())
+        if state is None:
+            return None, {}
+        self.global_steps = cs["global_steps"]
+        self.weight = float(np.asarray(state["params"]["w"]))
+        return load_dir, cs

@@ -1,0 +1,100 @@
+"""comm.comm robustness satellites: barrier semantics (returns None, honors
+``group``), multi-host teardown actually shutting jax.distributed down, and
+the watchdog guarding host-plane collectives."""
+
+import threading
+
+import pytest
+
+import jax
+
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.runtime.supervision import (StepWatchdog,
+                                               set_global_watchdog)
+from deepspeed_tpu.utils import fault_injection as fi
+from tests.unit.common import make_mesh
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.clear()
+    set_global_watchdog(None)
+
+
+def test_barrier_returns_none_and_honors_group():
+    make_mesh(dp=4, tp=2)
+    assert dist.barrier() is None
+    assert dist.barrier("data") is None
+    assert dist.barrier(("data", "model")) is None
+    # the group is resolved, not ignored: a bogus axis is an error now
+    with pytest.raises(KeyError):
+        dist.barrier("no_such_axis")
+
+
+def test_barrier_fires_fault_point():
+    make_mesh(dp=8)
+    with fi.inject("comm.barrier", fi.DelaySeconds(0.0)) as f:
+        dist.barrier()
+        dist.barrier("data")
+    assert f.fired == 2
+
+
+def test_hung_barrier_trips_the_collective_watchdog(tmp_path):
+    """HangFor at comm.barrier with the watchdog registered for collectives:
+    expiry must fire with the comm label while the barrier is blocked."""
+    make_mesh(dp=8)
+    hang = fi.HangFor(30.0)
+    expired = []
+    done = threading.Event()
+
+    def on_expire(rec):
+        expired.append(rec)
+        done.set()
+        hang.release()
+
+    wd = StepWatchdog(0.25, on_expire=on_expire)
+    set_global_watchdog(wd, collective_deadline_s=0.25)
+    try:
+        with fi.inject("comm.barrier", hang):
+            dist.barrier()
+        assert done.wait(5.0)
+        assert expired and expired[0]["label"] == "comm.barrier"
+    finally:
+        set_global_watchdog(None)
+        wd.stop()
+
+
+def test_destroy_process_group_shuts_down_multihost(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: calls.append(1))
+    monkeypatch.setattr(dist, "_INITIALIZED", True)
+    monkeypatch.setattr(dist, "_MULTIHOST", True)
+    dist.destroy_process_group()
+    assert calls == [1]
+    assert not dist.is_initialized()
+    assert dist._MULTIHOST is False
+
+
+def test_destroy_process_group_single_host_skips_shutdown(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: calls.append(1))
+    dist.init_distributed()  # single host: no jax.distributed.initialize
+    assert dist.is_initialized() and dist._MULTIHOST is False
+    dist.destroy_process_group()
+    assert calls == []
+    assert not dist.is_initialized()
+
+
+def test_destroy_process_group_survives_failed_shutdown(monkeypatch):
+    """Teardown runs on exit paths — a failing shutdown is logged, never
+    raised over the primary error."""
+    def boom():
+        raise RuntimeError("coordinator gone")
+    monkeypatch.setattr(jax.distributed, "shutdown", boom)
+    monkeypatch.setattr(dist, "_INITIALIZED", True)
+    monkeypatch.setattr(dist, "_MULTIHOST", True)
+    dist.destroy_process_group()  # must not raise
+    assert not dist.is_initialized()

@@ -1,0 +1,78 @@
+"""The validated ``"supervision"`` config section, in the
+``checkpoint``/``zero`` section style: typed subsections, loud rejection of
+nonsense values, and DeepSpeedConfig integration."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.supervision import DeepSpeedSupervisionConfig
+from tests.unit.common import make_mesh
+
+pytestmark = pytest.mark.chaos
+
+
+def _ds(section):
+    mm = make_mesh(dp=8)
+    return DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                            "supervision": section}, mesh_manager=mm)
+
+
+def test_defaults_when_section_absent():
+    cfg = DeepSpeedSupervisionConfig.from_dict({})
+    assert cfg.enabled
+    assert cfg.step_deadline_s is None
+    assert cfg.collective_deadline_s is None
+    assert cfg.heartbeat_config.enabled is False
+    assert cfg.rollback_config.max_rollbacks == 2
+    assert cfg.rollback_config.lr_factor == 1.0
+
+
+def test_full_section_parses_through_deepspeed_config():
+    c = _ds({"step_deadline_s": 1800, "collective_deadline_s": 600,
+             "heartbeat": {"enabled": True, "interval_s": 5, "gap_s": 30},
+             "rollback": {"max_rollbacks": 3, "lr_factor": 0.5,
+                          "reset_loss_scale": False, "skip_batches": 8}})
+    sup = c.supervision_config
+    assert sup.step_deadline_s == 1800
+    assert sup.collective_deadline_s == 600
+    assert sup.heartbeat_config.enabled and sup.heartbeat_config.gap_s == 30
+    rb = sup.rollback_config
+    assert (rb.max_rollbacks, rb.lr_factor, rb.reset_loss_scale,
+            rb.skip_batches) == (3, 0.5, False, 8)
+
+
+@pytest.mark.parametrize("section", [
+    {"step_deadline_s": 0},
+    {"step_deadline_s": -5},
+    {"collective_deadline_s": -1},
+    {"heartbeat": {"interval_s": 0}},
+    {"heartbeat": {"interval_s": 30, "gap_s": 30}},  # gap must exceed beat
+    {"rollback": {"max_rollbacks": -1}},
+    {"rollback": {"lr_factor": 0.0}},
+    {"rollback": {"lr_factor": 1.5}},
+    {"rollback": {"skip_batches": -2}},
+])
+def test_invalid_sections_rejected(section):
+    with pytest.raises(DeepSpeedConfigError, match="supervision"):
+        _ds(section)
+
+
+def test_disabled_section_disables_runner_supervision(tmp_path):
+    from deepspeed_tpu.elasticity import ElasticTrainRunner
+    from tests.unit.supervision.common import FakeEngine
+    runner = ElasticTrainRunner(
+        FakeEngine(), str(tmp_path / "ck"),
+        ds_config={"supervision": {"enabled": False,
+                                   "step_deadline_s": 1.0}})
+    assert runner.supervision is None
+    assert runner.watchdog is None and runner.supervisor is None
+
+
+def test_ds_config_supervision_section_reaches_runner(tmp_path):
+    from deepspeed_tpu.elasticity import ElasticTrainRunner
+    from tests.unit.supervision.common import FakeEngine
+    runner = ElasticTrainRunner(
+        FakeEngine(), str(tmp_path / "ck"),
+        ds_config={"supervision": {"rollback": {"max_rollbacks": 7}}})
+    assert runner.supervisor is not None
+    assert runner.supervision.rollback_config.max_rollbacks == 7

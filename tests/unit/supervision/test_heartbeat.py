@@ -1,0 +1,104 @@
+"""Chaos tests for host heartbeats: gap detection must name the dead rank,
+and the write path must be injectable (DelaySeconds/FailNTimes at the
+``supervision.heartbeat`` point) rather than need real dead hosts."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.supervision import (EventJournal, HeartbeatMonitor,
+                                               HeartbeatWriter, read_events)
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def test_gap_detection_names_the_dead_rank(tmp_path, monkeypatch):
+    d = str(tmp_path / "hb")
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    for rank in (0, 1, 2):
+        HeartbeatWriter(d, rank, journal=journal).beat(step=7)
+    mon = HeartbeatMonitor(d, gap_s=60.0, journal=journal, expected_ranks=4)
+
+    now = time.time()
+    res = mon.check(now=now)
+    assert res["alive"] == [0, 1, 2]
+    assert res["stale"] == []
+    assert res["missing"] == [3]  # never wrote a beat at all
+
+    # rank 1 goes quiet: 120s later ranks 0 and 2 beat again (stamped with
+    # the advanced clock, patched into the heartbeat module only)
+    import types
+
+    import deepspeed_tpu.runtime.supervision.heartbeat as hb_mod
+    monkeypatch.setattr(hb_mod, "time",
+                        types.SimpleNamespace(time=lambda: now + 120.0))
+    HeartbeatWriter(d, 0).beat()
+    HeartbeatWriter(d, 2).beat()
+    res = mon.check(now=now + 120.0)
+    assert [s["rank"] for s in res["stale"]] == [1]
+    assert res["stale"][0]["age_s"] > 60.0
+    assert res["stale"][0]["last_step"] == 7
+
+    gaps = read_events(journal.path, kind="heartbeat.gap")
+    assert len(gaps) == 1 and gaps[0]["rank"] == 1
+    # a second check does NOT re-journal the same dead rank
+    mon.check(now=now + 130.0)
+    assert len(read_events(journal.path, kind="heartbeat.gap")) == 1
+
+
+def test_recovered_rank_is_journaled(tmp_path):
+    d = str(tmp_path / "hb")
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    w = HeartbeatWriter(d, 0, journal=journal)
+    w.beat()
+    mon = HeartbeatMonitor(d, gap_s=30.0, journal=journal)
+    assert [s["rank"] for s in mon.check(now=time.time() + 60.0)["stale"]] == [0]
+    w.beat()  # the host comes back
+    res = mon.check(now=time.time())
+    assert res["alive"] == [0] and res["stale"] == []
+    assert len(read_events(journal.path, kind="heartbeat.recovered")) == 1
+
+
+def test_injected_delay_exercises_the_write_path(tmp_path):
+    """DelaySeconds at supervision.heartbeat: the beat slows but still
+    lands — the delayed-host model the monitor's gap math is built for."""
+    w = HeartbeatWriter(str(tmp_path / "hb"), 0)
+    with fi.inject("supervision.heartbeat", fi.DelaySeconds(0.2, n=1)) as f:
+        t0 = time.monotonic()
+        w.beat(step=3)
+        assert time.monotonic() - t0 >= 0.2
+        assert f.fired == 1
+    assert w.beats == 1
+    beats = HeartbeatMonitor(str(tmp_path / "hb"), gap_s=60.0).read_beats()
+    assert beats[0]["step"] == 3
+
+
+def test_injected_write_failure_is_not_fatal(tmp_path):
+    """A failing beat (dead shared filesystem) must never kill the host —
+    losing heartbeats is the condition being *reported*, not a crash."""
+    w = HeartbeatWriter(str(tmp_path / "hb"), 0)
+    with fi.inject("supervision.heartbeat", fi.FailNTimes(1)):
+        w.beat()  # injected OSError swallowed
+    assert w.beats == 0
+    w.beat()
+    assert w.beats == 1
+
+
+def test_background_writer_beats_and_stops(tmp_path):
+    w = HeartbeatWriter(str(tmp_path / "hb"), 0, interval_s=0.05)
+    w.start()
+    deadline = time.monotonic() + 5.0
+    while w.beats < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    w.stop()
+    assert w.beats >= 3
+    settled = w.beats
+    time.sleep(0.15)
+    assert w.beats == settled  # thread actually stopped

@@ -1,0 +1,146 @@
+"""Chaos tests for divergence rollback-and-retry: diverge → reload the
+newest verified tag → retry; diverge forever → abort after exactly
+``max_rollbacks`` — asserted through the event journal, the run's black
+box."""
+
+import math
+import os
+
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticTrainRunner
+from deepspeed_tpu.runtime.checkpoint_engine import resolve_tag
+from deepspeed_tpu.runtime.supervision import read_events
+from deepspeed_tpu.utils import fault_injection as fi
+
+from .common import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+NAN = float("nan")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def _events(save):
+    return read_events(os.path.join(save, "events.jsonl"))
+
+
+def test_divergence_rolls_back_to_verified_tag_and_recovers(tmp_path):
+    """4 good steps (tags at 2 and 4), then a 3-NaN streak: the runner must
+    reload step 4's verified state, shrink the LR, reset the loss scale,
+    and finish the run — with the whole story in the journal."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[1.0, 1.0, 1.0, 1.0, NAN, NAN, NAN], lr=0.1)
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=2, nan_abort_threshold=3,
+        supervision={"rollback": {"max_rollbacks": 2, "lr_factor": 0.5,
+                                  "reset_loss_scale": True}})
+    res = runner.run([1.0] * 12, resume=False)
+
+    assert res["rollbacks"] == 1
+    assert not res["preempted"]
+    # diverged at step 7, rolled back to the step-4 tag, then trained the
+    # remaining 5 batches: 4 + 5 = 9 steps of real weight
+    assert eng.global_steps == 9
+    assert eng.weight == pytest.approx(9.0)
+    assert eng.optimizer.param_groups[0]["lr"] == pytest.approx(0.05)
+    assert eng.loss_scale_resets == 1
+
+    evs = _events(save)
+    rb = [e for e in evs if e["kind"] == "rollback"]
+    assert len(rb) == 1
+    assert rb[0]["from_step"] == 7 and rb[0]["to_step"] == 4
+    assert rb[0]["index"] == 1 and rb[0]["max_rollbacks"] == 2
+    assert rb[0]["loss_scale_reset"] is True
+    # a checkpoint published past the divergence point resets the budget
+    rec = [e for e in evs if e["kind"] == "rollback.recovered"]
+    assert len(rec) == 1 and rec[0]["step"] > 7
+    assert runner.supervisor.consecutive_rollbacks == 0
+
+
+def test_skip_batches_steps_past_the_poisoned_window(tmp_path):
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[1.0, 1.0, NAN, NAN])
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=2, nan_abort_threshold=2,
+        supervision={"rollback": {"max_rollbacks": 1, "skip_batches": 3}})
+    res = runner.run([1.0] * 10, resume=False)
+    assert res["rollbacks"] == 1
+    # 10 batches: 4 trained pre-rollback, 3 skipped, 3 trained after the
+    # reload of the step-2 tag → 2 + 3 = 5 final steps
+    assert eng.global_steps == 5
+    rb = read_events(os.path.join(save, "events.jsonl"), kind="rollback")
+    assert rb[0]["skip_batches"] == 3
+
+
+def test_diverge_forever_aborts_after_max_rollbacks_never_infinite(tmp_path):
+    """NaN from step 3 on: every retry re-diverges.  The run must abort
+    after EXACTLY max_rollbacks reloads, and the poisoned state must never
+    be published over the good tag."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[1.0, 1.0] + [NAN] * 30)
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=2, nan_abort_threshold=3,
+        supervision={"rollback": {"max_rollbacks": 2}})
+    with pytest.raises(RuntimeError, match="non-finite"):
+        runner.run([1.0] * 30, resume=False)
+
+    assert runner.supervisor.total_rollbacks == 2
+    assert resolve_tag(save, None) == "elastic_step2"  # good tag survives
+    evs = _events(save)
+    assert len([e for e in evs if e["kind"] == "rollback"]) == 2
+    aborts = [e for e in evs if e["kind"] == "divergence.abort"]
+    assert len(aborts) == 1
+    assert aborts[0]["rollbacks"] == 2
+    assert aborts[0]["reason"] == "max_rollbacks exhausted"
+    assert not [e for e in evs if e["kind"] == "rollback.recovered"]
+
+
+def test_divergence_with_nothing_verified_aborts(tmp_path):
+    """No tag was ever published: rollback has nowhere to go and must abort
+    rather than 'recover' from nothing."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[NAN, NAN, NAN])
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=100, nan_abort_threshold=3,
+        supervision={"rollback": {"max_rollbacks": 5}})
+    with pytest.raises(RuntimeError, match="non-finite"):
+        runner.run([1.0] * 5, resume=False)
+    aborts = read_events(os.path.join(save, "events.jsonl"),
+                         kind="divergence.abort")
+    assert len(aborts) == 1
+    assert "no verified checkpoint" in aborts[0]["reason"]
+
+
+def test_max_rollbacks_zero_keeps_abort_always_semantics(tmp_path):
+    """rollback.max_rollbacks=0 (and no supervision at all) both preserve
+    PR 1's behavior: first confirmed divergence aborts, nothing reloads."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine(losses=[1.0, 1.0, NAN, NAN])
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=2, nan_abort_threshold=2,
+        supervision={"rollback": {"max_rollbacks": 0}})
+    with pytest.raises(RuntimeError, match="non-finite"):
+        runner.run([1.0] * 8, resume=False)
+    assert runner.supervisor.total_rollbacks == 0
+    assert eng.global_steps == 4  # no reload happened
+
+
+def test_transient_nans_never_consult_the_supervisor(tmp_path):
+    """Isolated NaNs (fp16 overflow skips) reset the streak and must not
+    burn rollback budget."""
+    save = str(tmp_path / "ck")
+    losses = [1.0, NAN, 0.5, NAN, 0.4, NAN, 0.3]
+    eng = FakeEngine(losses=losses)
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=100, nan_abort_threshold=2,
+        supervision={"rollback": {"max_rollbacks": 1}})
+    res = runner.run([1.0] * len(losses), resume=False)
+    assert res["steps"] == len(losses)
+    assert res["rollbacks"] == 0
+    assert sum(1 for l in res["losses"] if math.isnan(l)) == 3

@@ -1,0 +1,79 @@
+"""Double-SIGTERM escalation: the first preemption notice drains
+gracefully; a second must hit the PREVIOUS handler (normally: die now),
+because a stuck step makes a swallow-all drain unkillable."""
+
+import os
+import signal
+
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticTrainRunner
+from deepspeed_tpu.runtime.supervision import read_events
+from deepspeed_tpu.utils import fault_injection as fi
+
+from .common import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def test_first_signal_restores_previous_handler(tmp_path):
+    """After the first SIGTERM the runner's handler must be GONE: the
+    second signal lands on whatever was installed before the runner."""
+    seen = []
+    prev = {s: signal.signal(s, lambda n, f: seen.append(n))
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        runner = ElasticTrainRunner(FakeEngine(), str(tmp_path / "ck"),
+                                    save_interval=100)
+        runner._install()
+        assert signal.getsignal(signal.SIGTERM) == runner._on_signal
+
+        os.kill(os.getpid(), signal.SIGTERM)  # first: graceful drain
+        assert runner._preempted
+        assert not seen  # swallowed by the runner, as designed
+        # escalation armed: both signals now route to the pre-install
+        # handlers again, so a repeat is NOT swallowed
+        assert signal.getsignal(signal.SIGTERM) != runner._on_signal
+        assert signal.getsignal(signal.SIGINT) != runner._on_signal
+
+        os.kill(os.getpid(), signal.SIGTERM)  # second: escalates
+        assert seen == [signal.SIGTERM]
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+def test_drain_still_checkpoints_after_escalation_arming(tmp_path):
+    """Restoring handlers on the first signal must not break the graceful
+    path: an uninterrupted drain still checkpoints at the boundary."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine()
+    runner = ElasticTrainRunner(eng, save, save_interval=100)
+    with fi.inject("train.step", fi.SignalAtStep(2, signal.SIGTERM)):
+        res = runner.run([1.0] * 6, resume=False)
+    assert res["preempted"] and res["steps"] == 2
+    from deepspeed_tpu.runtime.checkpoint_engine import resolve_tag, verify_tag
+    tag = resolve_tag(save, None)
+    assert tag == "elastic_step2"
+    ok, problems = verify_tag(save, tag)
+    assert ok, problems
+
+
+def test_preemption_signal_is_journaled(tmp_path):
+    save = str(tmp_path / "ck")
+    runner = ElasticTrainRunner(
+        FakeEngine(), save, save_interval=100,
+        supervision={"rollback": {"max_rollbacks": 0}})
+    with fi.inject("train.step", fi.SignalAtStep(3, signal.SIGTERM)):
+        runner.run([1.0] * 6, resume=False)
+    evs = read_events(os.path.join(save, "events.jsonl"),
+                      kind="preempt.signal")
+    assert len(evs) == 1
+    assert evs[0]["signum"] == int(signal.SIGTERM)
+    assert evs[0]["step"] == 3

@@ -1,0 +1,67 @@
+"""scripts/dump_run_events.py: the journal must be reconstructable from the
+CLI, with abort-class events driving the exit code."""
+
+import importlib.util
+import os
+
+import pytest
+
+from deepspeed_tpu.runtime.supervision import EventJournal
+
+pytestmark = pytest.mark.chaos
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                       "scripts", "dump_run_events.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("dump_run_events", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dump_pretty_prints_and_flags_aborts(tmp_path, capsys):
+    mod = _load()
+    j = EventJournal(str(tmp_path / "ck" / "events.jsonl"), rank=0)
+    j.emit("rollback", from_step=7, to_step=4, index=1, max_rollbacks=2,
+           lr_factor=0.5, skip_batches=0)
+    j.emit("divergence.abort", step=10, rollbacks=2,
+           reason="max_rollbacks exhausted")
+
+    # a checkpoint DIR is accepted and resolved to its events.jsonl
+    rc = mod.main([str(tmp_path / "ck")])
+    out = capsys.readouterr().out
+    assert rc == 1  # abort-class event present
+    assert "rollback" in out and "from_step=7" in out
+    assert "max_rollbacks exhausted" in out
+
+    rc = mod.main([str(tmp_path / "ck"), "--kind", "rollback"])
+    out = capsys.readouterr().out
+    assert rc == 0  # filtered view has no abort-class events
+    assert "divergence.abort" not in out
+
+
+def test_dump_stacks_and_json_modes(tmp_path, capsys):
+    mod = _load()
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    j.emit("watchdog.expired", label="train.step", deadline_s=0.2,
+           stacks="--- Thread MainThread ---\n  fake frame")
+    rc = mod.main([str(tmp_path / "events.jsonl"), "--stacks"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fake frame" in out
+
+    rc = mod.main([str(tmp_path / "events.jsonl"), "--json"])
+    out = capsys.readouterr().out
+    assert '"kind": "watchdog.expired"' in out
+
+
+def test_dump_missing_or_empty_journal(tmp_path, capsys):
+    mod = _load()
+    assert mod.main([str(tmp_path / "nope")]) == 2
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    j.emit("rollback", from_step=1, to_step=0)
+    assert mod.main([str(tmp_path / "events.jsonl"),
+                     "--kind", "no.such.kind"]) == 2
+    capsys.readouterr()

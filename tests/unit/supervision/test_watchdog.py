@@ -1,0 +1,149 @@
+"""Chaos tests for the step watchdog: an injected hang must become a stack
+dump + structured abort event within the configured deadline — never a
+silently burning run."""
+
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticTrainRunner
+from deepspeed_tpu.runtime.supervision import (EventJournal, StepWatchdog,
+                                               dump_all_stacks, read_events)
+from deepspeed_tpu.utils import fault_injection as fi
+
+from .common import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def test_expiry_dumps_stacks_and_emits_event(tmp_path):
+    """Armed watchdog + a 'step' that never finishes: expiry fires within
+    the deadline (plus scheduling slack), journals the stack dump, and
+    calls the abort hook."""
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    expired = threading.Event()
+    wd = StepWatchdog(0.2, journal=journal, on_expire=lambda rec: expired.set())
+    t0 = time.monotonic()
+    prev = wd.arm("train.step")
+    assert prev == (None, None)
+    assert expired.wait(5.0), "watchdog never expired"
+    assert time.monotonic() - t0 < 5.0
+    wd.stop()
+
+    events = read_events(journal.path, kind="watchdog.expired")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["label"] == "train.step"
+    assert ev["deadline_s"] == pytest.approx(0.2)
+    # the dump must cover the hung MAIN thread, not just the watchdog's own
+    assert "MainThread" in ev["stacks"]
+    assert "test_expiry_dumps_stacks_and_emits_event" in ev["stacks"]
+
+
+def test_disarm_prevents_expiry(tmp_path):
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    fired = []
+    wd = StepWatchdog(0.15, journal=journal, on_expire=fired.append)
+    with wd.guard("train.step"):
+        pass  # step finished well inside the deadline
+    time.sleep(0.4)
+    wd.stop()
+    assert not fired
+    assert read_events(journal.path) == []
+
+
+def test_nested_guard_restores_outer_arming():
+    """A collective guard inside a step guard must hand the step deadline
+    back on exit, not leave the watchdog disarmed mid-step."""
+    wd = StepWatchdog(30.0, on_expire=lambda rec: None)
+    with wd.guard("train.step"):
+        outer = (wd._deadline, wd._label)
+        assert wd._label == "train.step"
+        with wd.guard("comm.barrier", 10.0):
+            assert wd._label == "comm.barrier"
+        assert (wd._deadline, wd._label) == outer
+    assert wd._label is None and wd._deadline is None
+    wd.stop()
+
+
+def test_rearm_extends_deadline():
+    """Re-arming per step pushes the deadline out: three quick steps under
+    a deadline shorter than their total must not expire."""
+    fired = []
+    wd = StepWatchdog(0.3, on_expire=fired.append)
+    for _ in range(3):
+        with wd.guard("train.step"):
+            time.sleep(0.15)
+    wd.stop()
+    assert not fired
+
+
+def test_runner_injected_step_hang_aborts_with_stack_dump(tmp_path):
+    """End to end: HangFor injected inside the runner's step guard models a
+    hung collective; the watchdog must journal the hang and fire the abort
+    path while the step is still blocked."""
+    save = str(tmp_path / "ck")
+    eng = FakeEngine()
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=100,
+        supervision={"step_deadline_s": 0.25})
+    hang = fi.HangFor(30.0)
+    expired = threading.Event()
+    # substitute the abort hook (default SIGABRT would kill pytest) and
+    # release the hung step so the test can observe the post-abort journal
+    def on_expire(rec):
+        expired.set()
+        hang.release()
+    runner.watchdog.on_expire = on_expire
+
+    t0 = time.monotonic()
+    with fi.inject("train.step_begin", hang):
+        runner.run([1.0] * 3, resume=False)
+    elapsed = time.monotonic() - t0
+    assert expired.is_set(), "injected hang never tripped the watchdog"
+    assert elapsed < 10.0, f"abort took {elapsed:.1f}s for a 0.25s deadline"
+
+    events = read_events(str(tmp_path / "ck" / "events.jsonl"),
+                         kind="watchdog.expired")
+    assert len(events) == 1
+    assert events[0]["label"] == "train.step"
+    assert "run" in events[0]["stacks"]  # the hung train loop is in frame
+
+
+def test_watchdog_rearms_after_stop():
+    """A stopped watchdog (end of run) must come back when the runner is
+    reused — arm() restarts the daemon thread."""
+    expired = threading.Event()
+    wd = StepWatchdog(0.15, on_expire=lambda rec: expired.set())
+    with wd.guard("train.step"):
+        pass
+    wd.stop()
+    wd.arm("train.step")
+    assert expired.wait(5.0), "expiry lost after stop()+re-arm"
+    wd.stop()
+
+
+def test_dump_all_stacks_covers_every_thread():
+    marker = threading.Event()
+    done = threading.Event()
+
+    def parked():
+        marker.set()
+        done.wait(10.0)
+
+    t = threading.Thread(target=parked, name="parked-thread", daemon=True)
+    t.start()
+    assert marker.wait(5.0)
+    try:
+        dump = dump_all_stacks()
+    finally:
+        done.set()
+    assert "parked-thread" in dump
+    assert "MainThread" in dump
