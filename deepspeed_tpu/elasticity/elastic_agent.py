@@ -187,6 +187,16 @@ class ElasticTrainRunner:
         Returns {"steps": n, "preempted": bool, "losses": [...],
         "rollbacks": n}.
         """
+        # a stateful (resumable) batch source registers with the engine
+        # BEFORE the resume load, so the checkpoint's iterator position is
+        # restored into it and rollback quarantine windows land on it
+        if hasattr(batches, "state_dict") and \
+                hasattr(batches, "load_state_dict") and \
+                hasattr(self.engine, "set_data_iterator"):
+            self.engine.set_data_iterator(batches)
+            if self.journal is not None and \
+                    getattr(batches, "journal", None) is None:
+                batches.journal = self.journal
         if resume:
             self.resume()
         start_step = self.engine.global_steps
@@ -199,18 +209,32 @@ class ElasticTrainRunner:
                 self.supervision.collective_deadline_s is not None:
             set_global_watchdog(self.watchdog,
                                 self.supervision.collective_deadline_s)
+        batch_iter = iter(batches)
         try:
-            for batch in batches:
+            while True:
+                # decide BEFORE fetching: pulling a batch advances a
+                # stateful loader, and a batch fetched past a preemption
+                # or the step budget would be recorded as consumed in the
+                # checkpointed iterator position without ever being trained
                 if max_steps is not None and \
                         self.engine.global_steps - start_step >= max_steps:
                     break
                 if self._preempted:
                     break
                 if skip_remaining > 0:
-                    # post-rollback: consume without training, stepping past
-                    # the data window that fed the divergence
+                    # post-rollback relative skip (plain iterators only —
+                    # resumable loaders enforce the absolute quarantine
+                    # window themselves): consume without training
+                    try:
+                        next(batch_iter)
+                    except StopIteration:
+                        break
                     skip_remaining -= 1
                     continue
+                try:
+                    batch = next(batch_iter)
+                except StopIteration:
+                    break
                 with self._step_guard():
                     fault_injection.fire("train.step_begin",
                                          step=self.engine.global_steps + 1)
@@ -240,7 +264,10 @@ class ElasticTrainRunner:
                                 f"(last={loss}) — aborting without "
                                 f"checkpointing the poisoned state")
                         # engine state already rolled back to the newest
-                        # verified tag; restart the streak and skip ahead
+                        # verified tag; restart the streak.  With a
+                        # resumable loader the supervisor installed an
+                        # absolute quarantine window (skip_batches is 0);
+                        # plain iterators fall back to the relative skip
                         self._nan_streak = 0
                         skip_remaining = int(directive.get("skip_batches", 0))
                         continue

@@ -265,6 +265,16 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(f"invalid 'supervision' section: {e}") from e
         self.supervision_config_dict = sup_dict
 
+        # data section (typed: resumable loader geometry, bad-record
+        # budget, iterator checkpointing — consumed by deepspeed_io)
+        data_dict = pd.get(C.DATA, {})
+        from .data_pipeline.config import DeepSpeedDataConfig
+        try:
+            self.data_config = DeepSpeedDataConfig.from_dict(data_dict)
+        except (TypeError, ValueError) as e:
+            raise DeepSpeedConfigError(f"invalid 'data' section: {e}") from e
+        self.data_config_dict = data_dict
+
         # pld
         pld_dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
         self.pld_enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)

@@ -211,6 +211,11 @@ ELASTICITY = "elasticity"
 SUPERVISION = "supervision"
 
 #############################################
+# Deterministic resumable data pipeline
+#############################################
+DATA = "data"
+
+#############################################
 # Flops profiler / monitor / autotuning keys live in their own modules
 #############################################
 FLOPS_PROFILER = "flops_profiler"

@@ -1,0 +1,14 @@
+"""Deterministic data pipeline: resumable loaders + curriculum scheduling.
+
+- ``resumable``: :class:`ResumableDataLoader` — endless batching iterator
+  with O(1) checkpointable position, absolute quarantine windows, and a
+  bounded bad-record policy (``docs/data-determinism.md``)
+- ``curriculum_scheduler``: difficulty schedules whose state rides in
+  engine checkpoints
+- ``config``: the validated ``"data"`` config section
+"""
+
+from .config import DATA, DeepSpeedDataConfig  # noqa: F401
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .resumable import (BadRecordBudgetError,  # noqa: F401
+                        ResumableDataLoader, STATE_VERSION)

@@ -13,6 +13,8 @@ from __future__ import annotations
 import math
 from typing import Callable, Dict, Optional
 
+from ...utils.logging import logger
+
 FIXED_LINEAR = "fixed_linear"
 FIXED_ROOT = "fixed_root"
 FIXED_DISCRETE = "fixed_discrete"
@@ -71,6 +73,29 @@ class CurriculumScheduler:
 
     def set_state(self, state: Dict) -> None:
         self.state = state
+
+    def state_dict(self) -> Dict:
+        """Checkpointable trajectory state (rides in the engine's
+        ``client_state["curriculum"]`` so difficulty survives resume)."""
+        return {"current_difficulty": self.state["current_difficulty"],
+                "schedule_type": self.state["schedule_type"]}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        """Restore ``current_difficulty``, clamped into the *constructed*
+        [min, max] — the schedule itself comes from config (source of
+        truth), only the trajectory position is checkpoint state."""
+        saved_type = sd.get("schedule_type")
+        if saved_type is not None and saved_type != self.state["schedule_type"]:
+            logger.warning(
+                f"curriculum checkpoint was written under schedule "
+                f"{saved_type!r} but this run uses "
+                f"{self.state['schedule_type']!r}; restoring the difficulty "
+                f"anyway (clamped)")
+        if "current_difficulty" in sd:
+            self.state["current_difficulty"] = min(
+                max(int(sd["current_difficulty"]),
+                    self.state["min_difficulty"]),
+                self.state["max_difficulty"])
 
     def _fixed_root_get_difficulty(self, global_steps: int, root_degree: Optional[int] = None) -> int:
         s = self.state["schedule"]

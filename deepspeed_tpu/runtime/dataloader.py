@@ -34,6 +34,8 @@ class DeepSpeedDataLoader:
     def __init__(self, dataset, batch_size: int, collate_fn: Optional[Callable] = None,
                  shuffle: bool = False, seed: int = 0, drop_last: bool = True,
                  mesh_manager=None):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or _default_collate
@@ -43,6 +45,13 @@ class DeepSpeedDataLoader:
         self.epoch = 0
         n = len(dataset)
         self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
+        if self.len == 0:
+            # degenerate geometry caught here, not as a bare StopIteration
+            # out of RepeatingLoader's "endless" iterator three layers up
+            raise ValueError(
+                f"DeepSpeedDataLoader would yield zero batches: batch_size "
+                f"({batch_size}) exceeds dataset size ({n}) with "
+                f"drop_last=True — shrink the batch or set drop_last=False")
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -65,6 +74,14 @@ class RepeatingLoader:
     """Endlessly cycle a loader (reference ``RepeatingLoader`` dataloader.py)."""
 
     def __init__(self, loader):
+        try:
+            empty = len(loader) == 0
+        except TypeError:
+            empty = False  # unsized iterables get the runtime check below
+        if empty:
+            raise ValueError(
+                "RepeatingLoader: underlying loader has zero batches — an "
+                "endless loader cannot cycle an empty epoch")
         self.loader = loader
         self.data_iter = iter(loader)
 
@@ -78,4 +95,12 @@ class RepeatingLoader:
             if hasattr(self.loader, "set_epoch"):
                 self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
             self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            try:
+                return next(self.data_iter)
+            except StopIteration:
+                # a bare StopIteration out of an "endless" iterator is a
+                # caller-visible lie; name the actual problem
+                raise RuntimeError(
+                    "RepeatingLoader: underlying loader yielded no batches "
+                    "after an epoch reset (empty dataset or batch_size > "
+                    "len(dataset) with drop_last=True)") from None

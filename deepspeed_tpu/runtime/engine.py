@@ -103,6 +103,11 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.skipped_steps = 0
 
+        # registered resumable data iterator: its O(1) position state rides
+        # in every checkpoint's client_state so any resume (elastic restart,
+        # fallback chain, rollback) lands on the exact next batch
+        self.data_iterator = None
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
@@ -1065,11 +1070,31 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=False,
                      data_sampler=None, collate_fn=None, num_local_io_workers=None):
-        return DeepSpeedDataLoader(
-            dataset,
-            batch_size=batch_size or self.train_micro_batch_size_per_gpu() * self.dp_world_size,
-            collate_fn=collate_fn or self.collate_fn,
-            mesh_manager=self.mesh_manager)
+        bs = batch_size or \
+            self.train_micro_batch_size_per_gpu() * self.dp_world_size
+        cf = collate_fn or self.collate_fn
+        dc = self._config.data_config
+        if dc.resumable:
+            from .data_pipeline.resumable import ResumableDataLoader
+            loader = ResumableDataLoader(
+                dataset, batch_size=bs, collate_fn=cf, shuffle=dc.shuffle,
+                seed=dc.seed, drop_last=dc.drop_last,
+                max_epochs=dc.max_epochs,
+                max_bad_records=dc.max_bad_records,
+                journal_batches=dc.journal_batches,
+                mesh_manager=self.mesh_manager)
+            if dc.checkpoint_iterator:
+                self.set_data_iterator(loader)
+            return loader
+        return DeepSpeedDataLoader(dataset, batch_size=bs, collate_fn=cf,
+                                   mesh_manager=self.mesh_manager)
+
+    def set_data_iterator(self, iterator) -> None:
+        """Register a stateful data iterator (``state_dict``/
+        ``load_state_dict``): its position is persisted in every checkpoint
+        and restored on every load, making resumes land on the exact next
+        batch (reference ``set_dataloader`` keeps a loader the same way)."""
+        self.data_iterator = iterator
 
     def _shard_batch(self, batch):
         """Place a host batch as a global array sharded over dp."""
@@ -1661,6 +1686,11 @@ class DeepSpeedEngine:
         if self._lr_scheduler is not None:
             client_state["lr_scheduler"] = self._lr_scheduler.state_dict()
         client_state["optimizer_param_groups"] = self.optimizer.param_groups
+        if self._curriculum is not None:
+            client_state["curriculum"] = self._curriculum.state_dict()
+        if self.data_iterator is not None and \
+                hasattr(self.data_iterator, "state_dict"):
+            client_state["data_iterator"] = self.data_iterator.state_dict()
         offload = self._offload_device is not None
         if offload:
             # host-side fp32 master + moments (zero_pp_rank_* analogue) —
@@ -1826,6 +1856,21 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self._lr_scheduler is not None and \
                 "lr_scheduler" in client_state:
             self._lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        if self._curriculum is not None and "curriculum" in client_state:
+            self._curriculum.load_state_dict(client_state["curriculum"])
+        if self.data_iterator is not None and \
+                hasattr(self.data_iterator, "load_state_dict") and \
+                "data_iterator" in client_state:
+            try:
+                self.data_iterator.load_state_dict(
+                    client_state["data_iterator"])
+            except ValueError as e:
+                # geometry changed between save and load: the saved position
+                # no longer names the same batches — keep the live position
+                # and say so, rather than silently replaying a different
+                # sequence under a "resumed" banner
+                logger.warning(
+                    f"data iterator state in checkpoint NOT restored: {e}")
         self._spill_params()  # restore the between-steps memory bound
         if "optimizer_param_groups" in client_state and load_optimizer_states:
             restored = client_state["optimizer_param_groups"]

@@ -68,11 +68,20 @@ class RunSupervisor:
     def on_divergence(self, step: int, loss: float) -> Optional[Dict[str, Any]]:
         """Decide recovery for a confirmed divergence at ``step``.
 
-        Returns a directive ``{"to_step", "skip_batches"}`` when the run
-        should retry from the reloaded state, or ``None`` when it must
-        abort (budget exhausted, or nothing verified to roll back to).
-        The engine's state has already been rolled back when a directive
-        is returned.
+        Returns a directive ``{"to_step", "skip_batches", "quarantine"}``
+        when the run should retry from the reloaded state, or ``None`` when
+        it must abort (budget exhausted, or nothing verified to roll back
+        to).  The engine's state has already been rolled back when a
+        directive is returned.
+
+        With a resumable data iterator registered on the engine, the
+        poisoned window is an ABSOLUTE quarantine ``[restored_data_step,
+        divergence_data_step + skip_batches)``: the checkpoint reload
+        rewinds the loader, the window is journaled (``data.quarantine``)
+        and installed on the loader, and the replay provably skips exactly
+        the batches that fed the divergence.  Without one, the directive
+        falls back to the old relative ``skip_batches`` count, which is
+        honest only about the iterator position it happens to start from.
         """
         rb = self.config.rollback_config
         if self.consecutive_rollbacks >= rb.max_rollbacks:
@@ -81,6 +90,13 @@ class RunSupervisor:
                        max_rollbacks=rb.max_rollbacks,
                        reason="max_rollbacks exhausted")
             return None
+        # the loader position at divergence must be read BEFORE the reload
+        # rewinds it — that position is the end of the poisoned window
+        loader = getattr(self.engine, "data_iterator", None)
+        if loader is None or not (hasattr(loader, "step")
+                                  and hasattr(loader, "quarantine")):
+            loader = None
+        div_data_step = int(loader.step) if loader is not None else None
         loaded, _ = self.engine.load_checkpoint(self.save_dir)
         if loaded is None:
             self._emit("divergence.abort", step=step, loss=loss,
@@ -91,20 +107,36 @@ class RunSupervisor:
         self.total_rollbacks += 1
         self._last_rollback_from_step = step
         to_step = int(getattr(self.engine, "global_steps", 0))
+        quarantine = None
+        if loader is not None:
+            q_from = int(loader.step)  # rewound by the checkpoint reload
+            q_to = div_data_step + rb.skip_batches
+            if q_to > q_from:
+                loader.quarantine(q_from, q_to)
+                quarantine = (q_from, q_to)
+                self._emit("data.quarantine", from_step=q_from, to_step=q_to,
+                           divergence_step=step)
         lr_factor = self._shrink_lr(rb.lr_factor)
         scale_reset = self._reset_loss_scale() if rb.reset_loss_scale else False
+        skip_batches = 0 if quarantine is not None else rb.skip_batches
         logger.warning(
             f"[supervision] divergence at step {step} (loss={loss}): rolled "
             f"back to verified step {to_step} "
             f"({self.consecutive_rollbacks}/{rb.max_rollbacks} consecutive), "
             f"lr_factor={lr_factor}, loss_scale_reset={scale_reset}, "
-            f"skipping {rb.skip_batches} batch(es)")
+            + (f"quarantined data steps [{quarantine[0]}, {quarantine[1]})"
+               if quarantine is not None
+               else f"skipping {skip_batches} batch(es)"))
         self._emit("rollback", from_step=step, to_step=to_step, loss=loss,
                    index=self.consecutive_rollbacks,
                    max_rollbacks=rb.max_rollbacks, lr_factor=lr_factor,
                    loss_scale_reset=scale_reset,
-                   skip_batches=rb.skip_batches)
-        return {"to_step": to_step, "skip_batches": rb.skip_batches}
+                   skip_batches=skip_batches,
+                   quarantine=list(quarantine) if quarantine else None)
+        directive = {"to_step": to_step, "skip_batches": skip_batches}
+        if quarantine is not None:
+            directive["quarantine"] = quarantine
+        return directive
 
     # ------------------------------------------------------------- knobs
     def _shrink_lr(self, factor: float) -> float:

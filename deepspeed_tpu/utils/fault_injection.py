@@ -27,6 +27,12 @@ Points currently wired:
                           (``HangFor`` models a barrier that never clears)
 ``supervision.heartbeat`` start of every heartbeat write; ctx: ``path``,
                           ``rank`` (delays/failures model a wedged host)
+``data.next``             start of every ResumableDataLoader batch fetch;
+                          ctx: ``step``, ``epoch`` (``BadRecord`` here
+                          models an unreadable shard / decode failure)
+``data.collate``          after the samples are fetched, before collate;
+                          ctx: ``step``, ``indices`` (``BadRecord`` models
+                          a malformed record that survives decode)
 ========================  =====================================================
 """
 
@@ -48,6 +54,12 @@ _lock = threading.Lock()
 
 class FaultError(OSError):
     """The exception injected write-failure faults raise by default."""
+
+
+class BadRecordError(ValueError):
+    """The exception :class:`BadRecord` raises — a decode/collate failure,
+    distinct from the I/O-flavored :class:`FaultError` so data-pipeline
+    tests can assert the bad-record path specifically."""
 
 
 class Fault:
@@ -144,6 +156,35 @@ class SignalAtStep(Fault):
         if step == self.step:
             self.fired += 1
             os.kill(os.getpid(), self.sig)
+
+
+class BadRecord(Fault):
+    """Raise :class:`BadRecordError` at ``data.next``/``data.collate`` —
+    the unreadable shard or malformed sample.
+
+    ``steps`` restricts the fault to specific absolute batch steps (every
+    matching fire otherwise); ``n`` bounds the total raises (``None`` =
+    every matching fire).  ``fired`` counts injections so tests can assert
+    the skip path actually ran.
+    """
+
+    def __init__(self, n: Optional[int] = 1, steps: Optional[List[int]] = None,
+                 exc_type=BadRecordError):
+        self.remaining = n
+        self.steps = set(steps) if steps is not None else None
+        self.exc_type = exc_type
+        self.fired = 0
+
+    def fire(self, point: str, step: Optional[int] = None, **ctx) -> None:
+        if self.steps is not None and step not in self.steps:
+            return
+        if self.remaining is not None and self.remaining <= 0:
+            return
+        if self.remaining is not None:
+            self.remaining -= 1
+        self.fired += 1
+        raise self.exc_type(
+            f"injected bad record #{self.fired} at {point} (step {step})")
 
 
 class HangFor(Fault):

@@ -1,0 +1,105 @@
+"""scripts/verify_replay.py: the replay audit must be reconstructable from
+the CLI, with mismatches driving the exit code."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import ResumableDataLoader
+from deepspeed_tpu.runtime.supervision import EventJournal
+
+from ..supervision.common import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                       "scripts", "verify_replay.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("verify_replay", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _train_and_save(save, steps_before=5, steps_after=6):
+    """A journaled run: checkpoint mid-stream, keep consuming after."""
+    j = EventJournal(os.path.join(save, "events.jsonl"))
+    loader = ResumableDataLoader(np.arange(32), 4, shuffle=True, seed=3,
+                                 journal=j, journal_batches=True)
+    eng = FakeEngine()
+    eng.set_data_iterator(loader)
+    for _ in range(steps_before):
+        next(loader)
+        eng.global_steps += 1
+    eng.save_checkpoint(save)
+    for _ in range(steps_after):  # the live run continues past the save
+        next(loader)
+    return loader
+
+
+def test_verify_replay_ok(tmp_path, capsys):
+    mod = _load()
+    save = str(tmp_path / "ck")
+    _train_and_save(save)
+    rc = mod.main([save, "--steps", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "OK" in out and "checked against the journal" in out
+
+
+def test_verify_replay_flags_tampered_journal(tmp_path, capsys):
+    mod = _load()
+    save = str(tmp_path / "ck")
+    _train_and_save(save)
+    jpath = os.path.join(save, "events.jsonl")
+    lines = open(jpath).read().splitlines()
+    doctored = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("kind") == "data.batch" and rec.get("step") == 7:
+            rec["sha"] = "0" * 16  # the replay that silently diverged
+        doctored.append(json.dumps(rec))
+    with open(jpath, "w") as f:
+        f.write("\n".join(doctored) + "\n")
+    rc = mod.main([save, "--steps", "16"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "MISMATCH" in out
+
+
+def test_verify_replay_honors_quarantine(tmp_path, capsys):
+    mod = _load()
+    save = str(tmp_path / "ck")
+    j = EventJournal(os.path.join(save, "events.jsonl"))
+    loader = ResumableDataLoader(np.arange(32), 4, shuffle=True, seed=3,
+                                 journal=j, journal_batches=True)
+    for _ in range(3):
+        next(loader)
+    loader.quarantine(4, 6)
+    eng = FakeEngine()
+    eng.set_data_iterator(loader)
+    eng.save_checkpoint(save)
+    for _ in range(5):  # journals steps 3, 6, 7, 8, 9 — the window skipped
+        next(loader)
+    rc = mod.main([save, "--steps", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "quarantine window(s) honored" in out
+
+
+def test_verify_replay_without_state_exits_2(tmp_path, capsys):
+    mod = _load()
+    save = str(tmp_path / "ck")
+    FakeEngine().save_checkpoint(save)  # no data iterator registered
+    rc = mod.main([save])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "no data_iterator state" in err
+
+    rc = mod.main([str(tmp_path / "nowhere")])
+    assert rc == 2

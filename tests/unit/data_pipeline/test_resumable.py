@@ -1,0 +1,280 @@
+"""ResumableDataLoader unit behavior: O(1) position state, deterministic
+epoch reshuffle, quarantine enforcement, the bounded bad-record policy, and
+the degenerate-geometry validation the old loaders lacked."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (BadRecordBudgetError,
+                                                 CurriculumScheduler,
+                                                 DeepSpeedDataConfig,
+                                                 ResumableDataLoader)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.supervision import EventJournal, read_events
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+def make_loader(n=24, bs=4, **kw):
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 7)
+    return ResumableDataLoader(np.arange(n), bs, **kw)
+
+
+def consume(loader, n):
+    """Next n batches as lists of dataset values."""
+    return [np.asarray(next(loader)).tolist() for _ in range(n)]
+
+
+# ------------------------------------------------------- degenerate geometry
+def test_deepspeed_dataloader_degenerate_length_raises():
+    with pytest.raises(ValueError, match="zero batches"):
+        DeepSpeedDataLoader(np.arange(3), batch_size=8, drop_last=True)
+    # drop_last=False keeps the short batch and stays legal
+    assert len(DeepSpeedDataLoader(np.arange(3), batch_size=8,
+                                   drop_last=False)) == 1
+
+
+def test_repeating_loader_rejects_empty_loader():
+    class EmptySized:
+        def __len__(self):
+            return 0
+
+        def __iter__(self):
+            return iter([])
+
+    with pytest.raises(ValueError, match="zero batches"):
+        RepeatingLoader(EmptySized())
+
+    class EmptyUnsized:
+        def __iter__(self):
+            return iter([])
+
+    rl = RepeatingLoader(EmptyUnsized())
+    with pytest.raises(RuntimeError, match="no batches"):
+        next(rl)
+
+
+def test_resumable_degenerate_length_raises():
+    with pytest.raises(ValueError, match="zero batches"):
+        ResumableDataLoader(np.arange(3), 8, drop_last=True)
+    with pytest.raises(ValueError):
+        ResumableDataLoader(np.arange(8), 0)
+
+
+# --------------------------------------------------------------- determinism
+def test_epoch_reshuffle_is_deterministic_and_distinct():
+    a, b = make_loader(), make_loader()
+    # same (seed, epoch) → identical orders across instances
+    assert np.array_equal(a.batch_indices(0), b.batch_indices(0))
+    epoch0 = [a.batch_indices(s).tolist() for s in range(len(a))]
+    epoch1 = [a.batch_indices(s + len(a)).tolist() for s in range(len(a))]
+    # different epochs reshuffle (same multiset, different order)
+    assert sorted(sum(epoch0, [])) == sorted(sum(epoch1, []))
+    assert epoch0 != epoch1
+    # iteration yields exactly the planned indices
+    assert consume(a, 6) == epoch0
+
+
+def test_skip_to_matches_consuming(tmp_path):
+    consumed = make_loader()
+    consume(consumed, 7)
+    jumped = make_loader()
+    jumped.skip_to(7)
+    assert (jumped.epoch, jumped.batch_index) == \
+        (consumed.epoch, consumed.batch_index)
+    assert jumped.samples_consumed == consumed.samples_consumed
+    assert consume(jumped, 5) == consume(consumed, 5)
+
+
+def test_skip_to_samples_exact_without_drop_last():
+    # 10 samples / bs 4 → batches of 4, 4, 2 per epoch
+    a = ResumableDataLoader(np.arange(10), 4, drop_last=False)
+    consume(a, 5)
+    b = ResumableDataLoader(np.arange(10), 4, drop_last=False)
+    b.skip_to(5)
+    assert b.samples_consumed == a.samples_consumed == 10 + 8
+
+
+# ------------------------------------------------------------------- state
+def test_state_roundtrips_through_json():
+    src = make_loader()
+    consume(src, 9)
+    src.quarantine(11, 13)
+    sd = json.loads(json.dumps(src.state_dict()))  # the client_state path
+    dst = make_loader()
+    dst.load_state_dict(sd)
+    assert dst.step == src.step == 9
+    assert dst.quarantine_windows == [(11, 13)]
+    assert dst.replay_plan(8) == src.replay_plan(8)
+    assert consume(dst, 8) == consume(src, 8)
+
+
+def test_geometry_mismatch_raises():
+    sd = make_loader(n=24, bs=4).state_dict()
+    with pytest.raises(ValueError, match="geometry"):
+        make_loader(n=24, bs=6).load_state_dict(sd)
+    with pytest.raises(ValueError, match="geometry"):
+        make_loader(n=20, bs=4).load_state_dict(sd)
+
+
+def test_from_state_needs_no_dataset():
+    src = make_loader()
+    consume(src, 5)
+    replay = ResumableDataLoader.from_state(src.state_dict())
+    assert replay.step == 5
+    assert replay.replay_plan(6) == src.replay_plan(6)
+
+
+# --------------------------------------------------------------- quarantine
+def test_quarantine_windows_are_skipped_exactly():
+    loader = make_loader(n=24, bs=4)  # 6 batches/epoch
+    loader.quarantine(2, 4)
+    got = consume(loader, 6)
+    want = [loader.batch_indices(s).tolist() for s in (0, 1, 4, 5, 6, 7)]
+    assert got == want
+    assert loader.step == 8
+
+
+def test_quarantine_merges_and_validates():
+    loader = make_loader()
+    loader.quarantine(2, 4)
+    loader.quarantine(3, 6)
+    loader.quarantine(10, 12)
+    assert loader.quarantine_windows == [(2, 6), (10, 12)]
+    with pytest.raises(ValueError):
+        loader.quarantine(5, 5)
+    # replay_plan jumps windows without yielding them
+    steps = [s for s, _ in loader.replay_plan(8)]
+    assert steps == [0, 1, 6, 7, 8, 9, 12, 13]
+
+
+def test_quarantine_skip_is_journaled_once_per_window(tmp_path):
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    loader = make_loader(journal=j)
+    loader.quarantine(1, 3)
+    consume(loader, 4)
+    evs = read_events(j.path, kind="data.quarantine.skip")
+    assert len(evs) == 1
+    assert evs[0]["from_step"] == 1 and evs[0]["to_step"] == 3
+
+
+# --------------------------------------------------------------- bad records
+class FlakyDataset:
+    """Raises for poisoned indices — the rotting shard."""
+
+    def __init__(self, n, bad=()):
+        self.n = n
+        self.bad = set(bad)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i in self.bad:
+            raise ValueError(f"undecodable record {i}")
+        return np.asarray(i)
+
+
+def test_bad_record_budget_skips_then_aborts(tmp_path):
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    # shuffle off: batch b holds samples [4b, 4b+4); poison batches 1, 3, 6
+    ds = FlakyDataset(32, bad=(4, 13, 25))
+    loader = ResumableDataLoader(ds, 4, shuffle=False, max_bad_records=2,
+                                 journal=j)
+    got = consume(loader, 4)
+    # batches 1 and 3 were skipped within budget
+    assert got == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19],
+                   [20, 21, 22, 23]]
+    bad = read_events(j.path, kind="data.bad_record")
+    assert [e["step"] for e in bad] == [1, 3]
+    # the third failure (batch 6) busts the budget of 2
+    with pytest.raises(BadRecordBudgetError):
+        consume(loader, 1)
+    aborts = read_events(j.path, kind="data.bad_record.abort")
+    assert len(aborts) == 1 and aborts[0]["bad_records"] == 3
+
+
+def test_injected_bad_record_fault_is_survivable():
+    loader = make_loader(max_bad_records=1)
+    with fi.inject("data.next", fi.BadRecord(steps=[2])) as f:
+        got = consume(loader, 4)
+    assert f.fired == 1
+    want = [loader.batch_indices(s).tolist() for s in (0, 1, 3, 4)]
+    assert got == want
+    assert loader.bad_records == 1
+
+
+def test_injected_collate_fault_aborts_past_budget():
+    loader = make_loader(max_bad_records=0)
+    with fi.inject("data.collate", fi.BadRecord(n=1)):
+        with pytest.raises(BadRecordBudgetError):
+            next(loader)
+
+
+# ------------------------------------------------------------ journal audit
+def test_journal_batches_fingerprints_match_plan(tmp_path):
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    loader = make_loader(journal=j, journal_batches=True)
+    plan = loader.replay_plan(5)
+    consume(loader, 5)
+    evs = read_events(j.path, kind="data.batch")
+    assert [(e["step"], e["sha"]) for e in evs] == plan
+
+
+def test_iterator_restore_is_journaled(tmp_path):
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    src = make_loader()
+    consume(src, 3)
+    dst = make_loader(journal=j)
+    dst.load_state_dict(src.state_dict())
+    evs = read_events(j.path, kind="data.iterator_restore")
+    assert len(evs) == 1 and evs[0]["step"] == 3
+
+
+# ---------------------------------------------------------------- config
+def test_data_config_validates():
+    assert DeepSpeedDataConfig.from_dict({}).resumable is False
+    cfg = DeepSpeedDataConfig.from_dict(
+        {"resumable": True, "shuffle": True, "seed": 3, "max_bad_records": 5})
+    assert cfg.max_bad_records == 5
+    with pytest.raises(ValueError):
+        DeepSpeedDataConfig.from_dict({"max_bad_records": -1})
+    with pytest.raises(ValueError):
+        DeepSpeedDataConfig.from_dict({"max_epochs": 0})
+    with pytest.raises(ValueError):
+        DeepSpeedDataConfig.from_dict({"seed": "abc"})
+
+
+# ------------------------------------------------------------- curriculum
+def test_curriculum_state_survives_json_roundtrip():
+    cfg = {"min_difficulty": 2, "max_difficulty": 10,
+           "schedule_type": "fixed_linear",
+           "schedule_config": {"total_curriculum_step": 10,
+                               "difficulty_step": 2}}
+    src = CurriculumScheduler(dict(cfg))
+    src.update_difficulty(8)
+    assert src.get_current_difficulty() > 2
+    dst = CurriculumScheduler(dict(cfg))
+    assert dst.get_current_difficulty() == 2  # the bug: resets on restart
+    dst.load_state_dict(json.loads(json.dumps(src.state_dict())))
+    assert dst.get_current_difficulty() == src.get_current_difficulty()
+
+
+def test_curriculum_load_clamps_out_of_range():
+    cfg = {"min_difficulty": 2, "max_difficulty": 10,
+           "schedule_type": "fixed_linear",
+           "schedule_config": {"total_curriculum_step": 10,
+                               "difficulty_step": 2}}
+    sched = CurriculumScheduler(dict(cfg))
+    sched.load_state_dict({"current_difficulty": 99})
+    assert sched.get_current_difficulty() == 10

@@ -1,0 +1,170 @@
+"""Chaos tests closing the loop the tentpole promises: a resumed or
+rolled-back run replays EXACTLY the uninterrupted trajectory's data.
+
+Same duck-typed-engine-over-real-checkpoint-stack pattern as the
+supervision suite — runner, supervisor, loader, journal, and checkpoint
+manifests are all real, only the jit train step is faked."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticTrainRunner
+from deepspeed_tpu.runtime.data_pipeline import ResumableDataLoader
+from deepspeed_tpu.runtime.supervision import read_events
+from deepspeed_tpu.utils import fault_injection as fi
+
+from ..supervision.common import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+NAN = float("nan")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+class RecordingEngine(FakeEngine):
+    """FakeEngine over real checkpoints, recording every batch it trained
+    on — the consumed-data trajectory the tests compare bitwise."""
+
+    def __init__(self, losses=None):
+        super().__init__(losses=losses)
+        self.consumed = []
+
+    def train_batch_fused(self, batch):
+        self.global_steps += 1
+        arr = np.asarray(batch)
+        self.consumed.append(arr.tolist())
+        self.weight += float(arr.sum())
+        if self._losses:
+            return self._losses.pop(0)
+        return 1.0 / self.global_steps
+
+
+def make_loader(**kw):
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 7)
+    return ResumableDataLoader(np.arange(40), 4, **kw)
+
+
+def _events(save, kind=None):
+    return read_events(os.path.join(save, "events.jsonl"), kind=kind)
+
+
+def test_kill_resume_replays_bitwise_identically(tmp_path):
+    """train → SIGTERM → fresh process resumes → the concatenated consumed
+    sequence is bitwise identical to an uninterrupted run's."""
+    # the reference trajectory: 10 uninterrupted steps
+    ref = RecordingEngine()
+    ElasticTrainRunner(ref, str(tmp_path / "ref"), save_interval=3).run(
+        make_loader(), max_steps=10, resume=False)
+    assert len(ref.consumed) == 10
+
+    # the interrupted run: preempted at step 4, checkpointed, process "dies"
+    save = str(tmp_path / "ck")
+    eng1 = RecordingEngine()
+    with fi.inject("train.step", fi.SignalAtStep(4, signal.SIGTERM)):
+        res = ElasticTrainRunner(eng1, save, save_interval=3).run(
+            make_loader(), max_steps=10, resume=False)
+    assert res["preempted"] and res["steps"] == 4
+
+    # the "restarted process": fresh engine, fresh loader, resume from disk
+    eng2 = RecordingEngine()
+    res2 = ElasticTrainRunner(eng2, save, save_interval=3).run(
+        make_loader(), max_steps=10 - res["steps"], resume=True)
+    assert not res2["preempted"] and eng2.global_steps == 10
+
+    assert eng1.consumed + eng2.consumed == ref.consumed
+    assert eng2.weight == pytest.approx(ref.weight)
+
+
+def test_resume_without_iterator_state_starts_loader_fresh(tmp_path):
+    """A checkpoint written before the resumable pipeline existed (no
+    data_iterator in client_state) must resume without rewinding, not
+    crash."""
+    save = str(tmp_path / "ck")
+    eng1 = FakeEngine()
+    ElasticTrainRunner(eng1, save, save_interval=2).run(
+        [1.0] * 4, max_steps=4, resume=False)  # plain list: no loader state
+    eng2 = RecordingEngine()
+    res = ElasticTrainRunner(eng2, save, save_interval=2).run(
+        make_loader(), max_steps=2, resume=True)
+    assert res["steps"] == 2
+    assert eng2.global_steps == 6  # resumed the counters all the same
+
+
+def test_rollback_replays_with_exact_quarantine_window(tmp_path):
+    """Divergence at step 9 with the newest verified tag at step 4: the
+    retry must quarantine data steps [4, 9) — journaled absolutely — and
+    the consumed trajectory must show batches 0..8 then 9.. with the
+    window never re-fed."""
+    save = str(tmp_path / "ck")
+    loader = make_loader()
+    # steps 7, 8, 9 are non-finite; threshold 3 → divergence at step 9.
+    # save_interval=4: step 4 published; step 8 is inside the streak and
+    # is NOT published, so the rollback lands on step 4.
+    eng = RecordingEngine(losses=[1.0] * 6 + [NAN, NAN, NAN])
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=4, nan_abort_threshold=3,
+        supervision={"rollback": {"max_rollbacks": 2, "lr_factor": 0.5}})
+    res = runner.run(loader, max_steps=14, resume=False)
+
+    assert res["rollbacks"] == 1 and not res["preempted"]
+    # trajectory: batches for data steps 0..8 fed pre-divergence, then the
+    # replay continues at 9 (4..8 quarantined, never re-fed)
+    probe = make_loader()
+    want = [probe.batch_indices(s).tolist() for s in range(9)]
+    want += [probe.batch_indices(s).tolist() for s in range(9, 9 + 14 - 4)]
+    assert eng.consumed == want
+
+    q = _events(save, "data.quarantine")
+    assert len(q) == 1
+    assert q[0]["from_step"] == 4 and q[0]["to_step"] == 9
+    assert q[0]["divergence_step"] == 9
+    rb = _events(save, "rollback")
+    assert rb[0]["quarantine"] == [4, 9] and rb[0]["skip_batches"] == 0
+    skips = _events(save, "data.quarantine.skip")
+    assert len(skips) == 1
+    assert skips[0]["from_step"] == 4 and skips[0]["to_step"] == 9
+    # the restore of the iterator position was journaled too
+    restores = _events(save, "data.iterator_restore")
+    assert any(e["step"] == 4 for e in restores)
+
+
+def test_rollback_skip_batches_extends_quarantine_window(tmp_path):
+    """rollback.skip_batches widens the absolute window past the
+    divergence step instead of acting as a blind relative skip."""
+    save = str(tmp_path / "ck")
+    eng = RecordingEngine(losses=[1.0] * 4 + [NAN, NAN])
+    runner = ElasticTrainRunner(
+        eng, save, save_interval=3, nan_abort_threshold=2,
+        supervision={"rollback": {"max_rollbacks": 2, "skip_batches": 2}})
+    runner.run(make_loader(), max_steps=10, resume=False)
+    q = _events(save, "data.quarantine")
+    # diverged at step 6, verified tag at step 3 → window [3, 6+2)
+    assert len(q) == 1
+    assert q[0]["from_step"] == 3 and q[0]["to_step"] == 8
+    probe = make_loader()
+    post_rollback = eng.consumed[6:]
+    assert post_rollback[0] == probe.batch_indices(8).tolist()
+
+
+def test_bad_record_budget_aborts_through_runner(tmp_path):
+    """The bad-record abort must surface out of the runner's loop, not be
+    swallowed as end-of-data."""
+    save = str(tmp_path / "ck")
+    loader = make_loader(max_bad_records=0)
+    eng = RecordingEngine()
+    runner = ElasticTrainRunner(eng, save, save_interval=100,
+                                supervision={})
+    with fi.inject("data.next", fi.BadRecord(steps=[2])):
+        with pytest.raises(Exception, match="max_bad_records"):
+            runner.run(loader, max_steps=10, resume=False)
+    evs = _events(save, "data.bad_record.abort")
+    assert len(evs) == 1 and evs[0]["step"] == 2

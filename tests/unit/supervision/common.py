@@ -33,6 +33,10 @@ class FakeEngine:
         self.optimizer = FakeOptimizer(lr)
         self.loss_scale_resets = 0
         self._losses = list(losses or [])
+        self.data_iterator = None
+
+    def set_data_iterator(self, it):
+        self.data_iterator = it
 
     # ------------------------------------------------------------- train
     def train_batch_fused(self, batch):
@@ -54,9 +58,11 @@ class FakeEngine:
 
     def save_checkpoint(self, save_dir, tag=None, **kw):
         tag = tag or f"fake_step{self.global_steps}"
-        save_engine_checkpoint(save_dir, tag, self._tree(),
-                               {"global_steps": self.global_steps,
-                                "weight": self.weight},
+        cs = {"global_steps": self.global_steps, "weight": self.weight}
+        if self.data_iterator is not None and \
+                hasattr(self.data_iterator, "state_dict"):
+            cs["data_iterator"] = self.data_iterator.state_dict()
+        save_engine_checkpoint(save_dir, tag, self._tree(), cs,
                                separate_master=True)
         return True
 
@@ -66,4 +72,8 @@ class FakeEngine:
             return None, {}
         self.global_steps = cs["global_steps"]
         self.weight = float(np.asarray(state["params"]["w"]))
+        if self.data_iterator is not None and \
+                hasattr(self.data_iterator, "load_state_dict") and \
+                "data_iterator" in cs:
+            self.data_iterator.load_state_dict(cs["data_iterator"])
         return load_dir, cs
