@@ -33,6 +33,7 @@ from typing import Any, Dict, Iterable, Optional, Union
 from ..runtime.supervision import (DeepSpeedSupervisionConfig, EventJournal,
                                    HeartbeatWriter, RunSupervisor,
                                    StepWatchdog, set_global_watchdog)
+from ..runtime.supervision.events import EventKind
 from ..utils import fault_injection
 from ..utils.logging import log_dist, logger
 from .elasticity import compute_elastic_config, elasticity_enabled
@@ -133,7 +134,7 @@ class ElasticTrainRunner:
                        "exits immediately)")
         self._preempted = True
         if self.journal is not None:
-            self.journal.emit("preempt.signal", signum=int(signum),
+            self.journal.emit(EventKind.PREEMPT_SIGNAL, signum=int(signum),
                               step=self.engine.global_steps)
         # escalation: hand the signals back to the pre-install handlers NOW,
         # so a second SIGTERM/SIGINT during a stuck drain terminates the
@@ -145,8 +146,12 @@ class ElasticTrainRunner:
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
                 self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
-            except ValueError:  # non-main thread (tests)
-                pass
+            except ValueError:
+                # non-main thread (tests): without handlers a preemption
+                # notice can't drain gracefully — say so instead of hiding it
+                logger.debug(
+                    f"[elastic] cannot install handler for signal {sig} "
+                    "from a non-main thread; preemption drain disabled")
 
     def _restore(self):
         for sig, h in self._prev_handlers.items():

@@ -101,5 +101,11 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def __del__(self):  # pragma: no cover - interpreter teardown
         try:
             self._pool.shutdown(wait=False)
-        except Exception:
-            pass
+        except Exception as e:
+            # a durability path never eats a failure silently — but the
+            # logging machinery itself may already be torn down here
+            try:
+                logger.warning(
+                    f"[async-ckpt] writer pool shutdown failed: {e!r}")
+            except Exception:  # dslint: disable=swallowed-exception — logger may be gone at interpreter teardown
+                pass

@@ -64,8 +64,11 @@ def _atomic_attempt(path: str, write_tmp: Callable[[str], None]) -> None:
         if os.path.exists(tmp):
             try:
                 os.remove(tmp)
-            except OSError:
-                pass
+            except OSError as e:
+                # the write itself already succeeded or raised; a leaked
+                # tmp file is harmless but worth a trace in the log
+                logger.warning(
+                    f"[ckpt-storage] could not remove stale tmp {tmp}: {e}")
 
 
 def _ensure_parent(path: str) -> None:

@@ -38,6 +38,7 @@ import numpy as np
 from ...utils import fault_injection
 from ...utils.logging import logger
 from ..dataloader import _default_collate
+from ..supervision.events import EventKind
 
 PyTree = Any
 
@@ -275,7 +276,8 @@ class ResumableDataLoader:
             self.quarantine(int(a), int(b))
         self._order_cache = None
         self._skipping_window = None
-        self._emit("data.iterator_restore", step=self.step, epoch=self.epoch,
+        self._emit(EventKind.DATA_ITERATOR_RESTORE, step=self.step,
+                   epoch=self.epoch,
                    batch_index=self.batch_index,
                    samples_consumed=self.samples_consumed,
                    quarantine=[[a, b] for a, b in self._quarantine])
@@ -294,7 +296,7 @@ class ResumableDataLoader:
                 # journal each window once per crossing, not per batch
                 if self._skipping_window != win:
                     self._skipping_window = win
-                    self._emit("data.quarantine.skip", from_step=win[0],
+                    self._emit(EventKind.DATA_QUARANTINE_SKIP, from_step=win[0],
                                to_step=win[1], at_step=step)
                     logger.info(
                         f"[data] skipping quarantined batch window "
@@ -317,18 +319,18 @@ class ResumableDataLoader:
                 continue
             self._advance(len(idx))
             if self.journal_batches:
-                self._emit("data.batch", step=step, epoch=self.epoch,
+                self._emit(EventKind.DATA_BATCH, step=step, epoch=self.epoch,
                            n=int(len(idx)), sha=self.batch_fingerprint(step))
             return batch
 
     # ---------------------------------------------------------- bad records
     def _on_bad_record(self, step: int, exc: Exception) -> None:
         self.bad_records += 1
-        self._emit("data.bad_record", step=step, epoch=self.epoch,
+        self._emit(EventKind.DATA_BAD_RECORD, step=step, epoch=self.epoch,
                    error=repr(exc), bad_records=self.bad_records,
                    max_bad_records=self.max_bad_records)
         if self.bad_records > self.max_bad_records:
-            self._emit("data.bad_record.abort", step=step,
+            self._emit(EventKind.DATA_BAD_RECORD_ABORT, step=step,
                        bad_records=self.bad_records,
                        max_bad_records=self.max_bad_records)
             raise BadRecordBudgetError(

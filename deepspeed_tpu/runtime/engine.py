@@ -1498,8 +1498,10 @@ class DeepSpeedEngine:
                         s["grad_acc"] = jax.tree_util.tree_unflatten(
                             jax.tree_util.tree_structure(s["grad_acc"]),
                             zero_leaves)
-                    except Exception:
-                        pass
+                    except Exception as restore_err:
+                        logger.warning(
+                            "[offload] best-effort grad_acc restore after a "
+                            f"failed master read also failed: {restore_err!r}")
                     try:
                         masters = None
                         for pi, leaf in enumerate(param_leaves):
@@ -1514,8 +1516,10 @@ class DeepSpeedEngine:
                         s["params"] = s["master"] = \
                             jax.tree_util.tree_unflatten(
                                 self._params_treedef, param_leaves)
-                    except Exception:
-                        pass
+                    except Exception as restore_err:
+                        logger.warning(
+                            "[offload] best-effort param restore after a "
+                            f"failed master read also failed: {restore_err!r}")
                     raise
                 s["params"] = jax.tree_util.tree_unflatten(
                     self._params_treedef, param_leaves)

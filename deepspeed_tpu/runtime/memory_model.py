@@ -71,6 +71,6 @@ def device_budget(memory_fraction: float = 0.85,
         total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         if total:
             return int(total * memory_fraction)
-    except Exception:
+    except Exception:  # dslint: disable=swallowed-exception — best-effort device-memory probe; None is the documented fallback
         pass
     return None

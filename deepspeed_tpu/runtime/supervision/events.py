@@ -23,9 +23,71 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ...utils.logging import logger
+
+
+class EventKind:
+    """Single source of truth for every journal event kind.
+
+    Every ``EventJournal.emit`` site must use one of these constants (or a
+    literal equal to one of them) — ``dslint``'s ``unregistered-journal-kind``
+    rule checks call sites against this class, and its ``event-kind-drift``
+    project check keeps :data:`SUMMARY_FIELDS`, :data:`ABORT_KINDS`, and the
+    journal-schema tables in ``docs/run-supervision.md`` /
+    ``docs/data-determinism.md`` in sync.  Register new kinds HERE first,
+    then document them (see ``docs/static-analysis.md``).
+    """
+
+    ROLLBACK = "rollback"
+    ROLLBACK_RECOVERED = "rollback.recovered"
+    DIVERGENCE_ABORT = "divergence.abort"
+    WATCHDOG_EXPIRED = "watchdog.expired"
+    PREEMPT_SIGNAL = "preempt.signal"
+    HEARTBEAT_GAP = "heartbeat.gap"
+    HEARTBEAT_RECOVERED = "heartbeat.recovered"
+    DATA_QUARANTINE = "data.quarantine"
+    DATA_QUARANTINE_SKIP = "data.quarantine.skip"
+    DATA_BAD_RECORD = "data.bad_record"
+    DATA_BAD_RECORD_ABORT = "data.bad_record.abort"
+    DATA_ITERATOR_RESTORE = "data.iterator_restore"
+    DATA_BATCH = "data.batch"
+
+
+#: every registered kind, as a set of strings
+EVENT_KINDS = frozenset(
+    v for k, v in vars(EventKind).items()
+    if not k.startswith("_") and isinstance(v, str))
+
+#: kinds that mean the run stopped abnormally (``dump_run_events`` exits 1)
+ABORT_KINDS = frozenset({
+    EventKind.DIVERGENCE_ABORT,
+    EventKind.WATCHDOG_EXPIRED,
+    EventKind.DATA_BAD_RECORD_ABORT,
+})
+
+#: kind → the fields worth a one-liner in ``dump_run_events`` (everything
+#: else is reachable via ``--json``); every registered kind has an entry
+SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
+    EventKind.ROLLBACK: ("from_step", "to_step", "index", "max_rollbacks",
+                         "lr_factor", "skip_batches", "quarantine"),
+    EventKind.ROLLBACK_RECOVERED: ("step", "rollbacks"),
+    EventKind.DIVERGENCE_ABORT: ("step", "rollbacks", "reason"),
+    EventKind.WATCHDOG_EXPIRED: ("label", "deadline_s"),
+    EventKind.PREEMPT_SIGNAL: ("signum", "step"),
+    EventKind.HEARTBEAT_GAP: ("rank", "age_s", "last_step"),
+    EventKind.HEARTBEAT_RECOVERED: ("rank",),
+    EventKind.DATA_QUARANTINE: ("from_step", "to_step", "divergence_step"),
+    EventKind.DATA_QUARANTINE_SKIP: ("from_step", "to_step", "at_step"),
+    EventKind.DATA_BAD_RECORD: ("step", "epoch", "bad_records",
+                                "max_bad_records", "error"),
+    EventKind.DATA_BAD_RECORD_ABORT: ("step", "bad_records",
+                                      "max_bad_records"),
+    EventKind.DATA_ITERATOR_RESTORE: ("step", "epoch", "batch_index",
+                                      "samples_consumed", "quarantine"),
+    EventKind.DATA_BATCH: ("step", "epoch", "n", "sha"),
+}
 
 
 class EventJournal:

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 from ...utils import fault_injection
 from ...utils.logging import logger
+from .events import EventKind
 
 _FILE_FMT = "rank{rank}.json"
 
@@ -159,9 +160,9 @@ class HeartbeatMonitor:
                     f"[supervision] heartbeat gap: rank {rec['rank']} last "
                     f"beat {rec['age_s']:.1f}s ago (gap_s={self.gap_s})")
                 if self.journal is not None:
-                    self.journal.emit("heartbeat.gap", **rec)
+                    self.journal.emit(EventKind.HEARTBEAT_GAP, **rec)
         for rank in sorted(self._stale_ranks - {s["rank"] for s in stale}):
             self._stale_ranks.discard(rank)
             if self.journal is not None:
-                self.journal.emit("heartbeat.recovered", rank=rank)
+                self.journal.emit(EventKind.HEARTBEAT_RECOVERED, rank=rank)
         return {"alive": alive, "stale": stale, "missing": missing}

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 
 from ...utils.logging import logger
 from .config import DeepSpeedSupervisionConfig
+from .events import EventKind
 
 
 class RunSupervisor:
@@ -55,7 +56,7 @@ class RunSupervisor:
         if self.consecutive_rollbacks and \
                 self._last_rollback_from_step is not None and \
                 step > self._last_rollback_from_step:
-            self._emit("rollback.recovered", step=step,
+            self._emit(EventKind.ROLLBACK_RECOVERED, step=step,
                        rollbacks=self.consecutive_rollbacks)
             logger.info(
                 f"[supervision] recovered: step {step} passed the "
@@ -85,7 +86,7 @@ class RunSupervisor:
         """
         rb = self.config.rollback_config
         if self.consecutive_rollbacks >= rb.max_rollbacks:
-            self._emit("divergence.abort", step=step, loss=loss,
+            self._emit(EventKind.DIVERGENCE_ABORT, step=step, loss=loss,
                        rollbacks=self.consecutive_rollbacks,
                        max_rollbacks=rb.max_rollbacks,
                        reason="max_rollbacks exhausted")
@@ -99,7 +100,7 @@ class RunSupervisor:
         div_data_step = int(loader.step) if loader is not None else None
         loaded, _ = self.engine.load_checkpoint(self.save_dir)
         if loaded is None:
-            self._emit("divergence.abort", step=step, loss=loss,
+            self._emit(EventKind.DIVERGENCE_ABORT, step=step, loss=loss,
                        rollbacks=self.consecutive_rollbacks,
                        reason="no verified checkpoint to roll back to")
             return None
@@ -114,7 +115,8 @@ class RunSupervisor:
             if q_to > q_from:
                 loader.quarantine(q_from, q_to)
                 quarantine = (q_from, q_to)
-                self._emit("data.quarantine", from_step=q_from, to_step=q_to,
+                self._emit(EventKind.DATA_QUARANTINE, from_step=q_from,
+                           to_step=q_to,
                            divergence_step=step)
         lr_factor = self._shrink_lr(rb.lr_factor)
         scale_reset = self._reset_loss_scale() if rb.reset_loss_scale else False
@@ -127,7 +129,8 @@ class RunSupervisor:
             + (f"quarantined data steps [{quarantine[0]}, {quarantine[1]})"
                if quarantine is not None
                else f"skipping {skip_batches} batch(es)"))
-        self._emit("rollback", from_step=step, to_step=to_step, loss=loss,
+        self._emit(EventKind.ROLLBACK, from_step=step, to_step=to_step,
+                   loss=loss,
                    index=self.consecutive_rollbacks,
                    max_rollbacks=rb.max_rollbacks, lr_factor=lr_factor,
                    loss_scale_reset=scale_reset,

@@ -32,6 +32,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ...utils.logging import logger
+from .events import EventKind
 
 
 def dump_all_stacks() -> str:
@@ -149,7 +150,7 @@ class StepWatchdog:
             f"aborting:\n{stacks}")
         rec = {"label": label, "deadline_s": self.deadline_s, "stacks": stacks}
         if self.journal is not None:
-            rec = self.journal.emit("watchdog.expired", **rec)
+            rec = self.journal.emit(EventKind.WATCHDOG_EXPIRED, **rec)
         if self.on_expire is not None:
             self.on_expire(rec)
         else:  # pragma: no cover - kills the test process by design

@@ -144,13 +144,13 @@ def see_memory_usage(message: str, force: bool = False) -> None:
                 used = stats.get("bytes_in_use", 0) / 2**30
                 limit = stats.get("bytes_limit", 0) / 2**30
                 lines.append(f"  {d}: {used:.2f}GB in use / {limit:.2f}GB limit")
-    except Exception:
+    except Exception:  # dslint: disable=swallowed-exception — diagnostics-only memory probe; partial output is the point
         pass
     try:
         import psutil
         vm = psutil.virtual_memory()
         lines.append(f"  host: {vm.used / 2**30:.2f}GB used ({vm.percent}%)")
-    except Exception:
+    except Exception:  # dslint: disable=swallowed-exception — psutil is optional; host line is best-effort
         pass
     logger.info("\n".join(lines))
 

@@ -46,6 +46,22 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+#: Single source of truth for every wired fault point.  ``dslint``'s
+#: ``unregistered-fault-point`` rule checks ``fire``/``install``/``inject``
+#: call sites against this set — register new points HERE (and document
+#: them in the table above) before wiring them into code.
+FAULT_POINTS = frozenset({
+    "ckpt.write",
+    "ckpt.post_write",
+    "ckpt.publish",
+    "train.step",
+    "train.step_begin",
+    "comm.barrier",
+    "supervision.heartbeat",
+    "data.next",
+    "data.collate",
+})
+
 # points with faults installed; guarded by _lock for install/clear, read
 # without it in fire() (list snapshot semantics are enough for tests)
 _faults: Dict[str, List["Fault"]] = {}

@@ -93,5 +93,5 @@ def force_cpu_platform(n_devices: int = 8,
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               0.5)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        except Exception:
-            pass  # older jax without the persistent-cache config knobs
+        except Exception:  # dslint: disable=swallowed-exception — older jax without the persistent-cache config knobs
+            pass

@@ -1,0 +1,100 @@
+#!/usr/bin/env python3
+"""dslint — static analysis for the durability/supervision/data invariants.
+
+Runs the project-native rule set (``tools/dslint/``) over the tree and
+compares against the committed baseline: pre-existing findings are
+grandfathered for burn-down, any NEW finding fails the run.  Pure stdlib —
+no jax, no deepspeed_tpu import — so it runs anywhere, fast.
+
+Usage:
+    python scripts/dslint.py                      # lint vs baseline
+    python scripts/dslint.py --no-baseline        # report everything
+    python scripts/dslint.py --update-baseline    # regenerate (sorted)
+    python scripts/dslint.py --list-rules
+    python scripts/dslint.py deepspeed_tpu/comm   # restrict to a subtree
+
+Exit codes: 0 clean vs baseline; 1 new findings; 2 usage error.
+Suppress a single line with ``# dslint: disable=<rule-id> — <reason>``.
+Docs: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.dslint import (BASELINE_PATH, default_rules,  # noqa: E402
+                          diff_against_baseline, format_baseline, lint_tree,
+                          load_baseline)
+from tools.dslint.project_checks import RULE_ID as DRIFT_RULE  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dslint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="restrict findings to these repo-relative prefixes")
+    ap.add_argument("--root", default=REPO_ROOT, help="repo root to lint")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_PATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(deterministic: sorted keys)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.id:<28s} {rule.description}")
+        print(f"{DRIFT_RULE:<28s} registry vs dump_run_events/docs tables "
+              "drift (project-level)")
+        return 0
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_PATH)
+    findings = lint_tree(root)
+    if args.paths:
+        prefixes = tuple(p.rstrip("/").replace(os.sep, "/")
+                         for p in args.paths)
+        findings = [f for f in findings
+                    if f.path.startswith(prefixes)]
+        if args.update_baseline:
+            print("error: --update-baseline requires a whole-tree run "
+                  "(drop the path arguments)", file=sys.stderr)
+            return 2
+
+    if args.update_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(format_baseline(findings))
+        print(f"baseline: {len(findings)} finding(s) -> {baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path) if not args.no_baseline else None
+    if baseline is None:
+        new, stale = list(findings), 0
+    else:
+        new, stale = diff_against_baseline(findings, baseline)
+
+    for f in new:
+        print(f.render())
+    n_base = len(findings) - len(new)
+    summary = (f"dslint: {len(findings)} finding(s), {n_base} baselined, "
+               f"{len(new)} new")
+    if stale:
+        summary += (f"; {stale} stale baseline entr"
+                    f"{'y' if stale == 1 else 'ies'} — violations fixed, "
+                    "run --update-baseline (or delete the lines) to burn "
+                    "them down")
+    print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,30 +27,10 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from deepspeed_tpu.runtime.supervision.events import read_events  # noqa: E402
-
-#: events that mean the run stopped abnormally
-ABORT_KINDS = ("divergence.abort", "watchdog.expired", "data.bad_record.abort")
-
-#: kind → the fields worth a one-liner (everything else via --json)
-_SUMMARY_FIELDS = {
-    "rollback": ("from_step", "to_step", "index", "max_rollbacks",
-                 "lr_factor", "skip_batches", "quarantine"),
-    "rollback.recovered": ("step", "rollbacks"),
-    "divergence.abort": ("step", "rollbacks", "reason"),
-    "watchdog.expired": ("label", "deadline_s"),
-    "preempt.signal": ("signum", "step"),
-    "heartbeat.gap": ("rank", "age_s", "last_step"),
-    "heartbeat.recovered": ("rank",),
-    "data.quarantine": ("from_step", "to_step", "divergence_step"),
-    "data.quarantine.skip": ("from_step", "to_step", "at_step"),
-    "data.bad_record": ("step", "epoch", "bad_records", "max_bad_records",
-                        "error"),
-    "data.bad_record.abort": ("step", "bad_records", "max_bad_records"),
-    "data.iterator_restore": ("step", "epoch", "batch_index",
-                              "samples_consumed", "quarantine"),
-    "data.batch": ("step", "epoch", "n", "sha"),
-}
+# the kind registry is the single source of truth (dslint's
+# event-kind-drift check keeps it, this script, and the docs in sync)
+from deepspeed_tpu.runtime.supervision.events import (  # noqa: E402
+    ABORT_KINDS, SUMMARY_FIELDS as _SUMMARY_FIELDS, read_events)
 
 
 def _fmt(ev: dict, show_stacks: bool) -> str:

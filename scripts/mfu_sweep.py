@@ -1,21 +1,33 @@
 #!/usr/bin/env python3
 """On-chip evidence sweep: MFU tuning rows + capability/inference rows.
 
-Runs the GPT-2 350M training bench under micro-batch / flash-block
-tuning configurations (BENCH_MB / FLASH_BLOCK_Q / FLASH_BLOCK_K env
-knobs), then the BERT headline, the ZeRO-offload capability ladder
-(2.7b → 1.3b), and the gpt_bench prefill/decode rows (bf16 / int8 /
-int8-compute), appending one JSON line per run to the log.  Ordered
-safest/most-valuable-first; each run gets a generous timeout and is
-stopped with SIGTERM (never SIGKILL — a hard kill mid-TPU-operation has
-wedged the axon relay before; see docs/performance.md measurement
-notes), and an unterminated wedge aborts the rest of the sweep.
+One parameterized runner for every sweep (the former ``mfu_sweep2/3/4.py``
+copies are the ``--set`` choices below — scripts are drift too; see
+``docs/static-analysis.md``).  Each row runs the named bench config in a
+subprocess, appending one JSON line per run to the log.  Rows are ordered
+safest/most-valuable-first; each run gets a generous timeout and is stopped
+with SIGTERM (never SIGKILL — a hard kill mid-TPU-operation has wedged the
+axon relay before; see docs/performance.md measurement notes), and an
+unterminated wedge aborts the rest of the sweep.
 
-Usage:  python scripts/mfu_sweep.py [logfile]
+Usage:  python scripts/mfu_sweep.py [--set NAME] [logfile]
+
+Sets:
+  full    the round-4/5 master list: GPT-2 350M micro-batch / flash-block
+          ladder, BERT headline, ZeRO-offload capability, gpt_bench
+          prefill/decode rows
+  remat   phase-2 remat-policy / attention-impl rows (micro-batch and
+          flash blocks were flat at ~39-40% MFU; the stall is the remat'd
+          attention forward — these rows attack exactly that)
+  round5  everything still unmeasured after phase 1, priority-ordered for
+          a flaky tunnel (remat levers first, then offload capability,
+          inference rows, stall anatomy, xplane trace)
+  short   the four highest-value rows, for a late tunnel-recovery window
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import signal
@@ -25,14 +37,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: (label, env overrides, bench argv) — safest/known-good first so a wedge
-#: later in the list still leaves earlier numbers on the record.  The
-#: default argv runs the driver's GPT-2 350M training bench; the tail rows
-#: capture the round-4 capability/inference evidence in the same log.
 _GPT_BENCH = ["-m", "deepspeed_tpu.benchmarks.inference.gpt_bench",
               "--model", "gpt2-125m", "--batch", "8", "--prompt", "512",
               "--new-tokens", "32"]
-CONFIGS = [
+
+#: rows are (label, env overrides, bench argv); argv None runs the default
+#: driver bench (GPT-2 350M training).  Safest/known-good first so a wedge
+#: later in the list still leaves earlier numbers on the record.
+_FULL = [
     ("baseline-mb32-b1024", {}, None),
     ("mb32-bq512", {"FLASH_BLOCK_Q": "512"}, None),
     ("mb32-b512", {"FLASH_BLOCK_Q": "512", "FLASH_BLOCK_K": "512"}, None),
@@ -65,6 +77,88 @@ CONFIGS = [
         "--dtype", "bfloat16", "--prompt", "896",   # + 32 new < 1024 ctx
         "--variant", "windowed:256"]),
 ]
+
+# phase-2 rows: remat_policy=attn_out saves each block's attention output
+# (64 MB/layer at mb32) so the remat backward skips re-running the
+# attention forward; =dots additionally saves matmul outputs;
+# BENCH_DENSE_ATTN=1 swaps the Pallas flash kernel for XLA's dense scores
+# path (MXU-friendly; the S^2 buffer is transient under remat)
+_REMAT = [
+    ("attn-out-mb32", {"BENCH_REMAT_POLICY": "attn_out"}, None),
+    ("attn-out-mb48", {"BENCH_REMAT_POLICY": "attn_out",
+                       "BENCH_MB": "48,40,32"}, None),
+    ("attn-out-bf16acc-mb64", {"BENCH_REMAT_POLICY": "attn_out",
+                               "BENCH_ACCUM_DTYPE": "bf16",
+                               "BENCH_MB": "64,48,32"}, None),
+    ("dots-mb32", {"BENCH_REMAT_POLICY": "dots",
+                   "BENCH_MB": "32,24,16"}, None),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+    ("dense-attn-out-mb32", {"BENCH_DENSE_ATTN": "1",
+                             "BENCH_REMAT_POLICY": "attn_out",
+                             "BENCH_MB": "32,24"}, None),
+]
+
+_ROUND5 = [
+    # --- MFU levers (highest value).  bench.py's default GPT config is
+    # now remat_policy=attn_out (HLO-proven to drop the backward's flash
+    # fwd re-run), so the first row IS the candidate best; the second is
+    # the A/B against the old full-recompute policy ---
+    ("attn-out-mb32", {}, None),
+    ("nothing-mb32", {"BENCH_REMAT_POLICY": "nothing"}, None),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+    ("dense-attn-out-mb32", {"BENCH_DENSE_ATTN": "1",
+                             "BENCH_REMAT_POLICY": "attn_out",
+                             "BENCH_MB": "32,24"}, None),
+    # anatomy early: ~2 min, and its per-component table decides where
+    # any remaining tuning effort goes
+    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
+     ["scripts/stall_anatomy.py"]),
+    ("attn-out-mb48", {"BENCH_REMAT_POLICY": "attn_out",
+                       "BENCH_MB": "48,40"}, None),
+    ("dots-mb24", {"BENCH_REMAT_POLICY": "dots",
+                   "BENCH_MB": "24,16"}, None),
+    ("attn-out-losschunk256", {"BENCH_REMAT_POLICY": "attn_out",
+                               "BENCH_LOSS_CHUNK": "256"}, None),
+    # no-remat rows: the extra forward is ~25% of executed flops — wins
+    # if no-remat activations fit at a micro-batch that still feeds MXU
+    ("gpt-noremat-mb12", {"BENCH_NO_REMAT": "1", "BENCH_MB": "12,8",
+                          "BENCH_GAS": "3"}, None),
+    ("bert-noremat-mb128", {"BENCH_NO_REMAT": "1",
+                            "BENCH_MB": "128,96,64"},
+     ["bench.py", "bert"]),
+    # --- capability (BASELINE #3) ---
+    ("offload-capability", {}, ["bench.py", "offload"]),
+    # --- inference rows ---
+    ("prefill-bf16", {}, _GPT_BENCH + ["--dtype", "bfloat16"]),
+    ("prefill-int8", {}, _GPT_BENCH + ["--dtype", "int8"]),
+    ("prefill-int8-compute", {}, _GPT_BENCH + ["--dtype", "int8-compute"]),
+    ("decode-int8-kv", {}, _GPT_BENCH + ["--dtype", "bfloat16",
+                                         "--kv-cache-dtype", "int8"]),
+    ("decode-alibi-int8-kv", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--kv-cache-dtype", "int8",
+        "--variant", "alibi"]),
+    ("decode-windowed256", {}, _GPT_BENCH + [
+        "--dtype", "bfloat16", "--prompt", "896",
+        "--variant", "windowed:256"]),
+    # --- xplane trace of the winning-config step (timing not comparable;
+    # runs last so a wedge here costs nothing) ---
+    ("trace-baseline", {"BENCH_TRACE": "bench_artifacts/xplane_r5"}, None),
+]
+
+_SHORT = [
+    ("attn-out-mb32", {}, None),                       # new bench default
+    ("nothing-mb32", {"BENCH_REMAT_POLICY": "nothing"}, None),  # A/B
+    ("stall-anatomy", {"SWEEP_SKIP_PREFLIGHT": "1"},
+     ["scripts/stall_anatomy.py"]),
+    ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
+]
+
+CONFIG_SETS = {
+    "full": _FULL,
+    "remat": _REMAT,
+    "round5": _ROUND5,
+    "short": _SHORT,
+}
 
 RUN_TIMEOUT_S = 1200
 TERM_GRACE_S = 180
@@ -128,15 +222,24 @@ def preflight() -> bool:
     return False
 
 
-def main(configs=CONFIGS, default_path="/tmp/mfu_sweep.jsonl", tag="sweep"):
-    path = sys.argv[1] if len(sys.argv) > 1 else default_path
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile", nargs="?", default=None,
+                    help="JSONL results log "
+                         "(default /tmp/mfu_sweep_<set>.jsonl)")
+    ap.add_argument("--set", dest="config_set", default="full",
+                    choices=sorted(CONFIG_SETS),
+                    help="which sweep row list to run (default: full)")
+    args = ap.parse_args(argv)
+    configs = CONFIG_SETS[args.config_set]
+    path = args.logfile or f"/tmp/mfu_sweep_{args.config_set}.jsonl"
     if not preflight() and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
         sys.exit(1)
     with open(path, "a") as log:
-        for label, env_over, argv in configs:
-            if not run_one(label, env_over, log, argv):
+        for label, env_over, row_argv in configs:
+            if not run_one(label, env_over, log, row_argv):
                 break
-    sys.stderr.write(f"[{tag}] results in {path}\n")
+    sys.stderr.write(f"[sweep:{args.config_set}] results in {path}\n")
 
 
 if __name__ == "__main__":

@@ -35,7 +35,8 @@ from deepspeed_tpu.runtime.checkpoint_engine.native_checkpoint_engine import (  
     resolve_tag)
 from deepspeed_tpu.runtime.data_pipeline.resumable import (  # noqa: E402
     ResumableDataLoader)
-from deepspeed_tpu.runtime.supervision.events import read_events  # noqa: E402
+from deepspeed_tpu.runtime.supervision.events import (  # noqa: E402
+    EventKind, read_events)
 
 
 def _load_iterator_state(ckpt_dir: str, tag: str) -> Optional[dict]:
@@ -104,7 +105,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     journal_checked = 0
     journal_bad = 0
     if os.path.exists(jpath):
-        for ev in read_events(jpath, kind="data.batch"):
+        for ev in read_events(jpath, kind=EventKind.DATA_BATCH):
             step = ev.get("step")
             if step not in by_step:
                 continue

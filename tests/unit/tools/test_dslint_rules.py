@@ -1,0 +1,258 @@
+"""Per-rule fixture tests: every dslint rule fires on its known-bad
+snippet, stays quiet on the good variant, and honors inline suppression.
+The registries are injected so these tests pin the rules' behavior, not
+the current contents of events.py / fault_injection.py (the real-tree
+interaction is ``test_dslint_tree.py``)."""
+
+import textwrap
+
+from tools.dslint import Project, lint_source
+
+PROJECT = Project(
+    event_kind_map={"ROLLBACK": "rollback", "DATA_BATCH": "data.batch"},
+    fault_points={"ckpt.write", "data.next"},
+)
+
+CKPT = "deepspeed_tpu/runtime/checkpoint_engine/fixture.py"
+SUP = "deepspeed_tpu/runtime/supervision/fixture.py"
+DATA = "deepspeed_tpu/runtime/data_pipeline/fixture.py"
+COMM = "deepspeed_tpu/comm/comm.py"
+OTHER = "deepspeed_tpu/runtime/fixture.py"
+
+
+def lint(src, relpath):
+    return lint_source(textwrap.dedent(src), relpath, PROJECT)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------- swallowed-exception
+def test_swallowed_exception_fires_on_bare_pass():
+    findings = lint("""
+        try:
+            risky()
+        except OSError:
+            pass
+    """, CKPT)
+    assert rules_of(findings) == ["swallowed-exception"]
+    assert findings[0].line == 4  # the `except` line
+    assert findings[0].path == CKPT
+
+
+def test_swallowed_exception_fires_on_ellipsis_and_docstring_bodies():
+    findings = lint("""
+        try:
+            risky()
+        except Exception:
+            ...
+        try:
+            risky()
+        except Exception:
+            "why would anyone do this"
+    """, SUP)
+    assert rules_of(findings) == ["swallowed-exception"] * 2
+
+
+def test_swallowed_exception_quiet_when_handled():
+    findings = lint("""
+        try:
+            risky()
+        except OSError as e:
+            logger.warning(f"risky failed: {e}")
+    """, CKPT)
+    assert findings == []
+
+
+def test_swallowed_exception_suppressed_inline_and_previous_line():
+    findings = lint("""
+        try:
+            risky()
+        except OSError:  # dslint: disable=swallowed-exception — benign cleanup
+            pass
+        try:
+            risky()
+        # dslint: disable=swallowed-exception — reason on its own line
+        except ValueError:
+            pass
+    """, CKPT)
+    assert findings == []
+
+
+def test_swallowed_exception_out_of_scope_tree():
+    findings = lint("try:\n    f()\nexcept OSError:\n    pass\n",
+                    "somewhere/else.py")
+    assert findings == []
+
+
+# --------------------------------------------------------- non-atomic-write
+def test_non_atomic_write_fires_on_plain_write_modes():
+    findings = lint("""
+        open(path, "w").write(x)
+        with open(path, mode="wb") as f:
+            f.write(b)
+    """, CKPT)
+    assert rules_of(findings) == ["non-atomic-write"] * 2
+
+
+def test_non_atomic_write_allows_tmp_read_append_and_helpers():
+    findings = lint("""
+        open(tmp, "w")                 # tmp side of the atomic pattern
+        open(path + ".tmp", "wb")
+        open(self.tmp_path, "w")
+        open(path)                     # read
+        open(path, "a")                # append-only journal
+        def write_tmp(tmp_path):
+            with open(dest, "wb") as f:  # inside the storage helper
+                f.write(b)
+    """, SUP)
+    assert findings == []
+
+
+def test_non_atomic_write_scoped_to_durability_dirs():
+    findings = lint('open(path, "w")\n', OTHER)
+    assert findings == []
+
+
+def test_non_atomic_write_suppressible():
+    findings = lint(
+        'open(p, "wb")  # dslint: disable=non-atomic-write — test scratch\n',
+        CKPT)
+    assert findings == []
+
+
+# --------------------------------------------------- unregistered-journal-kind
+def test_unregistered_journal_kind_literal():
+    findings = lint('self.journal.emit("totally.new", a=1)\n', SUP)
+    assert rules_of(findings) == ["unregistered-journal-kind"]
+    assert "totally.new" in findings[0].message
+
+
+def test_unregistered_journal_kind_attribute():
+    findings = lint("j.emit(EventKind.NOPE, a=1)\n", OTHER)
+    assert rules_of(findings) == ["unregistered-journal-kind"]
+    assert "EventKind.NOPE" in findings[0].message
+
+
+def test_registered_journal_kinds_pass():
+    findings = lint("""
+        j.emit("rollback", step=1)
+        j.emit(EventKind.ROLLBACK, step=1)
+        self._emit(EventKind.DATA_BATCH, step=2)
+        self._emit(kind, **fields)        # dynamic pass-through wrapper
+    """, SUP)
+    assert findings == []
+
+
+def test_journal_kind_rule_skips_the_registry_module_itself():
+    findings = lint('j.emit("anything.goes")\n',
+                    "deepspeed_tpu/runtime/supervision/events.py")
+    assert findings == []
+
+
+# ---------------------------------------------------- unregistered-fault-point
+def test_unregistered_fault_point_qualified_call():
+    findings = lint("""
+        from deepspeed_tpu.utils import fault_injection
+        fault_injection.fire("ckpt.wriet", path=p)
+    """, CKPT)
+    assert rules_of(findings) == ["unregistered-fault-point"]
+    assert "ckpt.wriet" in findings[0].message
+
+
+def test_unregistered_fault_point_bare_import():
+    findings = lint("""
+        from deepspeed_tpu.utils.fault_injection import inject
+        with inject("bogus.point", fault):
+            run()
+    """, DATA)
+    assert rules_of(findings) == ["unregistered-fault-point"]
+
+
+def test_registered_fault_points_and_unrelated_fire_pass():
+    findings = lint("""
+        from deepspeed_tpu.utils import fault_injection
+        fault_injection.fire("ckpt.write", path=p)
+        fault_injection.fire(point, **ctx)   # dynamic dispatch loop
+        gun.fire("bullet")                   # not our registry
+    """, CKPT)
+    assert findings == []
+
+
+# -------------------------------------------------------- untimed-collective
+def test_untimed_collective_fires():
+    findings = lint("""
+        def all_gather_base(tensor, group=None):
+            return tensor
+    """, COMM)
+    assert rules_of(findings) == ["untimed-collective"]
+    assert "all_gather_base" in findings[0].message
+
+
+def test_timed_collective_and_non_collectives_pass():
+    findings = lint("""
+        def all_reduce(tensor, group=None):
+            return _timed("all_reduce", lambda: tensor, 0, 1)
+        def barrier(group=None):
+            with comm_guard("comm.barrier"):
+                return None
+        def get_rank(group=None):     # introspection: no guard required
+            return 0
+        def _helper(tensor):          # private: caller owns the guard
+            return tensor
+    """, COMM)
+    assert findings == []
+
+
+def test_untimed_collective_only_applies_to_comm_module():
+    findings = lint("def all_gather_base(t):\n    return t\n",
+                    "deepspeed_tpu/comm/collectives.py")
+    assert findings == []
+
+
+# -------------------------------------------------- step-path-nondeterminism
+def test_nondeterminism_fires_on_wall_clock_and_global_rng():
+    findings = lint("""
+        import time, random
+        import numpy as np
+        t = time.time()
+        random.shuffle(xs)
+        np.random.shuffle(x)
+    """, DATA)
+    assert rules_of(findings) == ["step-path-nondeterminism"] * 3
+    assert [f.line for f in findings] == [4, 5, 6]
+
+
+def test_nondeterminism_allows_seeded_generators():
+    findings = lint("""
+        import random
+        import numpy as np
+        rng = np.random.default_rng(seed + epoch)
+        r = random.Random(7)
+    """, DATA)
+    assert findings == []
+
+
+def test_nondeterminism_covers_verify_replay_but_not_other_scripts():
+    bad = "import time\nt = time.time()\n"
+    assert rules_of(lint(bad, "scripts/verify_replay.py")) == \
+        ["step-path-nondeterminism"]
+    assert lint(bad, "scripts/dump_run_events.py") == []
+
+
+# ----------------------------------------------------- framework behaviors
+def test_parse_error_is_a_finding_not_a_crash():
+    findings = lint("def broken(:\n", DATA)
+    assert rules_of(findings) == ["parse-error"]
+
+
+def test_findings_sorted_and_render_format():
+    findings = lint("""
+        import time
+        random.shuffle(xs)
+        t = time.time()
+    """, DATA)
+    assert [f.line for f in findings] == sorted(f.line for f in findings)
+    r = findings[0].render()
+    assert r.startswith(f"{DATA}:3: step-path-nondeterminism")

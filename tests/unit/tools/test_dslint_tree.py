@@ -1,0 +1,160 @@
+"""The tier-1 gate: dslint over the real tree must be clean against the
+committed baseline, the parsed registries must match what the subsystems
+actually ship, and the drift checks must catch registry/docs skew.  This is
+the test that fails when someone introduces an unregistered journal kind,
+an un-``_timed`` collective, a swallowed exception, or a non-atomic
+durability write."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.dslint import (BASELINE_PATH, Project, diff_against_baseline,
+                          format_baseline, lint_source, lint_tree,
+                          load_baseline)
+from tools.dslint.project_checks import run_project_checks
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_tree_is_clean_against_baseline():
+    findings = lint_tree(REPO)
+    baseline = load_baseline(os.path.join(REPO, BASELINE_PATH))
+    new, _stale = diff_against_baseline(findings, baseline)
+    assert new == [], "new dslint findings (fix or suppress with a " \
+        "reason; do NOT baseline new code):\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_baseline_has_no_stale_entries():
+    findings = lint_tree(REPO)
+    baseline = load_baseline(os.path.join(REPO, BASELINE_PATH))
+    _new, stale = diff_against_baseline(findings, baseline)
+    assert stale == 0, (f"{stale} baseline entr(y/ies) no longer match any "
+                        "finding — the violations were fixed; delete the "
+                        "lines (burn-down) so they can't mask new ones")
+
+
+def test_registries_parse_from_the_real_modules():
+    p = Project(REPO)
+    assert "rollback" in p.event_kinds
+    assert "data.batch" in p.event_kinds
+    assert len(p.event_kinds) >= 13
+    assert {"ckpt.write", "comm.barrier", "data.next"} <= p.fault_points
+    # every registered kind has a dump_run_events summary entry
+    assert p.event_kind_names <= p.summary_field_names | p.event_kinds
+    assert p.abort_kind_names <= p.event_kind_names
+
+
+def test_unregistered_journal_kind_is_caught_against_real_registry():
+    findings = lint_source('j.emit("my.new.kind", step=1)\n',
+                           "deepspeed_tpu/runtime/supervision/x.py",
+                           Project(REPO))
+    assert [f.rule for f in findings] == ["unregistered-journal-kind"]
+
+
+def test_untimed_collective_is_caught_on_the_real_comm_module():
+    # bypass _timed in the real comm.py source: every public collective
+    # must light up
+    with open(os.path.join(REPO, "deepspeed_tpu/comm/comm.py")) as f:
+        src = f.read().replace("_timed(", "_untimed(")
+    findings = lint_source(src, "deepspeed_tpu/comm/comm.py", Project(REPO))
+    names = {f.message.split("'")[1] for f in findings
+             if f.rule == "untimed-collective"}
+    assert {"barrier", "all_reduce", "all_gather", "reduce_scatter",
+            "broadcast", "all_to_all_single"} <= names
+
+
+def test_drift_check_catches_removed_registry_kind():
+    p = Project(REPO)
+    del p.event_kind_map["ROLLBACK"]
+    findings = run_project_checks(REPO, p)
+    # the docs still document 'rollback' → drift both ways
+    assert any(f.rule == "event-kind-drift" and "'rollback'" in f.message
+               for f in findings)
+
+
+def test_drift_check_catches_undocumented_new_kind():
+    p = Project(REPO)
+    p.event_kind_map["BRAND_NEW"] = "brand.new"
+    msgs = [f.message for f in run_project_checks(REPO, p)
+            if f.rule == "event-kind-drift"]
+    assert any("no SUMMARY_FIELDS entry" in m for m in msgs)
+    assert any("documented in neither" in m for m in msgs)
+
+
+def test_drift_checks_pass_on_the_real_tree():
+    assert run_project_checks(REPO, Project(REPO)) == []
+
+
+# ------------------------------------------------------------------- CLI
+@pytest.fixture(scope="module")
+def cli():
+    spec = importlib.util.spec_from_file_location(
+        "dslint_cli", os.path.join(REPO, "scripts", "dslint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_exits_zero_on_clean_tree(cli, capsys):
+    assert cli.main([]) == 0
+    assert "0 new" in capsys.readouterr().err
+
+
+def test_cli_exits_nonzero_when_baseline_missing_entries(cli, tmp_path,
+                                                         capsys):
+    empty = tmp_path / "empty_baseline.txt"
+    empty.write_text("# no grandfathered findings\n")
+    assert cli.main(["--baseline", str(empty)]) == 1
+    out = capsys.readouterr()
+    assert "swallowed-exception" in out.out
+
+
+def test_cli_update_baseline_is_deterministic(cli, tmp_path):
+    b1, b2 = tmp_path / "b1.txt", tmp_path / "b2.txt"
+    assert cli.main(["--update-baseline", "--baseline", str(b1)]) == 0
+    assert cli.main(["--update-baseline", "--baseline", str(b2)]) == 0
+    assert b1.read_text() == b2.read_text()
+    # a regenerated baseline is immediately clean and sorted
+    assert cli.main(["--baseline", str(b1)]) == 0
+    keys = [l for l in b1.read_text().splitlines()
+            if l and not l.startswith("#")]
+    assert keys == sorted(keys)
+    # and semantically identical to the committed one
+    committed = load_baseline(os.path.join(REPO, BASELINE_PATH))
+    assert load_baseline(str(b1)) == committed
+
+
+def test_cli_path_filter_restricts_scope(cli, capsys):
+    # the comm subtree is clean even with no baseline at all
+    assert cli.main(["--no-baseline", "deepspeed_tpu/comm"]) == 0
+
+
+def test_cli_runs_standalone_without_jax():
+    """The linter must work as a bare subprocess (pre-commit / CI) with no
+    jax and no deepspeed_tpu import."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "dslint.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new" in r.stderr
+
+
+def test_baseline_format_round_trip():
+    from collections import Counter
+    findings = lint_tree(REPO)
+    current = Counter(f.key for f in findings)
+    # the committed baseline covers exactly the current findings
+    assert load_baseline(os.path.join(REPO, BASELINE_PATH)) == current
+    # and format/load round-trips
+    loaded = Counter()
+    for line in format_baseline(findings).splitlines():
+        if line and not line.startswith("#"):
+            loaded[line] += 1
+    assert loaded == current

@@ -1,0 +1,1 @@
+# developer tooling (static analysis, codegen); nothing here ships at runtime

@@ -1,0 +1,17 @@
+"""dslint: project-native static analysis enforcing the durability,
+supervision, and data-determinism invariants.  See ``core`` for the
+framework, ``rules/`` for the catalog, ``project_checks`` for the
+registry/docs drift checks, and ``docs/static-analysis.md`` for the
+workflow (suppression, baseline burn-down, adding rules).
+
+CLI: ``python scripts/dslint.py`` (exit 1 on any finding not covered by
+``tools/dslint/baseline.txt``).
+"""
+
+from .core import (BASELINE_PATH, FileContext, Finding, Project,  # noqa: F401
+                   Rule, default_rules, diff_against_baseline,
+                   find_repo_root, format_baseline, iter_python_files,
+                   lint_file, lint_source, lint_tree, load_baseline,
+                   suppressed_rules_by_line)
+from .project_checks import run_project_checks  # noqa: F401
+from .rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,326 @@
+"""dslint core: the checker framework.
+
+The robustness stack's guarantees (verified checkpoints, watchdog-guarded
+collectives, journaled events, deterministic replay) rest on conventions —
+every journal kind registered, every collective `_timed`, every durability
+write atomic, no silently-swallowed exceptions — that review discipline
+alone does not keep true.  dslint machine-checks them: a small set of
+AST-based rules (`tools/dslint/rules/`), per-file suppression
+(``# dslint: disable=<rule>``), and a committed baseline
+(`tools/dslint/baseline.txt`) that grandfathers pre-existing findings for
+burn-down while failing on any *new* one.
+
+Pure stdlib (``ast``), and it never imports ``deepspeed_tpu`` — the
+registries rules check against (``EventKind``, ``FAULT_POINTS``) are parsed
+statically by :class:`Project`, so the linter runs anywhere Python runs,
+jax or no jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: directories linted by default, relative to the repo root (tests are the
+#: checkers' exercise ground and intentionally violate rules; tools/ is us)
+LINTED_DIRS = ("deepspeed_tpu", "scripts")
+
+#: default baseline location, relative to the repo root
+BASELINE_PATH = os.path.join("tools", "dslint", "baseline.txt")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at ``path:line``.
+
+    The baseline identity (:attr:`key`) deliberately omits the line number:
+    unrelated edits that shift lines must not invalidate baseline entries.
+    """
+
+    path: str   # repo-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}|{self.rule}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}  {self.message}"
+
+
+class Rule:
+    """Base class for a checker.
+
+    Subclasses set :attr:`id` (the kebab-case name used in findings and
+    ``disable=`` comments) and :attr:`description`, scope themselves with
+    :meth:`applies_to`, and yield :class:`Finding`s from :meth:`check`.
+    """
+
+    id: str = ""
+    description: str = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module,
+              ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may need about the file under check."""
+
+    relpath: str
+    source: str
+    project: "Project"
+
+    def finding(self, rule_id: str, node, message: str) -> Finding:
+        line = getattr(node, "lineno", node)
+        return Finding(self.relpath, int(line), rule_id, message)
+
+
+class Project:
+    """The project-level registries rules check call sites against.
+
+    Parsed statically (AST, never imported) from the single-source modules:
+
+    - ``deepspeed_tpu/runtime/supervision/events.py`` — ``EventKind``
+      (name → kind string), ``SUMMARY_FIELDS`` keys, ``ABORT_KINDS``
+    - ``deepspeed_tpu/utils/fault_injection.py`` — ``FAULT_POINTS``
+
+    Tests inject the registries directly instead of passing a root.
+    """
+
+    EVENTS_MODULE = "deepspeed_tpu/runtime/supervision/events.py"
+    FAULTS_MODULE = "deepspeed_tpu/utils/fault_injection.py"
+
+    def __init__(self, root: Optional[str] = None,
+                 event_kind_map: Optional[Dict[str, str]] = None,
+                 fault_points: Optional[Set[str]] = None,
+                 summary_field_names: Optional[Set[str]] = None,
+                 abort_kind_names: Optional[Set[str]] = None):
+        self.root = root
+        self.event_kind_map: Dict[str, str] = event_kind_map or {}
+        self.fault_points: Set[str] = set(fault_points or ())
+        self.summary_field_names: Set[str] = set(summary_field_names or ())
+        self.abort_kind_names: Set[str] = set(abort_kind_names or ())
+        self.summary_fields_line = 1
+        self.abort_kinds_line = 1
+        if root is not None:
+            if event_kind_map is None:
+                self._parse_events(os.path.join(root, self.EVENTS_MODULE))
+            if fault_points is None:
+                self._parse_faults(os.path.join(root, self.FAULTS_MODULE))
+
+    # ---------------------------------------------------------- registries
+    @property
+    def event_kinds(self) -> Set[str]:
+        return set(self.event_kind_map.values())
+
+    @property
+    def event_kind_names(self) -> Set[str]:
+        return set(self.event_kind_map.keys())
+
+    def _parse_events(self, path: str) -> None:
+        tree = _parse_path(path)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == "EventKind":
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and isinstance(stmt.value, ast.Constant)
+                            and isinstance(stmt.value.value, str)):
+                        self.event_kind_map[stmt.targets[0].id] = \
+                            stmt.value.value
+            elif isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
+                target = node.target if isinstance(node, ast.AnnAssign) \
+                    else (node.targets[0] if len(node.targets) == 1 else None)
+                if not isinstance(target, ast.Name) or node.value is None:
+                    continue
+                if target.id == "SUMMARY_FIELDS" \
+                        and isinstance(node.value, ast.Dict):
+                    self.summary_fields_line = node.lineno
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Attribute):
+                            self.summary_field_names.add(k.attr)
+                        elif isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            self.summary_field_names.add(k.value)
+                elif target.id == "ABORT_KINDS":
+                    self.abort_kinds_line = node.lineno
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Attribute):
+                            self.abort_kind_names.add(n.attr)
+
+    def _parse_faults(self, path: str) -> None:
+        tree = _parse_path(path)
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "FAULT_POINTS"):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, str):
+                        self.fault_points.add(n.value)
+
+
+def _parse_path(path: str) -> ast.Module:
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+# ------------------------------------------------------------- suppression
+_SUPPRESS_RE = re.compile(r"#\s*dslint:\s*disable=([A-Za-z0-9_,-]+)")
+
+
+def suppressed_rules_by_line(source: str) -> Dict[int, Set[str]]:
+    """``# dslint: disable=<rule>[,<rule>]`` on a line suppresses those
+    rules for that line; on a standalone comment line it also covers the
+    line below (so long statements can carry the reason above them)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out[i] = out.get(i, set()) | rules
+        if line.lstrip().startswith("#"):
+            out[i + 1] = out.get(i + 1, set()) | rules
+    return out
+
+
+# ------------------------------------------------------------------- lint
+def default_rules() -> List[Rule]:
+    from .rules import ALL_RULES
+    return [cls() for cls in ALL_RULES]
+
+
+def lint_source(source: str, relpath: str, project: Project,
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint one file's source; returns findings sorted, suppressions applied."""
+    rules = default_rules() if rules is None else rules
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding(relpath, int(e.lineno or 1), "parse-error",
+                        f"file does not parse: {e.msg}")]
+    ctx = FileContext(relpath=relpath, source=source, project=project)
+    findings: List[Finding] = []
+    for rule in rules:
+        if rule.applies_to(relpath):
+            findings.extend(rule.check(tree, ctx))
+    suppressed = suppressed_rules_by_line(source)
+    findings = [f for f in findings
+                if f.rule not in suppressed.get(f.line, ())
+                and "all" not in suppressed.get(f.line, ())]
+    return sorted(findings)
+
+
+def lint_file(path: str, relpath: str, project: Project,
+              rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), relpath, project, rules)
+
+
+def iter_python_files(root: str):
+    """Yield ``(abspath, relpath)`` for every linted file, deterministically
+    sorted so runs (and the baseline) are reproducible."""
+    for top in LINTED_DIRS:
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    ap = os.path.join(dirpath, fn)
+                    yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+
+
+def lint_tree(root: str, rules: Optional[Sequence[Rule]] = None,
+              project: Optional[Project] = None) -> List[Finding]:
+    """Lint the whole tree: every file under :data:`LINTED_DIRS` plus the
+    project-level drift checks (registry ↔ consumers ↔ docs)."""
+    project = project if project is not None else Project(root)
+    findings: List[Finding] = []
+    for ap, rel in iter_python_files(root):
+        findings.extend(lint_file(ap, rel, project, rules))
+    from .project_checks import run_project_checks
+    findings.extend(run_project_checks(root, project))
+    return sorted(findings)
+
+
+# --------------------------------------------------------------- baseline
+BASELINE_HEADER = """\
+# dslint baseline — pre-existing findings grandfathered for burn-down.
+# One `path|rule|message` key per line; a key repeated N times covers N
+# identical sites in that file.  Line numbers are deliberately absent so
+# unrelated edits don't invalidate entries.
+#
+# Regenerate (drops these comments): python scripts/dslint.py --update-baseline
+# Policy: REMOVE lines as violations are fixed.  Never add lines to silence
+# new code — fix it, or carry an inline `# dslint: disable=<rule>` with a
+# reason next to the offending line.
+"""
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline as a multiset of finding keys (comments/blank lines skipped)."""
+    counts: Counter = Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                counts[line] += 1
+    return counts
+
+
+def format_baseline(findings: Sequence[Finding]) -> str:
+    """Deterministic (sorted) baseline text for the given findings."""
+    keys = sorted(f.key for f in findings)
+    return BASELINE_HEADER + "".join(k + "\n" for k in keys)
+
+
+def diff_against_baseline(findings: Sequence[Finding], baseline: Counter
+                          ) -> Tuple[List[Finding], int]:
+    """Split current findings against the baseline multiset.
+
+    Returns ``(new_findings, stale_entries)`` — findings not covered by the
+    baseline, and the count of baseline entries no longer matching anything
+    (fixed violations whose lines should be deleted from the file).
+    """
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    for f in sorted(findings):
+        if remaining[f.key] > 0:
+            remaining[f.key] -= 1
+        else:
+            new.append(f)
+    return new, sum(remaining.values())
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    """Walk up from ``start`` (default: this file) to the directory holding
+    the linted packages."""
+    d = os.path.abspath(start or os.path.dirname(__file__))
+    while True:
+        if all(os.path.isdir(os.path.join(d, t)) for t in LINTED_DIRS):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise RuntimeError(
+                "could not locate the repo root (no directory containing "
+                f"{LINTED_DIRS!r} above {start!r})")
+        d = parent

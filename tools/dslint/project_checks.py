@@ -1,0 +1,112 @@
+"""Tree-level drift checks (rule id ``event-kind-drift``): the kind
+registry in ``supervision/events.py`` is only a single source of truth if
+its consumers actually stay in sync with it.  Checked:
+
+- every ``EventKind`` has a ``SUMMARY_FIELDS`` entry (so
+  ``dump_run_events`` can one-line it) and every ``SUMMARY_FIELDS`` /
+  ``ABORT_KINDS`` entry names a registered kind;
+- the journal-schema tables in ``docs/run-supervision.md`` and
+  ``docs/data-determinism.md`` (the markdown tables whose first header
+  cell is ``` `kind` ```) document every registered kind — exactly or via
+  a ``prefix.*`` wildcard row — and name no kind that isn't registered.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List, Tuple
+
+from .core import Finding, Project
+
+RULE_ID = "event-kind-drift"
+
+KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md")
+
+_CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
+
+
+def run_project_checks(root: str, project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    events_rel = Project.EVENTS_MODULE
+    registered = project.event_kind_map
+
+    # --- registry self-consistency -------------------------------------
+    for name, value in sorted(registered.items()):
+        if name not in project.summary_field_names \
+                and value not in project.summary_field_names:
+            findings.append(Finding(
+                events_rel, project.summary_fields_line, RULE_ID,
+                f"event kind '{value}' (EventKind.{name}) has no "
+                "SUMMARY_FIELDS entry — dump_run_events cannot summarize "
+                "it"))
+    names = set(registered)
+    for extra in sorted(project.summary_field_names - names
+                        - set(registered.values())):
+        findings.append(Finding(
+            events_rel, project.summary_fields_line, RULE_ID,
+            f"SUMMARY_FIELDS names '{extra}', which is not a registered "
+            "EventKind"))
+    for extra in sorted(project.abort_kind_names - names):
+        findings.append(Finding(
+            events_rel, project.abort_kinds_line, RULE_ID,
+            f"ABORT_KINDS names EventKind.{extra}, which is not defined"))
+
+    # --- docs tables ----------------------------------------------------
+    documented: List[Tuple[str, str, int]] = []  # (kind-or-wildcard, doc, line)
+    for rel in KIND_DOCS:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            findings.append(Finding(rel, 1, RULE_ID,
+                                    "journal-kind doc is missing"))
+            continue
+        with open(path, encoding="utf-8") as f:
+            documented.extend((k, rel, ln)
+                              for k, ln in _kind_table_entries(f.read()))
+
+    kinds = set(registered.values())
+    doc_tokens = {k for k, _, _ in documented}
+    for value in sorted(kinds):
+        if not _is_documented(value, doc_tokens):
+            findings.append(Finding(
+                events_rel, 1, RULE_ID,
+                f"event kind '{value}' is registered but documented in "
+                f"neither journal-kind table ({', '.join(KIND_DOCS)})"))
+    for token, rel, line in documented:
+        if token in kinds:
+            continue
+        if token.endswith(".*") \
+                and any(k.startswith(token[:-1]) for k in kinds):
+            continue
+        findings.append(Finding(
+            rel, line, RULE_ID,
+            f"docs table names journal kind '{token}', which is not "
+            "registered in supervision/events.py::EventKind"))
+    return findings
+
+
+def _is_documented(kind: str, doc_tokens) -> bool:
+    if kind in doc_tokens:
+        return True
+    return any(t.endswith(".*") and kind.startswith(t[:-1])
+               for t in doc_tokens)
+
+
+def _kind_table_entries(md: str) -> Iterable[Tuple[str, int]]:
+    """Yield ``(token, line)`` for the first cell of every row of every
+    markdown table whose first header cell is ``` `kind` ```."""
+    in_table = False
+    for i, raw in enumerate(md.splitlines(), 1):
+        line = raw.strip()
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        first = line.split("|")[1].strip() if line.count("|") >= 2 else ""
+        if first == "`kind`":
+            in_table = True
+            continue
+        if not in_table:
+            continue
+        m = _CELL_KIND.match(first)
+        if m:
+            yield m.group(1), i

@@ -1,0 +1,21 @@
+"""The rule catalog.  To add a rule: write a module here subclassing
+:class:`tools.dslint.core.Rule`, append the class to :data:`ALL_RULES`,
+give it a fixture test in ``tests/unit/tools/test_dslint_rules.py``, and a
+row in ``docs/static-analysis.md``.
+"""
+
+from .swallowed_exception import SwallowedException  # noqa: F401
+from .non_atomic_write import NonAtomicWrite  # noqa: F401
+from .journal_kinds import UnregisteredJournalKind  # noqa: F401
+from .fault_points import UnregisteredFaultPoint  # noqa: F401
+from .untimed_collective import UntimedCollective  # noqa: F401
+from .nondeterminism import StepPathNondeterminism  # noqa: F401
+
+ALL_RULES = (
+    SwallowedException,
+    NonAtomicWrite,
+    UnregisteredJournalKind,
+    UnregisteredFaultPoint,
+    UntimedCollective,
+    StepPathNondeterminism,
+)

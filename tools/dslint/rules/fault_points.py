@@ -1,0 +1,71 @@
+"""unregistered-fault-point: every chaos hook compiled into production code
+(``fault_injection.fire("<point>")``) and every fault installation
+(``install``/``inject``) must name a point registered in
+``deepspeed_tpu/utils/fault_injection.py::FAULT_POINTS``.  A typo'd point
+is worse than a missing one — the test installs a fault that nothing ever
+fires, and the chaos coverage silently becomes a no-op.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from ..core import FileContext, Finding, Rule
+
+POINT_FUNCS = {"fire", "install", "inject", "clear", "remove"}
+
+
+class UnregisteredFaultPoint(Rule):
+    id = "unregistered-fault-point"
+    description = ("fault points must be registered in "
+                   "utils/fault_injection.py::FAULT_POINTS")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/")) \
+            and not relpath.endswith("utils/fault_injection.py")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        registered = ctx.project.fault_points
+        bare_names = _names_imported_from_fault_injection(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr not in POINT_FUNCS \
+                        or not _base_is_fault_injection(func.value):
+                    continue
+            elif isinstance(func, ast.Name):
+                if func.id not in bare_names:
+                    continue
+            else:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and arg.value not in registered:
+                yield ctx.finding(
+                    self.id, node,
+                    f"fault point '{arg.value}' is not registered in "
+                    "utils/fault_injection.py::FAULT_POINTS — register it "
+                    "(and document it in the module table) first")
+
+
+def _base_is_fault_injection(node: ast.expr) -> bool:
+    """Matches ``fault_injection.fire`` and any dotted tail ending there."""
+    if isinstance(node, ast.Name):
+        return node.id == "fault_injection"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "fault_injection"
+    return False
+
+
+def _names_imported_from_fault_injection(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("fault_injection"):
+            out |= {a.asname or a.name for a in node.names
+                    if a.name in POINT_FUNCS}
+    return out

@@ -1,0 +1,58 @@
+"""unregistered-journal-kind: every event journaled anywhere in the tree
+must carry a kind registered in
+``deepspeed_tpu/runtime/supervision/events.py::EventKind`` — the single
+source of truth that ``dump_run_events`` and the docs tables are kept in
+sync with (see ``project_checks``).  An ad-hoc string at an emit site is a
+kind the black-box tooling can't summarize and the docs don't explain.
+
+Checked call shapes: ``<journal>.emit(<kind>, ...)`` and the subsystems'
+``self._emit(<kind>, ...)`` wrappers, where ``<kind>`` is a string literal
+(must be a registered value) or an ``EventKind.X`` attribute (``X`` must be
+a registered name).  Dynamically-computed kinds pass through uninspected —
+the wrapper functions forwarding a ``kind`` parameter are exactly that.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+
+EMIT_NAMES = {"emit", "_emit"}
+
+
+class UnregisteredJournalKind(Rule):
+    id = "unregistered-journal-kind"
+    description = ("journal kinds must be registered in "
+                   "supervision/events.py::EventKind")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/")) \
+            and not relpath.endswith("supervision/events.py")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        kinds = ctx.project.event_kinds
+        names = ctx.project.event_kind_names
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in EMIT_NAMES and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value not in kinds:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"journal kind '{arg.value}' is not registered in "
+                        "supervision/events.py::EventKind — register it "
+                        "(and its SUMMARY_FIELDS/docs rows) first")
+            elif isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id == "EventKind":
+                if arg.attr not in names:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"EventKind.{arg.attr} is not defined in "
+                        "supervision/events.py::EventKind")

@@ -1,0 +1,95 @@
+"""non-atomic-write: a plain ``open(path, "w"/"wb")`` in a durability path
+can leave a torn half-file on crash that a reader then trusts.  Everything
+the checkpoint/journal subsystems persist must go through the tmp +
+``os.replace`` pattern (``checkpoint_engine.storage.atomic_write_*`` or a
+local ``<path>.tmp`` + replace), so readers never observe a partial write.
+
+A write is exempt when it demonstrably targets the tmp side of that
+pattern: the path expression is a ``tmp``-named variable/attribute, ends in
+a literal ``".tmp"``, or the enclosing function is one of the storage
+helpers (``write_tmp`` / ``_atomic_attempt``).  Append mode ("a") is
+allowed — the append-only event journal is torn-line-tolerant by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Finding, Rule
+
+SCOPES = (
+    "deepspeed_tpu/runtime/checkpoint_engine/",
+    "deepspeed_tpu/runtime/supervision/",
+    "deepspeed_tpu/runtime/data_pipeline/",
+)
+
+EXEMPT_FUNCS = {"write_tmp", "_atomic_attempt"}
+
+
+class NonAtomicWrite(Rule):
+    id = "non-atomic-write"
+    description = ("durability-path writes must be atomic: tmp + os.replace "
+                   "(storage.atomic_write_*), never a bare open(.., 'w')")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPES)
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._walk(tree, [], ctx, findings)
+        return findings
+
+    def _walk(self, node: ast.AST, func_stack: List[str], ctx: FileContext,
+              findings: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_stack.append(node.name)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, func_stack, ctx, findings)
+            func_stack.pop()
+            return
+        if isinstance(node, ast.Call) and _is_plain_write_open(node) \
+                and not (set(func_stack) & EXEMPT_FUNCS):
+            findings.append(ctx.finding(
+                self.id, node,
+                "non-atomic write in a durability path — route through "
+                "checkpoint_engine.storage.atomic_write_* (or write to a "
+                "'.tmp' path and os.replace) so a crash never publishes a "
+                "torn file"))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, func_stack, ctx, findings)
+
+
+def _is_plain_write_open(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            mode = kw.value.value
+    if mode is None or not (set(mode) & {"w", "x"}):
+        return False  # read or append: fine
+    return not (call.args and _targets_tmp(call.args[0]))
+
+
+def _targets_tmp(node: ast.expr) -> bool:
+    """Does the path expression visibly target the tmp side of the atomic
+    pattern?"""
+    if isinstance(node, ast.Name):
+        return "tmp" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "tmp" in node.attr.lower()
+    if isinstance(node, ast.BinOp):
+        right = node.right
+        return (isinstance(right, ast.Constant)
+                and isinstance(right.value, str)
+                and right.value.endswith(".tmp")) or _targets_tmp(node.left)
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.Constant) and isinstance(v.value, str)
+                   and ".tmp" in v.value for v in node.values)
+    return False

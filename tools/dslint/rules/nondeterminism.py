@@ -1,0 +1,82 @@
+"""step-path-nondeterminism: the data pipeline's whole contract is that a
+batch sequence is a pure function of the checkpointed position state —
+that's what makes kill/resume bitwise-replayable and rollback quarantine
+windows exact.  Wall-clock reads and *unseeded* global RNG calls in that
+path break the contract invisibly (the replay differs only when it
+matters).  Allowed: explicitly-seeded generators (``np.random.default_rng``
+/ ``random.Random(seed)``) — the shuffle-by-``(seed, epoch)`` construction
+depends on them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..core import FileContext, Finding, Rule
+
+SCOPES = ("deepspeed_tpu/runtime/data_pipeline/",)
+#: the offline replay auditor must be exactly as deterministic as the loader
+EXTRA_FILES = ("scripts/verify_replay.py",)
+
+WALL_CLOCK = {
+    "time.time", "time.time_ns", "datetime.now", "datetime.utcnow",
+    "datetime.today", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+}
+
+#: random-module attributes that construct a seedable generator (allowed)
+RANDOM_OK = {"Random"}
+
+#: np.random attributes that construct a seedable generator (allowed)
+NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                "PCG64DXSM", "Philox", "MT19937", "BitGenerator"}
+
+
+class StepPathNondeterminism(Rule):
+    id = "step-path-nondeterminism"
+    description = ("no wall-clock or unseeded global RNG in the data/replay "
+                   "path — replays must be pure functions of saved state")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPES) or relpath in EXTRA_FILES
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func)
+            if dotted is None:
+                continue
+            if dotted in WALL_CLOCK:
+                yield ctx.finding(
+                    self.id, node,
+                    f"wall-clock read ({dotted}) in the deterministic step "
+                    "path — derive it from checkpointed state or journal "
+                    "it outside the data plane")
+                continue
+            parts = dotted.split(".")
+            if parts[0] == "random" and len(parts) == 2 \
+                    and parts[1] not in RANDOM_OK:
+                yield ctx.finding(
+                    self.id, node,
+                    f"unseeded global RNG ({dotted}) in the deterministic "
+                    "step path — use random.Random(seed) or "
+                    "np.random.default_rng(seed) derived from loader state")
+            elif len(parts) >= 3 and parts[-3] in ("np", "numpy") \
+                    and parts[-2] == "random" and parts[-1] not in NP_RANDOM_OK:
+                yield ctx.finding(
+                    self.id, node,
+                    f"global numpy RNG ({dotted}) in the deterministic step "
+                    "path — use np.random.default_rng(seed) derived from "
+                    "loader state")
+
+
+def _dotted_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None

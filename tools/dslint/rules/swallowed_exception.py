@@ -1,0 +1,47 @@
+"""swallowed-exception: an ``except`` body that is only ``pass`` eats the
+failure.  In the durability/supervision/data paths that silence is exactly
+the failure mode the whole stack exists to prevent — a checkpoint write
+error or a dead heartbeat that nobody journals never gets recovered from.
+Handlers must journal, log, or re-raise; genuinely-benign swallows carry an
+inline ``# dslint: disable=swallowed-exception — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+
+
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    description = ("`except:` body is only `pass` — the failure must be "
+                   "journaled, logged, or re-raised")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/"))
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) \
+                    and _body_is_noop(node.body):
+                yield ctx.finding(
+                    self.id, node,
+                    "except block swallows the exception (body is only "
+                    "`pass`) — journal/log it, or disable with a reason")
+
+
+def _body_is_noop(body) -> bool:
+    return all(_stmt_is_noop(s) for s in body)
+
+
+def _stmt_is_noop(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, ast.Pass):
+        return True
+    # a bare docstring or `...` is just as silent as `pass`
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and (stmt.value.value is Ellipsis
+                 or isinstance(stmt.value.value, str)))

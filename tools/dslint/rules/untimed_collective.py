@@ -1,0 +1,62 @@
+"""untimed-collective: every public host-plane collective in
+``deepspeed_tpu/comm/comm.py`` must route through ``_timed`` (which arms
+the supervision watchdog via ``comm_guard`` and feeds the comms logger).
+A collective that bypasses it is a hang the watchdog cannot see — exactly
+the silently-burning-slice failure the supervision subsystem exists to
+bound.
+
+Collectives are recognized by the torch.distributed naming convention the
+facade keeps (``all_*``, ``reduce_*``, ``broadcast``, ``barrier``,
+``gather``/``scatter``, ``*_to_all*``, ``send``/``recv``); bootstrap and
+introspection helpers (``init_distributed``, ``get_rank``, ...) don't
+match and aren't required to arm anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+
+COLLECTIVE_NAME = re.compile(
+    r"^(barrier|broadcast|send|recv|gather|scatter|reduce"
+    r"|all_\w+|reduce_\w+|\w*_to_all\w*)$")
+
+GUARDS = {"_timed", "comm_guard"}
+
+
+class UntimedCollective(Rule):
+    id = "untimed-collective"
+    description = ("public collectives in comm/comm.py must route through "
+                   "_timed/comm_guard so the watchdog covers them")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath == "deepspeed_tpu/comm/comm.py"
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("_") \
+                    or not COLLECTIVE_NAME.match(node.name):
+                continue
+            if not _routes_through_guard(node):
+                yield ctx.finding(
+                    self.id, node,
+                    f"public collective '{node.name}' never calls "
+                    "_timed/comm_guard — a hang in it is invisible to the "
+                    "step watchdog (and to the comms logger)")
+
+
+def _routes_through_guard(func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else \
+                (f.attr if isinstance(f, ast.Attribute) else None)
+            if name in GUARDS:
+                return True
+    return False
