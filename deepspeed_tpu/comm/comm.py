@@ -42,8 +42,8 @@ from ..parallel import mesh as mesh_lib
 __all__ = [
     "ReduceOp", "init_distributed", "is_initialized", "get_rank", "get_world_size",
     "get_local_rank", "barrier", "all_reduce", "all_gather", "reduce_scatter",
-    "broadcast", "all_to_all_single", "comms_logger", "log_summary",
-    "configure", "destroy_process_group",
+    "broadcast", "all_to_all_single", "agree_min_int", "comms_logger",
+    "log_summary", "configure", "destroy_process_group",
 ]
 
 
@@ -161,6 +161,32 @@ def barrier(group=None) -> None:
 
     _timed("barrier", compute, 0, n)
     return None
+
+
+def agree_min_int(value: int, group=None) -> int:
+    """Host-plane min-agreement over one integer per process.
+
+    The resume-consensus primitive (``checkpoint_engine/commit.py``): every
+    host proposes a step number and the group agrees on the minimum.  Runs
+    as a timed collective under the watchdog's ``comm_guard`` like every
+    other op here, so a host that never answers becomes a stack-dumped
+    watchdog expiry instead of a silent wedge.  Single-host (no live
+    ``jax.distributed`` client) trivially returns ``value``.
+    """
+    n = _group_size(_resolve_group(group))
+
+    def compute():
+        # same injection point as barrier(): a HangFor here models the
+        # peer that never proposes, exactly where it would block for real
+        fault_injection.fire("comm.barrier", group=group)
+        if _MULTIHOST:
+            from jax.experimental import multihost_utils
+            proposals = multihost_utils.process_allgather(
+                jnp.asarray(int(value), jnp.int64))
+            return int(jnp.min(proposals))
+        return int(value)
+
+    return _timed("agree_min_int", compute, 8, n)
 
 
 # --------------------------------------------------------------------------

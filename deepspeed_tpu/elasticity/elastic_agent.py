@@ -30,9 +30,11 @@ import signal
 from contextlib import nullcontext
 from typing import Any, Dict, Iterable, Optional, Union
 
+from ..comm import comm as dist
 from ..runtime.supervision import (DeepSpeedSupervisionConfig, EventJournal,
-                                   HeartbeatWriter, RunSupervisor,
-                                   StepWatchdog, set_global_watchdog)
+                                   HeartbeatMonitor, HeartbeatWriter,
+                                   RunSupervisor, StepWatchdog,
+                                   set_global_watchdog)
 from ..runtime.supervision.events import EventKind
 from ..utils import fault_injection
 from ..utils.logging import log_dist, logger
@@ -88,6 +90,7 @@ class ElasticTrainRunner:
             ensure_immutable_elastic_config(ds_config["elasticity"])
 
         self._configure_supervision(supervision, ds_config)
+        self._attach_commit_context(int(getattr(self.engine, "global_rank", 0)))
 
     # -------------------------------------------------------- supervision
     def _configure_supervision(self, supervision, ds_config) -> None:
@@ -119,6 +122,37 @@ class ElasticTrainRunner:
             self.heartbeat = HeartbeatWriter(hb_dir, rank,
                                              interval_s=hb.interval_s,
                                              journal=self.journal)
+
+    def _attach_commit_context(self, rank: int) -> None:
+        """Wire the multi-host commit protocol into the engine: the commit
+        barrier gets this runner's journal and (on the coordinator) the
+        heartbeat monitor, so ranks already classified dead fail the
+        barrier immediately instead of burning the full deadline, and
+        resume consensus is journaled next to every other run decision."""
+        self.commit_ctx = None
+        if not hasattr(self.engine, "set_commit_context"):
+            return
+        cfg = getattr(getattr(self.engine, "_config", None),
+                      "checkpoint_config", None)
+        commit_cfg = getattr(cfg, "commit_config", None)
+        if commit_cfg is None or not commit_cfg.enabled:
+            return
+        from ..runtime.checkpoint_engine.commit import (
+            CollectiveConsensusChannel, CommitContext)
+        world = dist.get_world_size()
+        monitor = None
+        if rank == 0 and self.supervision is not None:
+            hb = self.supervision.heartbeat_config
+            if hb.enabled:
+                hb_dir = hb.dir or os.path.join(self.save_dir, "heartbeats")
+                monitor = HeartbeatMonitor(hb_dir, gap_s=hb.gap_s,
+                                           journal=self.journal,
+                                           expected_ranks=world)
+        self.commit_ctx = CommitContext(
+            world_size=world, rank=rank, config=commit_cfg,
+            journal=self.journal, heartbeat=monitor,
+            channel=CollectiveConsensusChannel() if world > 1 else None)
+        self.engine.set_commit_context(self.commit_ctx)
 
     def _step_guard(self):
         if self.watchdog is not None and \
@@ -161,12 +195,23 @@ class ElasticTrainRunner:
     # ------------------------------------------------------------------ run
     def resume(self) -> int:
         """Load the newest VERIFIED checkpoint if any; returns the step
-        resumed at.  The engine's load walks the verified-fallback chain, so
-        a corrupt newest tag or a stale ``latest`` marker resumes from the
-        newest surviving tag; only an actual load is logged/counted as a
-        resume — otherwise warn and start fresh."""
+        resumed at.  The engine's load walks the verified-fallback chain
+        (and, multi-host, runs the resume consensus), so a corrupt newest
+        tag or a stale ``latest`` marker resumes from the newest surviving
+        tag; only an actual load is logged/counted as a resume — otherwise
+        warn and start fresh.  The coordinator first quarantines torn tags
+        (shard files without a commit marker) so the fallback chain never
+        trips over a half-written save from the previous incarnation."""
         if not os.path.isdir(self.save_dir):
             return self.engine.global_steps
+        ctx = getattr(self, "commit_ctx", None)
+        if ctx is not None and ctx.is_coordinator and ctx.config.sweep_on_start:
+            from ..runtime.checkpoint_engine.commit import sweep_torn_tags
+            sweep_torn_tags(self.save_dir, journal=self.journal)
+            if getattr(ctx.channel, "sweep_rounds", None) is not None:
+                # stale consensus rounds from the previous incarnation
+                # must not outvote this one
+                ctx.channel.sweep_rounds()
         loaded, _ = self.engine.load_checkpoint(self.save_dir)
         if loaded is not None:
             log_dist(f"[elastic] resumed from step {self.engine.global_steps}",

@@ -5,12 +5,20 @@
   (with the verified-fallback resume chain)
 - ``async_checkpoint_engine``: background writers + deferred atomic publish
 - ``integrity``: per-tag manifests, verification, retention
+- ``commit``: multi-host two-phase commit, resume consensus, torn-tag
+  quarantine (``docs/checkpoint-durability.md``)
 - ``storage``: retrying atomic writers (the only place bytes hit disk)
 - ``config``: the validated ``"checkpoint"`` config section
 """
 
 from .checkpoint_engine import CheckpointEngine  # noqa: F401
-from .config import CheckpointRetryConfig, DeepSpeedCheckpointConfig  # noqa: F401
+from .commit import (CheckpointCommitError, CommitContext,  # noqa: F401
+                     FileConsensusChannel, ResumeConsensusError,
+                     agree_resume_tag, commit_status, is_committed, is_torn,
+                     publish_commit, read_commit, sweep_torn_tags,
+                     wait_for_ready, write_rank_manifest)
+from .config import (CheckpointCommitConfig, CheckpointRetryConfig,  # noqa: F401
+                     DeepSpeedCheckpointConfig)
 from .integrity import (CheckpointCorruptionError, list_tags,  # noqa: F401
                         newest_verified_tag, prune_checkpoints, verify_tag,
                         write_manifest)

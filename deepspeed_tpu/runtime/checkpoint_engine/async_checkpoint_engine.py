@@ -59,13 +59,22 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
         A failed write logs loudly, skips publication, and is re-raised at
         the next ``wait()``/``commit()``/``load()`` — a tag whose bytes
-        never landed must not look saved."""
+        never landed must not look saved.  ``publish`` may itself decline
+        (returning falsy) when the multi-host commit barrier expired and
+        the tag was abandoned — that is graceful degradation, not an error:
+        training continues on the previous committed tag."""
         def chain(pending):
             try:
                 for f in pending:
                     f.result()
-                publish()
-                logger.info(f"[async-ckpt] tag {tag} committed")
+                published = publish()
+                if published is False:
+                    logger.warning(
+                        f"[async-ckpt] tag {tag} ABANDONED by the commit "
+                        "protocol (barrier expiry or vote verification "
+                        "failure) — the latest marker was not moved")
+                else:
+                    logger.info(f"[async-ckpt] tag {tag} committed")
             except BaseException as e:  # surfaced on the next wait()
                 self._last_error = e
                 logger.error(f"[async-ckpt] writing tag {tag} FAILED — the "

@@ -14,6 +14,10 @@ one validated section covering the durability subsystem:
         "writers": 2,
         "retries": {"max_attempts": 3, "backoff_base": 0.05,
                     "backoff_max": 2.0, "jitter": 0.25},
+        "commit": {"enabled": true, "barrier_deadline_s": 300.0,
+                   "barrier_poll_s": 0.02, "barrier_backoff_max_s": 1.0,
+                   "consensus_deadline_s": 120.0, "sweep_on_start": true,
+                   "sweep_min_age_s": 0.0},
         "tag_validation": "Warn",
         "load_universal_checkpoint": false
     }}
@@ -59,6 +63,41 @@ class CheckpointRetryConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class CheckpointCommitConfig(DeepSpeedConfigModel):
+    """Multi-host two-phase commit + resume consensus (``commit.py``).
+
+    Every rank votes with an atomic ``rank<N>.ready`` manifest; the
+    coordinator polls the commit barrier (deadline + exponential backoff
+    from ``barrier_poll_s`` up to ``barrier_backoff_max_s``), verifies the
+    votes, and publishes ``commit.json`` before the ``latest`` marker may
+    move.  Resume runs a min-over-proposals consensus bounded by
+    ``consensus_deadline_s``.  ``sweep_on_start`` quarantines torn tags at
+    startup; ``sweep_min_age_s`` is the grace window retention-time sweeps
+    give a sibling writer's in-flight tag.
+    """
+
+    enabled: bool = True
+    barrier_deadline_s: float = 300.0
+    barrier_poll_s: float = 0.02
+    barrier_backoff_max_s: float = 1.0
+    consensus_deadline_s: float = 120.0
+    sweep_on_start: bool = True
+    sweep_min_age_s: float = 0.0
+
+    def __post_init__(self):
+        for name in ("barrier_deadline_s", "barrier_poll_s",
+                     "barrier_backoff_max_s", "consensus_deadline_s"):
+            if float(getattr(self, name)) <= 0:
+                raise ValueError(
+                    f"checkpoint commit.{name} must be > 0, got "
+                    f"{getattr(self, name)}")
+        if self.sweep_min_age_s < 0:
+            raise ValueError(
+                f"checkpoint commit.sweep_min_age_s must be >= 0, got "
+                f"{self.sweep_min_age_s}")
+
+
+@dataclasses.dataclass
 class DeepSpeedCheckpointConfig(DeepSpeedConfigModel):
     """Durability + backend selection for the checkpoint path.
 
@@ -82,16 +121,23 @@ class DeepSpeedCheckpointConfig(DeepSpeedConfigModel):
     keep_last: Optional[int] = None
     #: raw "retries" subsection (typed view: ``retry``)
     retries: Optional[Dict] = None
+    #: raw "commit" subsection (typed view: ``commit_config``) — the
+    #: multi-host two-phase commit + resume consensus protocol
+    commit: Optional[Dict] = None
     #: reference parity knobs (parsed in runtime/config.py as well)
     tag_validation: str = "Warn"
     load_universal_checkpoint: bool = False
 
     retry: CheckpointRetryConfig = dataclasses.field(
         default_factory=CheckpointRetryConfig)
+    commit_config: CheckpointCommitConfig = dataclasses.field(
+        default_factory=CheckpointCommitConfig)
 
     def __post_init__(self):
         if isinstance(self.retries, dict):
             self.retry = CheckpointRetryConfig.from_dict(self.retries)
+        if isinstance(self.commit, dict):
+            self.commit_config = CheckpointCommitConfig.from_dict(self.commit)
         if self.keep_last is not None:
             self.keep_last = int(self.keep_last)
             if self.keep_last <= 0:

@@ -57,8 +57,17 @@ def _is_tag_dir(load_dir: str, name: str) -> bool:
     d = os.path.join(load_dir, name)
     if not os.path.isdir(d):
         return False
-    return (os.path.exists(os.path.join(d, "model_states.npz"))
-            or os.path.exists(os.path.join(d, MANIFEST)))
+    if os.path.exists(os.path.join(d, "model_states.npz")) \
+            or os.path.exists(os.path.join(d, MANIFEST)) \
+            or os.path.exists(os.path.join(d, "commit.json")):
+        return True
+    # a shard-only dir a non-coordinator writer left behind (commit
+    # protocol, rank<N>.ready votes) is still a tag — the fallback walk
+    # must see it to reject it, and the torn-tag sweep to quarantine it
+    try:
+        return any(n.endswith(".ready") for n in os.listdir(d))
+    except OSError:
+        return False
 
 
 def read_manifest(load_dir: str, tag: str) -> Optional[Dict[str, Any]]:

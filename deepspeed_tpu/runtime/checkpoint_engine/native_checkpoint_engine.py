@@ -135,13 +135,36 @@ def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
                            save_latest: bool = True,
                            engine: Optional[CheckpointEngine] = None,
                            config: Optional[DeepSpeedCheckpointConfig] = None,
-                           manifest_meta: Optional[Dict[str, Any]] = None) -> None:
+                           manifest_meta: Optional[Dict[str, Any]] = None,
+                           commit_ctx=None) -> None:
+    """Persist an engine state tree as ``<save_dir>/<tag>``.
+
+    With a :class:`~.commit.CommitContext` the multi-host two-phase commit
+    runs: every rank votes ``rank<N>.ready`` after its shards land, and a
+    non-coordinator rank returns right after voting (the global files and
+    publication are the coordinator's).  The coordinator waits the commit
+    barrier, verifies every vote, publishes ``commit.json``, and only then
+    moves the ``latest`` marker; barrier expiry abandons the tag gracefully
+    (journaled ``ckpt.commit_timeout``) instead of wedging the step loop.
+    Without a context the single-writer path is unchanged (back-compat).
+    """
     if config is None:
         config = getattr(engine, "ckpt_config", None) or \
             DeepSpeedCheckpointConfig()
+    cctx = commit_ctx
+    if cctx is not None and not cctx.config.enabled:
+        cctx = None
     eng = engine or NativeCheckpointEngine(config)
     ckpt_dir = os.path.join(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
+    if cctx is not None and not cctx.is_coordinator:
+        # phase 1 only: this rank's shard files were written (atomically)
+        # by the engine before this call — hash them and vote ready.  The
+        # coordinator owns the global files, the barrier, and publication.
+        from .commit import write_rank_manifest
+        write_rank_manifest(save_dir, tag, cctx.rank, cctx.world_size,
+                            retry=config.retry)
+        return
     model_state = {"params": state["params"], "scale": state["scale"]}
     # grad_acc is saved so a checkpoint taken mid-accumulation-window resumes
     # with its partial gradients instead of silently dropping them
@@ -154,14 +177,39 @@ def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
                       json.dumps(client_state, default=str), config.retry)
 
     def publish():
-        # manifest first (it hashes every file of the tag, so all writes
-        # must have landed), then the latest marker, then retention — the
-        # marker never advertises an unhashed tag and retention never runs
-        # before the new tag is fully durable
+        # commit barrier first (every rank's shards must be voted whole),
+        # then the manifest (it hashes every file of the tag, ready votes
+        # included), then the commit marker, then the latest marker, then
+        # retention — the marker never advertises an uncommitted tag and
+        # retention never runs before the new tag is fully durable
+        step = client_state.get("global_steps")
+        if cctx is not None:
+            from .commit import (CheckpointCommitError, publish_commit,
+                                 sweep_torn_tags, wait_for_ready,
+                                 write_rank_manifest)
+            write_rank_manifest(save_dir, tag, cctx.rank, cctx.world_size,
+                                retry=config.retry)
+            ok, _missing, _dead = wait_for_ready(
+                save_dir, tag, cctx.world_size, config=cctx.config,
+                heartbeat=cctx.heartbeat, journal=cctx.journal)
+            if not ok:
+                # graceful degradation: the tag is abandoned (it will be
+                # swept as torn at the next startup/retention pass), the
+                # latest marker stays on the previous committed tag, and
+                # training continues
+                return False
         if config.integrity:
-            meta = {"step": client_state.get("global_steps")}
+            meta = {"step": step}
             meta.update(manifest_meta or {})
             write_manifest(save_dir, tag, meta, config.retry)
+        if cctx is not None:
+            try:
+                publish_commit(save_dir, tag, cctx.world_size,
+                               meta={"step": step}, retry=config.retry,
+                               journal=cctx.journal)
+            except CheckpointCommitError as e:
+                logger.error(f"[ckpt-commit] tag {tag} NOT committed: {e}")
+                return False
         if save_latest:
             fault_injection.fire("ckpt.publish", tag=tag)
             atomic_write_text(os.path.join(save_dir, "latest"), tag,
@@ -169,6 +217,10 @@ def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
         logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
         if config.keep_last:
             prune_checkpoints(save_dir, config.keep_last, protect=(tag,))
+        if cctx is not None:
+            sweep_torn_tags(save_dir, journal=cctx.journal, protect=(tag,),
+                            min_age_s=cctx.config.sweep_min_age_s)
+        return True
 
     # the latest marker publishes only after every write of the tag lands
     # (nebula semantics).  An async engine chains publication behind its
@@ -233,6 +285,8 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
     # one and is rejected by the fallback walk.
     any_manifest = any(has_manifest(load_dir, t) for t in candidates)
 
+    from .commit import is_torn
+
     for cand in candidates:
         ckpt_dir = os.path.join(load_dir, cand)
         if not os.path.isdir(ckpt_dir):
@@ -240,6 +294,18 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
                            + ("nothing loaded" if explicit else "skipping"))
             if explicit:
                 return None, {}
+            continue
+        if is_torn(load_dir, cand):
+            # ready votes without a commit marker: a writer died mid-save
+            # or the commit barrier expired — the tag may be missing
+            # another host's shards and must never be resumed from
+            if explicit:
+                raise CheckpointCorruptionError(
+                    f"checkpoint tag {cand!r} under {load_dir} is torn "
+                    f"(rank ready votes present but no commit marker)")
+            logger.error(f"[ckpt-integrity] REJECTED tag {cand}: torn "
+                         "(ready votes without commit.json — uncommitted "
+                         "multi-host save)")
             continue
         if cfg.verify_on_load:
             if has_manifest(load_dir, cand):

@@ -176,6 +176,11 @@ class DeepSpeedEngine:
                 AsyncCheckpointEngine)
             self._checkpoint_engine = AsyncCheckpointEngine(
                 self._config.checkpoint_config)
+        # multi-host commit/consensus context: the elastic runner attaches
+        # one carrying its journal + heartbeat monitor; without a runner a
+        # default is built lazily from the live comm world (see
+        # _commit_context)
+        self._commit_ctx = None
 
         # compression scheduler (reference engine.py:2002 steps it at every
         # optimizer step); the in-graph gating reads the step scalar the
@@ -1675,6 +1680,29 @@ class DeepSpeedEngine:
         return self._eval_jit(self.state["params"], batch)
 
     # ------------------------------------------------------------------ checkpoint
+    def set_commit_context(self, ctx) -> None:
+        """Attach a :class:`~.checkpoint_engine.commit.CommitContext` (the
+        elastic runner does, wiring in its journal and heartbeat monitor)
+        so saves run the two-phase commit and loads run resume consensus."""
+        self._commit_ctx = ctx
+
+    def _commit_context(self):
+        """The commit context for this save/load: the attached one, else a
+        default built from the live comm world.  ``None`` when the protocol
+        is disabled in config."""
+        cfg = self._config.checkpoint_config.commit_config
+        if not cfg.enabled:
+            return None
+        if self._commit_ctx is not None:
+            return self._commit_ctx
+        from .checkpoint_engine.commit import (CollectiveConsensusChannel,
+                                               CommitContext)
+        world = dist.get_world_size()
+        self._commit_ctx = CommitContext(
+            world_size=world, rank=self.global_rank, config=cfg,
+            channel=CollectiveConsensusChannel() if world > 1 else None)
+        return self._commit_ctx
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True) -> bool:
         from .checkpoint_engine.native_checkpoint_engine import save_engine_checkpoint
@@ -1707,18 +1735,23 @@ class DeepSpeedEngine:
             if getattr(self, "_offload_compress", "none") != "none":
                 # the error-feedback residual is part of the optimizer
                 # trajectory: persisting it makes resume exact (otherwise
-                # the carried quantization error is silently dropped)
-                np.savez(os.path.join(
+                # the carried quantization error is silently dropped);
+                # atomic like every other shard so a kill mid-save never
+                # leaves a torn rank file the commit vote then hashes
+                from .checkpoint_engine.storage import atomic_write_npz
+                atomic_write_npz(os.path.join(
                     save_dir, tag,
                     f"offload_residual_rank{self.global_rank}.npz"),
-                    **{f"r_{i}": np.asarray(jax.device_get(r), np.float32)
-                       for i, r in enumerate(self._offload_resid_leaves)})
+                    {f"r_{i}": np.asarray(jax.device_get(r), np.float32)
+                     for i, r in enumerate(self._offload_resid_leaves)},
+                    self._config.checkpoint_config.retry)
         if self._dcn_reduce is not None:
             # DCN error-feedback state is part of the trajectory: persist
             # for exact resume (like the offload compression residual).
             # Only this process's addressable shards are pulled — the EF
             # arrays are dcn-sharded and NOT fully addressable when the
             # slices span hosts (the deployment case)
+            from .checkpoint_engine.storage import atomic_write_npz
             from .zero.offload_engine import index_key, unique_local_blocks
             os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
             arrays = {"ef_scale": np.asarray(self._dcn_ef_scale)}
@@ -1726,10 +1759,10 @@ class DeepSpeedEngine:
                 for bi, (idx, blk) in enumerate(unique_local_blocks(arr)):
                     key = index_key(idx, arr.shape)
                     arrays[f"{name}_{bi}_key"] = np.asarray(key, np.int64)
-                    arrays[f"{name}_{bi}_data"] = blk
-            np.savez(os.path.join(save_dir, tag,
-                                  f"dcn_ef_rank{self.global_rank}.npz"),
-                     **arrays)
+                    arrays[f"{name}_{bi}_data"] = np.asarray(blk)
+            atomic_write_npz(os.path.join(save_dir, tag,
+                                          f"dcn_ef_rank{self.global_rank}.npz"),
+                             arrays, self._config.checkpoint_config.retry)
         save_engine_checkpoint(save_dir, tag, self.state, client_state,
                                separate_master=self._separate_master and not offload,
                                save_latest=save_latest,
@@ -1738,21 +1771,27 @@ class DeepSpeedEngine:
                                manifest_meta={
                                    "world_size": self.dp_world_size,
                                    "writer": {"rank": self.global_rank},
-                               })
+                               },
+                               commit_ctx=self._commit_context())
         self._copy_recovery_script(save_dir)
         # spilled-param engines return to the between-steps memory bound
         # (nothing big resident) as soon as the checkpoint is written
         self._spill_params()
         return True
 
-    @staticmethod
-    def _copy_recovery_script(save_dir: str) -> None:
+    def _copy_recovery_script(self, save_dir: str) -> None:
         """Drop a fp32-recovery shim next to the checkpoints (reference
-        engine.py:3249 copies utils/zero_to_fp32.py the same way)."""
+        engine.py:3249 copies utils/zero_to_fp32.py the same way).
+        Coordinator-only and atomic: on a pod every rank saves into the
+        same directory, and N ranks racing a plain ``open(path, "w")`` on
+        shared storage can interleave into a torn script."""
+        if self.global_rank != 0:
+            return
         path = os.path.join(save_dir, "zero_to_fp32.py")
         if os.path.exists(path):
             return
-        with open(path, "w") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             f.write(
                 "#!/usr/bin/env python3\n"
                 '"""Recover a consolidated fp32 state dict from this '
@@ -1761,6 +1800,7 @@ class DeepSpeedEngine:
                 "import sys\n"
                 "from deepspeed_tpu.utils.zero_to_fp32 import main\n"
                 "sys.exit(main())\n")
+        os.replace(tmp, path)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
@@ -1772,6 +1812,21 @@ class DeepSpeedEngine:
             # never read our own in-flight async writes (also re-raises a
             # background write failure here instead of losing it)
             self._checkpoint_engine.wait()
+        cctx = self._commit_context()
+        if tag is None and cctx is not None and cctx.world_size > 1:
+            # resume consensus: every host proposes its newest verified
+            # committed tag and the group agrees (min over proposals) —
+            # elastic restarts, rollbacks, and fallback loads all route
+            # through here, so no two hosts can silently resume from
+            # different tags.  A failed agreement raises
+            # ResumeConsensusError: split-brain is worse than a crash.
+            from .checkpoint_engine.commit import agree_resume_tag
+            tag = agree_resume_tag(load_dir, cctx)
+            if tag is None:
+                logger.warning(
+                    f"[ckpt-commit] resume consensus: no committed tag "
+                    f"anywhere under {load_dir}; starting fresh")
+                return None, {}
         offload = self._offload_device is not None
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state,

@@ -53,6 +53,11 @@ class EventKind:
     DATA_BAD_RECORD_ABORT = "data.bad_record.abort"
     DATA_ITERATOR_RESTORE = "data.iterator_restore"
     DATA_BATCH = "data.batch"
+    CKPT_COMMITTED = "ckpt.committed"
+    CKPT_COMMIT_TIMEOUT = "ckpt.commit_timeout"
+    CKPT_RESUME_CONSENSUS = "ckpt.resume_consensus"
+    CKPT_CONSENSUS_FAILURE = "ckpt.consensus_failure"
+    CKPT_TORN_TAG = "ckpt.torn_tag"
 
 
 #: every registered kind, as a set of strings
@@ -65,6 +70,8 @@ ABORT_KINDS = frozenset({
     EventKind.DIVERGENCE_ABORT,
     EventKind.WATCHDOG_EXPIRED,
     EventKind.DATA_BAD_RECORD_ABORT,
+    EventKind.CKPT_COMMIT_TIMEOUT,
+    EventKind.CKPT_CONSENSUS_FAILURE,
 })
 
 #: kind → the fields worth a one-liner in ``dump_run_events`` (everything
@@ -87,6 +94,14 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.DATA_ITERATOR_RESTORE: ("step", "epoch", "batch_index",
                                       "samples_consumed", "quarantine"),
     EventKind.DATA_BATCH: ("step", "epoch", "n", "sha"),
+    EventKind.CKPT_COMMITTED: ("tag", "world_size"),
+    EventKind.CKPT_COMMIT_TIMEOUT: ("tag", "missing_ranks", "dead_ranks",
+                                    "deadline_s", "reason"),
+    EventKind.CKPT_RESUME_CONSENSUS: ("tag", "step", "local_tag",
+                                      "local_step", "world_size"),
+    EventKind.CKPT_CONSENSUS_FAILURE: ("local_tag", "local_step",
+                                       "agreed_step", "reason"),
+    EventKind.CKPT_TORN_TAG: ("tag", "ready_ranks"),
 }
 
 

@@ -233,14 +233,19 @@ class HostOffloadOptimizer:
             self._swapper.flush_writes()
 
     def save(self, path: str) -> None:
-        """Persist step count + master/m/v as one npz (checkpoint dir)."""
+        """Persist step count + master/m/v as one npz (checkpoint dir).
+
+        Atomic (tmp + replace): this is a per-rank shard of a multi-host
+        tag — a kill mid-save must leave no torn file for the commit
+        vote (``rank<N>.ready``) to hash or the resume path to trust."""
+        from ..checkpoint_engine.storage import atomic_write_npz
         sd = self.state_dict()
         arrays = {"step": np.asarray(sd["step"])}
         for i in range(self.num_groups):
             arrays[f"master_{i}"] = sd["master"][i]
             arrays[f"m_{i}"] = sd["m"][i]
             arrays[f"v_{i}"] = sd["v"][i]
-        np.savez(path, **arrays)
+        atomic_write_npz(path, arrays)
 
     def load(self, path: str) -> None:
         with np.load(path) as z:

@@ -18,6 +18,17 @@ Points currently wired:
                           writes and bitrot)
 ``ckpt.publish``          just before the ``latest`` marker is written;
                           ctx: ``tag``
+``ckpt.rank_write``       start of a rank's phase-1 ready-manifest write
+                          (commit protocol); ctx: ``path``, ``tag``,
+                          ``rank`` (``DelaySeconds`` models a straggler
+                          rank, ``FailNTimes`` a killed writer)
+``ckpt.commit_barrier``   each poll of the coordinator's commit barrier;
+                          ctx: ``tag`` (``HangFor`` models a wedged
+                          barrier; raising models a coordinator fault)
+``ckpt.publish_commit``   just before ``commit.json`` is written — after
+                          every rank voted ready; ctx: ``tag`` (raising /
+                          ``SignalAtStep``-style kills model coordinator
+                          death between ready and commit)
 ``train.step``            once per completed runner step; ctx: ``step``
                           (SIGTERM-at-step models a preemption notice)
 ``train.step_begin``      inside the runner's watchdog guard, before the
@@ -54,6 +65,9 @@ FAULT_POINTS = frozenset({
     "ckpt.write",
     "ckpt.post_write",
     "ckpt.publish",
+    "ckpt.rank_write",
+    "ckpt.commit_barrier",
+    "ckpt.publish_commit",
     "train.step",
     "train.step_begin",
     "comm.barrier",

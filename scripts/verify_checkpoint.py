@@ -6,10 +6,18 @@ Runs ``verify_tag`` over every tag of a checkpoint directory (or one
 preflight/cron job so bitrot is found before the resume that needs the
 checkpoint, not during it.
 
+With ``--commit-status`` the multi-host commit protocol's state is
+reported instead: per-rank ready-manifest presence, the commit marker,
+and a torn-tag verdict for every tag — a *torn committed* tag (a
+``commit.json`` whose rank shards are missing or fail their hashes) is
+the serious one and fails the run.
+
 Usage:
     python scripts/verify_checkpoint.py CKPT_DIR [--tag TAG] [--quiet]
+    python scripts/verify_checkpoint.py CKPT_DIR --commit-status
 
-Exit codes: 0 all verified; 1 corruption found; 2 nothing to verify.
+Exit codes: 0 all verified; 1 corruption found (or, with
+``--commit-status``, a torn committed tag); 2 nothing to verify.
 """
 
 from __future__ import annotations
@@ -21,10 +29,46 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from deepspeed_tpu.runtime.checkpoint_engine.commit import (  # noqa: E402
+    commit_status)
 from deepspeed_tpu.runtime.checkpoint_engine.integrity import (  # noqa: E402
     has_manifest, list_tags, verify_tag)
 from deepspeed_tpu.runtime.checkpoint_engine.native_checkpoint_engine import (  # noqa: E402
     resolve_tag)
+
+
+def _report_commit_status(ckpt_dir: str, tags: List[str], advertised,
+                          quiet: bool) -> int:
+    """Per-tag commit-protocol verdicts; exit 1 on a torn committed tag."""
+    bad = 0
+    for tag in tags:
+        st = commit_status(ckpt_dir, tag)
+        mark = " (latest)" if tag == advertised else ""
+        ranks = (f"ready={st['ready_ranks']}"
+                 + (f" missing={st['missing_ranks']}"
+                    if st["missing_ranks"] else ""))
+        if st["verdict"] == "committed":
+            print(f"COMMITTED  {tag}{mark}: world_size={st['world_size']} "
+                  f"{ranks}")
+        elif st["verdict"] == "torn-committed":
+            bad += 1
+            print(f"TORN-COMMITTED  {tag}{mark}: commit marker present but "
+                  f"{len(st['problems'])} shard problem(s); {ranks}")
+            if not quiet:
+                for p in st["problems"]:
+                    print(f"           - {p}")
+        elif st["verdict"] == "torn":
+            print(f"TORN       {tag}{mark}: ready votes without commit.json "
+                  f"(quarantine candidate); {ranks}")
+        else:
+            print(f"PRE-COMMIT {tag}{mark}: no commit-protocol artifacts")
+        if tag == advertised and st["verdict"] in ("torn", "torn-committed"):
+            # the latest marker must never advertise a torn tag — if it
+            # does, the publish-order invariant was violated
+            bad += 1
+            print(f"           ^ latest marker advertises a torn tag!")
+    print(f"checked {len(tags)} tag(s): {bad} torn-committed/misadvertised")
+    return 1 if bad else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -34,6 +78,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="verify only this tag (default: every tag found)")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress per-file problem listings")
+    ap.add_argument("--commit-status", action="store_true",
+                    help="report the multi-host commit protocol state per "
+                         "tag (exit 1 on a torn committed tag)")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(args.ckpt_dir):
@@ -44,6 +91,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: no checkpoint tags under {args.ckpt_dir}",
               file=sys.stderr)
         return 2
+    if args.commit_status:
+        return _report_commit_status(args.ckpt_dir, tags,
+                                     resolve_tag(args.ckpt_dir, None),
+                                     args.quiet)
 
     advertised = resolve_tag(args.ckpt_dir, None)
     bad = 0

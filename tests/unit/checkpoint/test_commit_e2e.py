@@ -1,0 +1,133 @@
+"""Acceptance chaos test on the real engine: a rank killed mid-save never
+advances the ``latest`` marker; the torn tag is quarantined on restart;
+every simulated host resume-consensuses onto the same prior committed tag;
+and the replay from it is bitwise identical (``verify_replay`` contract).
+"""
+
+import os
+import threading
+
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import ElasticTrainRunner
+from deepspeed_tpu.runtime.checkpoint_engine import commit as cp
+from deepspeed_tpu.runtime.checkpoint_engine.config import (
+    CheckpointCommitConfig)
+from deepspeed_tpu.runtime.supervision.events import EventKind, read_events
+from tests.unit.common import (RandomTokenDataset, base_config, make_mesh,
+                               tiny_model)
+
+pytestmark = pytest.mark.chaos
+
+SEQ = 16
+DATA_CFG = {"data": {"resumable": True, "shuffle": True, "seed": 11}}
+SUP_CFG = {"supervision": {"enabled": True}}
+
+
+def build():
+    mm = make_mesh(dp=8)
+    cfg = base_config(micro_batch=2, extra=DATA_CFG)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=tiny_model(), config=cfg, mesh_manager=mm,
+        training_data=RandomTokenDataset(64, SEQ, seed=5),
+        rng=jax.random.PRNGKey(0))
+    return engine, loader
+
+
+def fast_commit_cfg():
+    return CheckpointCommitConfig(barrier_deadline_s=0.3, barrier_poll_s=0.01,
+                                  barrier_backoff_max_s=0.05)
+
+
+def test_rank_killed_midsave_then_consensus_resume_bitwise(tmp_path):
+    save = str(tmp_path / "ck")
+
+    # ---- incarnation 1: train 4 steps, committing tags at step 2 and 4
+    engine, loader = build()
+    runner = ElasticTrainRunner(engine, save, save_interval=2,
+                                ds_config=SUP_CFG)
+    out = runner.run(loader, max_steps=4, resume=True)
+    assert out["steps"] == 4
+    for tag in ("elastic_step2", "elastic_step4"):
+        assert cp.is_committed(save, tag)
+    assert open(os.path.join(save, "latest")).read().strip() == \
+        "elastic_step4"
+    expected_plan = loader.replay_plan(8)          # continuation from step 4
+
+    # ---- a second host joins the save of step 6 and dies before voting:
+    # the barrier expires, the tag is abandoned, latest never moves
+    evil = cp.CommitContext(world_size=2, rank=0, config=fast_commit_cfg(),
+                            journal=runner.journal)
+    engine.set_commit_context(evil)
+    assert engine.save_checkpoint(save, tag="elastic_step6")
+    assert open(os.path.join(save, "latest")).read().strip() == \
+        "elastic_step4"                            # NEVER the torn tag
+    assert cp.is_torn(save, "elastic_step6")
+    timeouts = read_events(os.path.join(save, "events.jsonl"),
+                           kind=EventKind.CKPT_COMMIT_TIMEOUT)
+    assert timeouts and timeouts[-1]["tag"] == "elastic_step6" \
+        and timeouts[-1]["missing_ranks"] == [1]
+
+    # ---- incarnation 2 (restart): two simulated hosts share the dir;
+    # the coordinator sweeps the torn tag, then both consensus-resume
+    engine2, loader2 = build()
+    runner2 = ElasticTrainRunner(engine2, save, save_interval=2,
+                                 ds_config=SUP_CFG)
+    shared = os.path.join(save, ".consensus")
+    ctx0 = cp.CommitContext(
+        world_size=2, rank=0, config=fast_commit_cfg(),
+        journal=runner2.journal,
+        channel=cp.FileConsensusChannel(shared, 0, 2, deadline_s=10.0,
+                                        poll_s=0.01))
+    engine2.set_commit_context(ctx0)
+    runner2.commit_ctx = ctx0
+    peer_result = {}
+
+    def peer_host():
+        # host B: same shared checkpoint dir, own consensus identity
+        ctx1 = cp.CommitContext(
+            world_size=2, rank=1, config=fast_commit_cfg(),
+            channel=cp.FileConsensusChannel(shared, 1, 2, deadline_s=10.0,
+                                            poll_s=0.01))
+        try:
+            peer_result["tag"] = cp.agree_resume_tag(save, ctx1)
+        except Exception as e:  # surfaced via the assert below
+            peer_result["tag"] = e
+
+    t = threading.Thread(target=peer_host)
+    t.start()
+    engine2.set_data_iterator(loader2)
+    resumed_at = runner2.resume()
+    t.join()
+
+    # every host landed on the same prior committed tag
+    assert peer_result["tag"] == "elastic_step4"
+    assert resumed_at == 4 and engine2.global_steps == 4
+    consensus = read_events(os.path.join(save, "events.jsonl"),
+                            kind=EventKind.CKPT_RESUME_CONSENSUS)
+    assert consensus and consensus[-1]["tag"] == "elastic_step4"
+
+    # the torn tag was quarantined on restart (journaled), latest intact
+    assert not os.path.isdir(os.path.join(save, "elastic_step6"))
+    torn = read_events(os.path.join(save, "events.jsonl"),
+                       kind=EventKind.CKPT_TORN_TAG)
+    assert torn and torn[-1]["tag"] == "elastic_step6"
+
+    # bitwise-identical replay from the agreed tag (PR 3's guarantee,
+    # now protected across hosts): the restored loader's upcoming plan
+    # equals the uninterrupted continuation recorded before the chaos
+    assert loader2.step == 4
+    assert loader2.replay_plan(8) == expected_plan
+
+    # and the standalone audit agrees (exit 0 = plans + journal verified)
+    import importlib.util
+    script = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "scripts",
+        "verify_replay.py")
+    spec = importlib.util.spec_from_file_location("verify_replay", script)
+    vr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vr)
+    assert vr.main([save, "--steps", "8"]) == 0

@@ -115,6 +115,17 @@ def test_non_atomic_write_scoped_to_durability_dirs():
     assert findings == []
 
 
+def test_non_atomic_write_covers_runtime_engine():
+    # the engine writes into the checkpoint dir too (recovery script,
+    # per-rank shards) — a plain write there races N ranks on shared
+    # storage, so runtime/engine.py is explicitly in scope
+    bad = lint('open(path, "w")\n', "deepspeed_tpu/runtime/engine.py")
+    assert rules_of(bad) == ["non-atomic-write"]
+    good = lint('open(path + ".tmp", "w")\n',
+                "deepspeed_tpu/runtime/engine.py")
+    assert good == []
+
+
 def test_non_atomic_write_suppressible():
     findings = lint(
         'open(p, "wb")  # dslint: disable=non-atomic-write — test scratch\n',

@@ -5,10 +5,11 @@ its consumers actually stay in sync with it.  Checked:
 - every ``EventKind`` has a ``SUMMARY_FIELDS`` entry (so
   ``dump_run_events`` can one-line it) and every ``SUMMARY_FIELDS`` /
   ``ABORT_KINDS`` entry names a registered kind;
-- the journal-schema tables in ``docs/run-supervision.md`` and
-  ``docs/data-determinism.md`` (the markdown tables whose first header
-  cell is ``` `kind` ```) document every registered kind — exactly or via
-  a ``prefix.*`` wildcard row — and name no kind that isn't registered.
+- the journal-schema tables in ``docs/run-supervision.md``,
+  ``docs/data-determinism.md``, and ``docs/checkpoint-durability.md``
+  (the markdown tables whose first header cell is ``` `kind` ```)
+  document every registered kind — exactly or via a ``prefix.*`` wildcard
+  row — and name no kind that isn't registered.
 """
 
 from __future__ import annotations
@@ -21,7 +22,8 @@ from .core import Finding, Project
 
 RULE_ID = "event-kind-drift"
 
-KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md")
+KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
+             "docs/checkpoint-durability.md")
 
 _CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
 

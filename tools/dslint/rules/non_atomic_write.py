@@ -3,6 +3,8 @@ can leave a torn half-file on crash that a reader then trusts.  Everything
 the checkpoint/journal subsystems persist must go through the tmp +
 ``os.replace`` pattern (``checkpoint_engine.storage.atomic_write_*`` or a
 local ``<path>.tmp`` + replace), so readers never observe a partial write.
+``runtime/engine.py`` is in scope too: its checkpoint-dir writes (the
+recovery script, per-rank shard files) race every rank on shared storage.
 
 A write is exempt when it demonstrably targets the tmp side of that
 pattern: the path expression is a ``tmp``-named variable/attribute, ends in
@@ -22,6 +24,9 @@ SCOPES = (
     "deepspeed_tpu/runtime/checkpoint_engine/",
     "deepspeed_tpu/runtime/supervision/",
     "deepspeed_tpu/runtime/data_pipeline/",
+    # the engine writes into the checkpoint dir too (recovery script,
+    # per-rank shard files) — those writes race N ranks on shared storage
+    "deepspeed_tpu/runtime/engine.py",
 )
 
 EXEMPT_FUNCS = {"write_tmp", "_atomic_attempt"}
