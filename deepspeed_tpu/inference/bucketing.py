@@ -1,0 +1,47 @@
+"""Power-of-two bucketing for serving geometry.
+
+Every distinct ``max_new_tokens`` used to compile its own fused reply loop
+(``InferenceSession._reply_prog`` keys its jit cache per ``n``), and every
+distinct session ``max_len`` its own cache geometry — under real traffic,
+where request budgets are all over the place, that is a compile per
+request shape.  Bucketing both to powers of two collapses the program
+population to ``O(log(max))`` while paying at most 2× idle loop steps
+(skipped via ``lax.cond``, so they cost a branch, not a forward) and at
+most 2× cache rows (the serving gateway buckets its slot cache the same
+way, so admission never recompiles).
+"""
+
+from __future__ import annotations
+
+#: no bucket smaller than this — tiny programs aren't worth distinguishing
+MIN_BUCKET = 8
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    if n < 1:
+        raise ValueError(f"bucketing needs n >= 1, got {n}")
+    return 1 << (int(n) - 1).bit_length()
+
+
+def bucket_max_new_tokens(n: int, cap: int | None = None) -> int:
+    """Round a reply budget up to its power-of-two bucket (floor
+    :data:`MIN_BUCKET`), clamped to ``cap`` when given.  The fused reply
+    loop compiles once per bucket and skips the steps past the true ``n``
+    at runtime."""
+    b = max(next_pow2(n), MIN_BUCKET)
+    if cap is not None:
+        if n > cap:
+            raise ValueError(f"max_new_tokens {n} exceeds cap {cap}")
+        b = min(b, int(cap))
+    return b
+
+
+def bucket_cache_len(n: int, cap: int) -> int:
+    """Round a cache length up to its power-of-two bucket (floor
+    :data:`MIN_BUCKET`), clamped to the model context ``cap``.  Sessions
+    and serving slots with nearby lengths land on one geometry, so they
+    share every compiled prefill/extend/decode program."""
+    if n < 1:
+        raise ValueError(f"cache length must be >= 1, got {n}")
+    return min(max(next_pow2(n), MIN_BUCKET), int(cap))

@@ -138,6 +138,15 @@ class InferenceEngine:
             self._weight_int8, int8_compute=self._int8_compute)
         self._forward_jit = jax.jit(self._apply_fn)
         self._generate_cache: Dict[Tuple, Any] = {}
+        # default sampling keys come from a fold-in sequence, not a fixed
+        # PRNGKey(0): two sampled generate() calls must not be bitwise
+        # identical unless the caller pins the key
+        self._key_seq = 0
+
+    def _next_key(self) -> jax.Array:
+        key = jax.random.fold_in(jax.random.PRNGKey(0), self._key_seq)
+        self._key_seq += 1
+        return key
 
     # -------------------------------------------------------------- forward
 
@@ -247,7 +256,7 @@ class InferenceEngine:
             self._generate_cache[sig] = self._build_generate(
                 max_len, max_new_tokens, greedy=not do_sample,
                 eos=eos_token_id, top_k=top_k, top_p=top_p)
-        key = key if key is not None else jax.random.PRNGKey(0)
+        key = key if key is not None else self._next_key()
         lens = jnp.asarray(prompt_lens, jnp.int32) if is_ragged \
             else jnp.full((B,), S, jnp.int32)
         return self._generate_cache[sig](
@@ -313,7 +322,7 @@ class InferenceEngine:
                                             top_k=top_k, top_p=top_p, key=k)
 
             self._generate_cache[sig] = jax.jit(run)
-        key = key if key is not None else jax.random.PRNGKey(0)
+        key = key if key is not None else self._next_key()
         return self._generate_cache[sig](self.params, dparams, tokens, key)
 
     # -------------------------------------------------------------- session
@@ -325,9 +334,28 @@ class InferenceEngine:
         prefill — the conversation is never re-prefilled), ``generate``
         decodes a reply that stays in the cache.  Serves every family —
         MoE sessions ride ``gpt_moe_inference.extend`` the same way.
+
+        ``max_len`` is bucketed to a power of two (clamped to the model
+        context), so sessions with nearby budgets share one cache
+        geometry — and therefore every compiled prefill/extend/decode
+        program.
         """
+        from .bucketing import bucket_cache_len
+        cap = self.model_config.max_seq_len
         return InferenceSession(self, batch,
-                                max_len or self.model_config.max_seq_len)
+                                bucket_cache_len(max_len or cap, cap))
+
+    # -------------------------------------------------------------- serving
+
+    def serve(self, config=None, journal=None, autostart: bool = True):
+        """A continuous-batching serving gateway over this engine: an
+        async request scheduler packing heterogeneous prompts into one
+        fixed-geometry ragged-decode slot batch (``serving/``).  ``config``
+        is a :class:`~deepspeed_tpu.serving.ServingConfig` or its dict;
+        ``journal`` an optional supervision ``EventJournal``."""
+        from ..serving import ServingGateway
+        return ServingGateway(self, config=config, journal=journal,
+                              autostart=autostart)
 
     def _session_programs(self):
         """Jitted prefill/extend/decode shared by ALL of this engine's
@@ -370,6 +398,7 @@ class InferenceSession:
         self.cache = fam.init_cache(cfg, batch, max_len,
                                     kv_dtype=engine._kv_dtype)
         self._last_logits = None
+        self._key_seq = 0
 
     @property
     def length(self) -> int:
@@ -394,6 +423,7 @@ class InferenceSession:
         new._progs = self._progs
         new.cache = self.cache
         new._last_logits = self._last_logits
+        new._key_seq = self._key_seq
         return new
 
     def append(self, tokens) -> jnp.ndarray:
@@ -407,30 +437,45 @@ class InferenceSession:
         self._last_logits = logits[:, -1]
         return logits
 
-    def _reply_prog(self, n: int, sample: bool, top_k: int, top_p: float):
-        """One fused reply loop (lax.scan over n tokens) per signature:
-        a 128-token reply is ONE dispatch, not 256."""
-        sig = (n, sample, top_k, top_p)
+    def _reply_prog(self, n_bucket: int, sample: bool, top_k: int,
+                    top_p: float):
+        """One fused reply loop (lax.scan) per BUCKET signature: a
+        128-token reply is ONE dispatch, not 256 — and replies of 5, 6,
+        and 8 tokens share one program (``bucketing.bucket_max_new_tokens``)
+        instead of compiling three.  The true token budget ``n`` is a
+        traced operand; steps past it are skipped by ``lax.cond`` (a
+        branch, not a forward) and never advance the cache."""
+        sig = (n_bucket, sample, top_k, top_p)
         if sig not in self._progs["reply"]:
             cfg = self._engine.model_config
             fam = self._engine._family
             from .sampling import filter_logits
 
-            def reply(params, last, cache, key, temperature):
-                def step(carry, k):
-                    last, cache = carry
-                    lg = last[:, :cfg.vocab_size]
-                    if sample:
-                        lg = filter_logits(lg, temperature, top_k=top_k,
-                                           top_p=top_p)
-                        nxt = jax.random.categorical(k, lg).astype(jnp.int32)
-                    else:
-                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-                    lg2, cache = fam.decode_step(params, nxt, cfg, cache)
-                    return (lg2, cache), nxt
+            def reply(params, last, cache, key, temperature, n):
+                def step(carry, xs):
+                    k, i = xs
+
+                    def live(c):
+                        last, cache = c
+                        lg = last[:, :cfg.vocab_size]
+                        if sample:
+                            lg = filter_logits(lg, temperature, top_k=top_k,
+                                               top_p=top_p)
+                            nxt = jax.random.categorical(k, lg).astype(
+                                jnp.int32)
+                        else:
+                            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                        lg2, cache = fam.decode_step(params, nxt, cfg, cache)
+                        return (lg2, cache), nxt
+
+                    def dead(c):
+                        return c, jnp.zeros((c[0].shape[0],), jnp.int32)
+
+                    return lax.cond(i < n, live, dead, carry)
 
                 (last, cache), toks = lax.scan(
-                    step, (last, cache), jax.random.split(key, n))
+                    step, (last, cache),
+                    (jax.random.split(key, n_bucket), jnp.arange(n_bucket)))
                 return toks.swapaxes(0, 1), last, cache
 
             self._progs["reply"][sig] = jax.jit(reply)
@@ -453,14 +498,18 @@ class InferenceSession:
         if max_new_tokens <= 0:
             return jnp.zeros((B, 0), jnp.int32)
         self._check_room(max_new_tokens)
-        key = key if key is not None else jax.random.PRNGKey(0)
+        if key is None:
+            key = jax.random.fold_in(jax.random.PRNGKey(0), self._key_seq)
+            self._key_seq += 1
+        from .bucketing import bucket_max_new_tokens
         toks, self._last_logits, self.cache = self._reply_prog(
-            max_new_tokens, bool(do_sample),
+            bucket_max_new_tokens(max_new_tokens), bool(do_sample),
             int(top_k) if do_sample else 0,
             float(top_p) if do_sample else 1.0)(
             self._engine.params, self._last_logits, self.cache, key,
-            jnp.asarray(temperature, jnp.float32))
-        return toks
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(max_new_tokens, jnp.int32))
+        return jnp.asarray(np.asarray(toks)[:, :max_new_tokens])
 
 
 def _save_16bit(params, dtype, path: str) -> None:

@@ -261,6 +261,70 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: gpt.GPTConfig,
                                        length=jnp.max(pos0) + Sc)
 
 
+# ------------------------------------------------------------- slot ops
+#
+# A continuous-batching server owns ONE fixed-geometry multi-slot cache and
+# retires/admits conversations per ROW without touching the others.  These
+# three ops are that contract: ``row`` may be a traced scalar, so one
+# compiled program serves every slot — admitting into slot 7 never
+# recompiles the program that admitted into slot 2.
+
+
+def write_slot(cache: KVCache, row, src: KVCache) -> KVCache:
+    """Insert a batch-1 cache into slot ``row`` of a live multi-slot cache
+    (admission: a newly prefilled prompt lands in a slot freed by a
+    finished generation).  ``src`` must share the cache dtype layout;
+    its ``max_len`` must not exceed the slot cache's.  ``length`` keeps
+    max-frontier semantics — the slot engine tracks per-row lengths
+    itself."""
+    if src.int8 != cache.int8:
+        raise ValueError(
+            f"write_slot dtype mismatch: src int8={src.int8}, "
+            f"cache int8={cache.int8}")
+    if src.max_len > cache.max_len:
+        raise ValueError(
+            f"write_slot src max_len {src.max_len} exceeds the slot "
+            f"cache's {cache.max_len}")
+
+    def ins(dst, s):
+        return lax.dynamic_update_slice(dst, s, (0, row, 0, 0, 0))
+
+    return dataclasses.replace(
+        cache, k=ins(cache.k, src.k), v=ins(cache.v, src.v),
+        length=jnp.maximum(cache.length, src.length),
+        k_scale=ins(cache.k_scale, src.k_scale) if cache.int8 else None,
+        v_scale=ins(cache.v_scale, src.v_scale) if cache.int8 else None)
+
+
+def reset_slot(cache: KVCache, row) -> KVCache:
+    """Zero slot ``row``'s K/V (and scales): a retired conversation's
+    K/V never bleeds into the next tenant, even through a masked read."""
+    def z(buf):
+        blank = jnp.zeros((buf.shape[0], 1) + buf.shape[2:], buf.dtype)
+        return lax.dynamic_update_slice(buf, blank, (0, row, 0, 0, 0))
+
+    return dataclasses.replace(
+        cache, k=z(cache.k), v=z(cache.v),
+        k_scale=z(cache.k_scale) if cache.int8 else None,
+        v_scale=z(cache.v_scale) if cache.int8 else None)
+
+
+def read_slot(cache: KVCache, row, length=None) -> KVCache:
+    """Slot ``row`` as a batch-1 cache (retiring a live conversation back
+    to a session).  ``length`` is the row's true frontier (the multi-slot
+    ``cache.length`` only tracks the max)."""
+    def rd(buf):
+        return lax.dynamic_slice(buf, (0, row, 0, 0, 0),
+                                 (buf.shape[0], 1) + buf.shape[2:])
+
+    return KVCache(
+        k=rd(cache.k), v=rd(cache.v),
+        length=jnp.asarray(length if length is not None else cache.length,
+                           jnp.int32),
+        k_scale=rd(cache.k_scale) if cache.int8 else None,
+        v_scale=rd(cache.v_scale) if cache.int8 else None)
+
+
 def decode_step(params: PyTree, token: jnp.ndarray, config: gpt.GPTConfig,
                 cache: KVCache, lengths=None) -> Tuple[jnp.ndarray, KVCache]:
     """One-token decode: token [B] int32 at position cache.length — or,

@@ -262,6 +262,69 @@ def extend(params: PyTree, tokens: jnp.ndarray, config: GPTMoEConfig,
         moe_k_scale=mks, moe_v_scale=mvs)
 
 
+# ------------------------------------------------------------- slot ops
+#
+# Dense-family contract (``gpt_inference.write_slot``/``reset_slot``/
+# ``read_slot``) over the dual cache banks: a continuous-batching server
+# admits/retires per ROW of one fixed-geometry cache, ``row`` traced so one
+# compiled program serves every slot.
+
+_BANKS = ("dense_k", "dense_v", "moe_k", "moe_v")
+_SCALES = ("dense_k_scale", "dense_v_scale", "moe_k_scale", "moe_v_scale")
+
+
+def write_slot(cache: MoEKVCache, row, src: MoEKVCache) -> MoEKVCache:
+    """Insert a batch-1 cache into slot ``row`` across both banks."""
+    if src.int8 != cache.int8:
+        raise ValueError(
+            f"write_slot dtype mismatch: src int8={src.int8}, "
+            f"cache int8={cache.int8}")
+    if src.max_len > cache.max_len:
+        raise ValueError(
+            f"write_slot src max_len {src.max_len} exceeds the slot "
+            f"cache's {cache.max_len}")
+
+    def ins(dst, s):
+        return lax.dynamic_update_slice(dst, s, (0, row, 0, 0, 0))
+
+    upd = {name: ins(getattr(cache, name), getattr(src, name))
+           for name in _BANKS}
+    if cache.int8:
+        upd.update({name: ins(getattr(cache, name), getattr(src, name))
+                    for name in _SCALES})
+    return dataclasses.replace(
+        cache, length=jnp.maximum(cache.length, src.length), **upd)
+
+
+def reset_slot(cache: MoEKVCache, row) -> MoEKVCache:
+    """Zero slot ``row`` across both banks (and scale banks when int8)."""
+    def z(buf):
+        blank = jnp.zeros((buf.shape[0], 1) + buf.shape[2:], buf.dtype)
+        return lax.dynamic_update_slice(buf, blank, (0, row, 0, 0, 0))
+
+    upd = {name: z(getattr(cache, name)) for name in _BANKS}
+    if cache.int8:
+        upd.update({name: z(getattr(cache, name)) for name in _SCALES})
+    return dataclasses.replace(cache, **upd)
+
+
+def read_slot(cache: MoEKVCache, row, length=None) -> MoEKVCache:
+    """Slot ``row`` as a batch-1 cache; ``length`` is the row's true
+    frontier."""
+    def rd(buf):
+        return lax.dynamic_slice(buf, (0, row, 0, 0, 0),
+                                 (buf.shape[0], 1) + buf.shape[2:])
+
+    upd = {name: rd(getattr(cache, name)) for name in _BANKS}
+    if cache.int8:
+        upd.update({name: rd(getattr(cache, name)) for name in _SCALES})
+    else:
+        upd.update({name: None for name in _SCALES})
+    return MoEKVCache(
+        length=jnp.asarray(length if length is not None else cache.length,
+                           jnp.int32), **upd)
+
+
 def decode_step(params: PyTree, token: jnp.ndarray, config: GPTMoEConfig,
                 cache: MoEKVCache,
                 lengths=None) -> Tuple[jnp.ndarray, MoEKVCache]:

@@ -58,6 +58,14 @@ class EventKind:
     CKPT_RESUME_CONSENSUS = "ckpt.resume_consensus"
     CKPT_CONSENSUS_FAILURE = "ckpt.consensus_failure"
     CKPT_TORN_TAG = "ckpt.torn_tag"
+    SERVE_REQUEST = "serve.request"
+    SERVE_ADMIT = "serve.admit"
+    SERVE_REJECT = "serve.reject"
+    SERVE_CANCEL = "serve.cancel"
+    SERVE_TIMEOUT = "serve.timeout"
+    SERVE_DONE = "serve.done"
+    SERVE_EVICT = "serve.evict"
+    SERVE_TICK = "serve.tick"
 
 
 #: every registered kind, as a set of strings
@@ -102,6 +110,17 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.CKPT_CONSENSUS_FAILURE: ("local_tag", "local_step",
                                        "agreed_step", "reason"),
     EventKind.CKPT_TORN_TAG: ("tag", "ready_ranks"),
+    EventKind.SERVE_REQUEST: ("request_id", "prompt_len", "max_new_tokens",
+                              "priority", "queue_depth"),
+    EventKind.SERVE_ADMIT: ("request_id", "slot", "queued_ms", "prefix_hit"),
+    EventKind.SERVE_REJECT: ("request_id", "reason", "queue_depth"),
+    EventKind.SERVE_CANCEL: ("request_id", "slot", "tokens_out"),
+    EventKind.SERVE_TIMEOUT: ("request_id", "slot", "deadline_s",
+                              "tokens_out", "queued"),
+    EventKind.SERVE_DONE: ("request_id", "slot", "tokens_out", "ttft_ms",
+                           "tok_per_s"),
+    EventKind.SERVE_EVICT: ("prefix", "reason", "idle_s"),
+    EventKind.SERVE_TICK: ("tick", "active", "queue_depth", "tok_per_s"),
 }
 
 

@@ -1,0 +1,36 @@
+"""Continuous-batching serving gateway over the ragged decode kernels.
+
+The inference stack owns the hard parts — persistent sessions, chunked
+prefill, zero-copy prefix ``fork()``, int8 KV, ragged right-padded
+batches — but drives them one hand-built batch at a time.  This package
+is the production front half:
+
+- ``batcher``: ONE fixed-geometry slot batch (B slots, bucketed cache
+  length); admission prefills through fixed-width chunks into slots freed
+  by finished generations, every decode tick advances all live slots one
+  ragged token — and nothing recompiles across ticks;
+- ``gateway``: the async request scheduler (stdlib ``threading``, like
+  the async checkpoint engine): bounded FIFO+priority admission queue,
+  per-request budgets/deadlines/seeds, cancellation, LRU prefix pool with
+  zero-copy fork dedup of shared system prompts;
+- ``metrics`` + supervision ``EventJournal`` ``serve.*`` events: queue
+  depth, TTFT, tokens/sec, slot occupancy — the black box and the
+  dashboard of the serving plane (``scripts/serve_bench.py`` tracks them
+  as ``BENCH_SERVE.json``).
+
+Entry point: ``InferenceEngine.serve()`` or :class:`ServingGateway`
+directly.  Reference: ``docs/serving.md``.
+"""
+
+from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
+from .config import SERVING, ServingConfig  # noqa: F401
+from .gateway import ServingGateway  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .request import (QueueFullError, RequestCancelled, RequestFailed,  # noqa: F401
+                      RequestHandle, RequestState, RequestTimedOut)
+
+__all__ = [
+    "SERVING", "ServingConfig", "ServingGateway", "ServingMetrics",
+    "SlotBatcher", "PrefixEntry", "RequestHandle", "RequestState",
+    "QueueFullError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
+]

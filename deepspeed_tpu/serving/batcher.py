@@ -1,0 +1,217 @@
+"""Slot-based continuous batcher: ONE fixed-geometry ragged decode batch.
+
+The inference engine's generate paths size a program per call batch; a
+server cannot afford that — traffic is heterogeneous and endless.  The
+batcher instead owns a single ``[L, B=slots, max_len, H, D]`` KV cache and
+drives it with a closed set of compiled programs whose shapes never depend
+on a request:
+
+- admission **prefill** runs batch-1 through fixed-width chunks (prompts
+  right-pad up to a multiple of ``prefill_chunk``; pad K/V lands beyond
+  the row's frontier where per-row visibility masks it) and the finished
+  batch-1 cache is inserted into a free slot with the model family's
+  ``write_slot`` — ``row`` is traced, so slot 0 and slot 7 share one
+  program;
+- each decode **tick** advances every slot one token through the family's
+  ragged ``decode_step`` (per-slot frontiers, per-slot RNG keys, per-slot
+  greedy/temperature — all traced operands of one compiled program).
+
+After the first request of each shape class warms the programs up, the
+batcher never compiles again: :meth:`compile_counts` exposes the jit cache
+sizes so tests can assert exactly that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..inference.bucketing import bucket_cache_len
+from ..inference.sampling import filter_logits
+from .config import ServingConfig
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """A shared prompt prefix held as a batch-1 cache of slot geometry —
+    forks are zero-copy (jax arrays are immutable), so N conversations
+    over one system prompt hold one copy of its K/V."""
+
+    cache: Any
+    length: int
+
+
+class SlotBatcher:
+    """Continuous batching over ``config.slots`` decode slots."""
+
+    def __init__(self, engine, config: ServingConfig):
+        self._engine = engine
+        self._fam = engine._family
+        cfg = engine.model_config
+        self._cfg = cfg
+        self._kv_dtype = engine._kv_dtype
+        self.slots = config.slots
+        self.max_len = bucket_cache_len(config.max_len or cfg.max_seq_len,
+                                        cfg.max_seq_len)
+        # a chunk wider than the slot cannot even land its first write
+        self.chunk = min(int(config.prefill_chunk), self.max_len)
+        fam = self._fam
+        B = self.slots
+        self.cache = fam.init_cache(cfg, B, self.max_len,
+                                    kv_dtype=self._kv_dtype)
+        self.lengths = jnp.zeros((B,), jnp.int32)
+        self.keys = jnp.stack([jax.random.PRNGKey(0)] * B)
+        self.greedy = jnp.ones((B,), bool)
+        self.temp = jnp.ones((B,), jnp.float32)
+        self.active = jnp.zeros((B,), bool)
+        self._last = None          # [B, padded_vocab], set on first admit
+        self._build_programs(config)
+
+    # ------------------------------------------------------------ programs
+
+    def _build_programs(self, config: ServingConfig) -> None:
+        fam, cfg = self._fam, self._cfg
+        top_k, top_p = int(config.top_k), float(config.top_p)
+        vocab = cfg.vocab_size
+
+        def tick(params, cache, lengths, last, keys, greedy, temp, active):
+            lg = last[:, :vocab]
+            ks = jax.vmap(jax.random.split)(keys)         # [B, 2, 2]
+            next_keys, subkeys = ks[:, 0], ks[:, 1]
+            filt = filter_logits(lg, temp[:, None], top_k=top_k, top_p=top_p)
+            sampled = jax.vmap(jax.random.categorical)(subkeys, filt)
+            nxt = jnp.where(greedy, jnp.argmax(lg, -1),
+                            sampled).astype(jnp.int32)
+            logits, cache = fam.decode_step(params, nxt, cfg, cache,
+                                            lengths=lengths)
+            # only live slots advance; a freed slot re-writes its own cell
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return nxt, logits, cache, new_lengths, next_keys
+
+        def bind(lengths, last, keys, greedy, temp, active,
+                 row, length, vec, key, g, t):
+            return (lengths.at[row].set(length), last.at[row].set(vec),
+                    keys.at[row].set(key), greedy.at[row].set(g),
+                    temp.at[row].set(t), active.at[row].set(True))
+
+        def release(lengths, active, row):
+            return lengths.at[row].set(0), active.at[row].set(False)
+
+        self._p = {
+            "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
+            "extend": jax.jit(
+                lambda p, t, c, l: fam.extend(p, t, cfg, c, lengths=l)),
+            "take_last": jax.jit(
+                lambda lg, i: lax.dynamic_index_in_dim(lg[0], i, 0,
+                                                       keepdims=False)),
+            "write_slot": jax.jit(
+                lambda c, row, src: fam.write_slot(c, row, src)),
+            "bind": jax.jit(bind),
+            "release": jax.jit(release),
+            "tick": jax.jit(tick),
+        }
+
+    def compile_counts(self) -> Dict[str, int]:
+        """jit-cache entries per program — the no-recompile contract is
+        ``all(v <= 1)`` after warmup, asserted by the e2e tests."""
+        return {name: prog._cache_size() for name, prog in self._p.items()}
+
+    # ------------------------------------------------------------- prefill
+
+    def _chunked_prefill(self, tokens: np.ndarray,
+                         start_cache=None, start_len: int = 0):
+        """Run ``tokens`` [S] through fixed-width chunks starting at
+        ``start_len`` of a batch-1 slot-geometry cache (fresh unless
+        continuing a shared prefix).  Returns ``(cache, last_vec,
+        frontier)`` — ``last_vec`` the logits at the LAST REAL token
+        (chunk padding sits beyond the frontier, masked by per-row
+        visibility and overwritten as decode advances)."""
+        fam, cfg = self._fam, self._cfg
+        C = self.chunk
+        S = int(tokens.shape[0])
+        pad = (-S) % C
+        padded = np.concatenate(
+            [np.asarray(tokens, np.int32),
+             np.zeros((pad,), np.int32)]) if pad else np.asarray(
+                 tokens, np.int32)
+        chunks = padded.reshape(-1, C)
+        cache = start_cache if start_cache is not None else fam.init_cache(
+            cfg, 1, self.max_len, kv_dtype=self._kv_dtype)
+        params = self._engine.params
+        lg = None
+        for i, ch in enumerate(chunks):
+            dev = jnp.asarray(ch[None])
+            pos = start_len + i * C
+            if pos == 0:
+                lg, cache = self._p["prefill"](params, dev, cache)
+            else:
+                lg, cache = self._p["extend"](
+                    params, dev, cache, jnp.asarray([pos], jnp.int32))
+        idx = S - 1 - (len(chunks) - 1) * C
+        vec = self._p["take_last"](lg, jnp.asarray(idx, jnp.int32))
+        return cache, vec, start_len + S
+
+    def build_prefix(self, tokens: np.ndarray) -> PrefixEntry:
+        """Prefill a shared prefix once; forks ride it zero-copy."""
+        cache, _vec, frontier = self._chunked_prefill(tokens)
+        return PrefixEntry(cache=cache, length=frontier)
+
+    # ----------------------------------------------------------- admission
+
+    def admit(self, row: int, tokens: np.ndarray, key, greedy: bool,
+              temperature: float,
+              prefix: Optional[PrefixEntry] = None) -> int:
+        """Prefill ``tokens`` and land them in slot ``row``; returns the
+        row's frontier (= prompt length).  With ``prefix``, only the
+        remainder past ``prefix.length`` prefills — the prefix K/V is the
+        pooled cache, shared zero-copy."""
+        if int(tokens.shape[0]) > self.max_len:
+            raise ValueError(
+                f"prompt of {int(tokens.shape[0])} tokens overflows the "
+                f"{self.max_len}-token slot")
+        if prefix is not None:
+            if prefix.length >= tokens.shape[0]:
+                raise ValueError(
+                    f"prefix ({prefix.length} tokens) must be shorter than "
+                    f"the prompt ({tokens.shape[0]})")
+            cache, vec, frontier = self._chunked_prefill(
+                np.asarray(tokens[prefix.length:]),
+                start_cache=prefix.cache, start_len=prefix.length)
+        else:
+            cache, vec, frontier = self._chunked_prefill(np.asarray(tokens))
+        row_dev = jnp.asarray(row, jnp.int32)
+        if self._last is None:
+            self._last = jnp.zeros((self.slots,) + vec.shape, vec.dtype)
+        self.cache = self._p["write_slot"](self.cache, row_dev, cache)
+        (self.lengths, self._last, self.keys, self.greedy, self.temp,
+         self.active) = self._p["bind"](
+            self.lengths, self._last, self.keys, self.greedy, self.temp,
+            self.active, row_dev, jnp.asarray(frontier, jnp.int32), vec,
+            key, jnp.asarray(bool(greedy)),
+            jnp.asarray(float(temperature), jnp.float32))
+        return frontier
+
+    def release(self, row: int) -> None:
+        """Retire a slot: it stops advancing (its tick writes re-hit one
+        dead cell) until the next admission overwrites the whole row."""
+        self.lengths, self.active = self._p["release"](
+            self.lengths, self.active, jnp.asarray(row, jnp.int32))
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self) -> np.ndarray:
+        """One continuous-batching decode step for every slot; returns the
+        [B] int32 tokens just emitted (junk in freed slots)."""
+        if self._last is None:
+            raise RuntimeError("tick() before any admission")
+        nxt, logits, self.cache, self.lengths, self.keys = self._p["tick"](
+            self._engine.params, self.cache, self.lengths, self._last,
+            self.keys, self.greedy, self.temp, self.active)
+        self._last = logits
+        return np.asarray(nxt)

@@ -1,0 +1,116 @@
+"""The ``"serving"`` config section, typed.
+
+Same validated dataclass-model style as ``supervision/config.py``:
+
+.. code-block:: json
+
+    {"serving": {
+        "slots": 4,
+        "max_len": null,
+        "prefill_chunk": 16,
+        "queue_capacity": 64,
+        "default_max_new_tokens": 64,
+        "default_deadline_s": null,
+        "top_k": 0, "top_p": 1.0,
+        "seed": 0,
+        "max_cached_prefixes": 8,
+        "prefix_ttl_s": 600.0,
+        "journal_every_ticks": 0,
+        "eos_token_id": null
+    }}
+
+``max_len`` is the per-slot cache length — bucketed to a power of two and
+clamped to the model context (``null`` = the whole context).  Full
+reference: ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+SERVING = "serving"
+
+
+@dataclasses.dataclass
+class ServingConfig(DeepSpeedConfigModel):
+    """Continuous-batching gateway knobs (see ``docs/serving.md``)."""
+
+    #: decode-batch width B: how many requests decode concurrently.  The
+    #: slot cache is [L, B, max_len, H, D] — sized once, never resized.
+    slots: int = 4
+    #: per-slot cache length (prompt + reply budget); None = model context.
+    #: Bucketed to a power of two so nearby deployments share programs.
+    max_len: Optional[int] = None
+    #: admission prefill chunk width: prompts pad up to a multiple and
+    #: prefill through fixed-shape chunks, so admission NEVER compiles a
+    #: per-prompt-length program
+    prefill_chunk: int = 16
+    #: bounded admission queue; submit() past this rejects loudly
+    queue_capacity: int = 64
+    #: reply budget when a request doesn't name one
+    default_max_new_tokens: int = 64
+    #: seconds from submit to completion before a request times out
+    #: (None = no deadline unless the request carries one)
+    default_deadline_s: Optional[float] = None
+    #: static sampling-filter shape for the shared decode tick program
+    #: (per-request temperature/greediness are traced; the filter shape
+    #: is compiled in — one program, not one per sampling config)
+    top_k: int = 0
+    top_p: float = 1.0
+    #: base seed for per-request key derivation (requests may pin their own)
+    seed: int = 0
+    #: LRU-bounded pool of shared-prefix sessions (system prompts,
+    #: deduplicated through zero-copy ``InferenceSession.fork``); 0
+    #: disables the pool
+    max_cached_prefixes: int = 8
+    #: a pooled prefix idle longer than this is evicted on the next sweep
+    prefix_ttl_s: float = 600.0
+    #: journal a ``serve.tick`` snapshot every N ticks (0 = off)
+    journal_every_ticks: int = 0
+    #: default eos: rows emitting it finish early (None = run the budget)
+    eos_token_id: Optional[int] = None
+    #: scheduler idle wait between queue polls, seconds
+    idle_wait_s: float = 0.02
+
+    def __post_init__(self):
+        if self.slots < 1:
+            raise ValueError(f"serving.slots must be >= 1, got {self.slots}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"serving.prefill_chunk must be >= 1, got "
+                f"{self.prefill_chunk}")
+        if self.queue_capacity < 1:
+            raise ValueError(
+                f"serving.queue_capacity must be >= 1, got "
+                f"{self.queue_capacity}")
+        if self.default_max_new_tokens < 1:
+            raise ValueError(
+                f"serving.default_max_new_tokens must be >= 1, got "
+                f"{self.default_max_new_tokens}")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(
+                f"serving.top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"serving.top_k must be >= 0, got {self.top_k}")
+        if self.max_cached_prefixes < 0:
+            raise ValueError(
+                f"serving.max_cached_prefixes must be >= 0, got "
+                f"{self.max_cached_prefixes}")
+        if self.default_deadline_s is not None and self.default_deadline_s <= 0:
+            raise ValueError(
+                f"serving.default_deadline_s must be > 0, got "
+                f"{self.default_deadline_s}")
+        if self.max_len is not None and self.max_len < 2:
+            raise ValueError(
+                f"serving.max_len must be >= 2 (a prompt token and a reply "
+                f"token), got {self.max_len}")
+        if self.journal_every_ticks < 0:
+            raise ValueError(
+                f"serving.journal_every_ticks must be >= 0, got "
+                f"{self.journal_every_ticks}")
+        if self.idle_wait_s <= 0:
+            raise ValueError(
+                f"serving.idle_wait_s must be > 0, got {self.idle_wait_s}")

@@ -1,0 +1,82 @@
+"""Serving metrics: thread-safe counters + a snapshot the journal, the
+bench harness, and operators share.
+
+Kept deliberately dumb — monotonically increasing counters and a bounded
+TTFT reservoir; percentile math happens in the consumer
+(``scripts/serve_bench.py``), not the hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: TTFT samples kept (oldest dropped) — enough for p99 at bench scale
+_TTFT_CAP = 4096
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.t_start = time.monotonic()
+        self.submitted = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.timeouts = 0
+        self.failed = 0
+        self.evictions = 0
+        self.prefix_hits = 0
+        self.prefix_builds = 0
+        self.ticks = 0
+        self.tokens_out = 0
+        self.active_slot_ticks = 0   # sum over ticks of active slots
+        self.slot_ticks = 0          # sum over ticks of total slots
+        self.ttft_s: List[float] = []
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def record_tick(self, active: int, slots: int, tokens: int) -> None:
+        with self._lock:
+            self.ticks += 1
+            self.tokens_out += tokens
+            self.active_slot_ticks += active
+            self.slot_ticks += slots
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft_s.append(float(seconds))
+            if len(self.ttft_s) > _TTFT_CAP:
+                del self.ttft_s[:len(self.ttft_s) - _TTFT_CAP]
+
+    def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
+        """One coherent view: counters, slot occupancy, tokens/sec over
+        the gateway's lifetime, and the raw TTFT reservoir."""
+        with self._lock:
+            elapsed = max(time.monotonic() - self.t_start, 1e-9)
+            snap = {
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "cancelled": self.cancelled,
+                "timeouts": self.timeouts,
+                "failed": self.failed,
+                "evictions": self.evictions,
+                "prefix_hits": self.prefix_hits,
+                "prefix_builds": self.prefix_builds,
+                "ticks": self.ticks,
+                "tokens_out": self.tokens_out,
+                "elapsed_s": elapsed,
+                "tokens_per_s": self.tokens_out / elapsed,
+                "slot_occupancy": (self.active_slot_ticks / self.slot_ticks
+                                   if self.slot_ticks else 0.0),
+                "ttft_s": list(self.ttft_s),
+            }
+        if queue_depth is not None:
+            snap["queue_depth"] = queue_depth
+        return snap

@@ -44,6 +44,19 @@ Points currently wired:
 ``data.collate``          after the samples are fetched, before collate;
                           ctx: ``step``, ``indices`` (``BadRecord`` models
                           a malformed record that survives decode)
+``serve.request``         start of every serving-gateway ``submit`` call;
+                          ctx: ``request_id`` (``DelaySeconds`` models a
+                          slow client trickling requests in; raising
+                          models a broken front-end)
+``serve.admit``           inside the scheduler, before a queued request's
+                          prompt prefills into its slot; ctx:
+                          ``request_id``, ``slot`` (raising fails the one
+                          admission — the gateway must fail that request
+                          and keep serving)
+``serve.decode_tick``     top of every continuous-batching decode tick;
+                          ctx: ``tick``, ``active`` (``HangFor`` models a
+                          wedged tick, ``DelaySeconds`` a slow one —
+                          deadline/timeout behavior under pressure)
 ========================  =====================================================
 """
 
@@ -74,6 +87,9 @@ FAULT_POINTS = frozenset({
     "supervision.heartbeat",
     "data.next",
     "data.collate",
+    "serve.request",
+    "serve.admit",
+    "serve.decode_tick",
 })
 
 # points with faults installed; guarded by _lock for install/clear, read

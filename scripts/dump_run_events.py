@@ -77,6 +77,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(json.dumps(ev, default=str))
         else:
             print(_fmt(ev, args.stacks))
+    serve = [e for e in events if str(e.get("kind", "")).startswith("serve.")]
+    if serve and not args.as_json:
+        by = {}
+        for e in serve:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        print("serving: " + "  ".join(
+            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by)),
+            file=sys.stderr)
     aborts = sum(1 for e in events if e.get("kind") in ABORT_KINDS)
     if aborts:
         print(f"\n{len(events)} event(s), {aborts} abort-class",

@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Synthetic-load benchmark for the continuous-batching serving gateway.
+
+Drives a real ``ServingGateway`` (tiny random-init GPT by default) with a
+seeded Poisson arrival process and mixed prompt/reply lengths, then writes
+``BENCH_SERVE.json`` — throughput tokens/s, TTFT p50/p99, slot occupancy,
+reject/timeout counts — so serving perf is a tracked per-PR trajectory
+like ``bench_artifacts/`` (schema: ``docs/serving.md``).
+
+Usage:
+    python scripts/serve_bench.py [--slots 4] [--requests 32] [--rate 20]
+                                  [--seed 0] [--out BENCH_SERVE.json]
+
+Exit codes: 0 bench completed; 1 any request failed/was rejected
+unexpectedly (rejections are expected only when --queue-capacity binds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_engine(n_layer: int, d_model: int, n_head: int, max_seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq_len=max_seq_len,
+                        n_layer=n_layer, n_head=n_head, d_model=d_model,
+                        dtype=jnp.float32, vocab_round_to=128)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    return deepspeed_tpu.init_inference(model=(cfg, params),
+                                        config={"dtype": "float32"})
+
+
+def run_bench(args) -> dict:
+    from deepspeed_tpu.serving import QueueFullError
+
+    engine = build_engine(args.layers, args.d_model, args.heads,
+                          max_seq_len=args.max_len)
+    gw = engine.serve(config={
+        "slots": args.slots, "max_len": args.max_len,
+        "prefill_chunk": args.prefill_chunk,
+        "queue_capacity": args.queue_capacity,
+        "default_deadline_s": args.deadline_s,
+    })
+    rng = np.random.default_rng(args.seed)
+    # Poisson arrivals: exponential inter-arrival gaps at --rate req/s
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    prompts = [rng.integers(0, 256, (int(rng.integers(
+        args.min_prompt, args.max_prompt + 1)),)).astype(np.int32)
+        for _ in range(args.requests)]
+    budgets = [int(rng.integers(args.min_new, args.max_new + 1))
+               for _ in range(args.requests)]
+    sampled = rng.random(args.requests) < args.sample_frac
+
+    handles: List[Optional[object]] = []
+    rejected = 0
+    t0 = time.monotonic()
+    for i in range(args.requests):
+        time.sleep(float(gaps[i]))
+        try:
+            handles.append(gw.submit(
+                prompts[i], max_new_tokens=budgets[i],
+                do_sample=bool(sampled[i]), temperature=0.9,
+                seed=int(args.seed) + i))
+        except QueueFullError:
+            rejected += 1
+            handles.append(None)
+    ok, failed = 0, 0
+    for h in handles:
+        if h is None:
+            continue
+        try:
+            h.result(timeout=args.timeout_s)
+            ok += 1
+        except Exception as e:  # timeouts/cancels count against the run
+            print(f"  request {h.request_id} failed: {e}", file=sys.stderr)
+            failed += 1
+    wall = time.monotonic() - t0
+    snap = gw.snapshot()
+    gw.shutdown()
+
+    ttft = np.asarray(snap.pop("ttft_s") or [0.0])
+    snap.pop("compile_counts", None)
+    result = {
+        "config": {
+            "slots": args.slots, "max_len": args.max_len,
+            "prefill_chunk": args.prefill_chunk,
+            "queue_capacity": args.queue_capacity,
+            "requests": args.requests, "rate": args.rate,
+            "seed": args.seed,
+            "prompt_len": [args.min_prompt, args.max_prompt],
+            "max_new_tokens": [args.min_new, args.max_new],
+            "sample_frac": args.sample_frac,
+            "model": {"layers": args.layers, "d_model": args.d_model,
+                      "heads": args.heads},
+        },
+        "wall_s": round(wall, 3),
+        "completed": ok, "failed": failed, "rejected": rejected,
+        "throughput_tok_s": round(snap["tokens_out"] / wall, 3),
+        "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 3),
+        "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 3),
+        "slot_occupancy": round(snap["slot_occupancy"], 4),
+        "metrics": {k: v for k, v in snap.items()
+                    if isinstance(v, (int, float))},
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--prefill-chunk", type=int, default=16)
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="mean arrivals per second (Poisson)")
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=48)
+    ap.add_argument("--min-new", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--sample-frac", type=float, default=0.5,
+                    help="fraction of requests that sample (rest greedy)")
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--out", default="BENCH_SERVE.json")
+    args = ap.parse_args(argv)
+
+    result = run_bench(args)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(f"wrote {args.out}:")
+    print(f"  throughput  {result['throughput_tok_s']} tok/s")
+    print(f"  ttft        p50 {result['ttft_p50_ms']} ms   "
+          f"p99 {result['ttft_p99_ms']} ms")
+    print(f"  occupancy   {result['slot_occupancy']}")
+    print(f"  completed {result['completed']}  failed {result['failed']}  "
+          f"rejected {result['rejected']}")
+    return 1 if result["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Serving-geometry bucketing + per-request key derivation satellites:
+- ``bucket_max_new_tokens``/``bucket_cache_len`` power-of-two helpers;
+- ``_reply_prog`` compiles per BUCKET, not per ``max_new_tokens``;
+- sampled generate() calls without an explicit key draw from a fold-in
+  sequence instead of all reusing PRNGKey(0)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.bucketing import (bucket_cache_len,
+                                               bucket_max_new_tokens,
+                                               next_pow2)
+from deepspeed_tpu.models import gpt
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=128, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+def _engine():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    return deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "float32"})
+
+
+def test_bucket_helpers():
+    assert [next_pow2(n) for n in (1, 2, 3, 5, 8, 9, 64, 65)] == \
+        [1, 2, 4, 8, 8, 16, 64, 128]
+    assert bucket_max_new_tokens(1) == 8          # floor
+    assert bucket_max_new_tokens(9) == 16
+    assert bucket_max_new_tokens(100, cap=128) == 128
+    assert bucket_cache_len(5, 128) == 8
+    assert bucket_cache_len(100, 128) == 128
+    assert bucket_cache_len(100, 96) == 96        # clamped to the context
+    with pytest.raises(ValueError):
+        next_pow2(0)
+    with pytest.raises(ValueError):
+        bucket_max_new_tokens(200, cap=128)
+
+
+def test_start_session_buckets_cache_geometry():
+    """Sessions with nearby max_len land on one cache geometry (shared
+    compiled programs); explicit powers of two are untouched."""
+    eng = _engine()
+    assert eng.start_session(max_len=48).cache.max_len == 64
+    assert eng.start_session(max_len=50).cache.max_len == 64
+    assert eng.start_session(max_len=64).cache.max_len == 64
+    assert eng.start_session().cache.max_len == 128   # model context
+
+
+def test_reply_prog_shared_across_bucket():
+    """generate(5) and generate(7) ride ONE compiled reply program (the
+    8-bucket); outputs keep exact per-n semantics — greedy n=5 equals the
+    first 5 tokens of n=8 from the same state."""
+    eng = _engine()
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, 256, (1, 6)), jnp.int32)
+
+    s1 = eng.start_session(batch=1, max_len=64)
+    s1.append(prompt)
+    r5 = np.asarray(s1.generate(max_new_tokens=5))
+    assert r5.shape == (1, 5)
+    s2 = eng.start_session(batch=1, max_len=64)
+    s2.append(prompt)
+    r7 = np.asarray(s2.generate(max_new_tokens=7))
+    s3 = eng.start_session(batch=1, max_len=64)
+    s3.append(prompt)
+    r8 = np.asarray(s3.generate(max_new_tokens=8))
+    # one bucket → one program for all three
+    assert len(s1._progs["reply"]) == 1
+    prog = next(iter(s1._progs["reply"].values()))
+    assert prog._cache_size() == 1
+    np.testing.assert_array_equal(r5, r8[:, :5])
+    np.testing.assert_array_equal(r7, r8[:, :7])
+    # the cache advanced by n, not by the bucket
+    assert s1.length == 6 + 5 and s2.length == 6 + 7
+
+
+def test_reply_prog_partial_bucket_keeps_conversation_state():
+    """After a non-bucket-aligned reply, the next turn continues from the
+    true frontier — dead bucket steps never leak into the cache."""
+    eng = _engine()
+    rng = np.random.default_rng(1)
+    t1 = jnp.asarray(rng.integers(0, 256, (1, 9)), jnp.int32)
+    t2 = jnp.asarray(rng.integers(0, 256, (1, 5)), jnp.int32)
+    s = eng.start_session(batch=1, max_len=128)
+    s.append(t1)
+    r1 = s.generate(max_new_tokens=5)          # bucket 8, 3 dead steps
+    s.append(t2)
+    r2 = np.asarray(s.generate(max_new_tokens=5))
+    # stateless reference over the concatenated history
+    hist = jnp.concatenate([t1, r1, t2], axis=1)
+    ref = np.asarray(eng.generate(hist, max_new_tokens=5))
+    np.testing.assert_array_equal(r2, ref)
+
+
+def test_default_sampling_keys_are_a_sequence():
+    """Without an explicit key, two sampled calls must NOT be bitwise
+    identical (the old PRNGKey(0) default made every reply the same);
+    pinned keys stay reproducible."""
+    eng = _engine()
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    a = np.asarray(eng.generate(prompt, max_new_tokens=8, do_sample=True,
+                                temperature=0.9))
+    b = np.asarray(eng.generate(prompt, max_new_tokens=8, do_sample=True,
+                                temperature=0.9))
+    assert not np.array_equal(a, b)
+    # sessions: same contract
+    s = eng.start_session(batch=2, max_len=64)
+    s.append(prompt)
+    r1 = np.asarray(s.generate(8, do_sample=True, temperature=0.9))
+    s2 = eng.start_session(batch=2, max_len=64)
+    s2.append(prompt)
+    r2 = np.asarray(s2.generate(8, do_sample=True, temperature=0.9))
+    # fresh sessions start the same seed sequence → reproducible runs
+    np.testing.assert_array_equal(r1, r2)
+    # but the SAME session never repeats its previous draw
+    s.append(prompt)
+    r3 = np.asarray(s.generate(8, do_sample=True, temperature=0.9))
+    assert not np.array_equal(r1, r3)

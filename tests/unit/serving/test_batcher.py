@@ -1,0 +1,172 @@
+"""Slot ops + SlotBatcher: per-row admission into a live cache, batched
+ragged decode ticks, and the no-recompile contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt, gpt_inference, gpt_moe, \
+    gpt_moe_inference
+from deepspeed_tpu.serving import ServingConfig, SlotBatcher
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=128, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+def _engine(**kw):
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    cfg = {"dtype": "float32"}
+    cfg.update(kw)
+    return deepspeed_tpu.init_inference(model=(CFG, params), config=cfg)
+
+
+# ------------------------------------------------------------- slot ops
+
+def test_write_read_reset_slot_dense():
+    """write_slot inserts a batch-1 cache at one row and ONLY that row;
+    read_slot round-trips it; reset_slot zeroes it."""
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    big = gpt_inference.init_cache(CFG, 3, 32)
+    t = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 256)
+    _, small = gpt_inference.prefill(params, t, CFG,
+                                     gpt_inference.init_cache(CFG, 1, 32))
+    big2 = gpt_inference.write_slot(big, jnp.asarray(1), small)
+    np.testing.assert_array_equal(np.asarray(big2.k[:, 1]),
+                                  np.asarray(small.k[:, 0]))
+    # other rows untouched (still zero)
+    assert not np.asarray(big2.k[:, 0]).any()
+    assert not np.asarray(big2.k[:, 2]).any()
+    back = gpt_inference.read_slot(big2, jnp.asarray(1), length=8)
+    np.testing.assert_array_equal(np.asarray(back.k), np.asarray(small.k))
+    assert int(back.length) == 8
+    wiped = gpt_inference.reset_slot(big2, jnp.asarray(1))
+    assert not np.asarray(wiped.k[:, 1]).any()
+    # geometry violations are loud
+    with pytest.raises(ValueError, match="max_len"):
+        gpt_inference.write_slot(gpt_inference.init_cache(CFG, 3, 16), 0,
+                                 small)
+    with pytest.raises(ValueError, match="int8"):
+        gpt_inference.write_slot(
+            gpt_inference.init_cache(CFG, 3, 32, kv_dtype="int8"), 0, small)
+
+
+def test_write_slot_int8_scales():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    big = gpt_inference.init_cache(CFG, 2, 32, kv_dtype="int8")
+    t = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, 256)
+    _, small = gpt_inference.prefill(
+        params, t, CFG, gpt_inference.init_cache(CFG, 1, 32,
+                                                 kv_dtype="int8"))
+    big2 = gpt_inference.write_slot(big, jnp.asarray(0), small)
+    np.testing.assert_array_equal(np.asarray(big2.k_scale[:, 0]),
+                                  np.asarray(small.k_scale[:, 0]))
+    assert gpt_inference.read_slot(big2, jnp.asarray(0)).int8
+
+
+def test_write_read_slot_moe_banks():
+    mcfg = gpt_moe.GPTMoEConfig(vocab_size=128, max_seq_len=64, n_layer=2,
+                                n_head=2, d_model=32, dtype=jnp.float32,
+                                vocab_round_to=128, num_experts=2)
+    mparams = gpt_moe.init(mcfg, jax.random.PRNGKey(0))
+    big = gpt_moe_inference.init_cache(mcfg, 2, 32)
+    t = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0, 128)
+    _, small = gpt_moe_inference.prefill(
+        params=mparams, tokens=t, config=mcfg,
+        cache=gpt_moe_inference.init_cache(mcfg, 1, 32))
+    big2 = gpt_moe_inference.write_slot(big, jnp.asarray(1), small)
+    for bank in ("dense_k", "dense_v", "moe_k", "moe_v"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(big2, bank)[:, 1]),
+            np.asarray(getattr(small, bank)[:, 0]), err_msg=bank)
+        assert not np.asarray(getattr(big2, bank)[:, 0]).any()
+    back = gpt_moe_inference.read_slot(big2, jnp.asarray(1), length=5)
+    assert int(back.length) == 5 and back.batch == 1
+    assert not np.asarray(
+        gpt_moe_inference.reset_slot(big2, jnp.asarray(1)).moe_k[:, 1]).any()
+
+
+# -------------------------------------------------------------- batcher
+
+def test_batcher_admit_tick_release_matches_sequential():
+    """Admit two rows, tick a few times, release one, admit a third into
+    the freed slot: every row's tokens match its own batch-1 run, and no
+    program compiled more than once."""
+    eng = _engine()
+    bat = SlotBatcher(eng, ServingConfig.from_dict(
+        {"slots": 2, "max_len": 64, "prefill_chunk": 8}))
+    assert bat.max_len == 64
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, (L,)).astype(np.int32)
+               for L in (5, 11, 7)]
+
+    def reference(p, n):
+        s = eng.start_session(batch=1, max_len=64)
+        s.append(jnp.asarray(p[None]))
+        return np.asarray(s.generate(max_new_tokens=n))[0].tolist()
+
+    key = jax.random.PRNGKey(9)
+    got = {0: [], 1: []}
+    bat.admit(0, prompts[0], key, True, 1.0)
+    bat.admit(1, prompts[1], key, True, 1.0)
+    for _ in range(4):
+        toks = bat.tick()
+        got[0].append(int(toks[0]))
+        got[1].append(int(toks[1]))
+    assert got[0] == reference(prompts[0], 4)
+    assert got[1] == reference(prompts[1], 4)
+
+    # slot 0 retires; a new prompt lands in it while slot 1 keeps decoding
+    bat.release(0)
+    bat.admit(0, prompts[2], key, True, 1.0)
+    got = {0: [], 1: []}
+    for _ in range(3):
+        toks = bat.tick()
+        got[0].append(int(toks[0]))
+        got[1].append(int(toks[1]))
+    assert got[0] == reference(prompts[2], 3)
+    assert got[1] == reference(prompts[1], 7)[4:]
+    counts = bat.compile_counts()
+    assert all(v <= 1 for v in counts.values()), counts
+
+
+def test_batcher_prefix_fork_admission():
+    """A pooled prefix admits through zero-copy fork: prefix prefilled
+    once, remainder extended at the true frontier — output equals the
+    whole prompt admitted flat."""
+    eng = _engine()
+    bat = SlotBatcher(eng, ServingConfig.from_dict(
+        {"slots": 2, "max_len": 64, "prefill_chunk": 8}))
+    rng = np.random.default_rng(1)
+    system = rng.integers(0, 256, (12,)).astype(np.int32)
+    turn = rng.integers(0, 256, (6,)).astype(np.int32)
+    whole = np.concatenate([system, turn])
+    key = jax.random.PRNGKey(4)
+
+    entry = bat.build_prefix(system)
+    assert entry.length == 12
+    bat.admit(0, whole, key, True, 1.0, prefix=entry)
+    bat.admit(1, whole, key, True, 1.0)          # flat, no prefix
+    a, b = [], []
+    for _ in range(5):
+        toks = bat.tick()
+        a.append(int(toks[0]))
+        b.append(int(toks[1]))
+    assert a == b
+    # a prefix at least as long as the prompt is a usage error
+    with pytest.raises(ValueError, match="shorter than"):
+        bat.admit(0, system, key, True, 1.0,
+                  prefix=bat.build_prefix(whole))
+
+
+def test_batcher_overflow_and_tick_guards():
+    eng = _engine()
+    bat = SlotBatcher(eng, ServingConfig.from_dict(
+        {"slots": 1, "max_len": 16, "prefill_chunk": 8}))
+    with pytest.raises(RuntimeError, match="before any admission"):
+        bat.tick()
+    with pytest.raises(ValueError, match="overflows"):
+        bat.admit(0, np.zeros(20, np.int32), jax.random.PRNGKey(0), True,
+                  1.0)

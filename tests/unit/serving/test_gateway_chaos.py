@@ -1,0 +1,245 @@
+"""Gateway chaos scenarios: request storms past capacity, mid-decode
+cancellation, deadline timeouts, admission faults, prefix-pool eviction —
+all driven through the registered ``serve.*`` fault points and asserted
+against the journal, never by monkeypatching scheduler internals."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt
+from deepspeed_tpu.runtime.supervision.events import EventJournal, EventKind
+from deepspeed_tpu.serving import (QueueFullError, RequestCancelled,
+                                   RequestFailed, RequestTimedOut)
+from deepspeed_tpu.utils import fault_injection
+from deepspeed_tpu.utils.fault_injection import (DelaySeconds, FailNTimes,
+                                                 HangFor)
+
+pytestmark = pytest.mark.chaos
+
+CFG = gpt.GPTConfig(vocab_size=256, max_seq_len=128, n_layer=2, n_head=4,
+                    d_model=64, dtype=jnp.float32, vocab_round_to=128)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    fault_injection.clear()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = gpt.init(CFG, jax.random.PRNGKey(0))
+    return deepspeed_tpu.init_inference(model=(CFG, params),
+                                        config={"dtype": "float32"})
+
+
+def _gateway(engine, tmp_path, autostart=True, **cfg):
+    base = {"slots": 2, "max_len": 64, "prefill_chunk": 8,
+            "queue_capacity": 4, "idle_wait_s": 0.01}
+    base.update(cfg)
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    return engine.serve(config=base, journal=journal,
+                        autostart=autostart), journal
+
+
+def _prompt(rng, lo=3, hi=12):
+    return rng.integers(0, 256, (int(rng.integers(lo, hi)),)).astype(
+        np.int32)
+
+
+def _kinds(journal):
+    return [e["kind"] for e in journal.read()]
+
+
+def test_request_storm_beyond_capacity(engine, tmp_path):
+    """Storm a stopped gateway: the bounded queue rejects the overflow
+    loudly; once started, everything queued completes with zero
+    recompiles past warmup."""
+    gw, journal = _gateway(engine, tmp_path, autostart=False,
+                           queue_capacity=4)
+    rng = np.random.default_rng(0)
+    handles, rejected = [], 0
+    for i in range(7):
+        try:
+            handles.append(gw.submit(_prompt(rng), max_new_tokens=4))
+        except QueueFullError:
+            rejected += 1
+    assert rejected == 3 and len(handles) == 4
+    gw.start()
+    outs = [h.result(timeout=90) for h in handles]
+    assert all(o.shape == (4,) for o in outs)
+    snap = gw.snapshot()
+    assert snap["rejected"] == 3 and snap["completed"] == 4
+    assert all(v <= 1 for v in snap["compile_counts"].values()), \
+        snap["compile_counts"]
+    kinds = _kinds(journal)
+    assert kinds.count(EventKind.SERVE_REJECT) == 3
+    assert kinds.count(EventKind.SERVE_DONE) == 4
+    gw.shutdown()
+    with pytest.raises(QueueFullError, match="shut down"):
+        gw.submit(_prompt(rng), max_new_tokens=4)
+
+
+def test_mid_decode_cancellation(engine, tmp_path):
+    """Cancel a long generation mid-decode: the caller gets
+    RequestCancelled with the partial tokens, the journal records the
+    cancel, and the freed slot serves the next request."""
+    gw, journal = _gateway(engine, tmp_path, slots=1)
+    rng = np.random.default_rng(1)
+    h = gw.submit(_prompt(rng), max_new_tokens=50)
+    while h.tokens_out < 3:        # genuinely mid-decode
+        time.sleep(0.01)
+    assert h.cancel()
+    with pytest.raises(RequestCancelled) as ei:
+        h.result(timeout=60)
+    assert ei.value.partial.shape[0] >= 3
+    assert h.state == "cancelled"
+    # slot is reusable
+    out = gw.submit(_prompt(rng), max_new_tokens=3).result(timeout=60)
+    assert out.shape == (3,)
+    kinds = _kinds(journal)
+    assert EventKind.SERVE_CANCEL in kinds and EventKind.SERVE_DONE in kinds
+    gw.shutdown()
+
+
+def test_deadline_timeout_mid_decode_journaled(engine, tmp_path):
+    """A slow decode tick (injected) blows a request's deadline: the
+    caller gets RequestTimedOut with partial output and the journal has
+    the serve.timeout with queued=False."""
+    gw, journal = _gateway(engine, tmp_path, slots=1)
+    with fault_injection.inject("serve.decode_tick",
+                                DelaySeconds(0.15, n=None)):
+        h = gw.submit(np.arange(5, dtype=np.int32), max_new_tokens=50,
+                      deadline_s=0.4)
+        with pytest.raises(RequestTimedOut) as ei:
+            h.result(timeout=60)
+    assert 0 < ei.value.partial.shape[0] < 50
+    evs = [e for e in journal.read()
+           if e["kind"] == EventKind.SERVE_TIMEOUT]
+    assert evs and evs[0]["queued"] is False
+    assert gw.snapshot()["timeouts"] == 1
+    gw.shutdown()
+
+
+def test_deadline_timeout_while_queued(engine, tmp_path):
+    """A request whose deadline passes before any slot frees is expired
+    from the queue, journaled with queued=True."""
+    gw, journal = _gateway(engine, tmp_path, autostart=False)
+    h = gw.submit(np.arange(4, dtype=np.int32), max_new_tokens=4,
+                  deadline_s=0.05)
+    time.sleep(0.1)
+    gw.start()
+    with pytest.raises(RequestTimedOut):
+        h.result(timeout=60)
+    evs = [e for e in journal.read()
+           if e["kind"] == EventKind.SERVE_TIMEOUT]
+    assert evs and evs[0]["queued"] is True and evs[0]["tokens_out"] == 0
+    gw.shutdown()
+
+
+def test_admission_fault_fails_one_request_not_the_gateway(engine,
+                                                           tmp_path):
+    """A raising fault at serve.admit fails exactly that request; the
+    scheduler keeps serving the rest."""
+    gw, journal = _gateway(engine, tmp_path, slots=1)
+    with fault_injection.inject("serve.admit", FailNTimes(1)):
+        h1 = gw.submit(np.arange(4, dtype=np.int32), max_new_tokens=3)
+        h2 = gw.submit(np.arange(6, dtype=np.int32), max_new_tokens=3)
+        with pytest.raises(RequestFailed, match="admission failed"):
+            h1.result(timeout=60)
+        assert h2.result(timeout=60).shape == (3,)
+    kinds = _kinds(journal)
+    assert EventKind.SERVE_REJECT in kinds      # admission_error reject
+    assert gw.snapshot()["failed"] == 1
+    gw.shutdown()
+
+
+def test_slow_client_fault_point(engine, tmp_path):
+    """serve.request faults fire inside submit() — a DelaySeconds there
+    models a slow client and is visible as raised submit latency."""
+    gw, _ = _gateway(engine, tmp_path)
+    with fault_injection.inject("serve.request",
+                                DelaySeconds(0.2, n=1)) as f:
+        t0 = time.monotonic()
+        h = gw.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        assert time.monotonic() - t0 >= 0.2 and f.fired == 1
+    assert h.result(timeout=60).shape == (2,)
+    gw.shutdown()
+
+
+def test_wedged_tick_holds_queue_then_drains(engine, tmp_path):
+    """HangFor at serve.decode_tick wedges the loop mid-storm; releasing
+    it drains the backlog — detection-and-recovery, not a deadlock."""
+    gw, _ = _gateway(engine, tmp_path, slots=1, queue_capacity=8)
+    rng = np.random.default_rng(3)
+    with fault_injection.inject("serve.decode_tick",
+                                HangFor(30.0)) as hang:
+        handles = [gw.submit(_prompt(rng), max_new_tokens=3)
+                   for _ in range(4)]
+        time.sleep(0.2)
+        assert sum(h.done() for h in handles) == 0   # wedged
+        hang.release()
+        outs = [h.result(timeout=90) for h in handles]
+    assert all(o.shape == (3,) for o in outs)
+    gw.shutdown()
+
+
+def test_prefix_pool_eviction_lru(engine, tmp_path):
+    """max_cached_prefixes=1: a second distinct prefix evicts the first
+    (serve.evict journaled); re-using the first rebuilds it."""
+    gw, journal = _gateway(engine, tmp_path, max_cached_prefixes=1)
+    rng = np.random.default_rng(4)
+    pa = rng.integers(0, 256, (10,)).astype(np.int32)
+    pb = rng.integers(0, 256, (10,)).astype(np.int32)
+    turn = rng.integers(0, 256, (4,)).astype(np.int32)
+
+    def ask(prefix):
+        return gw.submit(np.concatenate([prefix, turn]), max_new_tokens=3,
+                         prefix_len=10)
+
+    a1 = ask(pa).result(timeout=60)
+    a2 = ask(pa).result(timeout=60)          # pool hit
+    ask(pb).result(timeout=60)               # evicts pa
+    a3 = ask(pa).result(timeout=60)          # rebuild
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(a1, a3)
+    snap = gw.snapshot()
+    assert snap["prefix_builds"] == 3 and snap["prefix_hits"] == 1
+    assert snap["evictions"] >= 2
+    assert EventKind.SERVE_EVICT in _kinds(journal)
+    gw.shutdown()
+
+
+def test_queued_cancellation(engine, tmp_path):
+    """Cancelling while still queued never touches a slot."""
+    gw, journal = _gateway(engine, tmp_path, autostart=False)
+    h = gw.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+    assert h.cancel()
+    gw.start()
+    with pytest.raises(RequestCancelled):
+        h.result(timeout=60)
+    ev = [e for e in journal.read()
+          if e["kind"] == EventKind.SERVE_CANCEL][0]
+    assert ev["slot"] is None and ev["tokens_out"] == 0
+    gw.shutdown()
+
+
+def test_priority_over_fifo(engine, tmp_path):
+    """Higher-priority requests admit first; FIFO breaks ties."""
+    gw, _ = _gateway(engine, tmp_path, autostart=False, slots=1,
+                     queue_capacity=8)
+    rng = np.random.default_rng(5)
+    low = [gw.submit(_prompt(rng), max_new_tokens=2) for _ in range(2)]
+    high = gw.submit(_prompt(rng), max_new_tokens=2, priority=10)
+    gw.start()
+    for h in low + [high]:
+        h.result(timeout=90)
+    # the priority request was admitted before both earlier-submitted ones
+    assert high.t_admit < min(h.t_admit for h in low)
+    gw.shutdown()

@@ -1,0 +1,53 @@
+"""Serving CLI tooling: the synthetic-load bench writes a well-formed
+BENCH_SERVE.json, and dump_run_events renders serve.* journals with the
+serving summary footer."""
+
+import importlib.util
+import json
+import os
+
+from deepspeed_tpu.runtime.supervision.events import EventJournal, EventKind
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                        "scripts")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_bench_writes_artifact(tmp_path, capsys):
+    serve_bench = _load("serve_bench")
+    out = tmp_path / "BENCH_SERVE.json"
+    rc = serve_bench.main([
+        "--requests", "5", "--rate", "50", "--slots", "2",
+        "--max-len", "64", "--max-prompt", "16", "--max-new", "8",
+        "--out", str(out)])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    for key in ("throughput_tok_s", "ttft_p50_ms", "ttft_p99_ms",
+                "slot_occupancy", "completed", "config", "wall_s"):
+        assert key in data, key
+    assert data["completed"] == 5 and data["failed"] == 0
+    assert data["throughput_tok_s"] > 0
+    assert "throughput" in capsys.readouterr().out
+
+
+def test_dump_run_events_renders_serve_kinds(tmp_path, capsys):
+    dump_run_events = _load("dump_run_events")
+    j = EventJournal(str(tmp_path / "events.jsonl"))
+    j.emit(EventKind.SERVE_REQUEST, request_id="req-1", prompt_len=7,
+           max_new_tokens=4, priority=0, queue_depth=1)
+    j.emit(EventKind.SERVE_ADMIT, request_id="req-1", slot=0,
+           queued_ms=1.5, prefix_hit=False)
+    j.emit(EventKind.SERVE_DONE, request_id="req-1", slot=0, tokens_out=4,
+           ttft_ms=12.0, tok_per_s=80.0)
+    rc = dump_run_events.main([str(tmp_path)])
+    assert rc == 0          # serve.* kinds are not abort-class
+    cap = capsys.readouterr()
+    assert "serve.request" in cap.out and "request_id=req-1" in cap.out
+    assert "serving:" in cap.err and "done=1" in cap.err

@@ -1,0 +1,38 @@
+"""ServingConfig validation: bad geometry/policy knobs fail loudly at
+construction, not as a silent mis-serving gateway."""
+
+import pytest
+
+from deepspeed_tpu.serving import ServingConfig
+
+
+def test_defaults_valid():
+    cfg = ServingConfig()
+    assert cfg.slots == 4 and cfg.queue_capacity == 64
+    assert cfg.max_len is None and cfg.default_deadline_s is None
+
+
+def test_from_dict_round_trip():
+    cfg = ServingConfig.from_dict({"slots": 2, "max_len": 48,
+                                   "prefill_chunk": 8, "top_p": 0.9})
+    assert (cfg.slots, cfg.max_len, cfg.prefill_chunk, cfg.top_p) == \
+        (2, 48, 8, 0.9)
+
+
+@pytest.mark.parametrize("bad", [
+    {"slots": 0},
+    {"prefill_chunk": 0},
+    {"queue_capacity": 0},
+    {"default_max_new_tokens": 0},
+    {"top_p": 0.0},
+    {"top_p": 1.5},
+    {"top_k": -1},
+    {"max_cached_prefixes": -1},
+    {"default_deadline_s": 0.0},
+    {"max_len": 1},
+    {"journal_every_ticks": -1},
+    {"idle_wait_s": 0.0},
+])
+def test_invalid_configs_raise(bad):
+    with pytest.raises(ValueError):
+        ServingConfig.from_dict(bad)

@@ -6,7 +6,8 @@ its consumers actually stay in sync with it.  Checked:
   ``dump_run_events`` can one-line it) and every ``SUMMARY_FIELDS`` /
   ``ABORT_KINDS`` entry names a registered kind;
 - the journal-schema tables in ``docs/run-supervision.md``,
-  ``docs/data-determinism.md``, and ``docs/checkpoint-durability.md``
+  ``docs/data-determinism.md``, ``docs/checkpoint-durability.md``, and
+  ``docs/serving.md``
   (the markdown tables whose first header cell is ``` `kind` ```)
   document every registered kind — exactly or via a ``prefix.*`` wildcard
   row — and name no kind that isn't registered.
@@ -23,7 +24,7 @@ from .core import Finding, Project
 RULE_ID = "event-kind-drift"
 
 KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
-             "docs/checkpoint-durability.md")
+             "docs/checkpoint-durability.md", "docs/serving.md")
 
 _CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
 
