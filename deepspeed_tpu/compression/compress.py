@@ -207,5 +207,7 @@ def redundancy_clean(params: PyTree, deepspeed_config: Dict[str, Any]) -> PyTree
     ``redundancy_clean``): the returned tree is what the compressed model
     computes with, suitable for export/serving."""
     config = get_compression_config(deepspeed_config)
+    # one-shot export/bake step, not a serving or train path
+    # dslint: disable=jit-in-hot-path — jit invoked once and discarded
     return jax.jit(
         lambda p: compression_transform(p, _ALWAYS_ON, config))(params)

@@ -16,6 +16,17 @@ from __future__ import annotations
 #: no bucket smaller than this — tiny programs aren't worth distinguishing
 MIN_BUCKET = 8
 
+#: registered bucketing entry points — the single source of truth dslint's
+#: ``unbucketed-static-arg`` rule checks against (like ``FAULT_POINTS``):
+#: a request- or config-level shape scalar that keys a compiled-program
+#: cache must route through one of these names
+BUCKETING_HELPERS = (
+    "next_pow2",
+    "bucket_max_new_tokens",
+    "bucket_cache_len",
+    "tile_cache_len",
+)
+
 
 def next_pow2(n: int) -> int:
     """Smallest power of two >= n (n >= 1)."""
@@ -45,3 +56,13 @@ def bucket_cache_len(n: int, cap: int) -> int:
     if n < 1:
         raise ValueError(f"cache length must be >= 1, got {n}")
     return min(max(next_pow2(n), MIN_BUCKET), int(cap))
+
+
+def tile_cache_len(max_len: int, cap: int) -> int:
+    """Round a cache length up to a 128 multiple so the decode kernel
+    tiles (and compiles amortize across nearby lengths), clamped to the
+    model context ``cap``.  Coarser than :func:`bucket_cache_len` — the
+    batch ``generate()`` path uses it so one program serves a 128-token
+    neighborhood of budgets."""
+    max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
+    return min(max_len, cap)

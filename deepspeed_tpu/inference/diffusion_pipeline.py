@@ -84,7 +84,7 @@ class DiffusionPipeline:
             # SD latent scaling: the VAE was trained on x/0.18215
             return vae_decode(vparams, latents / 0.18215, vcfg)
 
-        return jax.jit(run)
+        return run
 
     def __call__(self, text_embeds, uncond_embeds=None, steps: int = 50,
                  guidance_scale: float = 7.5, height: Optional[int] = None,
@@ -120,7 +120,9 @@ class DiffusionPipeline:
             key, (B, h, w, ucfg.in_channels), jnp.float32)
         sig = (steps, guided, h, w)
         if sig not in self._cache:
-            self._cache[sig] = self._build(steps, guided)
+            # jit HERE, at the cache-assign site: _build returns the raw
+            # loop so a fresh jit can never silently escape the cache
+            self._cache[sig] = jax.jit(self._build(steps, guided))
         if uncond_embeds is None:
             uncond_embeds = jnp.zeros_like(text_embeds)
         return self._cache[sig](self.unet.params, self.vae.params,

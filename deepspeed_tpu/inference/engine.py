@@ -26,17 +26,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import gpt
 from ..parallel.mesh import MODEL_AXIS, MeshManager, get_mesh_manager
+from ..utils.compile_watch import CompiledProgramRegistry
 from ..utils.logging import logger
+from .bucketing import bucket_max_new_tokens, tile_cache_len as _tile_cache_len
 from .config import DeepSpeedInferenceConfig
 
 PyTree = Any
-
-
-def _tile_cache_len(max_len: int, cap: int) -> int:
-    """Round a cache length up so the decode kernel tiles (and recompiles
-    amortize across nearby lengths), clamped to the model's context."""
-    max_len = -(-max_len // 128) * 128 if max_len > 128 else max_len
-    return min(max_len, cap)
 
 
 def _serving_dtype(config: DeepSpeedInferenceConfig):
@@ -136,7 +131,11 @@ class InferenceEngine:
         self.params = _shard_and_quantize(
             self.params, self._logical_axes, self.mesh_manager, want_tp,
             self._weight_int8, int8_compute=self._int8_compute)
-        self._forward_jit = jax.jit(self._apply_fn)
+        #: every compiled program this engine drives, by name — the
+        #: compile-discipline gate (utils/compile_watch.py) watches it
+        self.compile_registry = CompiledProgramRegistry("inference")
+        self._forward_jit = self.compile_registry.register(
+            "forward", jax.jit(self._apply_fn))
         self._generate_cache: Dict[Tuple, Any] = {}
         # default sampling keys come from a fold-in sequence, not a fixed
         # PRNGKey(0): two sampled generate() calls must not be bitwise
@@ -158,8 +157,15 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- generate
 
-    def _build_generate(self, max_len: int, max_new: int, greedy: bool,
+    def _build_generate(self, max_len: int, n_bucket: int, greedy: bool,
                         eos: Optional[int], top_k: int, top_p: float):
+        """The raw generate loop for one ``(max_len, n_bucket, ...)``
+        shape class; the caller jits it ONCE into ``_generate_cache``
+        (jit caches key on the wrapped function object — a fresh jit per
+        call here would recompile every request).  ``n_bucket`` is the
+        power-of-two reply-budget bucket; the TRUE budget arrives as the
+        traced ``n_new`` operand, so nearby budgets share one program and
+        the loop just stops early."""
         cfg = self.model_config
 
         fam = self._family
@@ -174,7 +180,8 @@ class InferenceEngine:
 
         kv_dtype = self._kv_dtype
 
-        def run(params, tokens, prompt_len, key, temperature, is_ragged):
+        def run(params, tokens, prompt_len, key, temperature, n_new,
+                is_ragged):
             B, S = tokens.shape
             cache = (fam.init_cache(cfg, B, max_len, kv_dtype=kv_dtype)
                      if kv_dtype is not None else
@@ -182,13 +189,13 @@ class InferenceEngine:
             logits, cache = fam.prefill(params, tokens, cfg, cache)
             # logits at the last *prompt* token predict the first new token
             last = logits[jnp.arange(B), prompt_len - 1]
-            out = jnp.full((B, max_new), eos if eos is not None else 0,
+            out = jnp.full((B, n_bucket), eos if eos is not None else 0,
                            jnp.int32)
             done0 = jnp.zeros((B,), bool)
 
             def cond(st):
                 i, _, _, _, _, _, done = st
-                return jnp.logical_and(i < max_new, ~jnp.all(done))
+                return jnp.logical_and(i < n_new, ~jnp.all(done))
 
             def body(st):
                 i, out, last, cache, lengths, key, done = st
@@ -212,7 +219,7 @@ class InferenceEngine:
                 (jnp.int32(0), out, last, cache, prompt_len, key, done0))
             return out
 
-        return jax.jit(run, static_argnums=(5,))
+        return run
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
@@ -248,20 +255,29 @@ class InferenceEngine:
                 f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
                 f"max_seq_len ({self.model_config.max_seq_len}); decoding "
                 "past it would silently overwrite the last cache slot")
-        max_len = _tile_cache_len(S + max_new_tokens,
+        # bucket the reply budget: budgets of 5, 6, and 8 share one
+        # program (the true budget is a traced operand of the loop), and
+        # the cache length tiles off the BUCKET so geometry shares too
+        n_bucket = bucket_max_new_tokens(max_new_tokens)
+        max_len = _tile_cache_len(S + n_bucket,
                                   self.model_config.max_seq_len)
-        sig = (max_len, max_new_tokens, not do_sample, eos_token_id,
+        sig = (max_len, n_bucket, not do_sample, eos_token_id,
                top_k, top_p)
         if sig not in self._generate_cache:
-            self._generate_cache[sig] = self._build_generate(
-                max_len, max_new_tokens, greedy=not do_sample,
-                eos=eos_token_id, top_k=top_k, top_p=top_p)
+            self._generate_cache[sig] = self.compile_registry.register(
+                f"generate:{sig}",
+                jax.jit(self._build_generate(
+                    max_len, n_bucket, greedy=not do_sample,
+                    eos=eos_token_id, top_k=top_k, top_p=top_p),
+                    static_argnums=(6,)))
         key = key if key is not None else self._next_key()
         lens = jnp.asarray(prompt_lens, jnp.int32) if is_ragged \
             else jnp.full((B,), S, jnp.int32)
-        return self._generate_cache[sig](
+        out = self._generate_cache[sig](
             self.params, tokens, lens,
-            key, jnp.asarray(temperature, jnp.float32), is_ragged)
+            key, jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(max_new_tokens, jnp.int32), is_ragged)
+        return out[:, :max_new_tokens]
 
     # ---------------------------------------------------------- speculative
 
@@ -308,6 +324,10 @@ class InferenceEngine:
                 "draft must be (gpt.GPTConfig, params) or a dense "
                 f"GPT-family InferenceEngine (got config {type(dcfg)})")
         tokens = jnp.asarray(tokens, jnp.int32)
+        # the budget is baked into the draft/verify round structure
+        # (rounds accept variable token counts); bucketing it would run
+        # dead verify forwards, so speculative programs are per-budget:
+        # dslint: disable=unbucketed-static-arg — deliberate per-budget jit
         sig = ("spec", tokens.shape, int(max_new_tokens), int(draft_k),
                float(temperature), int(top_k), float(top_p),
                str(dcfg))  # draft ARCH baked in
@@ -321,7 +341,8 @@ class InferenceEngine:
                                             temperature=temperature,
                                             top_k=top_k, top_p=top_p, key=k)
 
-            self._generate_cache[sig] = jax.jit(run)
+            self._generate_cache[sig] = self.compile_registry.register(
+                f"speculative:{sig}", jax.jit(run))
         key = key if key is not None else self._next_key()
         return self._generate_cache[sig](self.params, dparams, tokens, key)
 
@@ -364,15 +385,26 @@ class InferenceEngine:
         if not hasattr(self, "_session_progs"):
             fam = self._family
             cfg = self.model_config
+            reg = self.compile_registry
             self._session_progs = {
-                "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
-                "extend": jax.jit(lambda p, t, c: fam.extend(p, t, cfg, c)),
-                "decode": jax.jit(
-                    lambda p, t, c: fam.decode_step(p, t, cfg, c)),
+                **reg.register_all({
+                    "prefill": jax.jit(
+                        lambda p, t, c: fam.prefill(p, t, cfg, c)),
+                    "extend": jax.jit(
+                        lambda p, t, c: fam.extend(p, t, cfg, c)),
+                    "decode": jax.jit(
+                        lambda p, t, c: fam.decode_step(p, t, cfg, c)),
+                }, prefix="session."),
                 "reply": {},   # fused reply loops, keyed by
                                # (n_tokens, sample, top_k, top_p)
             }
         return self._session_progs
+
+    def compile_counts(self) -> Dict[str, int]:
+        """jit-cache entries per registered program — the no-recompile
+        contract is ``all(v <= 1)`` for shape-stable programs (same
+        contract ``serving.SlotBatcher.compile_counts`` exposes)."""
+        return self.compile_registry.counts()
 
     # ----------------------------------------------------------- checkpoint
 
@@ -478,7 +510,9 @@ class InferenceSession:
                     (jax.random.split(key, n_bucket), jnp.arange(n_bucket)))
                 return toks.swapaxes(0, 1), last, cache
 
-            self._progs["reply"][sig] = jax.jit(reply)
+            self._progs["reply"][sig] = \
+                self._engine.compile_registry.register(
+                    f"session.reply:{sig}", jax.jit(reply))
         return self._progs["reply"][sig]
 
     def generate(self, max_new_tokens: int = 32, do_sample: bool = False,

@@ -124,11 +124,6 @@ INT8_COMPUTE_CONTRACT_RESIDUAL_MLP = {
 }
 
 
-def _quantize_compute_jit():
-    from ..ops.int8 import quantize_for_int8_compute
-    return jax.jit(quantize_for_int8_compute, static_argnums=(1, 2))
-
-
 _quantize_compute_cached = None
 
 
@@ -138,7 +133,9 @@ def quantize_params_int8_compute(params: PyTree) -> Tuple[PyTree, int]:
     int8×int8→int32 serving path.  Returns ``(new_params, n_quantized)``."""
     global _quantize_compute_cached
     if _quantize_compute_cached is None:  # one jit cache across engine inits
-        _quantize_compute_cached = _quantize_compute_jit()
+        from ..ops.int8 import quantize_for_int8_compute
+        _quantize_compute_cached = jax.jit(quantize_for_int8_compute,
+                                           static_argnums=(1, 2))
     qz = _quantize_compute_cached
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)

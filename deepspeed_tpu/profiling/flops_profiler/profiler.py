@@ -70,6 +70,8 @@ class FlopsProfiler:
     def profile_fn(self, fn: Callable, *args, static_argnums=(),
                    warmup: int = 1, iters: int = 3) -> Dict[str, float]:
         """Compile ``fn``, read its HLO cost analysis, and time it."""
+        # profiling compiles on purpose: the jit exists to be lowered
+        # dslint: disable=jit-in-hot-path — timed once, then discarded
         jitted = jax.jit(fn, static_argnums=static_argnums)
         compiled = jitted.lower(*args).compile()
         costs = _cost_analysis(compiled)

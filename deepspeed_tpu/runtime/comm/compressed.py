@@ -168,6 +168,8 @@ def compressed_grad_reduce_tree(mesh: Mesh, axis: str = "dcn",
                     for l in jax.tree_util.tree_leaves(tree))
         return -(-total // align) * align
 
+    # factory closure: built once per engine (_init_dcn_reduce caches it)
+    # dslint: disable=jit-in-hot-path — closure cached by the caller
     @jax.jit
     def run(stacked_tree, worker_err, server_err):
         leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
@@ -230,6 +232,8 @@ def compressed_allreduce_tree(mesh: Mesh, axis: str):
                     for l in jax.tree_util.tree_leaves(tree))
         return -(-total // align) * align
 
+    # factory closure: callers build once and reuse (tree variant)
+    # dslint: disable=jit-in-hot-path — closure cached by the caller
     @jax.jit
     def run(tree, worker_err, server_err):
         leaves, treedef = jax.tree_util.tree_flatten(tree)

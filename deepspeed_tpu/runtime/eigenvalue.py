@@ -76,6 +76,8 @@ class Eigenvalue:
 
         grad_fn = jax.grad(loss_fn)
 
+        # periodic diagnostic: one build per eigenvalue sweep, reused
+        # dslint: disable=jit-in-hot-path — by every power iteration in it
         @jax.jit
         def hvp(v):
             # H·v restricted to the layer-stacked subtree: tangents are zero
@@ -87,6 +89,7 @@ class Eigenvalue:
             return jax.tree_util.tree_map(
                 lambda x: x.astype(jnp.float32), hv[self.layer_name])
 
+        # dslint: disable=jit-in-hot-path — sweep-scoped, like hvp above
         @jax.jit
         def rayleigh(v, hv):
             return self._layer_reduce(

@@ -41,6 +41,7 @@ from ..ops.optimizer import (TpuOptimizer, get_optimizer_class,
                              resolve_param_groups)
 from ..parallel.mesh import (DATA_AXIS, DCN_AXIS, EXPERT_AXIS, MeshManager,
                              ParallelDims, get_mesh_manager, initialize_mesh)
+from ..utils.compile_watch import CompiledProgramRegistry, hot_path
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
                            FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
@@ -144,6 +145,11 @@ class DeepSpeedEngine:
             raise DeepSpeedConfigError(
                 "dcn>1 does not compose with the pipeline engine yet")
         self._dcn_reduce = None
+
+        #: every jitted program the step loop drives, by name — the
+        #: compile-discipline gate (utils/compile_watch.py) watches this
+        #: (the serving stack's compile_counts() contract, generalized)
+        self.compile_registry = CompiledProgramRegistry("engine")
 
         self._configure_sharding()
         self._configure_optimizer(optimizer, model_parameters)
@@ -426,9 +432,10 @@ class DeepSpeedEngine:
         def zeroed(stacked):
             return jax.tree_util.tree_map(jnp.zeros_like, stacked)
 
-        self._dcn_mean_jit = jax.jit(
-            lambda acc: (mean_of(acc), zeroed(acc)),
-            donate_argnums=(0,), out_shardings=(None, grads_sh))
+        self._dcn_mean_jit = self.compile_registry.register(
+            "dcn.mean", jax.jit(
+                lambda acc: (mean_of(acc), zeroed(acc)),
+                donate_argnums=(0,), out_shardings=(None, grads_sh)))
         if self._dcn_compress == "onebit":
             from .comm.compressed import compressed_grad_reduce_tree
             self._dcn_reduce = compressed_grad_reduce_tree(mesh, DCN_AXIS)
@@ -449,16 +456,21 @@ class DeepSpeedEngine:
                 collapsed, we2, se2 = reduce(acc, we, se)
                 return constrain_grads(collapsed), zeroed(acc), we2, se2
 
-            self._dcn_onebit_jit = jax.jit(
-                onebit_collapse, donate_argnums=(0, 1, 2),
-                out_shardings=(None, grads_sh, ef_sh, ef_sh))
-            self._dcn_rescale_ef_jit = jax.jit(
-                lambda we, se, r: (we * r, se * r),
-                donate_argnums=(0, 1))
-            self._dcn_finite_jit = jax.jit(
-                lambda acc: jnp.isfinite(jnp.asarray(
-                    [jnp.sum(jnp.abs(l.astype(jnp.float32)))
-                     for l in jax.tree_util.tree_leaves(acc)])).all())
+            self._dcn_onebit_jit = self.compile_registry.register(
+                "dcn.onebit", jax.jit(
+                    onebit_collapse, donate_argnums=(0, 1, 2),
+                    out_shardings=(None, grads_sh, ef_sh, ef_sh)))
+            self._dcn_rescale_ef_jit = self.compile_registry.register(
+                "dcn.rescale_ef", jax.jit(
+                    lambda we, se, r: (we * r, se * r),
+                    donate_argnums=(0, 1)))
+            self._dcn_finite_jit = self.compile_registry.register(
+                # the finiteness probe only READS the accumulator; the
+                # dslint: disable=missing-donation — collapse owns donation
+                "dcn.finite", jax.jit(
+                    lambda acc: jnp.isfinite(jnp.asarray(
+                        [jnp.sum(jnp.abs(l.astype(jnp.float32)))
+                         for l in jax.tree_util.tree_leaves(acc)])).all()))
 
     def _init_param_spill(self) -> None:
         """ZeRO-Infinity parameter NVMe spill: with
@@ -561,8 +573,8 @@ class DeepSpeedEngine:
         sh = self.shardings
         self._separate_master = True
         self._master_shardings_flat = jax.tree_util.tree_leaves(sh.master)
-        self._reshard_params_jit = jax.jit(lambda t: t,
-                                           out_shardings=sh.params)
+        self._reshard_params_jit = self.compile_registry.register(
+            "reshard_params", jax.jit(lambda t: t, out_shardings=sh.params))
         np_compute = np.dtype(self.compute_dtype)  # ml_dtypes handles bf16
         multihost = jax.process_count() > 1
 
@@ -931,13 +943,22 @@ class DeepSpeedEngine:
                 return (q.astype(jnp.int8).reshape(-1), s, resid_new,
                         jnp.zeros_like(g))
 
-            self._micro_jit = jax.jit(micro, donate_argnums=(1,))
-            self._grad_stats_jit = jax.jit(grad_stats)
-            self._prep_leaf_jit = jax.jit(prep_leaf, donate_argnums=(0,))
-            self._prep_onebit_jit = jax.jit(prep_onebit, donate_argnums=(0, 1))
-            self._prep_int8_jit = jax.jit(prep_int8, donate_argnums=(0, 1))
-            self._zero_leaf_jit = jax.jit(
-                lambda g: jnp.zeros_like(g), donate_argnums=(0,))
+            reg = self.compile_registry
+            self._micro_jit = reg.register(
+                "micro", jax.jit(micro, donate_argnums=(1,)))
+            self._grad_stats_jit = reg.register(
+                # the scalar-only stats pass READS the accumulator; the
+                # dslint: disable=missing-donation — preps own donation
+                "grad_stats", jax.jit(grad_stats))
+            self._prep_leaf_jit = reg.register(
+                "prep_leaf", jax.jit(prep_leaf, donate_argnums=(0,)))
+            self._prep_onebit_jit = reg.register(
+                "prep_onebit", jax.jit(prep_onebit, donate_argnums=(0, 1)))
+            self._prep_int8_jit = reg.register(
+                "prep_int8", jax.jit(prep_int8, donate_argnums=(0, 1)))
+            self._zero_leaf_jit = reg.register(
+                "zero_leaf", jax.jit(
+                    lambda g: jnp.zeros_like(g), donate_argnums=(0,)))
             return
 
         def apply_core(params, master, opt_state, grad_acc, scale_state, hyper):
@@ -1025,9 +1046,11 @@ class DeepSpeedEngine:
                                axis_names={DCN_AXIS}, check_vma=False)
                 return fn(params, grad_acc, scale_state, batch)
 
-            self._micro_jit = jax.jit(micro_dcn, donate_argnums=(1,))
+            self._micro_jit = self.compile_registry.register(
+                "micro", jax.jit(micro_dcn, donate_argnums=(1,)))
         else:
-            self._micro_jit = jax.jit(micro, donate_argnums=(1,))
+            self._micro_jit = self.compile_registry.register(
+                "micro", jax.jit(micro, donate_argnums=(1,)))
 
         # offload_param (ZeRO-3 parameter offload): the stored-param
         # placement is host memory — the step outputs must land back there
@@ -1040,8 +1063,9 @@ class DeepSpeedEngine:
             out_sh = (psh, None, None, None, None, None, None)
 
         if separate_master:
-            self._apply_jit = jax.jit(apply_core, donate_argnums=(0, 1, 2, 3, 4),
-                                      out_shardings=out_sh)
+            self._apply_jit = self.compile_registry.register(
+                "apply", jax.jit(apply_core, donate_argnums=(0, 1, 2, 3, 4),
+                                 out_shardings=out_sh))
 
             def fused(params, master, opt_state, grad_acc, scale_state, batches, hyper):
                 def body(acc, batch):
@@ -1051,16 +1075,18 @@ class DeepSpeedEngine:
                 out = apply_core(params, master, opt_state, grad_acc, scale_state, hyper)
                 return out + (jnp.mean(losses),)
 
-            self._fused_jit = jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4),
-                                      out_shardings=None if out_sh is None
-                                      else out_sh + (None,))
+            self._fused_jit = self.compile_registry.register(
+                "fused", jax.jit(fused, donate_argnums=(0, 1, 2, 3, 4),
+                                 out_shardings=None if out_sh is None
+                                 else out_sh + (None,)))
         else:
             # offload_param implies stage >= 3 implies separate_master, so
             # this branch never carries a host placement (out_sh is None)
             def apply_single(params, opt_state, grad_acc, scale_state, hyper):
                 return apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
 
-            self._apply_jit_single = jax.jit(apply_single, donate_argnums=(0, 1, 2, 3))
+            self._apply_jit_single = self.compile_registry.register(
+                "apply", jax.jit(apply_single, donate_argnums=(0, 1, 2, 3)))
 
             def fused_single(params, opt_state, grad_acc, scale_state, batches, hyper):
                 def body(acc, batch):
@@ -1070,7 +1096,8 @@ class DeepSpeedEngine:
                 out = apply_core(params, params, opt_state, grad_acc, scale_state, hyper)
                 return out + (jnp.mean(losses),)
 
-            self._fused_jit_single = jax.jit(fused_single, donate_argnums=(0, 1, 2, 3))
+            self._fused_jit_single = self.compile_registry.register(
+                "fused", jax.jit(fused_single, donate_argnums=(0, 1, 2, 3)))
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=False,
@@ -1194,6 +1221,7 @@ class DeepSpeedEngine:
                                    NamedSharding(self.mesh, P(None)))
         return {**batch, "_pld_theta": theta}
 
+    @hot_path
     def forward(self, batch, **kwargs):
         """Compute loss (and, fused, the gradients) for one micro-batch."""
         self._ensure_params_resident()
@@ -1535,6 +1563,7 @@ class DeepSpeedEngine:
         self._last_global_norm = norm
         return overflow_host
 
+    @hot_path
     def _take_model_step(self, lr_kwargs=None) -> None:
         if self._offload_device is not None:
             overflow_host = self._apply_offload_step()
@@ -1555,9 +1584,13 @@ class DeepSpeedEngine:
             # EF is linear in the gradient scale, so the rescale is exact.
             use_onebit = self._dcn_reduce is not None
             if use_onebit and self.scaler_config.enabled:
+                self.compile_registry.note_host_sync("step.dcn_finite")
+                # dslint: disable=host-sync-in-hot-path — one scalar pull
                 use_onebit = bool(jax.device_get(
                     self._dcn_finite_jit(s["grad_acc"])))
             if use_onebit:
+                self.compile_registry.note_host_sync("step.ef_scale")
+                # dslint: disable=host-sync-in-hot-path — one scalar pull
                 cur_scale = float(jax.device_get(s["scale"]["loss_scale"]))
                 if cur_scale != self._dcn_ef_scale:
                     ratio = cur_scale / self._dcn_ef_scale
@@ -1586,6 +1619,9 @@ class DeepSpeedEngine:
         s["scale"] = new_scale
         self._last_global_norm = norm  # device scalar; float() lazily
         self._spill_params()
+        self.compile_registry.note_host_sync("step.overflow")
+        # the step/skip decision is host control flow by design:
+        # dslint: disable=host-sync-in-hot-path — one scalar pull per step
         self._finish_model_step(bool(overflow), lr_kwargs)
 
     def _finish_model_step(self, overflow_host: bool, lr_kwargs=None) -> None:
@@ -1676,7 +1712,8 @@ class DeepSpeedEngine:
         batch = self._inject_compression_step(batch)
         batch = self._shard_batch(batch)
         if not hasattr(self, "_eval_jit"):
-            self._eval_jit = jax.jit(self.module.loss_fn)
+            self._eval_jit = self.compile_registry.register(
+                "eval", jax.jit(self.module.loss_fn))
         return self._eval_jit(self.state["params"], batch)
 
     # ------------------------------------------------------------------ checkpoint
@@ -1968,10 +2005,18 @@ class DeepSpeedEngine:
         """Clear accumulated gradients (donating re-zero of the
         accumulator tree — no new allocation survives the call)."""
         if self._zero_tree_jit is None:
-            self._zero_tree_jit = jax.jit(
-                lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
-                donate_argnums=(0,))
+            self._zero_tree_jit = self.compile_registry.register(
+                "zero_tree", jax.jit(
+                    lambda t: jax.tree_util.tree_map(jnp.zeros_like, t),
+                    donate_argnums=(0,)))
         self.state["grad_acc"] = self._zero_tree_jit(self.state["grad_acc"])
+
+    def compile_counts(self) -> Dict[str, int]:
+        """jit-cache entries per registered step program — the
+        no-recompile contract after warmup is ``all(v <= 1)`` per shape
+        class (the serving stack's ``compile_counts()``, generalized; see
+        ``utils/compile_watch.py`` and ``scripts/compile_report.py``)."""
+        return self.compile_registry.counts()
 
     def get_batch_info(self):
         """(train_batch_size, train_micro_batch_size_per_gpu,

@@ -66,6 +66,8 @@ class EventKind:
     SERVE_DONE = "serve.done"
     SERVE_EVICT = "serve.evict"
     SERVE_TICK = "serve.tick"
+    PERF_RECOMPILE = "perf.recompile"
+    PERF_HOST_SYNC = "perf.host_sync"
 
 
 #: every registered kind, as a set of strings
@@ -121,6 +123,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
                            "tok_per_s"),
     EventKind.SERVE_EVICT: ("prefix", "reason", "idle_s"),
     EventKind.SERVE_TICK: ("tick", "active", "queue_depth", "tok_per_s"),
+    EventKind.PERF_RECOMPILE: ("program", "registry", "count", "shapes",
+                               "compile_s"),
+    EventKind.PERF_HOST_SYNC: ("label", "count"),
 }
 
 

@@ -35,6 +35,8 @@ def materialize_sharded(init_fn: Callable[[jax.Array], PyTree],
                         rng: jax.Array, shardings: PyTree) -> PyTree:
     """Run ``init_fn(rng)`` inside jit with ``out_shardings`` — no leaf
     ever exists unsharded (the zero.Init capability as a function)."""
+    # one-shot sharded materialization at construction time
+    # dslint: disable=jit-in-hot-path — never called from a step loop
     return jax.jit(init_fn, out_shardings=shardings)(rng)
 
 

@@ -34,6 +34,7 @@ from jax import lax
 
 from ..inference.bucketing import bucket_cache_len
 from ..inference.sampling import filter_logits
+from ..utils.compile_watch import CompiledProgramRegistry, hot_path
 from .config import ServingConfig
 
 
@@ -71,6 +72,9 @@ class SlotBatcher:
         self.temp = jnp.ones((B,), jnp.float32)
         self.active = jnp.zeros((B,), bool)
         self._last = None          # [B, padded_vocab], set on first admit
+        #: every program the batcher drives, by name — the serving gate
+        #: (gateway CompileWatch, compile_report.py) watches this
+        self.registry = CompiledProgramRegistry("serving")
         self._build_programs(config)
 
     # ------------------------------------------------------------ programs
@@ -103,7 +107,7 @@ class SlotBatcher:
         def release(lengths, active, row):
             return lengths.at[row].set(0), active.at[row].set(False)
 
-        self._p = {
+        self._p = self.registry.register_all({
             "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
             "extend": jax.jit(
                 lambda p, t, c, l: fam.extend(p, t, cfg, c, lengths=l)),
@@ -115,12 +119,14 @@ class SlotBatcher:
             "bind": jax.jit(bind),
             "release": jax.jit(release),
             "tick": jax.jit(tick),
-        }
+        })
 
     def compile_counts(self) -> Dict[str, int]:
-        """jit-cache entries per program — the no-recompile contract is
-        ``all(v <= 1)`` after warmup, asserted by the e2e tests."""
-        return {name: prog._cache_size() for name, prog in self._p.items()}
+        """Cumulative compiles per program — the no-recompile contract is
+        ``all(v <= 1)`` after warmup, asserted by the e2e tests (and a
+        re-registered/un-cached program keeps counting: see
+        ``CompiledProgramRegistry``)."""
+        return self.registry.counts()
 
     # ------------------------------------------------------------- prefill
 
@@ -205,6 +211,7 @@ class SlotBatcher:
 
     # ---------------------------------------------------------------- tick
 
+    @hot_path
     def tick(self) -> np.ndarray:
         """One continuous-batching decode step for every slot; returns the
         [B] int32 tokens just emitted (junk in freed slots)."""
@@ -214,4 +221,7 @@ class SlotBatcher:
             self._engine.params, self.cache, self.lengths, self._last,
             self.keys, self.greedy, self.temp, self.active)
         self._last = logits
+        self.registry.note_host_sync("serving.tick")
+        # the emitted tokens ARE the tick's output boundary:
+        # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
         return np.asarray(nxt)

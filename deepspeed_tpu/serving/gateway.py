@@ -34,6 +34,7 @@ import jax
 
 from ..runtime.supervision.events import EventJournal, EventKind
 from ..utils import fault_injection
+from ..utils.compile_watch import CompileWatch
 from ..utils.logging import logger
 from .batcher import PrefixEntry, SlotBatcher
 from .config import ServingConfig
@@ -62,6 +63,12 @@ class ServingGateway:
         self._batcher = SlotBatcher(engine, config)
         self._journal = journal
         self.metrics = ServingMetrics()
+        # compile-discipline gate: serving programs are shape-stable by
+        # construction, so each program's FIRST compile is warmup and any
+        # later one is a regression — journaled as perf.recompile and
+        # surfaced through metrics.recompiles / snapshot()
+        self._watch = CompileWatch(self._batcher.registry, journal=journal,
+                                   first_compile_free=True).open()
         # RLock: submit() rejects (journal + depth read) while already
         # holding the condition for the queue-capacity check
         self._cond = threading.Condition(threading.RLock())
@@ -170,6 +177,7 @@ class ServingGateway:
     def snapshot(self) -> dict:
         """Metrics snapshot + live scheduler state (queue depth, active
         slots, pooled prefixes, compile counts)."""
+        self._pull_compile_stats()
         with self._cond:
             depth, active = len(self._queue), len(self._active)
             prefixes = len(self._prefixes)
@@ -178,6 +186,15 @@ class ServingGateway:
                     cached_prefixes=prefixes,
                     compile_counts=self._batcher.compile_counts())
         return snap
+
+    def _pull_compile_stats(self) -> None:
+        """Fold the CompileWatch's view into the metrics: new post-warmup
+        recompiles (also journaled as ``perf.recompile`` by the watch) and
+        the tick loop's sanctioned host-sync total."""
+        new = self._watch.check()
+        if new:
+            self.metrics.count("recompiles", len(new))
+        self.metrics.set_value("host_syncs", self._watch.total_host_syncs())
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
@@ -203,6 +220,8 @@ class ServingGateway:
             with self._cond:
                 self._cond.notify_all()
             self._thread.join(timeout=30.0)
+        self._pull_compile_stats()
+        self._watch.close()   # journals perf.host_sync totals
 
     def __enter__(self) -> "ServingGateway":
         return self

@@ -34,11 +34,23 @@ class ServingMetrics:
         self.tokens_out = 0
         self.active_slot_ticks = 0   # sum over ticks of active slots
         self.slot_ticks = 0          # sum over ticks of total slots
+        #: post-warmup compiles observed by the gateway's CompileWatch —
+        #: nonzero means the zero-recompile serving contract regressed
+        self.recompiles = 0
+        #: sanctioned device→host pulls on the tick loop (noted by the
+        #: batcher's registry; ~1 per tick is the design)
+        self.host_syncs = 0
         self.ttft_s: List[float] = []
 
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+
+    def set_value(self, field: str, value: int) -> None:
+        """Absolute update for gauge-style counters fed from an external
+        monotonic source (the CompileWatch host-sync totals)."""
+        with self._lock:
+            setattr(self, field, value)
 
     def record_tick(self, active: int, slots: int, tokens: int) -> None:
         with self._lock:
@@ -71,6 +83,8 @@ class ServingMetrics:
                 "prefix_builds": self.prefix_builds,
                 "ticks": self.ticks,
                 "tokens_out": self.tokens_out,
+                "recompiles": self.recompiles,
+                "host_syncs": self.host_syncs,
                 "elapsed_s": elapsed,
                 "tokens_per_s": self.tokens_out / elapsed,
                 "slot_occupancy": (self.active_slot_ticks / self.slot_ticks

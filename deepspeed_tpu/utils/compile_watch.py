@@ -1,0 +1,381 @@
+"""Compile & host-sync discipline: the runtime half of the gate.
+
+The serving gateway's "zero recompiles after warmup" contract and the MFU
+work's "no hidden host syncs in the step loop" contract are enforced two
+ways.  Statically, ``tools/dslint``'s compile-discipline rules catch the
+*construction* bugs (a fresh ``jax.jit`` per call, an un-bucketed shape
+scalar keying a program cache).  This module catches what static analysis
+cannot: a *stable, correctly-cached* program whose jit cache still grows
+after warmup — shape churn from an unpadded batch, dtype drift, a config
+scalar that varies per request.
+
+Three pieces:
+
+- :func:`hot_path` — a no-op decorator marking a function as part of the
+  steady-state step/tick loop.  dslint's ``host-sync-in-hot-path`` rule
+  flags device→host transfers (``.item()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready``, ``float()/int()/bool()`` on
+  device values) inside marked functions; sanctioned syncs carry an
+  inline ``# dslint: disable=...`` with a reason.
+- :class:`CompiledProgramRegistry` — the engine, the inference engine,
+  and the serving ``SlotBatcher`` register every jitted program by name
+  (generalizing serving's ``compile_counts()``).  Registered programs are
+  thin pass-through wrappers that record a :class:`CompileEvent` (name,
+  arg shape/dtype signature, wall seconds) whenever a call grows the
+  underlying jit cache.  Re-registering a name folds the old program's
+  compiles into a retired counter, so "un-caching" a program (rebuilding
+  it per call) cannot hide from the count.
+- :class:`CompileWatch` — a context manager over one or more registries:
+  snapshot, warm up, then any further compile is a *recompile* — reported
+  by :meth:`CompileWatch.check`, journaled as a ``perf.recompile`` event
+  (program name + arg-shape signature), and fatal via
+  :meth:`CompileWatch.assert_no_recompiles`.  Host-sync counters noted by
+  the hot paths ride along and are journaled as ``perf.host_sync`` debug
+  events on close.
+
+``scripts/compile_report.py`` drives the tiny CPU train-loop and serving
+fixtures under a watch and writes ``BENCH_COMPILE.json``, so per-program
+compile counts/seconds are a diffable per-PR artifact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+__all__ = [
+    "hot_path", "CompileEvent", "CompiledProgramRegistry", "CompileWatch",
+    "RecompileError",
+]
+
+
+def hot_path(fn: Callable) -> Callable:
+    """Mark ``fn`` as steady-state hot-path code (train micro/apply loop,
+    pipe schedule, serving decode tick).  Pure marker — no wrapping, no
+    overhead; the contract is enforced by dslint's
+    ``host-sync-in-hot-path`` rule and documented in
+    ``docs/static-analysis.md``."""
+    fn.__hot_path__ = True
+    return fn
+
+
+class RecompileError(RuntimeError):
+    """A registered program compiled past warmup (see the message for the
+    program name and the triggering arg-shape signature)."""
+
+
+#: leaves rendered into a shape signature before truncating
+_SIG_MAX_LEAVES = 16
+
+
+def _shape_sig(args: tuple, kwargs: dict) -> str:
+    """Compact ``dtype[shape]`` signature of a call's arguments — the
+    post-mortem breadcrumb for *which shape class* triggered a compile."""
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:  # registry must work even if jax is mid-teardown
+        leaves = list(args) + list(kwargs.values())
+    parts = []
+    for leaf in leaves[:_SIG_MAX_LEAVES]:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+        elif isinstance(leaf, (bool, int, float, str)):
+            parts.append(repr(leaf))
+        else:
+            parts.append(type(leaf).__name__)
+    if len(leaves) > _SIG_MAX_LEAVES:
+        parts.append(f"...+{len(leaves) - _SIG_MAX_LEAVES}")
+    return " ".join(parts)
+
+
+@dataclass(frozen=True)
+class CompileEvent:
+    """One observed compilation of a registered program."""
+
+    registry: str   # owning registry's name
+    program: str    # program name within the registry
+    count: int      # cumulative compiles of this NAME (retired + live)
+    shapes: str     # arg shape/dtype signature of the triggering call
+    seconds: float  # wall seconds of the compiling call (compile + run)
+    ts: float
+
+
+class _WrappedProgram:
+    """Pass-through wrapper for a registered jitted program.
+
+    Overhead per call is two C-level cache-size reads and one monotonic
+    clock read; the shape signature is only rendered when a compile
+    actually happened."""
+
+    __slots__ = ("_prog", "_reg", "name")
+
+    def __init__(self, prog, reg: "CompiledProgramRegistry", name: str):
+        self._prog = prog
+        self._reg = reg
+        self.name = name
+
+    def _cache_size(self) -> int:
+        return self._prog._cache_size()
+
+    def __getattr__(self, name):
+        # full pjit surface passthrough (.lower(), .trace(), ...) — the
+        # wrapper only interposes on __call__
+        return getattr(self._prog, name)
+
+    def __call__(self, *args, **kwargs):
+        before = self._prog._cache_size()
+        t0 = time.monotonic()
+        out = self._prog(*args, **kwargs)
+        after = self._prog._cache_size()
+        if after > before:
+            self._reg._on_compile(self.name, args, kwargs, after,
+                                  time.monotonic() - t0)
+        return out
+
+
+class CompiledProgramRegistry:
+    """Every jitted program an owner drives, by name.
+
+    ``register`` returns the wrapped program the owner must call through;
+    ``counts()`` is the generalized ``compile_counts()`` contract (the
+    no-recompile invariant is ``all(v <= 1)`` for shape-stable programs).
+    Thread-safe: the serving scheduler thread and the submitting threads
+    both touch it.
+    """
+
+    def __init__(self, name: str = "programs"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._programs: Dict[str, _WrappedProgram] = {}
+        #: compiles owned by programs later re-registered under the same
+        #: name — an un-cached (rebuilt-per-call) program keeps counting
+        self._retired: Dict[str, int] = {}
+        self._events: List[CompileEvent] = []
+        self._compile_s: Dict[str, float] = {}
+        self._host_syncs: Dict[str, int] = {}
+
+    # ---------------------------------------------------------- programs
+    def register(self, name: str, prog) -> _WrappedProgram:
+        """Wrap ``prog`` (a ``jax.jit`` result) under ``name``; call the
+        returned wrapper in place of the raw program."""
+        with self._lock:
+            prev = self._programs.get(name)
+            if prev is not None:
+                self._retired[name] = (self._retired.get(name, 0)
+                                       + prev._prog._cache_size())
+            wrapped = _WrappedProgram(prog, self, name)
+            self._programs[name] = wrapped
+            return wrapped
+
+    def register_all(self, programs: Dict[str, Any],
+                     prefix: str = "") -> Dict[str, _WrappedProgram]:
+        return {k: self.register(prefix + k, v) for k, v in programs.items()}
+
+    def _on_compile(self, name: str, args, kwargs, live: int,
+                    seconds: float) -> None:
+        sig = _shape_sig(args, kwargs)
+        with self._lock:
+            count = self._retired.get(name, 0) + live
+            self._compile_s[name] = self._compile_s.get(name, 0.0) + seconds
+            self._events.append(CompileEvent(
+                registry=self.name, program=name, count=count, shapes=sig,
+                seconds=seconds, ts=time.time()))
+
+    # ------------------------------------------------------------ queries
+    def counts(self) -> Dict[str, int]:
+        """Cumulative compiles per program name (retired + live cache)."""
+        with self._lock:
+            return {name: self._retired.get(name, 0) + w._prog._cache_size()
+                    for name, w in self._programs.items()}
+
+    def compile_seconds(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._compile_s)
+
+    @property
+    def events(self) -> List[CompileEvent]:
+        with self._lock:
+            return list(self._events)
+
+    # --------------------------------------------------------- host syncs
+    def note_host_sync(self, label: str, n: int = 1) -> None:
+        """Record ``n`` sanctioned device→host syncs at ``label`` (called
+        from the ``@hot_path`` sites whose syncs are by design)."""
+        with self._lock:
+            self._host_syncs[label] = self._host_syncs.get(label, 0) + n
+
+    def host_syncs(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._host_syncs)
+
+    def total_host_syncs(self) -> int:
+        with self._lock:
+            return sum(self._host_syncs.values())
+
+
+class CompileWatch:
+    """Watch one or more registries for post-warmup compiles.
+
+    Two warmup conventions:
+
+    - explicit: run the warmup iterations, call :meth:`mark_warm`; every
+      compile after the mark is a recompile (the train-loop shape);
+    - ``first_compile_free=True``: each program's first-ever compile is
+      warmup, anything beyond (``count > 1``) is a recompile (the serving
+      shape, where programs are shape-stable by construction).
+
+    ``check()`` returns (and journals, as ``perf.recompile``) the
+    recompiles seen since the last check; ``close()``/``__exit__`` does a
+    final check and journals the hot paths' ``perf.host_sync`` counters.
+    """
+
+    def __init__(self, registries: Union[CompiledProgramRegistry,
+                                         Sequence[CompiledProgramRegistry]],
+                 journal=None, first_compile_free: bool = False):
+        if isinstance(registries, CompiledProgramRegistry):
+            registries = [registries]
+        self._regs: List[CompiledProgramRegistry] = list(registries)
+        self._journal = journal
+        self._first_free = bool(first_compile_free)
+        self._base: Optional[List[int]] = None
+        self._warm: Optional[List[int]] = None
+        self._emitted: Optional[List[int]] = None
+        self._sync_base: Optional[List[Dict[str, int]]] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def open(self) -> "CompileWatch":
+        self._base = [len(r.events) for r in self._regs]
+        self._emitted = list(self._base)
+        self._sync_base = [r.host_syncs() for r in self._regs]
+        return self
+
+    def __enter__(self) -> "CompileWatch":
+        return self.open()
+
+    def mark_warm(self) -> None:
+        """End of warmup: compiles past this point are regressions."""
+        self._warm = [len(r.events) for r in self._regs]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.check()
+        if self._journal is not None:
+            for label, n in sorted(self.host_syncs().items()):
+                if n:
+                    self._journal.emit("perf.host_sync", label=label,
+                                       count=n)
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------------- events
+    def _require_open(self) -> None:
+        if self._base is None:
+            raise RuntimeError("CompileWatch used before open()/__enter__")
+
+    def _boundary(self, i: int) -> int:
+        """Index into registry ``i``'s event list where warmup ends."""
+        if self._warm is not None:
+            return self._warm[i]
+        if self._first_free:
+            return self._base[i]
+        # neither convention chosen yet: still warming up
+        return None  # type: ignore[return-value]
+
+    def _events_past(self, cursors: List[int]) -> List[CompileEvent]:
+        out: List[CompileEvent] = []
+        for i, reg in enumerate(self._regs):
+            boundary = self._boundary(i)
+            if boundary is None:
+                continue
+            events = reg.events
+            start = max(boundary, cursors[i])
+            for e in events[start:]:
+                if self._first_free and e.count <= 1:
+                    continue
+                out.append(e)
+        return sorted(out, key=lambda e: e.ts)
+
+    @property
+    def recompiles(self) -> List[CompileEvent]:
+        """Every post-warmup compile observed so far."""
+        self._require_open()
+        if self._warm is not None:
+            cursors = self._warm
+        else:
+            cursors = self._base
+        return self._events_past(cursors)
+
+    @property
+    def warmup_events(self) -> List[CompileEvent]:
+        """Compiles between open() and the warmup boundary."""
+        self._require_open()
+        out: List[CompileEvent] = []
+        for i, reg in enumerate(self._regs):
+            events = reg.events
+            end = self._warm[i] if self._warm is not None else len(events)
+            for e in events[self._base[i]:end]:
+                if self._first_free and e.count > 1:
+                    continue
+                out.append(e)
+        return out
+
+    def check(self) -> List[CompileEvent]:
+        """Recompiles since the last ``check()``; journals each as a
+        ``perf.recompile`` event."""
+        self._require_open()
+        new: List[CompileEvent] = []
+        for i, reg in enumerate(self._regs):
+            boundary = self._boundary(i)
+            if boundary is None:
+                continue
+            events = reg.events
+            start = max(boundary, self._emitted[i])
+            for e in events[start:]:
+                if self._first_free and e.count <= 1:
+                    continue
+                new.append(e)
+            self._emitted[i] = max(self._emitted[i], len(events))
+        new.sort(key=lambda e: e.ts)
+        if self._journal is not None:
+            for e in new:
+                self._journal.emit("perf.recompile", program=e.program,
+                                   registry=e.registry, count=e.count,
+                                   shapes=e.shapes,
+                                   compile_s=round(e.seconds, 4))
+        return new
+
+    def assert_no_recompiles(self, context: str = "") -> None:
+        rcs = self.recompiles
+        if rcs:
+            detail = "; ".join(
+                f"program '{e.program}' ({e.registry}) compiled "
+                f"{e.count}x, triggered by shapes [{e.shapes}]"
+                for e in rcs[:8])
+            where = f" in {context}" if context else ""
+            raise RecompileError(
+                f"{len(rcs)} post-warmup recompile(s){where}: {detail}")
+
+    # ---------------------------------------------------------- host syncs
+    def host_syncs(self) -> Dict[str, int]:
+        """Per-label host-sync counts accumulated since open()."""
+        self._require_open()
+        out: Dict[str, int] = {}
+        for i, reg in enumerate(self._regs):
+            base = self._sync_base[i]
+            for label, n in reg.host_syncs().items():
+                d = n - base.get(label, 0)
+                if d:
+                    out[label] = out.get(label, 0) + d
+        return out
+
+    def total_host_syncs(self) -> int:
+        return sum(self.host_syncs().values())

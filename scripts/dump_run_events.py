@@ -85,6 +85,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("serving: " + "  ".join(
             f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by)),
             file=sys.stderr)
+    perf = [e for e in events if str(e.get("kind", "")).startswith("perf.")]
+    if perf and not args.as_json:
+        by = {}
+        for e in perf:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        line = "perf: " + "  ".join(
+            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by))
+        progs = sorted({str(e.get("program")) for e in perf
+                        if e.get("kind") == "perf.recompile"
+                        and e.get("program")})
+        if progs:
+            line += "  recompiled_programs=" + ",".join(progs)
+        print(line, file=sys.stderr)
     aborts = sum(1 for e in events if e.get("kind") in ABORT_KINDS)
     if aborts:
         print(f"\n{len(events)} event(s), {aborts} abort-class",

@@ -111,6 +111,10 @@ def run_bench(args) -> dict:
         "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 3),
         "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 3),
         "slot_occupancy": round(snap["slot_occupancy"], 4),
+        # compile discipline: post-warmup recompiles must stay 0; the
+        # host-sync count is the tick loop's sanctioned d2h pulls
+        "recompiles": snap["recompiles"],
+        "host_syncs": snap["host_syncs"],
         "metrics": {k: v for k, v in snap.items()
                     if isinstance(v, (int, float))},
     }
@@ -154,7 +158,9 @@ def main(argv=None) -> int:
     print(f"  occupancy   {result['slot_occupancy']}")
     print(f"  completed {result['completed']}  failed {result['failed']}  "
           f"rejected {result['rejected']}")
-    return 1 if result["failed"] else 0
+    print(f"  recompiles  {result['recompiles']}   "
+          f"host_syncs {result['host_syncs']}")
+    return 1 if result["failed"] or result["recompiles"] else 0
 
 
 if __name__ == "__main__":

@@ -11,6 +11,8 @@ from tools.dslint import Project, lint_source
 PROJECT = Project(
     event_kind_map={"ROLLBACK": "rollback", "DATA_BATCH": "data.batch"},
     fault_points={"ckpt.write", "data.next"},
+    bucketing_helpers={"bucket_max_new_tokens", "bucket_cache_len",
+                       "tile_cache_len"},
 )
 
 CKPT = "deepspeed_tpu/runtime/checkpoint_engine/fixture.py"
@@ -18,6 +20,8 @@ SUP = "deepspeed_tpu/runtime/supervision/fixture.py"
 DATA = "deepspeed_tpu/runtime/data_pipeline/fixture.py"
 COMM = "deepspeed_tpu/comm/comm.py"
 OTHER = "deepspeed_tpu/runtime/fixture.py"
+INF = "deepspeed_tpu/inference/fixture.py"
+SERVE = "deepspeed_tpu/serving/fixture.py"
 
 
 def lint(src, relpath):
@@ -250,6 +254,212 @@ def test_nondeterminism_covers_verify_replay_but_not_other_scripts():
     assert rules_of(lint(bad, "scripts/verify_replay.py")) == \
         ["step-path-nondeterminism"]
     assert lint(bad, "scripts/dump_run_events.py") == []
+
+
+# ---------------------------------------------------------- jit-in-hot-path
+def test_jit_in_hot_path_fires_on_uncached_forms():
+    findings = lint("""
+        def per_call(self, x):
+            f = jax.jit(fn)                 # local binding: fresh per call
+            y = jax.jit(fn)(x)              # immediately invoked
+            return jax.jit(fn)              # escapes uncached
+    """, INF)
+    assert rules_of(findings) == ["jit-in-hot-path"] * 3
+    assert "per_call" in findings[0].message
+
+
+def test_jit_in_hot_path_fires_on_decorator_inside_function():
+    findings = lint("""
+        def factory(cfg):
+            @jax.jit
+            def run(x):
+                return x
+            return run
+    """, OTHER)
+    assert rules_of(findings) == ["jit-in-hot-path"]
+    assert "'run'" in findings[0].message and "factory" in findings[0].message
+
+
+def test_jit_in_hot_path_allows_cached_forms():
+    findings = lint("""
+        FWD = jax.jit(fn)                       # module scope
+
+        @jax.jit                                # module-scope decorator
+        def top(x):
+            return x
+
+        _CACHED = None
+
+        def lazily():
+            global _CACHED
+            if _CACHED is None:
+                _CACHED = jax.jit(fn)           # global-cached
+            return _CACHED
+
+        class E:
+            def __init__(self):
+                self._fwd_jit = jax.jit(fn)     # attribute
+                self._p = {"tick": jax.jit(fn)} # dict literal on attribute
+            def build(self, sig):
+                self._p[sig] = jax.jit(fn)      # keyed program dict
+            def register(self, reg):
+                self._f = reg.register("f", jax.jit(fn))  # wrapped+cached
+    """, INF)
+    assert findings == []
+
+
+def test_jit_in_hot_path_scope_excludes_benchmarks_and_scripts():
+    bad = "def f(x):\n    return jax.jit(g)(x)\n"
+    assert lint(bad, "deepspeed_tpu/benchmarks/inference/fixture.py") == []
+    assert lint(bad, "scripts/fixture.py") == []
+    assert rules_of(lint(bad, OTHER)) == ["jit-in-hot-path"]
+
+
+def test_jit_in_hot_path_suppressible():
+    findings = lint("""
+        def one_shot(rng):
+            # dslint: disable=jit-in-hot-path — init-time materialization
+            return jax.jit(init_fn)(rng)
+    """, OTHER)
+    assert findings == []
+
+
+# ---------------------------------------------------- unbucketed-static-arg
+def test_unbucketed_static_arg_fires_on_raw_sig_and_subscript():
+    findings = lint("""
+        class S:
+            def generate(self, max_new_tokens):
+                sig = (max_new_tokens, True)
+                return self._progs[sig]
+            def lookup(self, max_len):
+                return self._progs[max_len]
+    """, INF)
+    assert rules_of(findings) == ["unbucketed-static-arg"] * 2
+    assert "'max_new_tokens'" in findings[0].message
+    assert "'max_len'" in findings[1].message
+
+
+def test_unbucketed_static_arg_fires_on_config_attribute_key():
+    findings = lint("""
+        def admit(self, config):
+            return self._progs[config.max_len]
+    """, SERVE)
+    assert rules_of(findings) == ["unbucketed-static-arg"]
+
+
+def test_unbucketed_static_arg_allows_helper_routing_and_slices():
+    findings = lint("""
+        def generate(self, max_new_tokens, max_len):
+            n = bucket_max_new_tokens(max_new_tokens)   # sanitized rebind
+            max_len = bucket_cache_len(max_len, 128)    # self-rebind
+            sig = (n, max_len, True)
+            out = self._progs[sig](x)
+            key = self._p[bucket_max_new_tokens(max_new_tokens)]  # at use
+            return out[:, :max_new_tokens]              # array slice: fine
+    """, INF)
+    assert findings == []
+
+
+def test_unbucketed_static_arg_scoped_to_inference_and_serving():
+    bad = "def f(self, max_len):\n    return self._p[max_len]\n"
+    assert lint(bad, OTHER) == []
+    assert rules_of(lint(bad, SERVE)) == ["unbucketed-static-arg"]
+
+
+def test_unbucketed_static_arg_suppressible():
+    findings = lint("""
+        def gen(self, max_new_tokens):
+            # dslint: disable=unbucketed-static-arg — deliberate per-budget
+            sig = (max_new_tokens,)
+            return self._p[sig]
+    """, INF)
+    assert findings == []
+
+
+# --------------------------------------------------- host-sync-in-hot-path
+def test_host_sync_fires_inside_hot_path():
+    findings = lint("""
+        @hot_path
+        def tick(self):
+            toks = np.asarray(nxt)
+            s = jax.device_get(scale)
+            f = float(norm)
+            i = loss.item()
+    """, SERVE)
+    assert rules_of(findings) == ["host-sync-in-hot-path"] * 4
+    assert "'np.asarray'" in findings[0].message
+    assert "tick" in findings[0].message
+
+
+def test_host_sync_quiet_outside_hot_path_and_on_device_ops():
+    findings = lint("""
+        def not_hot(self):
+            return np.asarray(x)        # unmarked function: fine
+        @hot_path
+        def tick(self):
+            a = jnp.asarray(x)          # device-side: fine
+            n = float(1.0)              # literal: no device pull
+            return a
+    """, OTHER)
+    assert findings == []
+
+
+def test_host_sync_suppressible_with_reason():
+    findings = lint("""
+        @hot_path
+        def tick(self):
+            self.registry.note_host_sync("serving.tick")
+            # dslint: disable=host-sync-in-hot-path — output boundary
+            return np.asarray(nxt)
+    """, SERVE)
+    assert findings == []
+
+
+# -------------------------------------------------------- missing-donation
+def test_missing_donation_fires_on_state_sized_programs():
+    findings = lint("""
+        J = jax.jit(lambda params, batch: params)
+
+        def apply_core(params, master, opt_state, grad_acc, hyper):
+            return params
+
+        class E:
+            def build(self):
+                self._apply_jit = jax.jit(apply_core)
+    """, OTHER)
+    assert rules_of(findings) == ["missing-donation"] * 2
+    assert "params" in findings[0].message
+    assert "apply_core" in findings[1].message
+
+
+def test_missing_donation_allows_donating_and_benign_programs():
+    findings = lint("""
+        def micro(params, grad_acc, batch):
+            return grad_acc
+
+        class E:
+            def build(self):
+                self._micro_jit = jax.jit(micro, donate_argnums=(1,))
+                self._take = jax.jit(lambda lg, i: lg[i])   # small args
+                self._eval = jax.jit(self.module.loss_fn)   # unresolvable
+    """, OTHER)
+    assert findings == []
+
+
+def test_missing_donation_scoped_to_runtime():
+    bad = "J = jax.jit(lambda params: params)\n"
+    assert lint(bad, INF) == []
+    assert rules_of(lint(bad, OTHER)) == ["missing-donation"]
+
+
+def test_missing_donation_suppressible():
+    findings = lint("""
+        class E:
+            def build(self):
+                # dslint: disable=missing-donation — read-only stats pass
+                self._stats = jax.jit(lambda grad_acc: grad_acc.sum())
+    """, OTHER)
+    assert findings == []
 
 
 # ----------------------------------------------------- framework behaviors

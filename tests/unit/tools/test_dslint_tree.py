@@ -69,6 +69,34 @@ def test_untimed_collective_is_caught_on_the_real_comm_module():
             "broadcast", "all_to_all_single"} <= names
 
 
+def test_bucketing_registry_parses_from_the_real_module():
+    p = Project(REPO)
+    assert {"bucket_max_new_tokens", "bucket_cache_len",
+            "tile_cache_len"} <= p.bucketing_helpers
+
+
+def test_jit_in_hot_path_caught_on_the_real_batcher_module():
+    # un-cache the batcher's program dict in the real source: every jit in
+    # it becomes a fresh-compile-per-call and must light up
+    with open(os.path.join(REPO, "deepspeed_tpu/serving/batcher.py")) as f:
+        src = f.read().replace("self._p = self.registry.register_all({",
+                               "programs = ({")
+    findings = lint_source(src, "deepspeed_tpu/serving/batcher.py",
+                           Project(REPO))
+    assert sum(1 for f in findings if f.rule == "jit-in-hot-path") == 7
+
+
+def test_host_sync_caught_when_real_tick_suppression_removed():
+    with open(os.path.join(REPO, "deepspeed_tpu/serving/batcher.py")) as f:
+        src = f.read().replace(
+            "# dslint: disable=host-sync-in-hot-path — one d2h pull per "
+            "tick", "#")
+    findings = lint_source(src, "deepspeed_tpu/serving/batcher.py",
+                           Project(REPO))
+    assert [f.rule for f in findings] == ["host-sync-in-hot-path"]
+    assert "np.asarray" in findings[0].message
+
+
 def test_drift_check_catches_removed_registry_kind():
     p = Project(REPO)
     del p.event_kind_map["ROLLBACK"]

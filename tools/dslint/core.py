@@ -94,23 +94,27 @@ class Project:
     - ``deepspeed_tpu/runtime/supervision/events.py`` — ``EventKind``
       (name → kind string), ``SUMMARY_FIELDS`` keys, ``ABORT_KINDS``
     - ``deepspeed_tpu/utils/fault_injection.py`` — ``FAULT_POINTS``
+    - ``deepspeed_tpu/inference/bucketing.py`` — ``BUCKETING_HELPERS``
 
     Tests inject the registries directly instead of passing a root.
     """
 
     EVENTS_MODULE = "deepspeed_tpu/runtime/supervision/events.py"
     FAULTS_MODULE = "deepspeed_tpu/utils/fault_injection.py"
+    BUCKETING_MODULE = "deepspeed_tpu/inference/bucketing.py"
 
     def __init__(self, root: Optional[str] = None,
                  event_kind_map: Optional[Dict[str, str]] = None,
                  fault_points: Optional[Set[str]] = None,
                  summary_field_names: Optional[Set[str]] = None,
-                 abort_kind_names: Optional[Set[str]] = None):
+                 abort_kind_names: Optional[Set[str]] = None,
+                 bucketing_helpers: Optional[Set[str]] = None):
         self.root = root
         self.event_kind_map: Dict[str, str] = event_kind_map or {}
         self.fault_points: Set[str] = set(fault_points or ())
         self.summary_field_names: Set[str] = set(summary_field_names or ())
         self.abort_kind_names: Set[str] = set(abort_kind_names or ())
+        self.bucketing_helpers: Set[str] = set(bucketing_helpers or ())
         self.summary_fields_line = 1
         self.abort_kinds_line = 1
         if root is not None:
@@ -118,6 +122,9 @@ class Project:
                 self._parse_events(os.path.join(root, self.EVENTS_MODULE))
             if fault_points is None:
                 self._parse_faults(os.path.join(root, self.FAULTS_MODULE))
+            if bucketing_helpers is None:
+                self._parse_bucketing(
+                    os.path.join(root, self.BUCKETING_MODULE))
 
     # ---------------------------------------------------------- registries
     @property
@@ -170,6 +177,19 @@ class Project:
                     if isinstance(n, ast.Constant) \
                             and isinstance(n.value, str):
                         self.fault_points.add(n.value)
+
+    def _parse_bucketing(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        tree = _parse_path(path)
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "BUCKETING_HELPERS"):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, str):
+                        self.bucketing_helpers.add(n.value)
 
 
 def _parse_path(path: str) -> ast.Module:

@@ -24,7 +24,8 @@ from .core import Finding, Project
 RULE_ID = "event-kind-drift"
 
 KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
-             "docs/checkpoint-durability.md", "docs/serving.md")
+             "docs/checkpoint-durability.md", "docs/serving.md",
+             "docs/performance.md")
 
 _CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
 

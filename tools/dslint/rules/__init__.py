@@ -10,6 +10,10 @@ from .journal_kinds import UnregisteredJournalKind  # noqa: F401
 from .fault_points import UnregisteredFaultPoint  # noqa: F401
 from .untimed_collective import UntimedCollective  # noqa: F401
 from .nondeterminism import StepPathNondeterminism  # noqa: F401
+from .jit_hot_path import JitInHotPath  # noqa: F401
+from .unbucketed_static_arg import UnbucketedStaticArg  # noqa: F401
+from .host_sync import HostSyncInHotPath  # noqa: F401
+from .missing_donation import MissingDonation  # noqa: F401
 
 ALL_RULES = (
     SwallowedException,
@@ -18,4 +22,8 @@ ALL_RULES = (
     UnregisteredFaultPoint,
     UntimedCollective,
     StepPathNondeterminism,
+    JitInHotPath,
+    UnbucketedStaticArg,
+    HostSyncInHotPath,
+    MissingDonation,
 )

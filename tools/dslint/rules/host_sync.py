@@ -1,0 +1,92 @@
+"""host-sync-in-hot-path: a device→host transfer inside the steady-state
+step/tick loop (``.item()``, ``np.asarray`` on a device array,
+``jax.device_get``, ``block_until_ready``, ``float()/int()/bool()`` on a
+device scalar) forces the host to wait for the device and drains the
+dispatch pipeline — the stall anatomy in ``docs/performance.md`` showed
+exactly this class of call capping MFU.
+
+Regions are opted in with the ``@hot_path`` marker
+(``deepspeed_tpu/utils/compile_watch.py``): the train micro/apply loop,
+the SPMD pipe schedule executors, and the serving decode tick.  Inside a
+marked function every sync-shaped call is flagged; the handful of
+*sanctioned* syncs (the boundary-step overflow decision, the tick's token
+pull) carry an inline ``# dslint: disable=host-sync-in-hot-path`` with a
+reason — and a ``registry.note_host_sync(...)`` call so the runtime gate
+counts them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import FileContext, Finding, Rule
+
+#: method names that synchronize wherever they appear
+SYNC_METHODS = {"block_until_ready", "item"}
+
+#: ``np.<attr>`` calls that materialize on host
+NP_MATERIALIZERS = {"asarray", "array", "copy"}
+NP_MODULES = {"np", "numpy", "onp"}
+
+#: builtins that pull a device scalar when handed a non-literal
+SCALAR_PULLS = {"float", "int", "bool"}
+
+
+def _sync_call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr in SYNC_METHODS:
+            return f".{f.attr}()"
+        if f.attr in NP_MATERIALIZERS and isinstance(f.value, ast.Name) \
+                and f.value.id in NP_MODULES:
+            return f"np.{f.attr}"
+    elif isinstance(f, ast.Name):
+        if f.id == "device_get":
+            return "device_get"
+        if f.id in SCALAR_PULLS and len(call.args) == 1 \
+                and not call.keywords \
+                and not isinstance(call.args[0], ast.Constant):
+            return f"{f.id}()"
+    return None
+
+
+def _is_hot_path_marked(func: ast.AST) -> bool:
+    for dec in getattr(func, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == "hot_path":
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == "hot_path":
+            return True
+    return False
+
+
+class HostSyncInHotPath(Rule):
+    id = "host-sync-in-hot-path"
+    description = ("no device→host syncs (.item()/np.asarray/device_get/"
+                   "block_until_ready/float()) inside @hot_path regions — "
+                   "sanctioned ones carry a reasoned disable")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("deepspeed_tpu/")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_hot_path_marked(node):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        name = _sync_call_name(sub)
+                        if name is not None:
+                            findings.append(ctx.finding(
+                                self.id, sub,
+                                f"host sync '{name}' inside @hot_path "
+                                f"'{node.name}' — a device→host transfer "
+                                "stalls the dispatch pipeline; move it "
+                                "off the hot path (or disable with a "
+                                "reason and note_host_sync it)"))
+        return findings

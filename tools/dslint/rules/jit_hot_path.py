@@ -1,0 +1,117 @@
+"""jit-in-hot-path: ``jax.jit`` caches compiled programs *on the jit
+object* — a ``jax.jit(...)`` constructed inside a function body and not
+stored anywhere durable is a fresh, empty cache every call, i.e. a
+retrace + recompile per call.  That is exactly where TPU serving latency
+goes to die (the serving gateway's whole design is "a closed set of
+compiled programs whose shapes never depend on a request").
+
+Sanctioned storage patterns the rule recognizes as caching:
+
+- assignment to an attribute (``self._micro_jit = jax.jit(...)`` — any
+  attribute target, including the lazy ``if not hasattr`` idiom);
+- assignment into a subscript (a keyed program dict:
+  ``self._progs["reply"][sig] = jax.jit(...)``);
+- assignment to a ``global``-declared name (the module-level cache idiom);
+- module/class scope (no enclosing function).
+
+A ``jax.jit`` that is immediately invoked (``jax.jit(f)(x)``), returned,
+or bound to a local is flagged.  True one-shot init/load sites get
+baselined with a reason; deliberate factory closures carry an inline
+``# dslint: disable=jit-in-hot-path — <reason>``.  ``deepspeed_tpu/
+benchmarks/`` is out of scope (offline one-shot harnesses, like
+``scripts/``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..core import FileContext, Finding, Rule
+
+SCOPE_EXCLUDE = ("deepspeed_tpu/benchmarks/",)
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit")
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _collect_globals(func: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+class JitInHotPath(Rule):
+    id = "jit-in-hot-path"
+    description = ("jax.jit built inside a function must be cached (self "
+                   "attribute, keyed program dict, global, or module "
+                   "scope) — a fresh jit is a recompile per call")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("deepspeed_tpu/") \
+            and not relpath.startswith(SCOPE_EXCLUDE)
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._walk(tree, [], set(), False, ctx, findings)
+        return findings
+
+    def _walk(self, node: ast.AST, func_stack: List[str],
+              global_names: Set[str], cached: bool, ctx: FileContext,
+              findings: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if func_stack:
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_jax_jit(target):
+                        findings.append(ctx.finding(
+                            self.id, dec,
+                            f"@jax.jit on '{node.name}' inside "
+                            f"'{func_stack[-1]}' builds a fresh program "
+                            "cache per enclosing call — hoist it, cache "
+                            "the closure, or disable with a reason"))
+            func_stack.append(node.name)
+            inner_globals = _collect_globals(node)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, func_stack, inner_globals, False, ctx,
+                           findings)
+            func_stack.pop()
+            return
+        if isinstance(node, ast.Lambda):
+            func_stack.append("<lambda>")
+            self._walk(node.body, func_stack, set(), False, ctx, findings)
+            func_stack.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            cached_here = any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                or (isinstance(t, ast.Name) and t.id in global_names)
+                for t in targets)
+            if node.value is not None:
+                self._walk(node.value, func_stack, global_names,
+                           cached or cached_here, ctx, findings)
+            return
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            if func_stack and not cached:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"jax.jit constructed in '{func_stack[-1]}' without "
+                    "caching — a fresh jit is an empty compile cache "
+                    "every call; store it on an attribute, in a keyed "
+                    "program dict, or at module scope (one-shot "
+                    "init/load sites: baseline with a reason)"))
+            # nested jits inside the call's arguments are separate sites
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, func_stack, global_names, False, ctx,
+                           findings)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, func_stack, global_names, cached, ctx,
+                       findings)

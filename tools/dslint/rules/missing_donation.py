@@ -1,0 +1,97 @@
+"""missing-donation: a jitted program under ``runtime/`` whose signature
+takes engine-state-sized pytrees (``params``, ``master``, ``opt_state``,
+``grad_acc``) and declares no ``donate_argnums`` keeps the *old* buffers
+alive across the call — at engine-state size that doubles HBM exactly
+where the memory model says there is none to spare (the 10-bytes/param
+init peak that OOMed the 2.7B class was this failure mode).
+
+The rule resolves the wrapped callable when it can (an inline ``lambda``,
+a ``def`` in the same file, a ``@jax.jit`` decorator) and checks its
+parameter names against :data:`STATE_PARAMS`.  Programs that genuinely
+only *read* the state (a stats pass, a finiteness probe) carry an inline
+``# dslint: disable=missing-donation — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from ..core import FileContext, Finding, Rule
+
+#: parameter names that mean "an engine-state-sized pytree"
+STATE_PARAMS = {"params", "master", "opt_state", "grad_acc", "grads",
+                "grad_in", "acc"}
+
+DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit")
+            and isinstance(node.value, ast.Name) and node.value.id == "jax")
+
+
+def _param_names(args: ast.arguments) -> List[str]:
+    return [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+
+
+class MissingDonation(Rule):
+    id = "missing-donation"
+    description = ("jitted programs over engine-state-sized pytrees under "
+                   "runtime/ must declare donate_argnums (or a reasoned "
+                   "disable) — undonated state doubles HBM")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("deepspeed_tpu/runtime/")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        defs: Dict[str, ast.arguments] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node.args)
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                self._check_site(node, defs, ctx, findings)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # bare `@jax.jit` decorator (a Call decorator lands above)
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) and _is_jax_jit(dec):
+                        self._report(dec, node.name,
+                                     _param_names(node.args), ctx,
+                                     findings)
+        return findings
+
+    def _check_site(self, call: ast.Call, defs, ctx: FileContext,
+                    findings: List[Finding]) -> None:
+        if any(kw.arg in DONATE_KWARGS for kw in call.keywords):
+            return
+        if not call.args:
+            return
+        target = call.args[0]
+        params: Optional[List[str]] = None
+        name = "<jit>"
+        if isinstance(target, ast.Lambda):
+            params = _param_names(target.args)
+            name = "<lambda>"
+        elif isinstance(target, ast.Name):
+            args = defs.get(target.id)
+            if args is not None:
+                params = _param_names(args)
+                name = target.id
+        if params is None:
+            return  # unresolvable callee: nothing to claim
+        self._report(call, name, params, ctx, findings)
+
+    def _report(self, node, name: str, params: List[str],
+                ctx: FileContext, findings: List[Finding]) -> None:
+        hit = sorted(set(params) & STATE_PARAMS)
+        if hit:
+            findings.append(ctx.finding(
+                self.id, node,
+                f"jitted program '{name}' takes engine-state-sized "
+                f"arguments ({', '.join(hit)}) without donate_argnums — "
+                "the old buffers survive the call, doubling state HBM; "
+                "donate them (or disable with a reason if the program "
+                "only reads)"))

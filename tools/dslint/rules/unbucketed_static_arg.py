@@ -1,0 +1,148 @@
+"""unbucketed-static-arg: a compiled program keyed by a *raw* request- or
+config-level shape scalar (a ``max_new_tokens``, a session ``max_len``)
+compiles once per distinct value — under real traffic that is a compile
+per request shape.  The repo's answer is ``inference/bucketing.py``:
+shape scalars route through a registered bucketing helper
+(``BUCKETING_HELPERS``, parsed statically like ``FAULT_POINTS``) so the
+program population stays ``O(log(max))``.
+
+The rule fires when a shape-determining name (:data:`SHAPE_ARGS` — bound
+as a function parameter, or read as a ``.max_len``-style attribute) is
+used raw inside a *program-cache key context*:
+
+- the index of a subscript (``self._progs[(max_len, max_new_tokens)]``) —
+  colon slices (``out[:, :max_new_tokens]``) are array indexing, not
+  cache keys, and are exempt;
+- the value of an assignment to a ``sig``-named variable (the repo's
+  jit-cache-signature idiom).
+
+A name is sanitized by rebinding it through a registered helper
+(``n = bucket_max_new_tokens(max_new_tokens)`` sanitizes ``n``;
+``max_len = bucket_cache_len(max_len, cap)`` sanitizes ``max_len``) or by
+wrapping it in one at the use site.  Scope: ``deepspeed_tpu/inference/``
+and ``deepspeed_tpu/serving/`` (the request-driven planes); the bucketing
+module itself is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from ..core import FileContext, Finding, Rule
+
+SCOPES = ("deepspeed_tpu/inference/", "deepspeed_tpu/serving/")
+REGISTRY_MODULE = "deepspeed_tpu/inference/bucketing.py"
+
+#: parameter/attribute names treated as request/config shape scalars
+SHAPE_ARGS = {"max_new_tokens", "max_new", "max_len", "cache_len"}
+
+
+def _helper_name(func: ast.expr):
+    """The called helper's name, underscore-alias tolerant
+    (``_tile_cache_len`` matches the registered ``tile_cache_len``)."""
+    if isinstance(func, ast.Name):
+        return func.id.lstrip("_")
+    if isinstance(func, ast.Attribute):
+        return func.attr.lstrip("_")
+    return None
+
+
+def _func_defs(node: ast.AST):
+    """Immediate child function defs of a module/class/function body."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child
+        elif isinstance(child, ast.ClassDef):
+            yield from _func_defs(child)
+
+
+def _own_nodes(func: ast.AST):
+    """Every node of ``func``'s body that is not inside a nested def."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class UnbucketedStaticArg(Rule):
+    id = "unbucketed-static-arg"
+    description = ("request/config shape scalars keying a compiled-program "
+                   "cache must route through the registered "
+                   "inference/bucketing.py helpers")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPES) and relpath != REGISTRY_MODULE
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        helpers = {h.lstrip("_") for h in ctx.project.bucketing_helpers}
+        findings: List[Finding] = []
+        for func in _func_defs(tree):
+            self._check_function(func, set(), helpers, ctx, findings)
+        return findings
+
+    def _check_function(self, func, inherited_raw: Set[str],
+                        helpers: Set[str], ctx: FileContext,
+                        findings: List[Finding]) -> None:
+        args = func.args
+        own = {a.arg for a in (args.posonlyargs + args.args
+                               + args.kwonlyargs)} & SHAPE_ARGS
+        raw = set(inherited_raw) | own
+        # pass 1: rebinding a name through a registered helper sanitizes it
+        sanitized: Set[str] = set()
+        for node in _own_nodes(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _helper_name(node.value.func) in helpers:
+                sanitized.add(node.targets[0].id)
+        raw -= sanitized
+        # pass 2: raw names (and .max_len-style attributes) in cache-key
+        # contexts are findings
+        for node in _own_nodes(func):
+            if isinstance(node, ast.Subscript):
+                self._check_key(node.slice, raw, helpers, ctx, findings)
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and (node.targets[0].id == "sig"
+                         or node.targets[0].id.endswith("_sig")):
+                self._check_key(node.value, raw, helpers, ctx, findings)
+        # nested defs inherit the enclosing raw set (closures)
+        for nested in _func_defs(func):
+            self._check_function(nested, raw, helpers, ctx, findings)
+
+    def _check_key(self, expr: ast.AST, raw: Set[str], helpers: Set[str],
+                   ctx: FileContext, findings: List[Finding]) -> None:
+        seen: Set[Tuple[str, int]] = set()
+        self._walk_key(expr, raw, helpers, ctx, findings, seen)
+
+    def _walk_key(self, node: ast.AST, raw, helpers, ctx, findings,
+                  seen) -> None:
+        if isinstance(node, ast.Slice):
+            return  # colon slicing = array indexing, not a cache key
+        if isinstance(node, ast.Call) \
+                and _helper_name(node.func) in helpers:
+            return  # wrapped in a registered helper at the use site
+        name = None
+        if isinstance(node, ast.Name) and node.id in raw:
+            name = node.id
+        elif isinstance(node, ast.Attribute) and node.attr in SHAPE_ARGS:
+            name = node.attr
+        if name is not None:
+            key = (name, node.lineno)
+            if key not in seen:
+                seen.add(key)
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"shape scalar '{name}' keys a compiled-program cache "
+                    "raw — every distinct value compiles its own program; "
+                    "route it through a registered inference/bucketing.py "
+                    "helper"))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk_key(child, raw, helpers, ctx, findings, seen)
