@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 import os
 import signal
+import time
 from contextlib import nullcontext
 from typing import Any, Dict, Iterable, Optional, Union
 
@@ -62,6 +63,11 @@ class ElasticTrainRunner:
         losses (fp16 overflow skips) reset the streak.
       supervision: explicit supervision config (dict or typed), overriding
         ``ds_config["supervision"]``.
+      rank: host identity for supervision journaling, heartbeat files, and
+        the commit context (defaults to ``engine.global_rank``).  Simulated
+        fleets (``deepspeed_tpu/goodput``) run one single-process engine
+        per OS process, so every engine believes it is rank 0 — this is how
+        a spawned process asserts which host of the fleet it plays.
     """
 
     def __init__(self, engine, save_dir: str, save_interval: int = 100,
@@ -69,14 +75,18 @@ class ElasticTrainRunner:
                  tag_prefix: str = "elastic",
                  nan_abort_threshold: int = 5,
                  supervision: Optional[Union[Dict[str, Any],
-                                             DeepSpeedSupervisionConfig]] = None):
+                                             DeepSpeedSupervisionConfig]] = None,
+                 rank: Optional[int] = None):
         self.engine = engine
         self.save_dir = save_dir
         self.save_interval = max(1, save_interval)
         self.tag_prefix = tag_prefix
         self.nan_abort_threshold = max(0, nan_abort_threshold)
+        self.rank = int(rank) if rank is not None else \
+            int(getattr(engine, "global_rank", 0))
         self._nan_streak = 0
         self._preempted = False
+        self._preempt_at: Optional[float] = None
         self._prev_handlers = {}
 
         if ds_config is not None and elasticity_enabled(ds_config):
@@ -90,7 +100,7 @@ class ElasticTrainRunner:
             ensure_immutable_elastic_config(ds_config["elasticity"])
 
         self._configure_supervision(supervision, ds_config)
-        self._attach_commit_context(int(getattr(self.engine, "global_rank", 0)))
+        self._attach_commit_context(self.rank)
 
     # -------------------------------------------------------- supervision
     def _configure_supervision(self, supervision, ds_config) -> None:
@@ -106,7 +116,7 @@ class ElasticTrainRunner:
         self.heartbeat: Optional[HeartbeatWriter] = None
         if self.supervision is None:
             return
-        rank = int(getattr(self.engine, "global_rank", 0))
+        rank = self.rank
         jpath = self.supervision.event_journal or os.path.join(
             self.save_dir, "events.jsonl")
         self.journal = EventJournal(jpath, rank=rank)
@@ -147,7 +157,10 @@ class ElasticTrainRunner:
                 hb_dir = hb.dir or os.path.join(self.save_dir, "heartbeats")
                 monitor = HeartbeatMonitor(hb_dir, gap_s=hb.gap_s,
                                            journal=self.journal,
-                                           expected_ranks=world)
+                                           expected_ranks=world,
+                                           slow_factor=hb.slow_factor,
+                                           slow_min_intervals=
+                                           hb.slow_min_intervals)
         self.commit_ctx = CommitContext(
             world_size=world, rank=rank, config=commit_cfg,
             journal=self.journal, heartbeat=monitor,
@@ -167,6 +180,11 @@ class ElasticTrainRunner:
                        "and exit at the next step boundary (a repeat signal "
                        "exits immediately)")
         self._preempted = True
+        if self._preempt_at is None:
+            # the preempt-save deadline clock starts at the FIRST notice —
+            # a cloud preemptor's grace window is anchored there, not at
+            # whenever the step boundary lets the drain begin
+            self._preempt_at = time.monotonic()
         if self.journal is not None:
             self.journal.emit(EventKind.PREEMPT_SIGNAL, signum=int(signum),
                               step=self.engine.global_steps)
@@ -222,13 +240,56 @@ class ElasticTrainRunner:
                            f"{self.engine.global_steps}")
         return self.engine.global_steps
 
-    def _save(self):
+    def _save(self) -> str:
         tag = f"{self.tag_prefix}_step{self.engine.global_steps}"
         self.engine.save_checkpoint(self.save_dir, tag=tag)
         if self.supervisor is not None:
             # a published tag is forward progress: resets the consecutive
             # rollback budget once it passes the last divergence point
             self.supervisor.on_checkpoint(self.engine.global_steps)
+        return tag
+
+    def _preempt_save(self) -> None:
+        """The drain checkpoint, bounded by ``preempt_save_deadline_s``
+        when configured: attempt the commit only while the grace clock
+        (started at the first signal) has time left, and journal how the
+        race against the preemptor went — ``ckpt.preempt_save`` landed in
+        time, ``ckpt.preempt_save_timeout`` did not (``saved`` says whether
+        the tag made it to disk late or was skipped outright)."""
+        deadline = self.supervision.preempt_save_deadline_s \
+            if self.supervision is not None else None
+        if deadline is None or self._preempt_at is None:
+            self._save()
+            return
+        step = self.engine.global_steps
+        elapsed = time.monotonic() - self._preempt_at
+        if elapsed >= deadline:
+            logger.warning(
+                f"[elastic] preempt-save deadline ({deadline}s) already "
+                f"spent ({elapsed:.2f}s since the signal): skipping the "
+                f"drain checkpoint — the preemptor wins this race")
+            if self.journal is not None:
+                self.journal.emit(EventKind.CKPT_PREEMPT_SAVE_TIMEOUT,
+                                  step=step, elapsed_s=round(elapsed, 3),
+                                  deadline_s=deadline, saved=False)
+            return
+        tag = self._save()
+        elapsed = time.monotonic() - self._preempt_at
+        if elapsed <= deadline:
+            if self.journal is not None:
+                self.journal.emit(EventKind.CKPT_PREEMPT_SAVE, step=step,
+                                  tag=tag, elapsed_s=round(elapsed, 3),
+                                  deadline_s=deadline)
+        else:
+            logger.warning(
+                f"[elastic] drain checkpoint {tag} landed {elapsed:.2f}s "
+                f"after the signal — past the {deadline}s preempt-save "
+                f"deadline (the tag is on disk, but the preemptor may have "
+                f"already struck)")
+            if self.journal is not None:
+                self.journal.emit(EventKind.CKPT_PREEMPT_SAVE_TIMEOUT,
+                                  step=step, elapsed_s=round(elapsed, 3),
+                                  deadline_s=deadline, saved=True)
 
     def run(self, batches: Iterable[Any], max_steps: Optional[int] = None,
             resume: bool = True) -> Dict[str, Any]:
@@ -293,6 +354,13 @@ class ElasticTrainRunner:
                     else:
                         loss = self.engine.train_batch_fused(batch)
                     loss = float(loss)
+                # the loss rides in a mutable box so chaos plans can poison
+                # a batch window (NaNLossWindow) and drive the divergence
+                # machinery end-to-end from outside the process
+                box = {"loss": loss}
+                fault_injection.fire("train.loss",
+                                     step=self.engine.global_steps, box=box)
+                loss = float(box["loss"])
                 losses.append(loss)
                 if self.heartbeat is not None:
                     self.heartbeat.note_step(self.engine.global_steps)
@@ -337,7 +405,7 @@ class ElasticTrainRunner:
                     self._save()
             if self._preempted:
                 if self._nan_streak == 0:
-                    self._save()
+                    self._preempt_save()
                 else:
                     logger.warning(
                         "[elastic] preempted mid NaN-streak: NOT writing a "

@@ -1,0 +1,33 @@
+"""Goodput harness: the robustness stack, measured as a number.
+
+PRs 1–5 made training durable (verified checkpoints), supervised (watchdog
+/ heartbeat / rollback), deterministic (resumable data), and multi-host
+safe (two-phase commit + consensus resume) — each verified by targeted
+chaos tests.  This package measures the *product* of that stack: training
+goodput (useful steps over total work) under realistic preemption,
+corruption, and straggler schedules, on a simulated fleet of real engine
+processes.
+
+- :mod:`.fleet` — spawn N engine subprocesses over a shared run dir and
+  babysit them (bounded whole-group restarts);
+- :mod:`.scenarios` — the seeded, declarative fault-schedule registry;
+- :mod:`.score` — journal-derived goodput / MTTR / wasted-step metrics and
+  invariant checks (no split-brain, quarantine honored, bitwise replay);
+- :mod:`.rank_main` — the child-process entry point.
+
+``scripts/goodput_bench.py`` runs the scenario matrix into
+``BENCH_GOODPUT.json`` and gates regressions.  Docs: ``docs/goodput.md``.
+"""
+
+from .fleet import FleetConfig, FleetSupervisor, run_scenario
+from .scenarios import (SCENARIOS, CorruptTagAction, FaultSpec, Scenario,
+                        build_scenario, scenario_names)
+from .score import (check_invariants, score_events, score_run,
+                    score_scenario_run)
+
+__all__ = [
+    "FleetConfig", "FleetSupervisor", "run_scenario",
+    "SCENARIOS", "CorruptTagAction", "FaultSpec", "Scenario",
+    "build_scenario", "scenario_names",
+    "check_invariants", "score_events", "score_run", "score_scenario_run",
+]

@@ -1,0 +1,389 @@
+"""The simulated fleet: N real engine processes under one supervisor.
+
+``FleetSupervisor`` spawns ``world_size`` OS processes (one per simulated
+host, each running :mod:`~deepspeed_tpu.goodput.rank_main` on a single CPU
+device), shares a run directory between them — checkpoint dir, consensus
+dir, heartbeat dir, one ``events.jsonl`` — and babysits the group the way
+a cluster manager babysits a preempted TPU slice:
+
+- scenario faults are delivered to children through ``DS_FAULT_PLAN``
+  (installed by ``utils/fault_injection.py`` at import — the child code
+  never special-cases chaos);
+- a rank that exits without its orderly sentinel is a failure: the
+  supervisor SIGKILLs (or, configurably, SIGTERM-drains) the survivors and
+  respawns the *whole group* as a new incarnation — the TPU failure model,
+  where a slice loss restarts the job, and exactly the property the
+  consensus-resume protocol needs (every incarnation agrees on one tag);
+- respawns are bounded by ``max_restarts``; exhausting the budget journals
+  an abort-class ``fleet.abort`` instead of looping on a burning fleet;
+- a :class:`HeartbeatMonitor` (gap + slow-rank classification) polls the
+  shared beat dir for the observability the scenarios score.
+
+Everything the supervisor decides lands in the same journal the children
+write (rank ``-1``), so ``score.py`` reconstructs the whole run — MTTR
+included — from one file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..runtime.supervision.events import EventJournal, EventKind
+from ..runtime.supervision.heartbeat import HeartbeatMonitor
+from ..utils import fault_injection
+from ..utils.logging import logger
+from .scenarios import Scenario
+
+#: journal rank the supervisor writes under (children use 0..world_size-1)
+SUPERVISOR_RANK = -1
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Geometry + knobs for one simulated-fleet run.  Everything a child
+    needs is serialized to ``fleet.json`` so respawns are stateless."""
+
+    world_size: int = 2
+    target_steps: int = 10
+    save_interval: int = 2
+    seed: int = 0
+    # tiny-GPT fixture geometry (per-child; smaller = faster spawn)
+    micro_batch: int = 2
+    n_layer: int = 1
+    n_head: int = 2
+    d_model: int = 32
+    seq_len: int = 32
+    dataset_size: int = 256
+    # supervision knobs pushed into every child
+    heartbeat_interval_s: float = 0.2
+    heartbeat_gap_s: float = 2.0
+    slow_factor: Optional[float] = 2.0
+    slow_min_intervals: int = 2
+    barrier_deadline_s: float = 3.0
+    consensus_deadline_s: float = 30.0
+    sweep_min_age_s: float = 120.0
+    preempt_save_deadline_s: Optional[float] = 10.0
+    nan_abort_threshold: int = 2
+    max_rollbacks: int = 2
+    # supervisor policy
+    max_restarts: int = 2
+    drain_on_bounce: bool = False
+    drain_grace_s: float = 20.0
+    incarnation_timeout_s: float = 240.0
+    poll_s: float = 0.05
+
+    @classmethod
+    def from_scenario(cls, scenario: Scenario, **overrides) -> "FleetConfig":
+        base = dict(world_size=scenario.world_size,
+                    target_steps=scenario.target_steps,
+                    save_interval=scenario.save_interval,
+                    seed=scenario.seed,
+                    nan_abort_threshold=scenario.nan_abort_threshold,
+                    max_restarts=scenario.max_restarts,
+                    drain_on_bounce=scenario.drain_on_bounce)
+        base.update(overrides)
+        return cls(**base)
+
+    def child_payload(self, run_dir: str) -> Dict[str, Any]:
+        doc = dataclasses.asdict(self)
+        doc["run_dir"] = run_dir
+        return doc
+
+
+class FleetSupervisor:
+    """Spawn → watch → bounce → respawn, under a bounded restart budget."""
+
+    def __init__(self, run_dir: str, config: Optional[FleetConfig] = None,
+                 scenario: Optional[Scenario] = None):
+        if config is None:
+            if scenario is None:
+                raise ValueError("need a FleetConfig or a Scenario")
+            config = FleetConfig.from_scenario(scenario)
+        self.config = config
+        self.scenario = scenario
+        self.run_dir = str(run_dir)
+        self.ckpt_dir = os.path.join(self.run_dir, "ckpt")
+        self.heartbeat_dir = os.path.join(self.run_dir, "heartbeats")
+        self.log_dir = os.path.join(self.run_dir, "logs")
+        for d in (self.run_dir, self.ckpt_dir, self.log_dir):
+            os.makedirs(d, exist_ok=True)
+        self.journal = EventJournal(
+            os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
+        self._config_path = os.path.join(self.run_dir, "fleet.json")
+        from ..runtime.checkpoint_engine.storage import atomic_write_text
+        atomic_write_text(self._config_path,
+                          json.dumps(config.child_payload(self.run_dir),
+                                     indent=1, sort_keys=True))
+        self._log_handles: List[Any] = []
+
+    # ------------------------------------------------------------- spawn
+    def _child_env(self, rank: int, incarnation: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DS_FLEET_CONFIG"] = self._config_path
+        env["DS_FLEET_RANK"] = str(rank)
+        env["DS_FLEET_WORLD"] = str(self.config.world_size)
+        env["DS_FLEET_INC"] = str(incarnation)
+        plan = self.scenario.plan_for(rank, incarnation) \
+            if self.scenario is not None else ""
+        if plan:
+            env[fault_injection.PLAN_ENV] = plan
+        else:
+            env.pop(fault_injection.PLAN_ENV, None)
+        return env
+
+    def _spawn_rank(self, rank: int, incarnation: int) -> subprocess.Popen:
+        log_path = os.path.join(self.log_dir,
+                                f"inc{incarnation}.rank{rank}.log")
+        log = open(log_path, "ab")
+        self._log_handles.append(log)
+        return subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.goodput.rank_main"],
+            env=self._child_env(rank, incarnation),
+            stdout=log, stderr=subprocess.STDOUT,
+            cwd=self.run_dir)
+
+    def _sentinel_path(self, rank: int) -> str:
+        return os.path.join(self.run_dir, f"rank{rank}.exit.json")
+
+    def _read_sentinel(self, rank: int, incarnation: int) -> Optional[dict]:
+        try:
+            with open(self._sentinel_path(rank)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None  # no orderly exit record: the rank just died
+        if int(doc.get("incarnation", -1)) != incarnation:
+            return None  # stale sentinel that escaped the pre-spawn sweep
+        return doc
+
+    def _pre_spawn_cleanup(self) -> None:
+        """A new incarnation must not read the dead one's liveness: stale
+        sentinels would misclassify exits, stale beats would look like
+        dead-then-recovered ranks to the new monitor."""
+        for rank in range(self.config.world_size):
+            try:
+                os.remove(self._sentinel_path(rank))
+            except FileNotFoundError:  # dslint: disable=swallowed-exception — a missing sentinel is the normal case (first incarnation / crashed rank)
+                pass
+        shutil.rmtree(self.heartbeat_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------ actions
+    def _apply_actions(self, incarnation: int) -> None:
+        if self.scenario is None:
+            return
+        for action in self.scenario.actions:
+            if action.after_incarnation != incarnation:
+                continue
+            self._corrupt_newest_committed(action)
+
+    def _corrupt_newest_committed(self, action) -> None:
+        from ..runtime.checkpoint_engine import commit as cp
+        from ..runtime.checkpoint_engine.integrity import list_tags
+        for tag in list_tags(self.ckpt_dir, newest_first=True):
+            if not cp.is_committed(self.ckpt_dir, tag):
+                continue
+            tag_dir = os.path.join(self.ckpt_dir, tag)
+            for name in sorted(os.listdir(tag_dir)):
+                if action.file_match in name and not name.endswith(".json") \
+                        and not name.endswith(".ready"):
+                    path = os.path.join(tag_dir, name)
+                    fault_injection.corrupt_file(
+                        path, nbytes=action.nbytes, seed=action.seed)
+                    logger.warning(
+                        f"[goodput-fleet] scenario action: corrupted "
+                        f"{tag}/{name} ({action.nbytes} bytes) — resume "
+                        f"must fall back past this tag")
+                    return
+        logger.warning(
+            "[goodput-fleet] corrupt action found no committed tag to "
+            "corrupt — the scenario schedule is off")
+
+    # --------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.monotonic()
+        restarts = 0
+        incarnation = 0
+        try:
+            while True:
+                outcome = self._run_incarnation(incarnation)
+                if outcome["verdict"] == "done":
+                    final_step = outcome["final_step"]
+                    wall = time.monotonic() - t0
+                    self.journal.emit(EventKind.FLEET_DONE,
+                                      incarnation=incarnation,
+                                      final_step=final_step,
+                                      wall_s=round(wall, 3))
+                    return {"completed": True, "aborted": None,
+                            "final_step": final_step,
+                            "incarnations": incarnation + 1,
+                            "restarts": restarts,
+                            "wall_s": round(wall, 3)}
+                if outcome["verdict"] == "timeout":
+                    self.journal.emit(EventKind.FLEET_ABORT,
+                                      incarnation=incarnation,
+                                      reason="incarnation timeout",
+                                      restarts=restarts)
+                    return {"completed": False,
+                            "aborted": "incarnation timeout",
+                            "final_step": None,
+                            "incarnations": incarnation + 1,
+                            "restarts": restarts,
+                            "wall_s": round(time.monotonic() - t0, 3)}
+                # crash or preemption: the group must relaunch
+                if restarts >= cfg.max_restarts:
+                    self.journal.emit(EventKind.FLEET_ABORT,
+                                      incarnation=incarnation,
+                                      reason="restart budget exhausted",
+                                      restarts=restarts)
+                    return {"completed": False,
+                            "aborted": "restart budget exhausted",
+                            "final_step": None,
+                            "incarnations": incarnation + 1,
+                            "restarts": restarts,
+                            "wall_s": round(time.monotonic() - t0, 3)}
+                self._apply_actions(incarnation)
+                restarts += 1
+                incarnation += 1
+                self.journal.emit(EventKind.FLEET_RESTART,
+                                  incarnation=incarnation,
+                                  restarts=restarts,
+                                  budget=cfg.max_restarts,
+                                  reason=outcome["verdict"],
+                                  detect_ts=outcome["detect_ts"])
+        finally:
+            for h in self._log_handles:
+                try:
+                    h.close()
+                except OSError as e:  # a leaked handle must not mask the run
+                    logger.warning(f"[goodput-fleet] log close failed: {e}")
+            self._log_handles = []
+
+    def _run_incarnation(self, incarnation: int) -> Dict[str, Any]:
+        """Spawn the group, watch it, and classify how it ended:
+        ``done`` / ``rank_exit`` / ``preempt`` / ``timeout``."""
+        cfg = self.config
+        self._pre_spawn_cleanup()
+        # fresh monitor per incarnation: cadence tracking across a restart
+        # gap would read the downtime as one giant drifted interval
+        monitor = HeartbeatMonitor(
+            self.heartbeat_dir, gap_s=cfg.heartbeat_gap_s,
+            journal=self.journal, expected_ranks=cfg.world_size,
+            slow_factor=cfg.slow_factor,
+            slow_min_intervals=cfg.slow_min_intervals)
+        procs = {rank: self._spawn_rank(rank, incarnation)
+                 for rank in range(cfg.world_size)}
+        self.journal.emit(EventKind.FLEET_SPAWN, incarnation=incarnation,
+                          world_size=cfg.world_size,
+                          pids=[p.pid for p in procs.values()])
+        deadline = time.monotonic() + cfg.incarnation_timeout_s
+        statuses: Dict[int, Dict[str, Any]] = {}
+        detect_ts: Optional[float] = None
+        crashed = False
+        while len(statuses) < cfg.world_size:
+            time.sleep(cfg.poll_s)
+            try:
+                monitor.check()
+            except Exception as e:  # observability must not kill the fleet
+                logger.warning(f"[goodput-fleet] heartbeat check failed: "
+                               f"{e!r}")
+            for rank, proc in procs.items():
+                if rank in statuses:
+                    continue
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                sentinel = self._read_sentinel(rank, incarnation)
+                if rc == 0 and sentinel is not None:
+                    status = sentinel["status"]  # done | preempted
+                else:
+                    status = "crashed"
+                statuses[rank] = {"rc": rc, "status": status,
+                                  "sentinel": sentinel}
+                self.journal.emit(EventKind.FLEET_RANK_EXIT,
+                                  incarnation=incarnation, rank=rank,
+                                  returncode=rc, status=status)
+                if status != "done" and detect_ts is None:
+                    detect_ts = time.time()
+                if status == "crashed":
+                    crashed = True
+            if crashed:
+                self._bounce(procs, statuses, incarnation)
+                break
+            if time.monotonic() > deadline:
+                logger.error(
+                    f"[goodput-fleet] incarnation {incarnation} exceeded "
+                    f"{cfg.incarnation_timeout_s}s — killing the group")
+                self._bounce(procs, statuses, incarnation, force_kill=True)
+                return {"verdict": "timeout", "detect_ts": detect_ts,
+                        "final_step": None}
+        if all(s["status"] == "done" for s in statuses.values()):
+            final = max((s["sentinel"] or {}).get("final_step", 0)
+                        for s in statuses.values())
+            return {"verdict": "done", "detect_ts": None,
+                    "final_step": final}
+        verdict = "rank_exit" if any(
+            s["status"] in ("crashed", "bounced")
+            for s in statuses.values()) else "preempt"
+        return {"verdict": verdict, "detect_ts": detect_ts,
+                "final_step": None}
+
+    def _bounce(self, procs, statuses, incarnation: int,
+                force_kill: bool = False) -> None:
+        """Take down the survivors of a failed incarnation: a partial
+        fleet can neither commit (the barrier needs every vote) nor
+        consensus-resume — the restart is whole-group by design."""
+        cfg = self.config
+        survivors = {r: p for r, p in procs.items() if r not in statuses}
+        for proc in survivors.values():
+            if cfg.drain_on_bounce and not force_kill:
+                proc.terminate()
+            else:
+                proc.kill()
+        grace = time.monotonic() + (cfg.drain_grace_s
+                                    if cfg.drain_on_bounce and not force_kill
+                                    else 5.0)
+        for rank, proc in survivors.items():
+            timeout = max(0.1, grace - time.monotonic())
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    f"[goodput-fleet] rank {rank} ignored the bounce for "
+                    f"{timeout:.1f}s — SIGKILL")
+                proc.kill()
+                proc.wait(timeout=10.0)
+            statuses[rank] = {"rc": proc.returncode, "status": "bounced",
+                              "sentinel": None}
+            self.journal.emit(EventKind.FLEET_RANK_EXIT,
+                              incarnation=incarnation, rank=rank,
+                              returncode=proc.returncode, status="bounced")
+
+
+def run_scenario(run_dir: str, scenario: Scenario,
+                 **config_overrides) -> Dict[str, Any]:
+    """Run one scenario to completion and score it — the single call the
+    bench script and the tier-1 smoke test share."""
+    from .score import score_scenario_run
+    supervisor = FleetSupervisor(
+        run_dir, FleetConfig.from_scenario(scenario, **config_overrides),
+        scenario=scenario)
+    result = supervisor.run()
+    score = score_scenario_run(run_dir, scenario)
+    score["fleet"] = result
+    if not result["completed"]:
+        score["ok"] = False
+        score["failures"] = list(score.get("failures", ())) + [
+            f"fleet did not complete: {result['aborted']}"]
+    return score

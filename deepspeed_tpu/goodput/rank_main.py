@@ -1,0 +1,206 @@
+"""One simulated fleet rank: a real engine process, not a mock.
+
+``python -m deepspeed_tpu.goodput.rank_main`` is what
+:class:`~deepspeed_tpu.goodput.fleet.FleetSupervisor` spawns, once per
+rank per incarnation.  Identity and wiring arrive via environment
+variables so the process is fully relaunchable:
+
+========================  ===============================================
+``DS_FLEET_CONFIG``       path to the fleet's JSON config (geometry,
+                          deadlines, seeds) written once by the supervisor
+``DS_FLEET_RANK``         which host of the fleet this process plays
+``DS_FLEET_WORLD``        fleet world size
+``DS_FLEET_INC``          incarnation index (scopes consensus rounds)
+``DS_FAULT_PLAN``         scenario faults, armed at import by
+                          ``utils/fault_injection.py`` — this module never
+                          sees them
+========================  ===============================================
+
+The process builds a tiny GPT ``DeepSpeedEngine`` (CPU, 1 device), wires
+the PR 1–5 robustness stack exactly the way a real multi-host launch
+would — shared checkpoint dir, ``FileConsensusChannel``, shared heartbeat
+dir, shared ``events.jsonl`` — and drives ``ElasticTrainRunner`` to the
+fleet's target step.  Every rank journals with its *fleet* rank (the
+engine itself is single-process and believes it is rank 0), and rank 0 is
+the commit-protocol coordinator: it alone publishes global files,
+``commit.json``, and the ``latest`` marker.
+
+Exit contract: an atomic ``rank<N>.exit.json`` sentinel
+(``status: done|preempted``, final step) plus exit code 0 on an orderly
+exit; anything else — a kill, an injected ``os._exit`` — is a failure the
+supervisor classifies from the raw returncode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _fleet_env() -> dict:
+    with open(os.environ["DS_FLEET_CONFIG"]) as f:
+        cfg = json.load(f)
+    cfg["rank"] = int(os.environ["DS_FLEET_RANK"])
+    cfg["world_size"] = int(os.environ["DS_FLEET_WORLD"])
+    cfg["incarnation"] = int(os.environ.get("DS_FLEET_INC", "0"))
+    return cfg
+
+
+def build_ds_config(cfg: dict) -> dict:
+    """The child's deepspeed config: every robustness subsystem on."""
+    run_dir = cfg["run_dir"]
+    return {
+        "train_micro_batch_size_per_gpu": int(cfg.get("micro_batch", 2)),
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "data": {
+            "resumable": True,
+            "shuffle": True,
+            "seed": int(cfg.get("seed", 0)),
+            "journal_batches": True,  # the scoring audit trail
+        },
+        "checkpoint": {
+            "commit": {
+                "enabled": True,
+                "barrier_deadline_s": float(cfg.get("barrier_deadline_s", 3.0)),
+                "barrier_poll_s": 0.01,
+                "barrier_backoff_max_s": 0.05,
+                "consensus_deadline_s":
+                    float(cfg.get("consensus_deadline_s", 30.0)),
+                # ranks here are NOT step-lockstepped (no per-step
+                # collective couples them), so a fast vote-only rank runs
+                # ahead and its early votes for future tags must survive
+                # the coordinator's retention-time torn-tag sweep — the
+                # sibling-writer grace window is load-bearing, not optional
+                "sweep_min_age_s": float(cfg.get("sweep_min_age_s", 120.0)),
+            },
+        },
+        "supervision": {
+            "enabled": True,
+            "event_journal": os.path.join(run_dir, "events.jsonl"),
+            "preempt_save_deadline_s": cfg.get("preempt_save_deadline_s"),
+            "heartbeat": {
+                "enabled": True,
+                "interval_s": float(cfg.get("heartbeat_interval_s", 0.2)),
+                "gap_s": float(cfg.get("heartbeat_gap_s", 2.0)),
+                "dir": os.path.join(run_dir, "heartbeats"),
+                "slow_factor": cfg.get("slow_factor"),
+                "slow_min_intervals": int(cfg.get("slow_min_intervals", 2)),
+            },
+            "rollback": {
+                "max_rollbacks": int(cfg.get("max_rollbacks", 2)),
+                "lr_factor": 0.5,
+            },
+        },
+    }
+
+
+def build_engine(cfg: dict, ds_config: dict):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.runtime.model import from_gpt
+
+    seq = int(cfg.get("seq_len", 32))
+    model_cfg = gpt.GPTConfig(
+        vocab_size=256, max_seq_len=seq,
+        n_layer=int(cfg.get("n_layer", 1)), n_head=int(cfg.get("n_head", 2)),
+        d_model=int(cfg.get("d_model", 32)),
+        dtype=jnp.float32, vocab_round_to=128)
+
+    class _FixtureDataset:
+        """Deterministic random tokens — identical on every rank, which is
+        what makes cross-rank fingerprint agreement a scorable invariant."""
+
+        def __init__(self, n: int, seed: int):
+            rng = np.random.default_rng(seed)
+            self.data = rng.integers(
+                0, 256, size=(n, seq + 1)).astype(np.int32)
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"tokens": self.data[i]}
+
+    dataset = _FixtureDataset(int(cfg.get("dataset_size", 256)),
+                              int(cfg.get("seed", 0)))
+    return deepspeed_tpu.initialize(
+        model=from_gpt(model_cfg), config=ds_config,
+        training_data=dataset,
+        rng=jax.random.PRNGKey(int(cfg.get("seed", 0))))
+
+
+def _write_sentinel(run_dir: str, rank: int, incarnation: int, status: str,
+                    final_step: int, steps: int) -> None:
+    from deepspeed_tpu.runtime.checkpoint_engine.storage import \
+        atomic_write_text
+    atomic_write_text(
+        os.path.join(run_dir, f"rank{rank}.exit.json"),
+        json.dumps({"rank": rank, "incarnation": incarnation,
+                    "status": status, "final_step": int(final_step),
+                    "steps": int(steps)}))
+
+
+def main() -> int:
+    cfg = _fleet_env()
+    rank, world = cfg["rank"], cfg["world_size"]
+    inc = cfg["incarnation"]
+    run_dir = cfg["run_dir"]
+
+    # one CPU device per simulated host, pinned before jax backend init
+    from deepspeed_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(n_devices=1, persistent_cache=False)
+
+    ds_config = build_ds_config(cfg)
+    engine, _, loader, _ = build_engine(cfg, ds_config)
+
+    from deepspeed_tpu.elasticity.elastic_agent import ElasticTrainRunner
+    from deepspeed_tpu.runtime.checkpoint_engine.commit import (
+        CommitContext, FileConsensusChannel)
+
+    ckpt_dir = os.path.join(run_dir, "ckpt")
+    runner = ElasticTrainRunner(
+        engine, ckpt_dir,
+        save_interval=int(cfg.get("save_interval", 2)),
+        ds_config=ds_config,
+        nan_abort_threshold=int(cfg.get("nan_abort_threshold", 2)),
+        rank=rank)
+    # the fleet identity overrides the engine-derived commit context: this
+    # process is host <rank> of <world>, agreeing over the shared FS (the
+    # per-incarnation round_id keeps a respawned group's consensus rounds
+    # disjoint from a dead incarnation's)
+    ctx = CommitContext(
+        world_size=world, rank=rank,
+        config=engine._config.checkpoint_config.commit_config,
+        journal=runner.journal,
+        channel=FileConsensusChannel(
+            os.path.join(run_dir, "consensus"), rank, world,
+            round_id=f"inc{inc}",
+            deadline_s=float(cfg.get("consensus_deadline_s", 30.0)),
+            poll_s=0.02) if world > 1 else None)
+    engine.set_commit_context(ctx)
+    runner.commit_ctx = ctx
+
+    engine.set_data_iterator(loader)
+    resumed_at = runner.resume()
+    target = int(cfg["target_steps"])
+    remaining = max(0, target - resumed_at)
+    if remaining == 0:
+        _write_sentinel(run_dir, rank, inc, "done", resumed_at, 0)
+        return 0
+    out = runner.run(loader, max_steps=remaining, resume=False)
+    status = "preempted" if out["preempted"] and \
+        engine.global_steps < target else "done"
+    _write_sentinel(run_dir, rank, inc, status, engine.global_steps,
+                    out["steps"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

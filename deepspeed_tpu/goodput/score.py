@@ -1,0 +1,290 @@
+"""Journal-derived goodput scoring: the run's black box is the dataset.
+
+Everything here is computed from ``events.jsonl`` records the existing
+subsystems already emit — ``data.batch`` fingerprints (PR 3),
+``ckpt.commit``/consensus kinds (PR 5), supervision rollback/quarantine
+kinds (PR 2), plus the fleet's own ``fleet.*`` lifecycle events — so the
+score needs no cooperation from the processes being scored, works on a
+journal recovered from a dead run, and tolerates torn trailing lines
+(:func:`read_events` skips them).
+
+Metric definitions (full prose: ``docs/goodput.md``):
+
+goodput
+    ``useful_steps / (useful_steps + wasted_steps)`` — deterministic given
+    a fault schedule, which is what a regression gate needs.  Useful steps
+    are the distinct step indices rank 0 trained; waste is every re-trained
+    step (work re-done after resuming from an older tag or a rollback)
+    plus every quarantine-skipped batch slot.
+goodput_wall
+    the wall-clock flavor: ``useful_steps × median_step_s / span`` —
+    reported for trend-watching, too noisy on shared CI to gate hard.
+MTTR
+    per incident, seconds from the supervisor *detecting* a failure
+    (``fleet.restart``'s ``detect_ts``) to the first useful step trained
+    after the restart.
+invariants
+    split-brain (two resume-consensus tags inside one incarnation),
+    quarantine violations (a batch trained inside a journaled quarantine
+    window after the quarantine landed), replay mismatches (one step, two
+    fingerprints, with no rollback between to excuse it), and abort-class
+    events outside the scenario's allowance.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..runtime.supervision.events import ABORT_KINDS, EventKind, read_events
+
+
+def _by_kind(events: List[dict], kind: str) -> List[dict]:
+    return [e for e in events if e.get("kind") == kind]
+
+
+def _median(values: List[float]) -> float:
+    if not values:
+        return 0.0
+    v = sorted(values)
+    n = len(v)
+    return v[n // 2] if n % 2 else 0.5 * (v[n // 2 - 1] + v[n // 2])
+
+
+def _incarnation_spans(events: List[dict]) -> List[Dict[str, Any]]:
+    """Time spans between consecutive ``fleet.spawn`` events (the whole
+    journal when a run was scored without fleet lifecycle records)."""
+    spawns = sorted(_by_kind(events, EventKind.FLEET_SPAWN),
+                    key=lambda e: float(e.get("ts", 0.0)))
+    if not spawns:
+        return [{"incarnation": 0, "from_ts": float("-inf"),
+                 "to_ts": float("inf")}]
+    spans = []
+    for i, s in enumerate(spawns):
+        end = float(spawns[i + 1]["ts"]) if i + 1 < len(spawns) \
+            else float("inf")
+        spans.append({"incarnation": s.get("incarnation", i),
+                      "from_ts": float(s["ts"]), "to_ts": end})
+    return spans
+
+
+def check_invariants(events: List[dict],
+                     allow_abort_kinds=()) -> Dict[str, Any]:
+    """The robustness contract, re-verified from the journal alone."""
+    problems: List[str] = []
+
+    # --- no split-brain resume: within one incarnation every host's
+    # resume consensus must land on the same tag
+    split_brain = 0
+    for span in _incarnation_spans(events):
+        tags = {e.get("tag")
+                for e in _by_kind(events, EventKind.CKPT_RESUME_CONSENSUS)
+                if span["from_ts"] <= float(e.get("ts", 0.0)) < span["to_ts"]}
+        if len(tags) > 1:
+            split_brain += 1
+            problems.append(
+                f"split-brain: incarnation {span['incarnation']} resumed "
+                f"from {sorted(str(t) for t in tags)}")
+
+    # --- quarantine honored: no batch trained inside a journaled window
+    # after the window landed
+    quarantine_violations = 0
+    for q in _by_kind(events, EventKind.DATA_QUARANTINE):
+        lo, hi = q.get("from_step"), q.get("to_step")
+        if lo is None or hi is None:
+            continue
+        for b in _by_kind(events, EventKind.DATA_BATCH):
+            if float(b.get("ts", 0.0)) > float(q.get("ts", 0.0)) and \
+                    lo <= int(b.get("step", -1)) < hi:
+                quarantine_violations += 1
+                problems.append(
+                    f"quarantine violated: step {b.get('step')} trained "
+                    f"after quarantine [{lo}, {hi}) landed")
+
+    # --- bitwise replay where expected: one step index, one fingerprint —
+    # unless a rollback (which legitimately re-plans the window via
+    # quarantine) sits between the two trainings
+    replay_mismatches = 0
+    rollback_ts = sorted(float(e.get("ts", 0.0))
+                         for e in _by_kind(events, EventKind.ROLLBACK))
+    by_step: Dict[int, List[dict]] = {}
+    for b in _by_kind(events, EventKind.DATA_BATCH):
+        if b.get("sha") is not None and b.get("step") is not None:
+            by_step.setdefault(int(b["step"]), []).append(b)
+    for step, recs in sorted(by_step.items()):
+        if len({r["sha"] for r in recs}) <= 1:
+            continue
+        lo = min(float(r.get("ts", 0.0)) for r in recs)
+        hi = max(float(r.get("ts", 0.0)) for r in recs)
+        if any(lo <= t <= hi for t in rollback_ts):
+            continue  # a rollback re-planned the window: divergence is real
+        replay_mismatches += 1
+        problems.append(
+            f"replay mismatch: step {step} trained with "
+            f"{len({r['sha'] for r in recs})} distinct fingerprints and no "
+            f"rollback between")
+
+    # --- abort-class events outside the scenario's allowance
+    allowed = set(allow_abort_kinds)
+    unexpected_aborts = [e["kind"] for e in events
+                         if e.get("kind") in ABORT_KINDS
+                         and e["kind"] not in allowed]
+    for kind in unexpected_aborts:
+        problems.append(f"unexpected abort-class event: {kind}")
+
+    total = split_brain + quarantine_violations + replay_mismatches + \
+        len(unexpected_aborts)
+    return {"split_brain": split_brain,
+            "quarantine_violations": quarantine_violations,
+            "replay_mismatches": replay_mismatches,
+            "unexpected_aborts": len(unexpected_aborts),
+            "total": total,
+            "problems": problems}
+
+
+def score_events(events: List[dict], *, target_steps: int,
+                 world_size: int = 1, name: Optional[str] = None,
+                 expect: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    """Score one run's journal records into the goodput report."""
+    expect = dict(expect or {})
+    batches = [e for e in _by_kind(events, EventKind.DATA_BATCH)
+               if e.get("step") is not None]
+    # rank 0 is the canonical trajectory; other ranks' records feed the
+    # cross-rank replay check but must not double-count work
+    r0 = [e for e in batches if e.get("rank", 0) == 0]
+    trained_steps = len(r0)
+    unique_steps = len({int(e["step"]) for e in r0})
+    skipped = len([e for e in _by_kind(events, EventKind.DATA_QUARANTINE_SKIP)
+                   if e.get("rank", 0) == 0])
+    # useful = the final trajectory's length (fleet.done's final_step):
+    # work re-done after a resume *repeats* data steps, work re-done after
+    # a rollback+quarantine consumes *new* data steps — anchoring on the
+    # end state charges both kinds of re-work as waste.  Without a fleet
+    # lifecycle record (incomplete run / bare corpus), the distinct data
+    # steps capped at the target are the honest fallback.
+    done = _by_kind(events, EventKind.FLEET_DONE)
+    if done and done[-1].get("final_step") is not None:
+        useful_steps = int(done[-1]["final_step"])
+    else:
+        useful_steps = min(unique_steps, int(target_steps))
+    wasted_steps = max(0, (trained_steps + skipped) - useful_steps)
+    denom = useful_steps + wasted_steps
+    goodput = (useful_steps / denom) if denom else 0.0
+
+    # wall-clock flavor: useful step-time over the span from the first
+    # trained step to the last (first-incarnation process startup is the
+    # fixture's cost, not the robustness stack's; checkpoint commits,
+    # restart downtime, and rollback re-work all land inside the span and
+    # are exactly the overhead this metric charges)
+    ts_batches = [float(e.get("ts", 0.0)) for e in r0 if e.get("ts")]
+    span = (max(ts_batches) - min(ts_batches)) if len(ts_batches) > 1 else 0.0
+    deltas = []
+    r0_sorted = sorted(r0, key=lambda e: float(e.get("ts", 0.0)))
+    for a, b in zip(r0_sorted, r0_sorted[1:]):
+        dt = float(b.get("ts", 0.0)) - float(a.get("ts", 0.0))
+        # resets/waits between incarnations are exactly what goodput loses,
+        # so only same-stride deltas inform the per-step cost estimate
+        if 0.0 < dt and int(b["step"]) == int(a["step"]) + 1:
+            deltas.append(dt)
+    median_step_s = _median(deltas)
+    span += median_step_s  # the first step's own cost
+    goodput_wall = min(1.0, useful_steps * median_step_s / span) \
+        if span > 0 and median_step_s > 0 else (1.0 if useful_steps else 0.0)
+
+    # --- incidents + MTTR: detection → first useful step after restart
+    restarts = sorted(_by_kind(events, EventKind.FLEET_RESTART),
+                      key=lambda e: float(e.get("ts", 0.0)))
+    mttr_all: List[float] = []
+    for r in restarts:
+        detect = float(r.get("detect_ts") or r.get("ts", 0.0))
+        after = [float(b.get("ts", 0.0)) for b in batches
+                 if float(b.get("ts", 0.0)) > float(r.get("ts", 0.0))]
+        if after:
+            mttr_all.append(round(min(after) - detect, 3))
+    incidents = len(restarts)
+
+    invariants = check_invariants(
+        events, allow_abort_kinds=expect.get("allow_abort_kinds", ()))
+
+    kinds: Dict[str, int] = {}
+    for e in events:
+        k = str(e.get("kind", "?"))
+        kinds[k] = kinds.get(k, 0) + 1
+
+    score: Dict[str, Any] = {
+        "scenario": name,
+        "world_size": int(world_size),
+        "target_steps": int(target_steps),
+        "useful_steps": useful_steps,
+        "unique_steps": unique_steps,
+        "trained_steps": trained_steps,
+        "wasted_steps": wasted_steps,
+        "quarantine_skipped": skipped,
+        "goodput": round(goodput, 4),
+        "goodput_wall": round(goodput_wall, 4),
+        "median_step_s": round(median_step_s, 4),
+        "wall_s": round(span, 3),
+        "incidents": incidents,
+        "mttr_s": {"all": mttr_all,
+                   "mean": round(sum(mttr_all) / len(mttr_all), 3)
+                   if mttr_all else None,
+                   "max": max(mttr_all) if mttr_all else None},
+        "invariant_violations": invariants,
+        "kinds": kinds,
+    }
+    score["ok"], score["failures"] = _judge(score, expect)
+    return score
+
+
+def _judge(score: Dict[str, Any], expect: Mapping[str, Any]):
+    """Fold the scenario's expectations into a verdict."""
+    failures: List[str] = []
+    if score["useful_steps"] < score["target_steps"]:
+        failures.append(
+            f"run incomplete: {score['useful_steps']} useful steps < "
+            f"target {score['target_steps']}")
+    if score["invariant_violations"]["total"]:
+        failures.extend(score["invariant_violations"]["problems"])
+    min_goodput = expect.get("min_goodput")
+    if min_goodput is not None and score["goodput"] < min_goodput:
+        failures.append(
+            f"goodput {score['goodput']} < expected {min_goodput}")
+    max_wasted = expect.get("max_wasted_steps")
+    if max_wasted is not None and score["wasted_steps"] > max_wasted:
+        failures.append(
+            f"wasted_steps {score['wasted_steps']} > expected {max_wasted}")
+    max_incidents = expect.get("max_incidents")
+    if max_incidents is not None and score["incidents"] > max_incidents:
+        failures.append(
+            f"incidents {score['incidents']} > expected {max_incidents}")
+    max_mttr = expect.get("max_mttr_s")
+    if max_mttr is not None:
+        worst = score["mttr_s"]["max"]
+        if score["incidents"] and worst is None:
+            failures.append("incident(s) with no recovery step: MTTR "
+                            "unmeasurable (the fleet never resumed)")
+        elif worst is not None and worst > max_mttr:
+            failures.append(f"MTTR {worst}s > expected {max_mttr}s")
+    for kind in expect.get("expect_kinds", ()):
+        if not score["kinds"].get(kind):
+            failures.append(f"expected event kind {kind!r} never journaled")
+    return (not failures), failures
+
+
+def score_run(run_dir: str, *, target_steps: int, world_size: int = 1,
+              name: Optional[str] = None,
+              expect: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    """Score a fleet run directory (reads ``<run_dir>/events.jsonl``;
+    torn trailing lines are skipped by the reader, not fatal)."""
+    path = run_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    return score_events(read_events(path), target_steps=target_steps,
+                        world_size=world_size, name=name, expect=expect)
+
+
+def score_scenario_run(run_dir: str, scenario) -> Dict[str, Any]:
+    """Score a run directory against its :class:`~.scenarios.Scenario`."""
+    return score_run(run_dir, target_steps=scenario.target_steps,
+                     world_size=scenario.world_size, name=scenario.name,
+                     expect=scenario.expect)

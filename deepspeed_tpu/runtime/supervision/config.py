@@ -10,8 +10,10 @@ Same validated dataclass-model style as ``checkpoint_engine/config.py`` and
         "step_deadline_s": 1800,
         "collective_deadline_s": 600,
         "event_journal": null,
+        "preempt_save_deadline_s": null,
         "heartbeat": {"enabled": true, "interval_s": 15, "gap_s": 60,
-                      "dir": null},
+                      "dir": null, "slow_factor": null,
+                      "slow_min_intervals": 2},
         "rollback": {"max_rollbacks": 2, "lr_factor": 0.5,
                      "reset_loss_scale": true, "skip_batches": 0}
     }}
@@ -42,6 +44,13 @@ class HeartbeatConfig(DeepSpeedConfigModel):
     gap_s: float = 60.0
     #: shared directory for the beat files (None → <save_dir>/heartbeats)
     dir: Optional[str] = None
+    #: a rank whose observed beat interval exceeds ``slow_factor ×`` its
+    #: advertised interval (sustained over ``slow_min_intervals`` beats) is
+    #: classified slow — journaled once per transition as
+    #: ``heartbeat.slow`` (None disables slow-rank detection)
+    slow_factor: Optional[float] = None
+    #: consecutive drifted intervals before the slow transition fires
+    slow_min_intervals: int = 2
 
     def __post_init__(self):
         if self.interval_s <= 0:
@@ -53,6 +62,14 @@ class HeartbeatConfig(DeepSpeedConfigModel):
                 f"supervision heartbeat.gap_s ({self.gap_s}) must exceed "
                 f"interval_s ({self.interval_s}) or every live host looks "
                 f"dead between beats")
+        if self.slow_factor is not None and float(self.slow_factor) <= 1.0:
+            raise ValueError(
+                f"supervision heartbeat.slow_factor must be > 1 (or null to "
+                f"disable), got {self.slow_factor}")
+        if self.slow_min_intervals < 1:
+            raise ValueError(
+                f"supervision heartbeat.slow_min_intervals must be >= 1, "
+                f"got {self.slow_min_intervals}")
 
 
 @dataclasses.dataclass
@@ -104,6 +121,12 @@ class DeepSpeedSupervisionConfig(DeepSpeedConfigModel):
     collective_deadline_s: Optional[float] = None
     #: JSONL event journal path (None → <save_dir>/events.jsonl)
     event_journal: Optional[str] = None
+    #: proactive checkpoint-on-SIGTERM budget: the first preemption signal
+    #: starts this clock, and the drain save is attempted only while it has
+    #: time left — journaled ``ckpt.preempt_save`` on success within the
+    #: deadline, ``ckpt.preempt_save_timeout`` otherwise (None keeps the
+    #: unbounded PR 2 drain; double-SIGTERM escalation is unchanged)
+    preempt_save_deadline_s: Optional[float] = None
     #: raw subsections (typed views: ``heartbeat_config``/``rollback_config``)
     heartbeat: Optional[Dict] = None
     rollback: Optional[Dict] = None
@@ -118,7 +141,8 @@ class DeepSpeedSupervisionConfig(DeepSpeedConfigModel):
             self.heartbeat_config = HeartbeatConfig.from_dict(self.heartbeat)
         if isinstance(self.rollback, dict):
             self.rollback_config = RollbackConfig.from_dict(self.rollback)
-        for name in ("step_deadline_s", "collective_deadline_s"):
+        for name in ("step_deadline_s", "collective_deadline_s",
+                     "preempt_save_deadline_s"):
             v = getattr(self, name)
             if v is not None and float(v) <= 0:
                 raise ValueError(

@@ -47,6 +47,7 @@ class EventKind:
     PREEMPT_SIGNAL = "preempt.signal"
     HEARTBEAT_GAP = "heartbeat.gap"
     HEARTBEAT_RECOVERED = "heartbeat.recovered"
+    HEARTBEAT_SLOW = "heartbeat.slow"
     DATA_QUARANTINE = "data.quarantine"
     DATA_QUARANTINE_SKIP = "data.quarantine.skip"
     DATA_BAD_RECORD = "data.bad_record"
@@ -58,6 +59,13 @@ class EventKind:
     CKPT_RESUME_CONSENSUS = "ckpt.resume_consensus"
     CKPT_CONSENSUS_FAILURE = "ckpt.consensus_failure"
     CKPT_TORN_TAG = "ckpt.torn_tag"
+    CKPT_PREEMPT_SAVE = "ckpt.preempt_save"
+    CKPT_PREEMPT_SAVE_TIMEOUT = "ckpt.preempt_save_timeout"
+    FLEET_SPAWN = "fleet.spawn"
+    FLEET_RANK_EXIT = "fleet.rank_exit"
+    FLEET_RESTART = "fleet.restart"
+    FLEET_DONE = "fleet.done"
+    FLEET_ABORT = "fleet.abort"
     SERVE_REQUEST = "serve.request"
     SERVE_ADMIT = "serve.admit"
     SERVE_REJECT = "serve.reject"
@@ -82,6 +90,7 @@ ABORT_KINDS = frozenset({
     EventKind.DATA_BAD_RECORD_ABORT,
     EventKind.CKPT_COMMIT_TIMEOUT,
     EventKind.CKPT_CONSENSUS_FAILURE,
+    EventKind.FLEET_ABORT,
 })
 
 #: kind → the fields worth a one-liner in ``dump_run_events`` (everything
@@ -94,7 +103,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.WATCHDOG_EXPIRED: ("label", "deadline_s"),
     EventKind.PREEMPT_SIGNAL: ("signum", "step"),
     EventKind.HEARTBEAT_GAP: ("rank", "age_s", "last_step"),
-    EventKind.HEARTBEAT_RECOVERED: ("rank",),
+    EventKind.HEARTBEAT_RECOVERED: ("rank", "slow"),
+    EventKind.HEARTBEAT_SLOW: ("rank", "observed_s", "expected_s", "factor",
+                               "last_step"),
     EventKind.DATA_QUARANTINE: ("from_step", "to_step", "divergence_step"),
     EventKind.DATA_QUARANTINE_SKIP: ("from_step", "to_step", "at_step"),
     EventKind.DATA_BAD_RECORD: ("step", "epoch", "bad_records",
@@ -112,6 +123,16 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.CKPT_CONSENSUS_FAILURE: ("local_tag", "local_step",
                                        "agreed_step", "reason"),
     EventKind.CKPT_TORN_TAG: ("tag", "ready_ranks"),
+    EventKind.CKPT_PREEMPT_SAVE: ("step", "tag", "elapsed_s", "deadline_s"),
+    EventKind.CKPT_PREEMPT_SAVE_TIMEOUT: ("step", "elapsed_s", "deadline_s",
+                                          "saved"),
+    EventKind.FLEET_SPAWN: ("incarnation", "world_size", "pids"),
+    EventKind.FLEET_RANK_EXIT: ("incarnation", "rank", "returncode",
+                                "status"),
+    EventKind.FLEET_RESTART: ("incarnation", "restarts", "budget", "reason",
+                              "detect_ts"),
+    EventKind.FLEET_DONE: ("incarnation", "final_step", "wall_s"),
+    EventKind.FLEET_ABORT: ("incarnation", "reason", "restarts"),
     EventKind.SERVE_REQUEST: ("request_id", "prompt_len", "max_new_tokens",
                               "priority", "queue_depth"),
     EventKind.SERVE_ADMIT: ("request_id", "slot", "queued_ms", "prefix_hit"),

@@ -19,7 +19,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ...utils import fault_injection
 from ...utils.logging import logger
@@ -71,8 +71,12 @@ class HeartbeatWriter:
         try:
             fault_injection.fire("supervision.heartbeat", path=self.path,
                                  rank=self.rank)
+            # interval_s rides in the payload so a monitor can judge beat
+            # cadence drift (slow-rank detection) without being configured
+            # with every writer's interval
             payload = {"rank": self.rank, "pid": os.getpid(),
-                       "step": self._step, "ts": time.time()}
+                       "step": self._step, "ts": time.time(),
+                       "interval_s": self.interval_s}
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f)
@@ -109,15 +113,30 @@ class HeartbeatMonitor:
     a monitor thread that itself blocks in a collective would be useless.
     Every newly-stale rank is journaled once as ``heartbeat.gap``; a rank
     that resumes beating is journaled as ``heartbeat.recovered``.
+
+    Slow-rank classification (``slow_factor``): a rank that keeps beating
+    but whose observed beat-to-beat interval exceeds ``slow_factor ×`` the
+    interval its own payload advertises — sustained over
+    ``slow_min_intervals`` consecutive beats — is the straggler the gap
+    detector cannot see (it never goes stale, it just drags the pod).  The
+    transition is journaled once as ``heartbeat.slow``; dropping back under
+    the factor journals ``heartbeat.recovered`` (with ``slow=True``).
     """
 
     def __init__(self, directory: str, gap_s: float = 60.0, journal=None,
-                 expected_ranks: Optional[int] = None):
+                 expected_ranks: Optional[int] = None,
+                 slow_factor: Optional[float] = None,
+                 slow_min_intervals: int = 2):
         self.directory = str(directory)
         self.gap_s = float(gap_s)
         self.journal = journal
         self.expected_ranks = expected_ranks
+        self.slow_factor = None if slow_factor is None else float(slow_factor)
+        self.slow_min_intervals = max(1, int(slow_min_intervals))
         self._stale_ranks: set = set()
+        self._slow_ranks: set = set()
+        #: rank → (last observed beat ts, consecutive drifted intervals)
+        self._beat_track: Dict[int, Tuple[float, int]] = {}
 
     def read_beats(self) -> Dict[int, Dict[str, Any]]:
         beats: Dict[int, Dict[str, Any]] = {}
@@ -165,4 +184,51 @@ class HeartbeatMonitor:
             self._stale_ranks.discard(rank)
             if self.journal is not None:
                 self.journal.emit(EventKind.HEARTBEAT_RECOVERED, rank=rank)
-        return {"alive": alive, "stale": stale, "missing": missing}
+        slow = self._classify_slow(beats)
+        return {"alive": alive, "stale": stale, "missing": missing,
+                "slow": slow}
+
+    def _classify_slow(self, beats: Dict[int, Dict[str, Any]]) -> List[int]:
+        """Update beat-cadence tracking from freshly-read beats and return
+        the ranks currently classified slow.  Only a *new* beat advances
+        the tracker (``check`` is usually polled faster than ranks beat),
+        and stale ranks are the gap detector's problem, not this one's."""
+        if self.slow_factor is None:
+            return sorted(self._slow_ranks)
+        for rank, rec in sorted(beats.items()):
+            ts = float(rec.get("ts", 0.0))
+            expected = rec.get("interval_s")
+            prev = self._beat_track.get(rank)
+            if prev is None or expected is None:
+                self._beat_track[rank] = (ts, 0)
+                continue
+            prev_ts, drift = prev
+            if ts <= prev_ts or rank in self._stale_ranks:
+                continue  # no new beat yet / already reported dead
+            observed = ts - prev_ts
+            expected = float(expected)
+            if expected > 0 and observed > self.slow_factor * expected:
+                drift += 1
+                if drift >= self.slow_min_intervals and \
+                        rank not in self._slow_ranks:
+                    self._slow_ranks.add(rank)
+                    logger.warning(
+                        f"[supervision] heartbeat slow: rank {rank} beating "
+                        f"every {observed:.2f}s vs advertised {expected:.2f}s "
+                        f"({observed / expected:.1f}x, "
+                        f"slow_factor={self.slow_factor})")
+                    if self.journal is not None:
+                        self.journal.emit(
+                            EventKind.HEARTBEAT_SLOW, rank=rank,
+                            observed_s=observed, expected_s=expected,
+                            factor=observed / expected,
+                            last_step=rec.get("step"))
+            else:
+                drift = 0
+                if rank in self._slow_ranks:
+                    self._slow_ranks.discard(rank)
+                    if self.journal is not None:
+                        self.journal.emit(EventKind.HEARTBEAT_RECOVERED,
+                                          rank=rank, slow=True)
+            self._beat_track[rank] = (ts, drift)
+        return sorted(self._slow_ranks)

@@ -30,7 +30,13 @@ Points currently wired:
                           ``SignalAtStep``-style kills model coordinator
                           death between ready and commit)
 ``train.step``            once per completed runner step; ctx: ``step``
-                          (SIGTERM-at-step models a preemption notice)
+                          (SIGTERM-at-step models a preemption notice;
+                          ``KillAtStep``/``ExitAtStep`` model a hard
+                          preemption or a crashing worker)
+``train.loss``            after the runner pulled the step loss to host;
+                          ctx: ``step``, ``box`` (a mutable ``{"loss": x}``
+                          carrier — ``NaNLossWindow`` overwrites it to model
+                          a poisoned batch window feeding divergence)
 ``train.step_begin``      inside the runner's watchdog guard, before the
                           train call; ctx: ``step`` (``HangFor`` here models
                           a hung collective / wedged input pipeline)
@@ -58,6 +64,14 @@ Points currently wired:
                           wedged tick, ``DelaySeconds`` a slow one —
                           deadline/timeout behavior under pressure)
 ========================  =====================================================
+
+Subprocess fault plans (the goodput fleet's delivery channel): a parent
+process serializes a list of ``(point, fault, kwargs)`` specs with
+:func:`serialize_plan` into the ``DS_FAULT_PLAN`` environment variable; a
+child that imports this module installs them immediately (the import-time
+hook at the bottom of this file), so scenario faults are armed before the
+engine is even built — no RPC into the child required.  Only the
+whitelisted :data:`PLAN_FAULTS` types (JSON-native kwargs) are allowed.
 """
 
 from __future__ import annotations
@@ -83,6 +97,7 @@ FAULT_POINTS = frozenset({
     "ckpt.publish_commit",
     "train.step",
     "train.step_begin",
+    "train.loss",
     "comm.barrier",
     "supervision.heartbeat",
     "data.next",
@@ -202,6 +217,65 @@ class SignalAtStep(Fault):
         if step == self.step:
             self.fired += 1
             os.kill(os.getpid(), self.sig)
+
+
+class KillAtStep(SignalAtStep):
+    """SIGKILL this process when the train loop reaches ``step`` — the hard
+    preemption (no notice, no drain).  The goodput fleet's bread and
+    butter: the supervisor must detect the corpse and respawn the rank."""
+
+    def __init__(self, step: int, sig: int = signal.SIGKILL):
+        super().__init__(step, sig=sig)
+
+
+class ExitAtStep(Fault):
+    """``os._exit(code)`` when the loop reaches ``step`` — a crashing
+    worker that dies with a nonzero exit code instead of a signal (OOM
+    killer shims, assertion aborts, container evictions)."""
+
+    def __init__(self, step: int, code: int = 3):
+        self.step = int(step)
+        self.code = int(code)
+        self.fired = 0
+
+    def fire(self, point: str, step: Optional[int] = None, **ctx) -> None:
+        if step == self.step:
+            self.fired += 1
+            os._exit(self.code)
+
+
+class NaNLossWindow(Fault):
+    """Overwrite the step loss with NaN while ``from_step <= step <
+    to_step`` — the poisoned batch window that feeds a divergence.
+
+    Fires at ``train.loss``, whose ctx carries a mutable ``box`` dict
+    (``{"loss": x}``); the fault rewrites ``box["loss"]``.  ``n`` bounds the
+    total injections (default: the window width) so a rollback that
+    quarantines the poisoned batches and retrains the same step numbers is
+    not re-poisoned — the fault models bad *data*, which the quarantine
+    removed, not bad step indices.
+    """
+
+    def __init__(self, from_step: int, to_step: int, n: Optional[int] = None,
+                 value: float = float("nan")):
+        self.from_step = int(from_step)
+        self.to_step = int(to_step)
+        self.remaining = int(to_step - from_step) if n is None else n
+        self.value = float(value)
+        self.fired = 0
+
+    def fire(self, point: str, step: Optional[int] = None,
+             box: Optional[dict] = None, **ctx) -> None:
+        if box is None or step is None:
+            return
+        if not (self.from_step <= step < self.to_step):
+            return
+        if self.remaining is not None and self.remaining <= 0:
+            return
+        if self.remaining is not None:
+            self.remaining -= 1
+        self.fired += 1
+        box["loss"] = self.value
 
 
 class BadRecord(Fault):
@@ -343,3 +417,77 @@ def inject(point: str, fault: Fault):
         yield fault
     finally:
         remove(point, fault)
+
+
+# ------------------------------------------------- subprocess fault plans
+#: environment variable a parent sets to arm faults in a child at import
+PLAN_ENV = "DS_FAULT_PLAN"
+
+#: fault types a serialized plan may instantiate — JSON-native kwargs only.
+#: A plan naming anything else is rejected loudly (a typo'd scenario must
+#: not silently run fault-free and score a fake-perfect goodput).
+PLAN_FAULTS = {
+    "FailNTimes": FailNTimes,
+    "TruncateAfterBytes": TruncateAfterBytes,
+    "CorruptRandomBytes": CorruptRandomBytes,
+    "SignalAtStep": SignalAtStep,
+    "KillAtStep": KillAtStep,
+    "ExitAtStep": ExitAtStep,
+    "NaNLossWindow": NaNLossWindow,
+    "BadRecord": BadRecord,
+    "HangFor": HangFor,
+    "DelaySeconds": DelaySeconds,
+}
+
+
+def serialize_plan(specs) -> str:
+    """Serialize ``[{"point": ..., "fault": ..., "args": {...}}, ...]`` for
+    the ``DS_FAULT_PLAN`` env var, validating every entry against
+    :data:`FAULT_POINTS` and :data:`PLAN_FAULTS` at serialization time so
+    the error surfaces in the parent, not a dead child."""
+    import json as _json
+    out = []
+    for spec in specs:
+        point = spec["point"]
+        fault = spec["fault"]
+        args = dict(spec.get("args") or {})
+        if point not in FAULT_POINTS:
+            raise ValueError(f"fault plan names unregistered point {point!r}")
+        if fault not in PLAN_FAULTS:
+            raise ValueError(
+                f"fault plan names unknown fault type {fault!r} "
+                f"(allowed: {sorted(PLAN_FAULTS)})")
+        PLAN_FAULTS[fault](**args)  # constructor-validate the kwargs now
+        out.append({"point": point, "fault": fault, "args": args})
+    return _json.dumps(out)
+
+
+def install_plan(serialized: str) -> List[Fault]:
+    """Install every fault of a :func:`serialize_plan` string; returns the
+    installed fault objects (tests introspect ``fired`` counters)."""
+    import json as _json
+    installed: List[Fault] = []
+    for spec in _json.loads(serialized):
+        point = spec["point"]
+        fault_name = spec["fault"]
+        if point not in FAULT_POINTS:
+            raise ValueError(f"fault plan names unregistered point {point!r}")
+        if fault_name not in PLAN_FAULTS:
+            raise ValueError(
+                f"fault plan names unknown fault type {fault_name!r}")
+        fault = PLAN_FAULTS[fault_name](**(spec.get("args") or {}))
+        installed.append(install(point, fault))
+    return installed
+
+
+def install_env_plan() -> List[Fault]:
+    """Install the plan in ``DS_FAULT_PLAN``, if any (no-op otherwise)."""
+    serialized = os.environ.get(PLAN_ENV)
+    if not serialized:
+        return []
+    return install_plan(serialized)
+
+
+# subprocess ranks arm their scenario faults the moment this module loads
+# (deepspeed_tpu imports it early), before any engine exists to miss a fire
+_ENV_PLAN = install_env_plan()

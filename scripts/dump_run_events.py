@@ -85,6 +85,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("serving: " + "  ".join(
             f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by)),
             file=sys.stderr)
+    fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
+    if fleet and not args.as_json:
+        by = {}
+        for e in fleet:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        print("fleet: " + "  ".join(
+            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by)),
+            file=sys.stderr)
     perf = [e for e in events if str(e.get("kind", "")).startswith("perf.")]
     if perf and not args.as_json:
         by = {}

@@ -1,0 +1,111 @@
+"""Env-serialized fault plans: the fleet's delivery channel, proven
+without a fleet — including real subprocess kills driven purely by
+``DS_FAULT_PLAN`` (no jax in the child: the module loads standalone)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+FI_PATH = fi.__file__
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    fi.clear()
+
+
+# ------------------------------------------------------------ in-process
+def test_serialize_validates_in_the_parent():
+    with pytest.raises(ValueError, match="unregistered point"):
+        fi.serialize_plan([{"point": "nope", "fault": "KillAtStep",
+                            "args": {"step": 1}}])
+    with pytest.raises(ValueError, match="unknown fault type"):
+        fi.serialize_plan([{"point": "train.step", "fault": "Nope"}])
+    with pytest.raises(TypeError):  # kwargs constructor-validated early
+        fi.serialize_plan([{"point": "train.step", "fault": "KillAtStep",
+                            "args": {"bogus_kw": 1}}])
+
+
+def test_install_plan_round_trip_fires():
+    plan = fi.serialize_plan([
+        {"point": "train.loss", "fault": "NaNLossWindow",
+         "args": {"from_step": 3, "to_step": 5}},
+    ])
+    (fault,) = fi.install_plan(plan)
+    try:
+        box = {"loss": 1.0}
+        fi.fire("train.loss", step=2, box=box)
+        assert box["loss"] == 1.0
+        fi.fire("train.loss", step=3, box=box)
+        assert box["loss"] != box["loss"]  # NaN
+        box["loss"] = 1.0
+        fi.fire("train.loss", step=4, box=box)
+        assert box["loss"] != box["loss"]
+        # bounded at the window width: re-treading the step numbers after
+        # a quarantine must NOT re-poison (the fault models bad data)
+        box["loss"] = 1.0
+        fi.fire("train.loss", step=4, box=box)
+        assert box["loss"] == 1.0
+        assert fault.fired == 2
+    finally:
+        fi.remove("train.loss", fault)
+
+
+def test_install_env_plan_noop_without_env(monkeypatch):
+    monkeypatch.delenv(fi.PLAN_ENV, raising=False)
+    assert fi.install_env_plan() == []
+
+
+# ------------------------------------------------------------ subprocess
+CHILD = textwrap.dedent("""
+    import importlib.util, sys
+    spec = importlib.util.spec_from_file_location("fi", {fi_path!r})
+    fi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fi)          # installs DS_FAULT_PLAN at import
+    for step in range(1, 10):
+        fi.fire("train.step", step=step)
+    print("SURVIVED", flush=True)
+""")
+
+
+def _run_child(plan_env):
+    env = dict(os.environ)
+    if plan_env is None:
+        env.pop(fi.PLAN_ENV, None)
+    else:
+        env[fi.PLAN_ENV] = plan_env
+    return subprocess.run([sys.executable, "-c",
+                           CHILD.format(fi_path=FI_PATH)],
+                          env=env, capture_output=True, text=True,
+                          timeout=60)
+
+
+def test_kill_at_step_kills_the_child_at_the_step():
+    plan = fi.serialize_plan([{"point": "train.step", "fault": "KillAtStep",
+                               "args": {"step": 5}}])
+    res = _run_child(plan)
+    assert res.returncode == -signal.SIGKILL
+    assert "SURVIVED" not in res.stdout
+
+
+def test_exit_at_step_exits_with_the_code():
+    plan = fi.serialize_plan([{"point": "train.step", "fault": "ExitAtStep",
+                               "args": {"step": 3, "code": 7}}])
+    res = _run_child(plan)
+    assert res.returncode == 7
+    assert "SURVIVED" not in res.stdout
+
+
+def test_no_plan_child_survives():
+    res = _run_child(None)
+    assert res.returncode == 0
+    assert "SURVIVED" in res.stdout

@@ -1,0 +1,22 @@
+"""The full scenario matrix end-to-end — the same runs
+``scripts/goodput_bench.py`` scores into BENCH_GOODPUT.json.  Each
+scenario is a real multi-process fleet, so the matrix is `slow`; tier-1
+covers kill_one_rank (test_fleet_smoke) plus the scoring units.
+"""
+
+import pytest
+
+from deepspeed_tpu.goodput import build_scenario, run_scenario
+from deepspeed_tpu.goodput.scenarios import scenario_names
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+@pytest.mark.parametrize("name", scenario_names())
+def test_scenario_scores_ok(tmp_path, name):
+    scenario = build_scenario(name, seed=0)
+    score = run_scenario(str(tmp_path / name), scenario)
+    assert score["fleet"]["completed"], score
+    assert score["ok"], score["failures"]
+    assert score["invariant_violations"]["total"] == 0, \
+        score["invariant_violations"]["problems"]

@@ -1,0 +1,198 @@
+"""Scoring units over canned journals: known corpora, known answers.
+
+These pin the metric definitions (``docs/goodput.md``) independently of
+the fleet — goodput arithmetic, MTTR anchoring, waste accounting for both
+resume-replay and rollback-quarantine re-work, every invariant check, and
+torn-journal tolerance.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.goodput.score import (check_invariants, score_events,
+                                         score_run)
+
+T0 = 1000.0
+
+
+def ev(kind, ts, rank=0, **fields):
+    rec = {"ts": ts, "seq": 0, "rank": rank, "kind": kind}
+    rec.update(fields)
+    return rec
+
+
+def batch(step, ts, rank=0, sha=None):
+    return ev("data.batch", ts, rank=rank, step=step, epoch=0, n=2,
+              sha=sha or f"sha-{step}")
+
+
+def clean_corpus(steps=10):
+    events = [ev("fleet.spawn", T0, rank=-1, incarnation=0, world_size=1,
+                 pids=[1])]
+    events += [batch(s, T0 + s) for s in range(1, steps + 1)]
+    events.append(ev("fleet.done", T0 + steps + 1, rank=-1, incarnation=0,
+                     final_step=steps, wall_s=steps + 1.0))
+    return events
+
+
+def test_clean_run_scores_perfect():
+    score = score_events(clean_corpus(), target_steps=10,
+                         expect={"min_goodput": 0.999,
+                                 "max_wasted_steps": 0})
+    assert score["ok"], score["failures"]
+    assert score["goodput"] == 1.0
+    assert score["useful_steps"] == 10
+    assert score["wasted_steps"] == 0
+    assert score["incidents"] == 0
+    assert score["mttr_s"]["max"] is None
+    assert score["invariant_violations"]["total"] == 0
+
+
+def test_kill_restart_waste_and_mttr_are_exact():
+    # incarnation 0: steps 1..6 trained, tag committed at 4, rank dies;
+    # detection at T0+10, restart record at T0+12, incarnation 1 replays
+    # steps 5..12 (same fingerprints: bitwise replay), first new batch at
+    # T0+15 → MTTR = 15 - 10 = 5s; steps 5,6 trained twice → waste 2
+    events = [ev("fleet.spawn", T0, rank=-1, incarnation=0, world_size=2,
+                 pids=[1, 2])]
+    events += [batch(s, T0 + s) for s in range(1, 7)]
+    events += [
+        ev("fleet.rank_exit", T0 + 10, rank=-1, incarnation=0, rank_id=1,
+           returncode=-9, status="crashed"),
+        ev("fleet.restart", T0 + 12, rank=-1, incarnation=1, restarts=1,
+           budget=2, reason="rank_exit", detect_ts=T0 + 10),
+        ev("fleet.spawn", T0 + 13, rank=-1, incarnation=1, world_size=2,
+           pids=[3, 4]),
+        ev("ckpt.resume_consensus", T0 + 14, rank=0, tag="elastic_step4",
+           step=4),
+        ev("ckpt.resume_consensus", T0 + 14.1, rank=1, tag="elastic_step4",
+           step=4),
+    ]
+    events += [batch(s, T0 + 15 + (s - 5)) for s in range(5, 13)]
+    events.append(ev("fleet.done", T0 + 30, rank=-1, incarnation=1,
+                     final_step=12, wall_s=30.0))
+    score = score_events(events, target_steps=12, world_size=2,
+                         expect={"min_goodput": 0.5, "max_mttr_s": 60.0})
+    assert score["ok"], score["failures"]
+    assert score["trained_steps"] == 14
+    assert score["useful_steps"] == 12
+    assert score["wasted_steps"] == 2
+    assert score["goodput"] == round(12 / 14, 4)
+    assert score["incidents"] == 1
+    assert score["mttr_s"]["all"] == [5.0]
+
+
+def test_quarantine_rework_counts_as_waste():
+    # rollback at step 6 back to 4, quarantine [4, 6): re-work consumes NEW
+    # data steps (5..13 never repeat) plus 2 skipped slots — anchoring
+    # useful on final_step charges all of it
+    events = [ev("fleet.spawn", T0, rank=-1, incarnation=0, world_size=1,
+                 pids=[1])]
+    events += [batch(s, T0 + s) for s in range(1, 7)]        # 5,6 poisoned
+    events += [
+        ev("rollback", T0 + 7, from_step=6, to_step=4, index=1),
+        ev("data.quarantine", T0 + 7.1, from_step=4, to_step=6,
+           divergence_step=6),
+        ev("data.quarantine.skip", T0 + 7.2, from_step=4, to_step=6,
+           at_step=4),
+        ev("data.quarantine.skip", T0 + 7.3, from_step=4, to_step=6,
+           at_step=5),
+    ]
+    events += [batch(s, T0 + 8 + (s - 6)) for s in range(6, 14)]
+    events.append(ev("fleet.done", T0 + 20, rank=-1, incarnation=0,
+                     final_step=12, wall_s=20.0))
+    score = score_events(events, target_steps=12)
+    assert score["useful_steps"] == 12
+    # 14 trained batch events + 2 skips - 12 useful = 4 wasted... except
+    # step 6 was trained twice (before and after the rollback) with
+    # different data — the rollback between excuses the fingerprints
+    assert score["trained_steps"] == 14
+    assert score["quarantine_skipped"] == 2
+    assert score["wasted_steps"] == 4
+    assert score["goodput"] == 0.75
+    assert score["invariant_violations"]["replay_mismatches"] == 0
+
+
+def test_replay_mismatch_without_rollback_is_a_violation():
+    events = clean_corpus()
+    events.append(batch(3, T0 + 20, sha="DIFFERENT"))
+    score = score_events(events, target_steps=10)
+    assert score["invariant_violations"]["replay_mismatches"] == 1
+    assert not score["ok"]
+
+
+def test_quarantine_violation_detected():
+    events = clean_corpus()
+    events.append(ev("data.quarantine", T0 + 20, from_step=4, to_step=6,
+                     divergence_step=6))
+    events.append(batch(5, T0 + 21, sha="sha-5"))
+    inv = check_invariants(events)
+    assert inv["quarantine_violations"] == 1
+
+
+def test_split_brain_detected_within_one_incarnation():
+    events = clean_corpus()
+    events.insert(1, ev("ckpt.resume_consensus", T0 + 0.1, rank=0,
+                        tag="elastic_step4", step=4))
+    events.insert(2, ev("ckpt.resume_consensus", T0 + 0.2, rank=1,
+                        tag="elastic_step2", step=2))
+    inv = check_invariants(events)
+    assert inv["split_brain"] == 1
+
+
+def test_abort_kinds_need_an_allowance():
+    events = clean_corpus()
+    events.append(ev("ckpt.commit_timeout", T0 + 5, tag="t",
+                     missing_ranks=[1]))
+    assert check_invariants(events)["unexpected_aborts"] == 1
+    assert check_invariants(
+        events, allow_abort_kinds=("ckpt.commit_timeout",))["total"] == 0
+
+
+def test_incomplete_run_fails_and_caps_useful_at_target():
+    events = [ev("fleet.spawn", T0, rank=-1, incarnation=0, world_size=1,
+                 pids=[1])]
+    events += [batch(s, T0 + s) for s in range(1, 5)]  # died at 4, no done
+    score = score_events(events, target_steps=10)
+    assert score["useful_steps"] == 4
+    assert not score["ok"]
+    assert any("incomplete" in f for f in score["failures"])
+
+
+def test_score_run_tolerates_a_torn_journal(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        for rec in clean_corpus():
+            f.write(json.dumps(rec) + "\n")
+        f.write('{"ts": 1020.0, "kind": "data.ba')  # the killed writer
+    score = score_run(str(tmp_path), target_steps=10)
+    assert score["ok"], score["failures"]
+    assert score["goodput"] == 1.0
+
+
+def test_bench_gate_flags_regressions():
+    import importlib.util
+    script = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "scripts",
+        "goodput_bench.py")
+    spec = importlib.util.spec_from_file_location("goodput_bench", script)
+    gb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gb)
+
+    def artifact(goodput, violations=0, ok=True):
+        return {"scenarios": {"kill_one_rank": {
+            "goodput": goodput, "ok": ok, "failures": [],
+            "invariant_violations": {"total": violations, "problems": []},
+        }}}
+
+    base = artifact(0.85)
+    assert gb.gate(artifact(0.80), base, tolerance=0.1) == []
+    assert any("regressed" in p
+               for p in gb.gate(artifact(0.70), base, tolerance=0.1))
+    assert any("invariant" in p
+               for p in gb.gate(artifact(0.85, violations=1), base, 0.1))
+    # a scenario missing from the baseline gates only on its own verdict
+    assert gb.gate({"scenarios": {"new_one": {
+        "goodput": 0.1, "ok": True, "failures": [],
+        "invariant_violations": {"total": 0, "problems": []}}}}, base,
+        0.1) == []

@@ -102,3 +102,78 @@ def test_background_writer_beats_and_stops(tmp_path):
     settled = w.beats
     time.sleep(0.15)
     assert w.beats == settled  # thread actually stopped
+
+
+# -------------------------------------------------------------- slow ranks
+def _write_beat(directory, rank, ts, interval_s=0.2, step=0):
+    """A beat file with a scripted timestamp — slow-rank classification is
+    about payload-ts cadence, so no real clocks or sleeps are needed."""
+    import json
+    import os
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, f"rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "pid": 1, "step": step, "ts": ts,
+                   "interval_s": interval_s}, f)
+
+
+def test_slow_rank_is_classified_and_journaled_once(tmp_path):
+    d = str(tmp_path / "hb")
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    mon = HeartbeatMonitor(d, gap_s=600.0, journal=journal,
+                           slow_factor=2.0, slow_min_intervals=2)
+    t = 100.0
+    # healthy cadence first: 0.2s advertised, 0.2s observed
+    for ts in (t, t + 0.2):
+        _write_beat(d, 1, ts)
+        assert mon.check(now=ts + 0.05)["slow"] == []
+    # drift: 0.7s per beat = 3.5x advertised — first drifted interval is
+    # below slow_min_intervals, the second flips the classification
+    _write_beat(d, 1, t + 0.9)
+    assert mon.check(now=t + 0.95)["slow"] == []
+    _write_beat(d, 1, t + 1.6)
+    assert mon.check(now=t + 1.65)["slow"] == [1]
+    slow = read_events(journal.path, kind="heartbeat.slow")
+    assert len(slow) == 1 and slow[0]["rank"] == 1
+    assert slow[0]["factor"] > 2.0
+    # still slow: journaled once per transition, like gap/recovered
+    _write_beat(d, 1, t + 2.3)
+    assert mon.check(now=t + 2.35)["slow"] == [1]
+    assert len(read_events(journal.path, kind="heartbeat.slow")) == 1
+    # cadence recovers → heartbeat.recovered carries the slow flag
+    _write_beat(d, 1, t + 2.5)
+    assert mon.check(now=t + 2.55)["slow"] == []
+    rec = read_events(journal.path, kind="heartbeat.recovered")
+    assert len(rec) == 1 and rec[0]["rank"] == 1 and rec[0]["slow"] is True
+
+
+def test_slow_detection_disabled_by_default(tmp_path):
+    d = str(tmp_path / "hb")
+    mon = HeartbeatMonitor(d, gap_s=600.0)
+    t = 100.0
+    for i, ts in enumerate((t, t + 5.0, t + 10.0, t + 15.0)):
+        _write_beat(d, 0, ts)  # wildly drifted vs 0.2s advertised
+        assert mon.check(now=ts + 0.05)["slow"] == []
+
+
+def test_stale_rank_is_gap_not_slow(tmp_path):
+    """A rank past gap_s is DEAD to the monitor: the slow classifier must
+    not also pile on (one incident, one classification)."""
+    d = str(tmp_path / "hb")
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    mon = HeartbeatMonitor(d, gap_s=1.0, journal=journal,
+                           slow_factor=2.0, slow_min_intervals=1)
+    t = 100.0
+    _write_beat(d, 0, t)
+    mon.check(now=t + 0.1)
+    _write_beat(d, 0, t + 5.0)  # one giant drifted interval...
+    res = mon.check(now=t + 7.0)  # ...but by now it is also past gap_s
+    assert [s["rank"] for s in res["stale"]] == [0]
+    assert res["slow"] == []
+    assert read_events(journal.path, kind="heartbeat.slow") == []
+
+
+def test_writer_advertises_its_interval(tmp_path):
+    w = HeartbeatWriter(str(tmp_path / "hb"), 0, interval_s=7.5)
+    w.beat()
+    beats = HeartbeatMonitor(str(tmp_path / "hb"), gap_s=60.0).read_beats()
+    assert beats[0]["interval_s"] == 7.5

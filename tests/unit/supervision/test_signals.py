@@ -77,3 +77,66 @@ def test_preemption_signal_is_journaled(tmp_path):
     assert len(evs) == 1
     assert evs[0]["signum"] == int(signal.SIGTERM)
     assert evs[0]["step"] == 3
+
+
+# ------------------------------------------------- preempt-save deadline
+def test_preempt_save_within_deadline_is_journaled(tmp_path):
+    """The drain save raced the (generous) deadline and won: the journal
+    must carry ckpt.preempt_save naming the tag that landed."""
+    save = str(tmp_path / "ck")
+    runner = ElasticTrainRunner(
+        FakeEngine(), save, save_interval=100,
+        ds_config={"supervision": {"enabled": True,
+                                   "preempt_save_deadline_s": 30.0}})
+    with fi.inject("train.step", fi.SignalAtStep(2, signal.SIGTERM)):
+        res = runner.run([1.0] * 6, resume=False)
+    assert res["preempted"] and res["steps"] == 2
+    evs = read_events(f"{save}/events.jsonl", kind="ckpt.preempt_save")
+    assert len(evs) == 1
+    assert evs[0]["tag"] == "elastic_step2"
+    assert 0.0 <= evs[0]["elapsed_s"] <= 30.0
+    assert read_events(f"{save}/events.jsonl",
+                       kind="ckpt.preempt_save_timeout") == []
+    from deepspeed_tpu.runtime.checkpoint_engine import resolve_tag
+    assert resolve_tag(save, None) == "elastic_step2"
+
+
+def test_preempt_save_deadline_spent_skips_the_save(tmp_path):
+    """A deadline that is already gone when the drain begins: attempting
+    a multi-second checkpoint the preemptor will cut in half is worse
+    than exiting clean — skip, and say so in the journal."""
+    import os as _os
+    save = str(tmp_path / "ck")
+    runner = ElasticTrainRunner(
+        FakeEngine(), save, save_interval=100,
+        ds_config={"supervision": {"enabled": True,
+                                   "preempt_save_deadline_s": 1e-9}})
+    with fi.inject("train.step", fi.SignalAtStep(2, signal.SIGTERM)):
+        res = runner.run([1.0] * 6, resume=False)
+    assert res["preempted"]
+    evs = read_events(f"{save}/events.jsonl",
+                      kind="ckpt.preempt_save_timeout")
+    assert len(evs) == 1
+    assert evs[0]["saved"] is False
+    assert evs[0]["elapsed_s"] >= 0.0
+    assert read_events(f"{save}/events.jsonl",
+                       kind="ckpt.preempt_save") == []
+    # no tag was written: the poisoned-by-deadline drain really skipped
+    assert not _os.path.isdir(_os.path.join(save, "elastic_step2"))
+
+
+def test_no_deadline_keeps_the_unbounded_drain(tmp_path):
+    """preempt_save_deadline_s=null is the PR 2 behavior: drain saves,
+    nothing preempt-save-flavored in the journal."""
+    save = str(tmp_path / "ck")
+    runner = ElasticTrainRunner(
+        FakeEngine(), save, save_interval=100,
+        ds_config={"supervision": {"enabled": True}})
+    with fi.inject("train.step", fi.SignalAtStep(2, signal.SIGTERM)):
+        runner.run([1.0] * 6, resume=False)
+    from deepspeed_tpu.runtime.checkpoint_engine import resolve_tag
+    assert resolve_tag(save, None) == "elastic_step2"
+    assert read_events(f"{save}/events.jsonl",
+                       kind="ckpt.preempt_save") == []
+    assert read_events(f"{save}/events.jsonl",
+                       kind="ckpt.preempt_save_timeout") == []

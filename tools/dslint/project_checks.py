@@ -25,7 +25,7 @@ RULE_ID = "event-kind-drift"
 
 KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
              "docs/checkpoint-durability.md", "docs/serving.md",
-             "docs/performance.md")
+             "docs/performance.md", "docs/goodput.md")
 
 _CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
 
