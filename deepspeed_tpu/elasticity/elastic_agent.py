@@ -37,6 +37,8 @@ from ..runtime.supervision import (DeepSpeedSupervisionConfig, EventJournal,
                                    RunSupervisor, StepWatchdog,
                                    set_global_watchdog)
 from ..runtime.supervision.events import EventKind
+from ..telemetry.metrics import MetricName
+from ..telemetry.spans import SpanName
 from ..utils import fault_injection
 from ..utils.logging import log_dist, logger
 from .elasticity import compute_elastic_config, elasticity_enabled
@@ -101,6 +103,30 @@ class ElasticTrainRunner:
 
         self._configure_supervision(supervision, ds_config)
         self._attach_commit_context(self.rank)
+        self._configure_telemetry()
+
+    # ---------------------------------------------------------- telemetry
+    def _configure_telemetry(self) -> None:
+        """Ride the engine's telemetry: runner-phase spans (data fetch,
+        resume, rollback) land in the engine's tracer, the runner's
+        rollback counter streams through the engine's metrics sampler,
+        and the sampler journals under the runner's FLEET rank (the
+        engine itself always believes it is rank 0 in simulated fleets)."""
+        self.tracer = getattr(self.engine, "tracer", None)
+        sampler = getattr(self.engine, "metrics_sampler", None)
+        if sampler is not None and sampler.enabled:
+            sampler.rank = self.rank
+            sampler.attach_source(self._metrics_source)
+
+    def _metrics_source(self) -> Dict[str, Any]:
+        if self.supervisor is None:
+            return {}
+        return {MetricName.ROLLBACKS: self.supervisor.total_rollbacks}
+
+    def _span(self, name: str, **args):
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **args)
 
     # -------------------------------------------------------- supervision
     def _configure_supervision(self, supervision, ds_config) -> None:
@@ -220,6 +246,10 @@ class ElasticTrainRunner:
         warn and start fresh.  The coordinator first quarantines torn tags
         (shard files without a commit marker) so the fallback chain never
         trips over a half-written save from the previous incarnation."""
+        with self._span(SpanName.ELASTIC_RESUME):
+            return self._resume_inner()
+
+    def _resume_inner(self) -> int:
         if not os.path.isdir(self.save_dir):
             return self.engine.global_steps
         ctx = getattr(self, "commit_ctx", None)
@@ -343,7 +373,9 @@ class ElasticTrainRunner:
                     skip_remaining -= 1
                     continue
                 try:
-                    batch = next(batch_iter)
+                    with self._span(SpanName.TRAIN_DATA_FETCH,
+                                    step=self.engine.global_steps + 1):
+                        batch = next(batch_iter)
                 except StopIteration:
                     break
                 with self._step_guard():
@@ -373,8 +405,10 @@ class ElasticTrainRunner:
                             self._nan_streak >= self.nan_abort_threshold:
                         directive = None
                         if self.supervisor is not None:
-                            directive = self.supervisor.on_divergence(
-                                self.engine.global_steps, loss)
+                            with self._span(SpanName.ELASTIC_ROLLBACK,
+                                            step=self.engine.global_steps):
+                                directive = self.supervisor.on_divergence(
+                                    self.engine.global_steps, loss)
                         if directive is None:
                             raise RuntimeError(
                                 f"[elastic] loss was non-finite for "

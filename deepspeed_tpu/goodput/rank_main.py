@@ -78,6 +78,17 @@ def build_ds_config(cfg: dict) -> dict:
                 "sweep_min_age_s": float(cfg.get("sweep_min_age_s", 120.0)),
             },
         },
+        "telemetry": {
+            # every rank streams metrics into the shared run dir: a rank
+            # that stops producing parseable telemetry under restarts is
+            # caught by run_report (run per scenario by goodput_bench)
+            "enabled": True,
+            "metrics": {
+                "path": os.path.join(
+                    run_dir, f"metrics.rank{cfg['rank']}.jsonl"),
+                "interval_steps": 1,
+            },
+        },
         "supervision": {
             "enabled": True,
             "event_journal": os.path.join(run_dir, "events.jsonl"),
@@ -186,6 +197,13 @@ def main() -> int:
             poll_s=0.02) if world > 1 else None)
     engine.set_commit_context(ctx)
     runner.commit_ctx = ctx
+
+    # the incarnation index rides the metrics stream so a post-mortem can
+    # line samples up with whole-group restarts
+    if engine.metrics_sampler.enabled:
+        from deepspeed_tpu.telemetry.metrics import MetricName
+        engine.metrics_sampler.attach_source(
+            lambda: {MetricName.RESTARTS: inc})
 
     engine.set_data_iterator(loader)
     resumed_at = runner.resume()

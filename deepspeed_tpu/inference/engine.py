@@ -368,15 +368,17 @@ class InferenceEngine:
 
     # -------------------------------------------------------------- serving
 
-    def serve(self, config=None, journal=None, autostart: bool = True):
+    def serve(self, config=None, journal=None, autostart: bool = True,
+              tracer=None):
         """A continuous-batching serving gateway over this engine: an
         async request scheduler packing heterogeneous prompts into one
         fixed-geometry ragged-decode slot batch (``serving/``).  ``config``
         is a :class:`~deepspeed_tpu.serving.ServingConfig` or its dict;
-        ``journal`` an optional supervision ``EventJournal``."""
+        ``journal`` an optional supervision ``EventJournal``; ``tracer``
+        an optional telemetry ``Tracer`` recording the serve.* spans."""
         from ..serving import ServingGateway
         return ServingGateway(self, config=config, journal=journal,
-                              autostart=autostart)
+                              autostart=autostart, tracer=tracer)
 
     def _session_programs(self):
         """Jitted prefill/extend/decode shared by ALL of this engine's

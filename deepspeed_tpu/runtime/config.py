@@ -275,6 +275,16 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(f"invalid 'data' section: {e}") from e
         self.data_config_dict = data_dict
 
+        # telemetry section (typed: span tracing, metrics stream, trace
+        # capture — consumed by the engine and the elastic runner)
+        tel_dict = pd.get(C.TELEMETRY, {})
+        from ..telemetry.config import DeepSpeedTelemetryConfig
+        try:
+            self.telemetry_config = DeepSpeedTelemetryConfig.from_dict(tel_dict)
+        except (TypeError, ValueError) as e:
+            raise DeepSpeedConfigError(f"invalid 'telemetry' section: {e}") from e
+        self.telemetry_config_dict = tel_dict
+
         # pld
         pld_dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
         self.pld_enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)

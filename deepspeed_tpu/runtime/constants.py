@@ -216,6 +216,11 @@ SUPERVISION = "supervision"
 DATA = "data"
 
 #############################################
+# Unified telemetry (span tracing / metrics stream / trace capture)
+#############################################
+TELEMETRY = "telemetry"
+
+#############################################
 # Flops profiler / monitor / autotuning keys live in their own modules
 #############################################
 FLOPS_PROFILER = "flops_profiler"

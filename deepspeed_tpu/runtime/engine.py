@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
@@ -41,12 +42,14 @@ from ..ops.optimizer import (TpuOptimizer, get_optimizer_class,
                              resolve_param_groups)
 from ..parallel.mesh import (DATA_AXIS, DCN_AXIS, EXPERT_AXIS, MeshManager,
                              ParallelDims, get_mesh_manager, initialize_mesh)
+from ..telemetry.metrics import (MetricName, MetricsRegistry,
+                                 MetricsSampler, analytic_mfu,
+                                 host_rss_bytes, live_buffer_bytes,
+                                 peak_flops_per_chip)
+from ..telemetry.spans import SpanName, Tracer
 from ..utils.compile_watch import CompiledProgramRegistry, hot_path
 from ..utils.logging import log_dist, logger
-from ..utils.timer import (BACKWARD_GLOBAL_TIMER, BACKWARD_MICRO_TIMER,
-                           FORWARD_GLOBAL_TIMER, FORWARD_MICRO_TIMER,
-                           STEP_GLOBAL_TIMER, STEP_MICRO_TIMER,
-                           SynchronizedWallClockTimer, ThroughputTimer)
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from . import loss_scaler as ls
 from .config import DeepSpeedConfig, DeepSpeedConfigError
 from .dataloader import DeepSpeedDataLoader
@@ -109,10 +112,20 @@ class DeepSpeedEngine:
         # fallback chain, rollback) lands on the exact next batch
         self.data_iterator = None
 
-        self.timers = SynchronizedWallClockTimer()
+        #: every jitted program the step loop drives, by name — the
+        #: compile-discipline gate (utils/compile_watch.py) watches this
+        #: (the serving stack's compile_counts() contract, generalized)
+        self.compile_registry = CompiledProgramRegistry("engine")
+
+        # timers kept for API parity; their device sync is opt-in per
+        # timer now and routed through the registry (docs/telemetry.md)
+        self.timers = SynchronizedWallClockTimer(
+            sync_registry=self.compile_registry)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
-            steps_per_output=self._config.steps_per_print)
+            steps_per_output=self._config.steps_per_print,
+            sync_registry=self.compile_registry)
+        self._configure_telemetry()
 
         self.compute_dtype = _dtype_of(self._config)
         self.grad_accum_dtype = self._resolve_grad_accum_dtype()
@@ -145,11 +158,6 @@ class DeepSpeedEngine:
             raise DeepSpeedConfigError(
                 "dcn>1 does not compose with the pipeline engine yet")
         self._dcn_reduce = None
-
-        #: every jitted program the step loop drives, by name — the
-        #: compile-discipline gate (utils/compile_watch.py) watches this
-        #: (the serving stack's compile_counts() contract, generalized)
-        self.compile_registry = CompiledProgramRegistry("engine")
 
         self._configure_sharding()
         self._configure_optimizer(optimizer, model_parameters)
@@ -281,6 +289,138 @@ class DeepSpeedEngine:
         carried scaler trajectory belongs to the diverged run and would
         otherwise re-enter the step that overflowed at the same scale."""
         self.state["scale"] = ls.init_state(self.scaler_config)
+
+    # ------------------------------------------------------------- telemetry
+    def _configure_telemetry(self) -> None:
+        """Build the tracer + metrics stream from the ``"telemetry"``
+        section.  ``wall_clock_breakdown`` alone also enables spans — the
+        old ``SynchronizedWallClockTimer`` log lines are now derived from
+        span aggregates, so both consumers feed from one instrumentation
+        point (docs/telemetry.md)."""
+        tcfg = self._config.telemetry_config
+        spans_on = (tcfg.enabled and tcfg.spans.enabled) or \
+            self.wall_clock_breakdown()
+        self.tracer = Tracer(enabled=spans_on,
+                             capacity=tcfg.spans.capacity,
+                             synced=tcfg.spans.synced,
+                             sync_registry=self.compile_registry,
+                             name="engine")
+        self.metrics = MetricsRegistry("engine")
+        self._mem_interval_s = float(tcfg.metrics.memory_interval_s)
+        self._mem_cache = (0.0, 0, 0)  # (refreshed_at, rss, hbm)
+        path = tcfg.metrics.path if (tcfg.enabled and tcfg.metrics.enabled) \
+            else None
+        self.metrics_sampler = MetricsSampler(
+            self.metrics, path, rank=self.global_rank,
+            interval_steps=tcfg.metrics.interval_steps)
+        if self.metrics_sampler.enabled:
+            self.metrics_sampler.attach_source(self._metrics_source)
+            self.metrics_sampler.start()
+        # online MFU: analytic FLOPs/token from the model family when it
+        # advertises one, peak from config override or the device table
+        self._flops_per_token = None
+        cfg = self.module.meta.get("config")
+        if "flops_per_token" in self.module.meta:
+            self._flops_per_token = float(self.module.meta["flops_per_token"])
+        elif cfg is not None and hasattr(cfg, "d_model"):
+            try:
+                from ..models import gpt as _gpt
+                self._flops_per_token = float(_gpt.flops_per_token(cfg))
+            except Exception:  # non-GPT configs: MFU reports 0
+                self._flops_per_token = None
+        if tcfg.metrics.peak_tflops is not None:
+            self._peak_flops = float(tcfg.metrics.peak_tflops) * 1e12
+        else:
+            dev = jax.devices()[0]
+            self._peak_flops = peak_flops_per_chip(
+                getattr(dev, "device_kind", ""))
+        self._step_t_last: Optional[float] = None
+        self._tokens_since_sample = 0
+        self._steps_since_sample = 0
+        self._wall_since_sample = 0.0
+        self._breakdown_base: Dict[str, Any] = {}
+
+    def _metrics_source(self) -> Dict[str, Any]:
+        """Engine-owned gauges pulled at every sample: memory census +
+        compile-discipline counters.  The census (live-buffer walk + RSS
+        read) dwarfs the rest of a sample, so it refreshes at most once
+        per ``metrics.memory_interval_s`` and rides cached in between."""
+        t_mem, rss, hbm = self._mem_cache
+        now = time.monotonic()
+        if t_mem == 0.0 or now - t_mem >= self._mem_interval_s:
+            rss, hbm = host_rss_bytes(), live_buffer_bytes()
+            self._mem_cache = (now, rss, hbm)
+        return {
+            MetricName.STEPS: self.global_steps,
+            MetricName.SKIPPED_STEPS: self.skipped_steps,
+            MetricName.HOST_RSS_BYTES: rss,
+            MetricName.HBM_LIVE_BYTES: hbm,
+            MetricName.COMPILES: sum(self.compile_registry.counts().values()),
+            MetricName.HOST_SYNCS: self.compile_registry.total_host_syncs(),
+        }
+
+    def _count_batch_tokens(self, batch, n_micro: int = 1) -> None:
+        """Accumulate trained tokens for the throughput gauges (GPT-style
+        batches: rows × (seq − 1) next-token targets; non-token batches
+        count rows)."""
+        if not self.metrics_sampler.enabled:
+            return
+        toks = batch.get("tokens") if isinstance(batch, dict) else None
+        shape = np.shape(toks) if toks is not None else None
+        if shape and len(shape) >= 2:
+            self._tokens_since_sample += int(np.prod(shape[:-1])) \
+                * max(1, shape[-1] - 1)
+        elif shape:
+            self._tokens_since_sample += int(shape[0])
+
+    def _note_step_telemetry(self) -> None:
+        """Boundary-step bookkeeping: step-time histogram + (at the sample
+        cadence) tokens/s, online MFU, memory, compile counters streamed
+        to metrics.jsonl; wall_clock_breakdown log lines from the span
+        aggregates."""
+        now = time.monotonic()
+        if self._step_t_last is not None:
+            dt = now - self._step_t_last
+            self._wall_since_sample += dt
+            self._steps_since_sample += 1
+            if self.metrics_sampler.enabled:
+                self.metrics.histogram(MetricName.STEP_TIME_S).observe(dt)
+        self._step_t_last = now
+        if self.metrics_sampler.enabled and \
+                self.metrics_sampler.should_sample(self.global_steps):
+            if self._wall_since_sample > 0:
+                tok_s = self._tokens_since_sample / self._wall_since_sample
+                self.metrics.gauge(MetricName.TOKENS_PER_S).set(tok_s)
+                if self._flops_per_token:
+                    m = analytic_mfu(tok_s, self._flops_per_token,
+                                     self._peak_flops,
+                                     n_chips=self.world_size)
+                    self.metrics.gauge(MetricName.MFU).set(m["mfu"])
+                    self.metrics.gauge(MetricName.TFLOPS).set(m["tflops"])
+            self.metrics_sampler.sample(step=self.global_steps)
+            self._tokens_since_sample = 0
+            self._steps_since_sample = 0
+            self._wall_since_sample = 0.0
+        if self.wall_clock_breakdown() and \
+                self.global_steps % self.steps_per_print() == 0:
+            self._log_breakdown()
+
+    def _log_breakdown(self) -> None:
+        """The old timer-log line, fed from span aggregates: mean ms per
+        span name since the previous breakdown line."""
+        agg = self.tracer.aggregates()
+        parts = []
+        for name, cur in agg.items():
+            base = self._breakdown_base.get(name, {"count": 0,
+                                                   "total_s": 0.0})
+            dc = cur["count"] - base["count"]
+            if dc <= 0:
+                continue
+            dt_ms = (cur["total_s"] - base["total_s"]) * 1e3 / dc
+            parts.append(f"{name}: {dt_ms:.2f}")
+        self._breakdown_base = agg
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=[0])
 
     # ------------------------------------------------------------------ setup
     def _configure_sharding(self) -> None:
@@ -1232,20 +1372,19 @@ class DeepSpeedEngine:
             loss = self.eval_loss(batch)
             self._pending = loss
             return loss
-        if self.wall_clock_breakdown():
-            self.timers(FORWARD_MICRO_TIMER).start()
         self.tput_timer.start()
-        batch = self._apply_curriculum(batch)
-        batch = self._inject_pld(batch)
-        batch = self._inject_compression_step(batch)
-        batch = self._inject_train_rng(batch)
-        batch = self._shard_batch(batch)
-        new_acc, loss = self._micro_jit(
-            self.state["params"], self.state["grad_acc"], self.state["scale"], batch)
+        self._count_batch_tokens(batch)
+        with self.tracer.span(SpanName.TRAIN_FWD):
+            batch = self._apply_curriculum(batch)
+            batch = self._inject_pld(batch)
+            batch = self._inject_compression_step(batch)
+            batch = self._inject_train_rng(batch)
+            batch = self._shard_batch(batch)
+            new_acc, loss = self._micro_jit(
+                self.state["params"], self.state["grad_acc"],
+                self.state["scale"], batch)
         self.state["grad_acc"] = new_acc
         self._pending = loss
-        if self.wall_clock_breakdown():
-            self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
     __call__ = forward
@@ -1253,11 +1392,12 @@ class DeepSpeedEngine:
     def backward(self, loss=None, allreduce_gradients: bool = True, release_loss: bool = False):
         """Accumulation bookkeeping (gradients were produced in forward)."""
         assert self._pending is not None, "backward() called before forward()"
-        if self.wall_clock_breakdown():
-            self.timers(BACKWARD_MICRO_TIMER).start()
-            self.timers(BACKWARD_MICRO_TIMER).stop()
-        loss = self._pending
-        self._pending = None
+        # gradients were produced in the fused forward; the span records
+        # the (host-side) bookkeeping cost and keeps the phase visible in
+        # the timeline
+        with self.tracer.span(SpanName.TRAIN_BWD, fused=True):
+            loss = self._pending
+            self._pending = None
         if self.monitor.enabled and getattr(self, "_training", True) and \
                 self.is_gradient_accumulation_boundary():
             # eval-mode losses must not land in the train-loss stream
@@ -1272,16 +1412,13 @@ class DeepSpeedEngine:
 
     def step(self, lr_kwargs=None):
         """Apply the optimizer at the gas boundary; otherwise just count."""
-        if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).start()
         boundary = self.is_gradient_accumulation_boundary()
         if boundary:
-            self._take_model_step(lr_kwargs)
+            with self.tracer.span(SpanName.TRAIN_OPTIMIZER):
+                self._take_model_step(lr_kwargs)
         self.tput_timer.stop(global_step=boundary)
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu() * self.dp_world_size
-        if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).stop()
 
     def _hyper(self) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v, jnp.float32)
@@ -1582,27 +1719,30 @@ class DeepSpeedEngine:
             # which skips the step and backs the scale off as usual), and
             # a loss-scale change re-denominates the carried residual —
             # EF is linear in the gradient scale, so the rescale is exact.
-            use_onebit = self._dcn_reduce is not None
-            if use_onebit and self.scaler_config.enabled:
-                self.compile_registry.note_host_sync("step.dcn_finite")
-                # dslint: disable=host-sync-in-hot-path — one scalar pull
-                use_onebit = bool(jax.device_get(
-                    self._dcn_finite_jit(s["grad_acc"])))
-            if use_onebit:
-                self.compile_registry.note_host_sync("step.ef_scale")
-                # dslint: disable=host-sync-in-hot-path — one scalar pull
-                cur_scale = float(jax.device_get(s["scale"]["loss_scale"]))
-                if cur_scale != self._dcn_ef_scale:
-                    ratio = cur_scale / self._dcn_ef_scale
-                    self._dcn_we, self._dcn_se = self._dcn_rescale_ef_jit(
-                        self._dcn_we, self._dcn_se,
-                        jnp.float32(ratio))
-                    self._dcn_ef_scale = cur_scale
-                (grad_in, zeroed_stacked, self._dcn_we,
-                 self._dcn_se) = self._dcn_onebit_jit(
-                    s["grad_acc"], self._dcn_we, self._dcn_se)
-            else:
-                grad_in, zeroed_stacked = self._dcn_mean_jit(s["grad_acc"])
+            with self.tracer.span(SpanName.TRAIN_GRAD_SYNC,
+                                  axis="dcn", n=self._dcn_n):
+                use_onebit = self._dcn_reduce is not None
+                if use_onebit and self.scaler_config.enabled:
+                    self.compile_registry.note_host_sync("step.dcn_finite")
+                    # dslint: disable=host-sync-in-hot-path — one scalar pull
+                    use_onebit = bool(jax.device_get(
+                        self._dcn_finite_jit(s["grad_acc"])))
+                if use_onebit:
+                    self.compile_registry.note_host_sync("step.ef_scale")
+                    # dslint: disable=host-sync-in-hot-path — one scalar pull
+                    cur_scale = float(jax.device_get(s["scale"]["loss_scale"]))
+                    if cur_scale != self._dcn_ef_scale:
+                        ratio = cur_scale / self._dcn_ef_scale
+                        self._dcn_we, self._dcn_se = self._dcn_rescale_ef_jit(
+                            self._dcn_we, self._dcn_se,
+                            jnp.float32(ratio))
+                        self._dcn_ef_scale = cur_scale
+                    (grad_in, zeroed_stacked, self._dcn_we,
+                     self._dcn_se) = self._dcn_onebit_jit(
+                        s["grad_acc"], self._dcn_we, self._dcn_se)
+                else:
+                    grad_in, zeroed_stacked = self._dcn_mean_jit(
+                        s["grad_acc"])
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm,
              overflow) = self._apply_jit(
@@ -1620,9 +1760,12 @@ class DeepSpeedEngine:
         self._last_global_norm = norm  # device scalar; float() lazily
         self._spill_params()
         self.compile_registry.note_host_sync("step.overflow")
-        # the step/skip decision is host control flow by design:
-        # dslint: disable=host-sync-in-hot-path — one scalar pull per step
-        self._finish_model_step(bool(overflow), lr_kwargs)
+        with self.tracer.span(SpanName.TRAIN_HOST_SYNC,
+                              label="step.overflow"):
+            # the step/skip decision is host control flow by design:
+            # dslint: disable=host-sync-in-hot-path — one scalar pull per step
+            overflow_host = bool(overflow)
+        self._finish_model_step(overflow_host, lr_kwargs)
 
     def _finish_model_step(self, overflow_host: bool, lr_kwargs=None) -> None:
         """Post-step bookkeeping shared by the device and offload paths:
@@ -1645,10 +1788,16 @@ class DeepSpeedEngine:
             self.monitor.write_events(events)
         if self._compression_scheduler is not None:
             self._compression_scheduler.step()
+        self._note_step_telemetry()
 
     # fused whole-batch path -------------------------------------------------
     def train_batch_fused(self, batches):
         """Run a full train batch (gas stacked on dim 0) in one jit call."""
+        with self.tracer.span(SpanName.TRAIN_STEP,
+                              step=self.global_steps + 1):
+            return self._train_batch_fused_inner(batches)
+
+    def _train_batch_fused_inner(self, batches):
         if self._offload_device is not None or self._dcn_mode:
             # host step (offload) / boundary collapse (dcn) can't live
             # inside one jit: run the micro loop, step at the boundary
@@ -1664,6 +1813,7 @@ class DeepSpeedEngine:
                 self.step()
             return jnp.mean(jnp.stack(losses))
         self._ensure_params_resident()
+        self._count_batch_tokens(batches)
         s = self.state
         batches = self._apply_curriculum(batches)
         batches = jax.tree_util.tree_map(
@@ -1703,7 +1853,11 @@ class DeepSpeedEngine:
         self._spill_params()
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
-        self._finish_model_step(bool(overflow))
+        self.compile_registry.note_host_sync("step.overflow")
+        with self.tracer.span(SpanName.TRAIN_HOST_SYNC,
+                              label="step.overflow"):
+            overflow_host = bool(overflow)
+        self._finish_model_step(overflow_host)
         return mean_loss
 
     # ------------------------------------------------------------------ eval
@@ -1721,6 +1875,8 @@ class DeepSpeedEngine:
         """Attach a :class:`~.checkpoint_engine.commit.CommitContext` (the
         elastic runner does, wiring in its journal and heartbeat monitor)
         so saves run the two-phase commit and loads run resume consensus."""
+        if ctx is not None and getattr(ctx, "tracer", None) is None:
+            ctx.tracer = self.tracer  # ckpt.commit spans land in our trace
         self._commit_ctx = ctx
 
     def _commit_context(self):
@@ -1737,14 +1893,21 @@ class DeepSpeedEngine:
         world = dist.get_world_size()
         self._commit_ctx = CommitContext(
             world_size=world, rank=self.global_rank, config=cfg,
-            channel=CollectiveConsensusChannel() if world > 1 else None)
+            channel=CollectiveConsensusChannel() if world > 1 else None,
+            tracer=self.tracer)
         return self._commit_ctx
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True) -> bool:
+        tag = tag or f"global_step{self.global_steps}"
+        with self.tracer.span(SpanName.CKPT_SAVE, tag=tag):
+            return self._save_checkpoint_inner(save_dir, tag, client_state,
+                                               save_latest)
+
+    def _save_checkpoint_inner(self, save_dir, tag, client_state,
+                               save_latest) -> bool:
         from .checkpoint_engine.native_checkpoint_engine import save_engine_checkpoint
         self._ensure_params_resident()
-        tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
             "micro_steps": self.micro_steps,
@@ -1842,6 +2005,14 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        with self.tracer.span(SpanName.CKPT_LOAD, tag=tag or ""):
+            return self._load_checkpoint_inner(
+                load_dir, tag, load_module_strict, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+
+    def _load_checkpoint_inner(self, load_dir, tag, load_module_strict,
+                               load_optimizer_states,
+                               load_lr_scheduler_states, load_module_only):
         from .checkpoint_engine.native_checkpoint_engine import (
             load_engine_checkpoint, resolve_tag)
         self._ensure_params_resident()  # state acts as the load template

@@ -76,6 +76,9 @@ class EventKind:
     SERVE_TICK = "serve.tick"
     PERF_RECOMPILE = "perf.recompile"
     PERF_HOST_SYNC = "perf.host_sync"
+    METRICS_SAMPLE = "metrics.sample"
+    TRACE_CAPTURE = "trace.capture"
+    TRACE_EXPORT = "trace.export"
 
 
 #: every registered kind, as a set of strings
@@ -147,6 +150,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.PERF_RECOMPILE: ("program", "registry", "count", "shapes",
                                "compile_s"),
     EventKind.PERF_HOST_SYNC: ("label", "count"),
+    EventKind.METRICS_SAMPLE: ("step",),
+    EventKind.TRACE_CAPTURE: ("logdir", "started"),
+    EventKind.TRACE_EXPORT: ("path", "spans"),
 }
 
 

@@ -34,6 +34,7 @@ from jax import lax
 
 from ..inference.bucketing import bucket_cache_len
 from ..inference.sampling import filter_logits
+from ..telemetry.spans import SpanName, Tracer
 from ..utils.compile_watch import CompiledProgramRegistry, hot_path
 from .config import ServingConfig
 
@@ -51,7 +52,12 @@ class PrefixEntry:
 class SlotBatcher:
     """Continuous batching over ``config.slots`` decode slots."""
 
-    def __init__(self, engine, config: ServingConfig):
+    def __init__(self, engine, config: ServingConfig,
+                 tracer: Optional[Tracer] = None):
+        #: telemetry tracer shared with the owning gateway (disabled
+        #: no-op when serving runs without telemetry)
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=False, name="serving")
         self._engine = engine
         self._fam = engine._family
         cfg = engine.model_config
@@ -141,26 +147,28 @@ class SlotBatcher:
         fam, cfg = self._fam, self._cfg
         C = self.chunk
         S = int(tokens.shape[0])
-        pad = (-S) % C
-        padded = np.concatenate(
-            [np.asarray(tokens, np.int32),
-             np.zeros((pad,), np.int32)]) if pad else np.asarray(
-                 tokens, np.int32)
-        chunks = padded.reshape(-1, C)
-        cache = start_cache if start_cache is not None else fam.init_cache(
-            cfg, 1, self.max_len, kv_dtype=self._kv_dtype)
-        params = self._engine.params
-        lg = None
-        for i, ch in enumerate(chunks):
-            dev = jnp.asarray(ch[None])
-            pos = start_len + i * C
-            if pos == 0:
-                lg, cache = self._p["prefill"](params, dev, cache)
-            else:
-                lg, cache = self._p["extend"](
-                    params, dev, cache, jnp.asarray([pos], jnp.int32))
-        idx = S - 1 - (len(chunks) - 1) * C
-        vec = self._p["take_last"](lg, jnp.asarray(idx, jnp.int32))
+        with self.tracer.span(SpanName.SERVE_PREFILL, tokens=S,
+                              start=start_len):
+            pad = (-S) % C
+            padded = np.concatenate(
+                [np.asarray(tokens, np.int32),
+                 np.zeros((pad,), np.int32)]) if pad else np.asarray(
+                     tokens, np.int32)
+            chunks = padded.reshape(-1, C)
+            cache = start_cache if start_cache is not None else fam.init_cache(
+                cfg, 1, self.max_len, kv_dtype=self._kv_dtype)
+            params = self._engine.params
+            lg = None
+            for i, ch in enumerate(chunks):
+                dev = jnp.asarray(ch[None])
+                pos = start_len + i * C
+                if pos == 0:
+                    lg, cache = self._p["prefill"](params, dev, cache)
+                else:
+                    lg, cache = self._p["extend"](
+                        params, dev, cache, jnp.asarray([pos], jnp.int32))
+            idx = S - 1 - (len(chunks) - 1) * C
+            vec = self._p["take_last"](lg, jnp.asarray(idx, jnp.int32))
         return cache, vec, start_len + S
 
     def build_prefix(self, tokens: np.ndarray) -> PrefixEntry:
@@ -217,11 +225,14 @@ class SlotBatcher:
         [B] int32 tokens just emitted (junk in freed slots)."""
         if self._last is None:
             raise RuntimeError("tick() before any admission")
-        nxt, logits, self.cache, self.lengths, self.keys = self._p["tick"](
-            self._engine.params, self.cache, self.lengths, self._last,
-            self.keys, self.greedy, self.temp, self.active)
-        self._last = logits
-        self.registry.note_host_sync("serving.tick")
-        # the emitted tokens ARE the tick's output boundary:
-        # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
-        return np.asarray(nxt)
+        with self.tracer.span(SpanName.SERVE_TICK):
+            nxt, logits, self.cache, self.lengths, self.keys = \
+                self._p["tick"](
+                    self._engine.params, self.cache, self.lengths,
+                    self._last, self.keys, self.greedy, self.temp,
+                    self.active)
+            self._last = logits
+            self.registry.note_host_sync("serving.tick")
+            # the emitted tokens ARE the tick's output boundary:
+            # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
+            return np.asarray(nxt)

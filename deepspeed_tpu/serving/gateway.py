@@ -33,6 +33,8 @@ import numpy as np
 import jax
 
 from ..runtime.supervision.events import EventJournal, EventKind
+from ..telemetry.metrics import MetricName
+from ..telemetry.spans import SpanName, Tracer
 from ..utils import fault_injection
 from ..utils.compile_watch import CompileWatch
 from ..utils.logging import logger
@@ -54,13 +56,19 @@ class ServingGateway:
     """Continuous-batching front half over one :class:`InferenceEngine`."""
 
     def __init__(self, engine, config=None, journal: Optional[EventJournal]
-                 = None, autostart: bool = True):
+                 = None, autostart: bool = True,
+                 tracer: Optional[Tracer] = None):
         if config is None:
             config = ServingConfig()
         elif isinstance(config, dict):
             config = ServingConfig.from_dict(config)
         self.config = config
-        self._batcher = SlotBatcher(engine, config)
+        #: telemetry tracer (shared with the batcher): serve.admit /
+        #: serve.prefill / serve.tick spans for the unified timeline.
+        #: Callers pass one to record; the default is a disabled no-op.
+        self.tracer = tracer if tracer is not None else Tracer(
+            enabled=False, name="serving")
+        self._batcher = SlotBatcher(engine, config, tracer=self.tracer)
         self._journal = journal
         self.metrics = ServingMetrics()
         # compile-discipline gate: serving programs are shape-stable by
@@ -186,6 +194,22 @@ class ServingGateway:
                     cached_prefixes=prefixes,
                     compile_counts=self._batcher.compile_counts())
         return snap
+
+    def attach_metrics(self, sampler) -> None:
+        """Stream this gateway's gauges through a telemetry
+        :class:`~deepspeed_tpu.telemetry.metrics.MetricsSampler`: every
+        sample row then carries queue depth, slot occupancy, TTFT
+        percentiles, and decode tokens/s next to the train-side fields."""
+        sampler.attach_source(self._metrics_source)
+
+    def _metrics_source(self) -> dict:
+        snap = self.snapshot()
+        return {
+            MetricName.SERVE_QUEUE_DEPTH: snap["queue_depth"],
+            MetricName.SERVE_OCCUPANCY: snap["slot_occupancy"],
+            MetricName.SERVE_TOKENS_PER_S: snap["tokens_per_s"],
+            MetricName.SERVE_TTFT_S: self.metrics.ttft.snapshot(),
+        }
 
     def _pull_compile_stats(self) -> None:
         """Fold the CompileWatch's view into the metrics: new post-warmup
@@ -345,6 +369,11 @@ class ServingGateway:
                 req.handle._finish(RequestState.FAILED, error=err)
 
     def _admit_one(self, row: int, req: ServeRequest) -> None:
+        with self.tracer.span(SpanName.SERVE_ADMIT, slot=row,
+                              prompt_len=req.prompt_len):
+            self._admit_one_inner(row, req)
+
+    def _admit_one_inner(self, row: int, req: ServeRequest) -> None:
         fault_injection.fire("serve.admit", request_id=req.rid, slot=row)
         prefix_hit = False
         prefix = None

@@ -1,16 +1,22 @@
 """Serving metrics: thread-safe counters + a snapshot the journal, the
 bench harness, and operators share.
 
-Kept deliberately dumb — monotonically increasing counters and a bounded
-TTFT reservoir; percentile math happens in the consumer
-(``scripts/serve_bench.py``), not the hot path.
+Kept deliberately dumb — monotonically increasing counters plus a TTFT
+:class:`~deepspeed_tpu.telemetry.metrics.Histogram` (the ONE latency
+implementation: the bounded reservoir that feeds ``BENCH_SERVE.json``
+p50/p99 is the same object the telemetry ``metrics.jsonl`` stream
+samples, so the two artifacts can't disagree).  Percentile math on the
+raw reservoir stays in the consumer (``scripts/serve_bench.py``), not the
+hot path; the snapshot's ``ttft_s`` list is that reservoir, API-stable.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
+
+from ..telemetry.metrics import Histogram, MetricName
 
 #: TTFT samples kept (oldest dropped) — enough for p99 at bench scale
 _TTFT_CAP = 4096
@@ -40,7 +46,9 @@ class ServingMetrics:
         #: sanctioned device→host pulls on the tick loop (noted by the
         #: batcher's registry; ~1 per tick is the design)
         self.host_syncs = 0
-        self.ttft_s: List[float] = []
+        #: time-to-first-token, seconds — the shared telemetry histogram
+        #: (count/sum exact, reservoir bounded at :data:`_TTFT_CAP`)
+        self.ttft = Histogram(MetricName.SERVE_TTFT_S, cap=_TTFT_CAP)
 
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -60,10 +68,7 @@ class ServingMetrics:
             self.slot_ticks += slots
 
     def record_ttft(self, seconds: float) -> None:
-        with self._lock:
-            self.ttft_s.append(float(seconds))
-            if len(self.ttft_s) > _TTFT_CAP:
-                del self.ttft_s[:len(self.ttft_s) - _TTFT_CAP]
+        self.ttft.observe(float(seconds))
 
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
         """One coherent view: counters, slot occupancy, tokens/sec over
@@ -89,8 +94,8 @@ class ServingMetrics:
                 "tokens_per_s": self.tokens_out / elapsed,
                 "slot_occupancy": (self.active_slot_ticks / self.slot_ticks
                                    if self.slot_ticks else 0.0),
-                "ttft_s": list(self.ttft_s),
             }
+        snap["ttft_s"] = self.ttft.values()
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
         return snap

@@ -1,0 +1,31 @@
+"""Unified telemetry: span tracing, metrics stream, and Perfetto export.
+
+The single observability substrate the ``wall_clock_breakdown`` timers,
+``ServingMetrics``, the compile-discipline watch, and the goodput scorer
+all used to re-derive piecemeal:
+
+- :mod:`.spans` — nestable thread-aware :class:`Tracer` spans over the
+  train step phases, the serving tick/admission path, and the elastic
+  runner; names single-sourced in :class:`SpanName`;
+- :mod:`.metrics` — :class:`MetricsRegistry` counters/gauges/histograms
+  plus a :class:`MetricsSampler` streaming ``metrics.sample`` rows to a
+  torn-line-tolerant ``metrics.jsonl`` sidecar; names single-sourced in
+  :class:`MetricName`; online MFU via :func:`analytic_mfu`;
+- :mod:`.export` — Chrome/Perfetto ``trace_event`` JSON export of the
+  collected spans, schema validation, and the opt-in
+  ``jax.profiler.trace`` capture window;
+- :mod:`.config` — the validated ``"telemetry"`` config section.
+
+``scripts/run_report.py`` joins the three streams into one per-run
+report and gates overhead + span inventory in ``BENCH_TELEMETRY.json``.
+Reference: ``docs/telemetry.md``.
+"""
+
+from .config import DeepSpeedTelemetryConfig  # noqa: F401
+from .export import (profiler_trace, trace_events, validate_trace,  # noqa: F401
+                     write_trace)
+from .metrics import (METRIC_NAMES, Counter, Gauge, Histogram,  # noqa: F401
+                      MetricName, MetricsRegistry, MetricsSampler,
+                      analytic_mfu, host_rss_bytes, live_buffer_bytes,
+                      peak_flops_per_chip, read_metrics)
+from .spans import SPAN_NAMES, SpanName, SpanRecord, Tracer  # noqa: F401

@@ -1,15 +1,24 @@
 """Wall-clock and throughput timers.
 
 TPU-native counterpart of the reference's ``deepspeed/utils/timer.py``
-(``SynchronizedWallClockTimer`` / ``ThroughputTimer``).  "Synchronized" here
-means block-until-ready on the last JAX computation instead of a CUDA device
-synchronize.
+(``SynchronizedWallClockTimer`` / ``ThroughputTimer``).  "Synchronized"
+here means block-until-ready on the last JAX computation instead of a CUDA
+device synchronize — and it is **opt-in per timer** (``synced=True``):
+JAX calls return at dispatch, so a default timer measures host-side wall
+time with zero device round-trips, while a synced timer buys execution
+accuracy at the cost of a full host sync per edge.  Synced timers report
+each barrier through the owning ``CompiledProgramRegistry``
+(``note_host_sync("timer.sync")``) so calibration runs stay visible to the
+compile/host-sync discipline gates — an unconditional hidden sync inside
+``@hot_path`` regions is exactly the stall class ``docs/performance.md``
+hunts.  Span-based timing (``deepspeed_tpu/telemetry/spans.py``) follows
+the same default: dispatch-time unless the tracer is built ``synced``.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from .logging import log_dist
 
@@ -40,23 +49,39 @@ def _device_synchronize() -> None:
 
 
 class Timer:
-    """A single named wall-clock timer with start/stop/elapsed accumulation."""
+    """A single named wall-clock timer with start/stop/elapsed accumulation.
 
-    def __init__(self, name: str):
+    ``synced=True`` inserts a device barrier at each start/stop edge
+    (calibration mode) and notes it on ``sync_registry`` as a
+    ``timer.sync`` host sync; the default measures dispatch time with no
+    device round-trip.
+    """
+
+    def __init__(self, name: str, synced: bool = False,
+                 sync_registry: Any = None):
         self.name_ = name
+        self.synced = bool(synced)
+        self.sync_registry = sync_registry
         self.started_ = False
         self.elapsed_ = 0.0
         self.start_time = 0.0
 
+    def _sync(self) -> None:
+        if not self.synced:
+            return
+        _device_synchronize()
+        if self.sync_registry is not None:
+            self.sync_registry.note_host_sync("timer.sync")
+
     def start(self) -> None:
         assert not self.started_, f"{self.name_} timer has already been started"
-        _device_synchronize()
+        self._sync()
         self.start_time = time.time()
         self.started_ = True
 
     def stop(self, reset: bool = False) -> None:
         assert self.started_, f"{self.name_} timer is not started"
-        _device_synchronize()
+        self._sync()
         delta = time.time() - self.start_time
         self.elapsed_ = delta if reset else self.elapsed_ + delta
         self.started_ = False
@@ -82,14 +107,19 @@ class Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Group of named timers; mirrors reference `utils/timer.py` class of the same name."""
+    """Group of named timers; mirrors reference `utils/timer.py` class of
+    the same name.  The device sync is opt-in per timer:
+    ``timers("fwd", synced=True)`` builds a calibrated timer, the default
+    is dispatch-time."""
 
-    def __init__(self):
+    def __init__(self, sync_registry: Any = None):
+        self.sync_registry = sync_registry
         self.timers: Dict[str, Timer] = {}
 
-    def __call__(self, name: str) -> Timer:
+    def __call__(self, name: str, synced: bool = False) -> Timer:
         if name not in self.timers:
-            self.timers[name] = Timer(name)
+            self.timers[name] = Timer(name, synced=synced,
+                                      sync_registry=self.sync_registry)
         return self.timers[name]
 
     def has_timer(self, name: str) -> bool:
@@ -122,11 +152,15 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS tracking across steps (reference ThroughputTimer)."""
+    """Samples/sec + TFLOPS tracking across steps (reference
+    ThroughputTimer).  Dispatch-time by default; ``synced=True`` restores
+    the old barrier-at-both-edges behavior (each barrier noted as a
+    ``timer.sync`` host sync on ``sync_registry``)."""
 
     def __init__(self, batch_size: int, start_step: int = 2,
                  steps_per_output: Optional[int] = None, monitor_memory: bool = False,
-                 logging_fn=None):
+                 logging_fn=None, synced: bool = False,
+                 sync_registry: Any = None):
         self.start_time = 0.0
         self.end_time = 0.0
         self.started = False
@@ -141,6 +175,15 @@ class ThroughputTimer:
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
         self.initialized = False
+        self.synced = bool(synced)
+        self.sync_registry = sync_registry
+
+    def _sync(self) -> None:
+        if not self.synced:
+            return
+        _device_synchronize()
+        if self.sync_registry is not None:
+            self.sync_registry.note_host_sync("timer.sync")
 
     def update_epoch_count(self) -> None:
         self.epoch_count += 1
@@ -153,7 +196,7 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_synchronize()
+            self._sync()
             self.start_time = time.time()
 
     def stop(self, global_step: bool = False, report_speed: bool = True) -> None:
@@ -164,7 +207,7 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_synchronize()
+            self._sync()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

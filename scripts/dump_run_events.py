@@ -106,6 +106,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if progs:
             line += "  recompiled_programs=" + ",".join(progs)
         print(line, file=sys.stderr)
+    tel = [e for e in events
+           if str(e.get("kind", "")).startswith(("metrics.", "trace."))]
+    if tel and not args.as_json:
+        by = {}
+        for e in tel:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        print("telemetry: " + "  ".join(
+            f"{k}={by[k]}" for k in sorted(by)), file=sys.stderr)
     aborts = sum(1 for e in events if e.get("kind") in ABORT_KINDS)
     if aborts:
         print(f"\n{len(events)} event(s), {aborts} abort-class",

@@ -33,10 +33,27 @@ import argparse
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_rank_telemetry(run_dir: str, world_size: int) -> bool:
+    """Every rank must have produced a parseable ``metrics.rank<N>.jsonl``
+    — verified by running ``scripts/run_report.py`` on the scenario's run
+    dir (the report CLI is the single implementation of that check)."""
+    rr = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "run_report.py")
+    proc = subprocess.run(
+        [sys.executable, rr, run_dir,
+         "--expect-rank-metrics", str(world_size)],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        print(f"[goodput-bench]   telemetry check failed:\n{proc.stderr}",
+              file=sys.stderr, flush=True)
+    return proc.returncode == 0
 
 
 def run_matrix(args) -> dict:
@@ -57,6 +74,15 @@ def run_matrix(args) -> dict:
                   f"target={scenario.target_steps} "
                   f"faults={len(scenario.faults)}", flush=True)
             score = run_scenario(run_dir, scenario)
+            # silent telemetry breakage under restarts fails the scenario
+            # like any other expectation
+            score["telemetry_ok"] = check_rank_telemetry(
+                run_dir, scenario.world_size)
+            if not score["telemetry_ok"]:
+                score["ok"] = False
+                score.setdefault("failures", []).append(
+                    "a rank produced no parseable metrics.jsonl "
+                    "(run_report --expect-rank-metrics)")
             scores[name] = score
             print(f"[goodput-bench]   goodput={score['goodput']} "
                   f"wasted={score['wasted_steps']} "

@@ -1,0 +1,91 @@
+"""Perfetto export units: trace_event schema, validation, multi-tracer
+process tracks, and the atomic write path."""
+
+import json
+
+from deepspeed_tpu.telemetry.export import (trace_events, validate_trace,
+                                            write_trace)
+from deepspeed_tpu.telemetry.spans import SpanName, Tracer
+
+
+def _tracer_with_spans(name="engine"):
+    tr = Tracer(name=name)
+    with tr.span(SpanName.TRAIN_STEP, step=1):
+        with tr.span(SpanName.TRAIN_FWD):
+            pass
+    return tr
+
+
+def test_trace_events_schema_and_units():
+    tr = _tracer_with_spans()
+    obj = trace_events(tr)
+    assert obj["displayTimeUnit"] == "ms"
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"train.step", "train.fwd"}
+    for e in xs:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["dur"] >= 1                       # microseconds, floored
+        assert e["cat"] == e["name"].split(".")[0]
+    step = next(e for e in xs if e["name"] == "train.step")
+    fwd = next(e for e in xs if e["name"] == "train.fwd")
+    # nesting is reconstructed from ts/dur on the same tid
+    assert step["tid"] == fwd["tid"]
+    assert step["ts"] <= fwd["ts"]
+    assert step["ts"] + step["dur"] >= fwd["ts"] + fwd["dur"]
+    assert step["args"] == {"step": 1}
+    # metadata: process + thread names present
+    metas = [e for e in obj["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"] == "engine" for e in metas)
+    assert any(e["name"] == "thread_name" for e in metas)
+
+
+def test_multiple_tracers_become_distinct_pids():
+    obj = trace_events([_tracer_with_spans("engine"),
+                        _tracer_with_spans("serving")])
+    pids = {e["pid"] for e in obj["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2
+    names = {e["args"]["name"] for e in obj["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"engine", "serving"}
+
+
+def test_validate_trace_accepts_export_output():
+    assert validate_trace(trace_events(_tracer_with_spans())) == []
+
+
+def test_validate_trace_catches_schema_problems():
+    assert validate_trace([]) != []                # not an object
+    assert validate_trace({}) != []                # no traceEvents
+    assert any("no complete" in p
+               for p in validate_trace({"traceEvents": []}))
+    bad_ph = {"traceEvents": [{"ph": "B", "name": "train.fwd", "ts": 1,
+                               "dur": 1, "pid": 0, "tid": 0}]}
+    assert any("unsupported ph" in p for p in validate_trace(bad_ph))
+    float_ts = {"traceEvents": [{"ph": "X", "name": "train.fwd",
+                                 "ts": 1.5, "dur": 1, "pid": 0, "tid": 0}]}
+    assert any("'ts' must be an integer" in p
+               for p in validate_trace(float_ts))
+    unknown = {"traceEvents": [{"ph": "X", "name": "train.nope", "ts": 1,
+                                "dur": 1, "pid": 0, "tid": 0}]}
+    assert any("not registered" in p for p in validate_trace(unknown))
+    # ...unless registered-name checking is waived
+    assert validate_trace(unknown, require_registered_names=False) == []
+
+
+def test_write_trace_atomic_and_loadable(tmp_path):
+    class Journal:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, kind, **fields):
+            self.events.append((kind, fields))
+
+    path = str(tmp_path / "out" / "trace.json")
+    j = Journal()
+    write_trace(path, _tracer_with_spans(), journal=j)
+    with open(path) as f:
+        obj = json.load(f)
+    assert validate_trace(obj) == []
+    assert j.events[0][0] == "trace.export"
+    assert j.events[0][1]["spans"] == 2

@@ -1,0 +1,130 @@
+"""Tracer units: nesting, disable cost, thread safety, capacity, synced
+calibration mode, and name validation."""
+
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry.spans import (SPAN_NAMES, SpanName, Tracer,
+                                           _NOOP)
+
+
+def test_span_records_name_duration_and_args():
+    tr = Tracer()
+    with tr.span(SpanName.TRAIN_FWD, step=3):
+        time.sleep(0.01)
+    (rec,) = tr.spans()
+    assert rec.name == "train.fwd"
+    assert rec.dur >= 0.009
+    assert rec.args == {"step": 3}
+    assert rec.depth == 0
+    agg = tr.aggregates()
+    assert agg["train.fwd"]["count"] == 1
+    assert agg["train.fwd"]["total_s"] == pytest.approx(rec.dur)
+
+
+def test_nesting_depth_tracked_per_thread():
+    tr = Tracer()
+    with tr.span(SpanName.TRAIN_STEP):
+        with tr.span(SpanName.TRAIN_FWD):
+            with tr.span(SpanName.TRAIN_HOST_SYNC):
+                pass
+    by_name = {r.name: r for r in tr.spans()}
+    assert by_name["train.step"].depth == 0
+    assert by_name["train.fwd"].depth == 1
+    assert by_name["train.host_sync"].depth == 2
+    # inner spans complete first
+    assert [r.name for r in tr.spans()] == \
+        ["train.host_sync", "train.fwd", "train.step"]
+
+
+def test_disabled_tracer_returns_shared_noop_and_records_nothing():
+    tr = Tracer(enabled=False)
+    ctx = tr.span(SpanName.TRAIN_FWD)
+    assert ctx is _NOOP                      # no allocation per call
+    assert ctx is tr.span("not-even-a-registered-name")  # no validation cost
+    with ctx:
+        pass
+    assert tr.spans() == []
+    assert tr.aggregates() == {}
+
+
+def test_unregistered_name_raises_when_enabled():
+    tr = Tracer()
+    with pytest.raises(ValueError, match="not registered in SpanName"):
+        tr.span("train.made_up")
+
+
+def test_every_spanname_constant_is_in_the_frozen_set():
+    for k, v in vars(SpanName).items():
+        if not k.startswith("_") and isinstance(v, str):
+            assert v in SPAN_NAMES
+
+
+def test_thread_safety_and_thread_attribution():
+    tr = Tracer()
+    n, per = 8, 50
+
+    def worker():
+        for _ in range(per):
+            with tr.span(SpanName.SERVE_TICK):
+                pass
+
+    threads = [threading.Thread(target=worker, name=f"w{i}")
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = tr.spans()
+    assert len(recs) == n * per
+    assert tr.aggregates()["serve.tick"]["count"] == n * per
+    # thread attribution by NAME (the OS may reuse idents of joined
+    # threads, so tids can collide across workers)
+    assert {r.thread for r in recs} == {f"w{i}" for i in range(n)}
+    # depth stayed 0 in every thread (no cross-thread stack bleed)
+    assert all(r.depth == 0 for r in recs)
+
+
+def test_capacity_bounds_records_but_not_aggregates():
+    tr = Tracer(capacity=3)
+    for _ in range(10):
+        with tr.span(SpanName.TRAIN_FWD):
+            pass
+    assert len(tr.spans()) == 3
+    assert tr.dropped == 7
+    assert tr.aggregates()["train.fwd"]["count"] == 10
+    tr.clear()
+    assert tr.spans() == [] and tr.dropped == 0
+
+
+def test_synced_mode_notes_host_syncs_on_the_registry():
+    class FakeRegistry:
+        def __init__(self):
+            self.notes = []
+
+        def note_host_sync(self, label, n=1):
+            self.notes.append((label, n))
+
+    reg = FakeRegistry()
+    tr = Tracer(synced=True, sync_registry=reg)
+    with tr.span(SpanName.TRAIN_OPTIMIZER):
+        pass
+    # one barrier per span edge, both reported to the discipline gate
+    assert reg.notes == [("span.sync", 1), ("span.sync", 1)]
+    # default mode never touches the registry
+    reg2 = FakeRegistry()
+    tr2 = Tracer(sync_registry=reg2)
+    with tr2.span(SpanName.TRAIN_OPTIMIZER):
+        pass
+    assert reg2.notes == []
+
+
+def test_span_inventory_sorted_distinct():
+    tr = Tracer()
+    for name in (SpanName.TRAIN_FWD, SpanName.TRAIN_BWD,
+                 SpanName.TRAIN_FWD):
+        with tr.span(name):
+            pass
+    assert tr.span_inventory() == ["train.bwd", "train.fwd"]

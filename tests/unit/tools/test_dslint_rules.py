@@ -477,3 +477,65 @@ def test_findings_sorted_and_render_format():
     assert [f.line for f in findings] == sorted(f.line for f in findings)
     r = findings[0].render()
     assert r.startswith(f"{DATA}:3: step-path-nondeterminism")
+
+
+# ------------------------------------------------- unregistered-telemetry-name
+TEL_PROJECT = Project(
+    event_kind_map={"ROLLBACK": "rollback"},
+    fault_points=set(),
+    bucketing_helpers=set(),
+    span_name_map={"TRAIN_FWD": "train.fwd", "SERVE_TICK": "serve.tick"},
+    metric_name_map={"MFU": "train.mfu", "STEP_TIME_S": "train.step_time_s"},
+)
+
+
+def tlint(src, relpath=OTHER):
+    return lint_source(textwrap.dedent(src), relpath, TEL_PROJECT)
+
+
+def test_telemetry_name_fires_on_unregistered_span_literal():
+    findings = tlint("""
+        with tracer.span("train.mystery"):
+            work()
+    """)
+    assert rules_of(findings) == ["unregistered-telemetry-name"]
+    assert "train.mystery" in findings[0].message
+
+
+def test_telemetry_name_fires_on_unknown_spanname_attr():
+    findings = tlint("""
+        with self.tracer.span(SpanName.TRAIN_MYSTERY):
+            work()
+    """)
+    assert rules_of(findings) == ["unregistered-telemetry-name"]
+
+
+def test_telemetry_name_fires_on_unregistered_metric():
+    findings = tlint("""
+        reg.gauge("train.bogus").set(1.0)
+        reg.histogram(MetricName.BOGUS).observe(2.0)
+    """)
+    assert rules_of(findings) == ["unregistered-telemetry-name"] * 2
+
+
+def test_telemetry_name_quiet_on_registered_names_and_dynamic():
+    findings = tlint("""
+        with tracer.span("train.fwd"):
+            reg.gauge("train.mfu").set(0.4)
+        with tracer.span(SpanName.SERVE_TICK):
+            reg.histogram(MetricName.STEP_TIME_S).observe(0.1)
+        tracer.span(name_variable)       # dynamic: passes uninspected
+        soup.span  # bare attribute, not a call
+    """)
+    assert findings == []
+
+
+def test_telemetry_name_skips_the_registry_modules_and_suppresses():
+    bad = 'tracer.span("nope")\n'
+    assert tlint(bad, "deepspeed_tpu/telemetry/spans.py") == []
+    assert tlint(bad, "deepspeed_tpu/telemetry/metrics.py") == []
+    findings = tlint("""
+        # dslint: disable=unregistered-telemetry-name — fixture
+        tracer.span("nope")
+    """)
+    assert findings == []

@@ -95,6 +95,8 @@ class Project:
       (name → kind string), ``SUMMARY_FIELDS`` keys, ``ABORT_KINDS``
     - ``deepspeed_tpu/utils/fault_injection.py`` — ``FAULT_POINTS``
     - ``deepspeed_tpu/inference/bucketing.py`` — ``BUCKETING_HELPERS``
+    - ``deepspeed_tpu/telemetry/spans.py`` — ``SpanName``
+    - ``deepspeed_tpu/telemetry/metrics.py`` — ``MetricName``
 
     Tests inject the registries directly instead of passing a root.
     """
@@ -102,19 +104,25 @@ class Project:
     EVENTS_MODULE = "deepspeed_tpu/runtime/supervision/events.py"
     FAULTS_MODULE = "deepspeed_tpu/utils/fault_injection.py"
     BUCKETING_MODULE = "deepspeed_tpu/inference/bucketing.py"
+    SPANS_MODULE = "deepspeed_tpu/telemetry/spans.py"
+    METRICS_MODULE = "deepspeed_tpu/telemetry/metrics.py"
 
     def __init__(self, root: Optional[str] = None,
                  event_kind_map: Optional[Dict[str, str]] = None,
                  fault_points: Optional[Set[str]] = None,
                  summary_field_names: Optional[Set[str]] = None,
                  abort_kind_names: Optional[Set[str]] = None,
-                 bucketing_helpers: Optional[Set[str]] = None):
+                 bucketing_helpers: Optional[Set[str]] = None,
+                 span_name_map: Optional[Dict[str, str]] = None,
+                 metric_name_map: Optional[Dict[str, str]] = None):
         self.root = root
         self.event_kind_map: Dict[str, str] = event_kind_map or {}
         self.fault_points: Set[str] = set(fault_points or ())
         self.summary_field_names: Set[str] = set(summary_field_names or ())
         self.abort_kind_names: Set[str] = set(abort_kind_names or ())
         self.bucketing_helpers: Set[str] = set(bucketing_helpers or ())
+        self.span_name_map: Dict[str, str] = span_name_map or {}
+        self.metric_name_map: Dict[str, str] = metric_name_map or {}
         self.summary_fields_line = 1
         self.abort_kinds_line = 1
         if root is not None:
@@ -125,6 +133,12 @@ class Project:
             if bucketing_helpers is None:
                 self._parse_bucketing(
                     os.path.join(root, self.BUCKETING_MODULE))
+            if span_name_map is None:
+                self.span_name_map = self._parse_name_class(
+                    os.path.join(root, self.SPANS_MODULE), "SpanName")
+            if metric_name_map is None:
+                self.metric_name_map = self._parse_name_class(
+                    os.path.join(root, self.METRICS_MODULE), "MetricName")
 
     # ---------------------------------------------------------- registries
     @property
@@ -177,6 +191,33 @@ class Project:
                     if isinstance(n, ast.Constant) \
                             and isinstance(n.value, str):
                         self.fault_points.add(n.value)
+
+    @property
+    def span_names(self) -> Set[str]:
+        return set(self.span_name_map.values())
+
+    @property
+    def metric_names(self) -> Set[str]:
+        return set(self.metric_name_map.values())
+
+    @staticmethod
+    def _parse_name_class(path: str, class_name: str) -> Dict[str, str]:
+        """name → string value of every str constant on ``class_name``
+        (the EventKind parse, reused for SpanName/MetricName)."""
+        out: Dict[str, str] = {}
+        if not os.path.exists(path):
+            return out
+        tree = _parse_path(path)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == class_name:
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and isinstance(stmt.value, ast.Constant)
+                            and isinstance(stmt.value.value, str)):
+                        out[stmt.targets[0].id] = stmt.value.value
+        return out
 
     def _parse_bucketing(self, path: str) -> None:
         if not os.path.exists(path):

@@ -11,6 +11,13 @@ its consumers actually stay in sync with it.  Checked:
   (the markdown tables whose first header cell is ``` `kind` ```)
   document every registered kind — exactly or via a ``prefix.*`` wildcard
   row — and name no kind that isn't registered.
+
+A second check (rule id ``telemetry-name-drift``) applies the same
+machinery to the telemetry registries: every ``SpanName`` /
+``MetricName`` value (``deepspeed_tpu/telemetry/``) must be documented in
+``docs/telemetry.md``'s span/metric tables (first header cell
+``` `span` ``` / ``` `metric` ```) and those tables must name no
+unregistered entry.
 """
 
 from __future__ import annotations
@@ -25,7 +32,11 @@ RULE_ID = "event-kind-drift"
 
 KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
              "docs/checkpoint-durability.md", "docs/serving.md",
-             "docs/performance.md", "docs/goodput.md")
+             "docs/performance.md", "docs/goodput.md",
+             "docs/telemetry.md")
+
+TELEMETRY_RULE_ID = "telemetry-name-drift"
+TELEMETRY_DOC = "docs/telemetry.md"
 
 _CELL_KIND = re.compile(r"^`([A-Za-z0-9_.*-]+)`$")
 
@@ -86,7 +97,58 @@ def run_project_checks(root: str, project: Project) -> List[Finding]:
             rel, line, RULE_ID,
             f"docs table names journal kind '{token}', which is not "
             "registered in supervision/events.py::EventKind"))
+
+    findings.extend(_telemetry_drift(root, project))
     return findings
+
+
+def _telemetry_drift(root: str, project: Project) -> List[Finding]:
+    """SpanName/MetricName ↔ the span/metric tables in docs/telemetry.md."""
+    findings: List[Finding] = []
+    if not project.span_name_map and not project.metric_name_map:
+        return findings  # injected-registry test projects: nothing to check
+    path = os.path.join(root, TELEMETRY_DOC)
+    if not os.path.exists(path):
+        return [Finding(TELEMETRY_DOC, 1, TELEMETRY_RULE_ID,
+                        "telemetry-name doc is missing")]
+    with open(path, encoding="utf-8") as f:
+        md = f.read()
+    for header, registered, module in (
+            ("span", project.span_names, Project.SPANS_MODULE),
+            ("metric", project.metric_names, Project.METRICS_MODULE)):
+        documented = dict(_first_cell_entries(md, header))
+        for value in sorted(registered - set(documented)):
+            findings.append(Finding(
+                module, 1, TELEMETRY_RULE_ID,
+                f"telemetry {header} '{value}' is registered but not "
+                f"documented in the `{header}` table of {TELEMETRY_DOC}"))
+        for token, line in sorted(documented.items()):
+            if token not in registered:
+                findings.append(Finding(
+                    TELEMETRY_DOC, line, TELEMETRY_RULE_ID,
+                    f"docs table names telemetry {header} '{token}', "
+                    f"which is not registered in {module}"))
+    return findings
+
+
+def _first_cell_entries(md: str, header: str) -> Iterable[Tuple[str, int]]:
+    """``(token, line)`` for the first cell of every row of every markdown
+    table whose first header cell is ``` `<header>` ```."""
+    in_table = False
+    for i, raw in enumerate(md.splitlines(), 1):
+        line = raw.strip()
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        first = line.split("|")[1].strip() if line.count("|") >= 2 else ""
+        if first == f"`{header}`":
+            in_table = True
+            continue
+        if not in_table:
+            continue
+        m = _CELL_KIND.match(first)
+        if m:
+            yield m.group(1), i
 
 
 def _is_documented(kind: str, doc_tokens) -> bool:

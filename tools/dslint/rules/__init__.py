@@ -14,6 +14,7 @@ from .jit_hot_path import JitInHotPath  # noqa: F401
 from .unbucketed_static_arg import UnbucketedStaticArg  # noqa: F401
 from .host_sync import HostSyncInHotPath  # noqa: F401
 from .missing_donation import MissingDonation  # noqa: F401
+from .telemetry_names import UnregisteredTelemetryName  # noqa: F401
 
 ALL_RULES = (
     SwallowedException,
@@ -26,4 +27,5 @@ ALL_RULES = (
     UnbucketedStaticArg,
     HostSyncInHotPath,
     MissingDonation,
+    UnregisteredTelemetryName,
 )
