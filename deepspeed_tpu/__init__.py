@@ -44,6 +44,23 @@ if not hasattr(_jax, "shard_map"):
 
     _jax.shard_map = _shard_map_compat
 
+# lax.axis_size is also newer than this jax; inside shard_map the old
+# spelling is jax.core.axis_frame(name) (a static int on 0.4.x, a frame
+# object with .size on some later versions)
+if not hasattr(_jax.lax, "axis_size"):
+    from jax import core as _core
+
+    def _axis_size_compat(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= _axis_size_compat(a)
+            return n
+        frame = _core.axis_frame(axis_name)
+        return frame if isinstance(frame, int) else frame.size
+
+    _jax.lax.axis_size = _axis_size_compat
+
 from . import comm as _comm_pkg  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401 — reference parity
 from .comm.comm import init_distributed

@@ -29,11 +29,42 @@ def _qrange(bits: int) -> float:
     return float(2 ** (bits - 1) - 1)
 
 
+# ----------------------------------------------------- shared symmetric math
+#
+# The symmetric grouped scheme (absmax scale per group, round-to-nearest,
+# clip to the signed range) is shared verbatim with the quantized wire
+# collectives (``runtime/comm/quantized.py``): the collective payloads must
+# quantize exactly like the kernels so parity tests and EF bounds transfer.
+
+def quantize_symmetric(x2: jnp.ndarray, bits: int = 8
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``x2 [groups, gsize]`` → ``(codes int8 [groups, gsize],
+    scales f32 [groups])`` — symmetric per-group absmax quantization.
+
+    Pure jnp (shard_map/jit-safe).  All-zero groups get the 1e-12 scale
+    floor, so codes are 0 and the round trip is exactly 0 — no 0/0."""
+    qmax = _qrange(bits)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x2.astype(jnp.float32)), axis=1, keepdims=True)
+        / qmax, 1e-12)
+    q = jnp.clip(jnp.round(x2 / scale), -qmax, qmax).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def dequantize_symmetric(codes: jnp.ndarray,
+                         scales: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_symmetric`; returns f32 [groups, gsize]."""
+    return codes.astype(jnp.float32) * scales[:, None]
+
+
 # ------------------------------------------------------------------ reference
 
 def _quantize_ref(x2, bits, symmetric, stochastic, key):
     qmax = _qrange(bits)
     if symmetric:
+        if not stochastic:
+            q, scales = quantize_symmetric(x2, bits)
+            return q, scales, jnp.zeros_like(scales)
         scale = jnp.max(jnp.abs(x2), axis=1, keepdims=True) / qmax
         scale = jnp.maximum(scale, 1e-12)
         offset = jnp.zeros_like(scale)

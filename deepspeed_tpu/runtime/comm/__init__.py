@@ -1,8 +1,13 @@
 """Specialized communication backends (reference ``runtime/comm/``:
-compressed 1-bit collectives + coalesced helpers)."""
+compressed 1-bit collectives + coalesced helpers), plus the blockwise
+int8/int4 quantized collectives (EQuARX, PAPERS.md)."""
 
 from .compressed import (compressed_allreduce, compressed_allreduce_tree,
                          pack_signs, unpack_signs)
+from .quantized import (quantized_all_gather, quantized_allreduce,
+                        quantized_grad_reduce_tree, quantized_reduce_scatter)
 
 __all__ = ["compressed_allreduce", "compressed_allreduce_tree",
-           "pack_signs", "unpack_signs"]
+           "pack_signs", "unpack_signs",
+           "quantized_allreduce", "quantized_reduce_scatter",
+           "quantized_all_gather", "quantized_grad_reduce_tree"]

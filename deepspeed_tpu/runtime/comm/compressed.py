@@ -37,7 +37,15 @@ PyTree = Any
 
 
 def pack_signs(signs: jnp.ndarray) -> jnp.ndarray:
-    """bool [N] (N % 8 == 0) → uint8 [N/8]; bit i of byte j = signs[8j+i]."""
+    """bool [N] (N % 8 == 0) → uint8 [N/8]; bit i of byte j = signs[8j+i].
+
+    The divisibility is part of the padding contract (see
+    :func:`compressed_grad_reduce_tree`): callers zero-pad flat payloads
+    to ``flat_size`` before packing — never pack a raw leaf directly."""
+    if signs.shape[0] % 8:
+        raise ValueError(
+            f"pack_signs needs a multiple of 8 elements, got "
+            f"{signs.shape[0]} — zero-pad to the flat_size contract first")
     bits = signs.reshape(-1, 8).astype(jnp.uint8)
     weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
     return jnp.sum(bits * weights, axis=1, dtype=jnp.uint8)
@@ -77,9 +85,28 @@ def _compressed_allreduce_local(x, worker_err, server_err, axis: str,
     """Body run per-worker inside shard_map.  x [N]; ``block`` > 0 uses
     per-block L1 scales (N % (n*block) == 0, block % 8 == 0), else one
     norm-based scale per vector (N % (8*n) == 0 — the reference's
-    whole-buffer granularity); server_err is this worker's [N/n] chunk."""
+    whole-buffer granularity); server_err is this worker's [N/n] chunk.
+
+    Alignment is validated here (shapes are static at trace time) so a
+    caller that skipped the flat_size zero-padding contract gets a named
+    error instead of a reshape failure deep in the exchange.  All-zero
+    vectors/blocks are safe by construction: both the norm and the L1
+    scale quantize them to scale 0, the reconstruction is exactly 0, and
+    no stage divides by a scale."""
     n = lax.axis_size(axis)
     N = x.shape[0]
+    if block:
+        if block % 8:
+            raise ValueError(f"block={block} must be a multiple of 8 "
+                             "(bit packing)")
+        if N % (n * block):
+            raise ValueError(
+                f"flat size {N} must be a multiple of world*block = "
+                f"{n}*{block} — zero-pad with flat_size() first")
+    elif N % (8 * n):
+        raise ValueError(
+            f"flat size {N} must be a multiple of 8*world = 8*{n} — "
+            "zero-pad with flat_size() first")
     chunk = N // n
 
     # stage 1 compress (reference nccl.py:60-83)
@@ -158,9 +185,19 @@ def compressed_grad_reduce_tree(mesh: Mesh, axis: str = "dcn",
     ``flat/n`` server chunk (sharded over ``axis``).
 
     ``block`` sets the per-block L1 scale granularity (the 1-bit Adam
-    quantizer): ~1 bit + 32/block bits per element on the wire."""
+    quantizer): ~1 bit + 32/block bits per element on the wire.
+
+    Padding contract: leaf element counts need NOT divide 8×world or the
+    block size — ``flat_size`` rounds the concatenated total up to
+    ``world*block`` and ``run`` zero-pads the tail.  Padded elements ride
+    the exchange like real ones (all-zero blocks quantize to scale 0
+    exactly) and are dropped on unflatten; the caller-held error buffers
+    are sized to the PADDED flat size, so the tail's residual stays 0
+    forever."""
     n = int(mesh.shape[axis])
-    assert block % 8 == 0, "block must be a multiple of 8 (bit packing)"
+    if block % 8:
+        raise ValueError(f"block={block} must be a multiple of 8 "
+                         "(bit packing)")
     align = n * block
 
     def flat_size(tree) -> int:
@@ -168,7 +205,7 @@ def compressed_grad_reduce_tree(mesh: Mesh, axis: str = "dcn",
                     for l in jax.tree_util.tree_leaves(tree))
         return -(-total // align) * align
 
-    # factory closure: built once per engine (_init_dcn_reduce caches it)
+    # factory closure: built once per engine (_init_grad_collapse caches it)
     # dslint: disable=jit-in-hot-path — closure cached by the caller
     @jax.jit
     def run(stacked_tree, worker_err, server_err):

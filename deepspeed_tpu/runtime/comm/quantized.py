@@ -1,0 +1,284 @@
+"""Blockwise-quantized collectives over a mesh axis (EQuARX, PAPERS.md).
+
+The middle rungs between the fp32 mean collapse and the aggressive 1-bit
+collective (``compressed.py``): gradient payloads cross the wire as int8
+or packed-int4 codes with one fp32 absmax scale per ``block`` elements,
+and the quantization error of BOTH stages is carried in device-resident
+error-feedback residuals threaded through caller state — the same
+functional ``we``/``se`` contract as the onebit path, so the residuals
+shard and checkpoint like optimizer state.
+
+Structure mirrors the reference two-stage algorithm
+(``NcclBackend.compressed_allreduce``, runtime/comm/nccl.py:51) and
+EQuARX's in-XLA deployment:
+
+  stage 1 — :func:`quantized_reduce_scatter`: each worker adds its
+      residual, quantizes blockwise, and ``all_to_all``s chunk j (codes +
+      scales) to worker j, which dequantizes and averages its chunk;
+  stage 2 — :func:`quantized_all_gather`: worker j adds its server
+      residual, re-quantizes its reduced chunk, and ``all_gather``s the
+      codes + scales back to everyone.
+
+:func:`quantized_allreduce` is their composition;
+:func:`quantized_grad_reduce_tree` is the engine-facing factory
+(``compressed_grad_reduce_tree``'s contract: stacked per-worker partials
+in, averaged tree + new residuals out).
+
+Quantization math is shared with the grouped kernels
+(``ops/pallas/quantizer.py::quantize_symmetric``): symmetric per-block
+absmax, round-to-nearest, zero-safe scale floor.  Padding contract: flat
+payloads are zero-padded to ``world * block`` (``flat_size``); padded
+tail blocks quantize to code 0 exactly and are dropped on unflatten.
+
+Wire accounting (:func:`wire_bytes` / :func:`logical_bytes`) is the
+single source the engine metrics and ``scripts/comm_bench.py`` use, so
+the compression-ratio gate and the telemetry stream can't disagree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...ops.pallas.quantizer import dequantize_symmetric, quantize_symmetric
+
+PyTree = Any
+
+#: wire dtypes → code bits on the wire (int4 travels nibble-packed)
+WIRE_BITS = {"int8": 8, "int4": 4}
+
+
+# ------------------------------------------------------------- int4 packing
+
+def pack_int4(codes: jnp.ndarray) -> jnp.ndarray:
+    """int8 codes in [-7, 7], flat [N] (N even) → uint8 [N/2]; element 2j
+    in the low nibble, 2j+1 in the high nibble (two's-complement)."""
+    if codes.shape[0] % 2:
+        raise ValueError(
+            f"pack_int4 needs an even element count, got {codes.shape[0]} — "
+            "pad to the flat_size contract first")
+    u = codes.astype(jnp.uint8) & 0xF
+    return (u[0::2] | (u[1::2] << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [M] → int8 codes [2M] (sign-extended nibbles)."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    both = jnp.stack([lo, hi], axis=1).reshape(-1)
+    return jnp.where(both >= 8, both - 16, both).astype(jnp.int8)
+
+
+def _quantize_blocks(x: jnp.ndarray, block: int, bits: int):
+    """flat [N] (N % block == 0) → (codes int8 [N], scales f32 [N/block])."""
+    q, s = quantize_symmetric(x.reshape(-1, block), bits)
+    return q.reshape(-1), s
+
+
+def _dequantize_blocks(codes, scales, block):
+    return dequantize_symmetric(codes.reshape(-1, block), scales).reshape(-1)
+
+
+def _check_shapes(N: int, n: int, block: int, where: str) -> None:
+    if block % 2:
+        raise ValueError(f"{where}: block must be even (int4 packing), "
+                         f"got {block}")
+    if N % (n * block):
+        raise ValueError(
+            f"{where}: flat size {N} must be a multiple of world*block = "
+            f"{n}*{block} — pad with flat_size() first")
+
+
+# -------------------------------------------------- in-shard_map primitives
+
+def quantized_reduce_scatter(x: jnp.ndarray, worker_err: jnp.ndarray,
+                             axis: str, *, block: int = 2048,
+                             wire: str = "int8", mean: bool = True
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """In-shard_map: reduce ``x`` over ``axis``, each worker keeping its
+    ``N/n`` chunk, with the payload quantized blockwise on the wire.
+
+    ``x``/``worker_err`` are this worker's flat [N] views; returns
+    ``(my reduced chunk [N/n], new worker residual [N])``.  The worker's
+    own contribution rides the same quantizer as its peers' (uniform
+    treatment — the all_to_all includes self), so the residual telescopes
+    exactly.
+    """
+    bits = WIRE_BITS[wire]
+    n = lax.axis_size(axis)
+    N = x.shape[0]
+    _check_shapes(N, n, block, "quantized_reduce_scatter")
+    chunk = N // n
+
+    corrected = x + worker_err
+    codes, scales = _quantize_blocks(corrected, block, bits)
+    recon = _dequantize_blocks(codes, scales, block)
+    new_worker_err = corrected - recon
+
+    # chunk j of my codes + scales → worker j (codes packed for int4)
+    payload = pack_int4(codes) if wire == "int4" else codes
+    recv = lax.all_to_all(payload.reshape(n, -1), axis, split_axis=0,
+                          concat_axis=0, tiled=False)
+    recv_scales = lax.all_to_all(scales.reshape(n, chunk // block), axis,
+                                 split_axis=0, concat_axis=0, tiled=False)
+    rcodes = unpack_int4(recv.reshape(-1)) if wire == "int4" \
+        else recv.reshape(-1)
+    contrib = _dequantize_blocks(
+        rcodes, recv_scales.reshape(-1), block).reshape(n, chunk)
+    red = jnp.mean(contrib, axis=0) if mean else jnp.sum(contrib, axis=0)
+    return red, new_worker_err
+
+
+def quantized_all_gather(chunk: jnp.ndarray, server_err: jnp.ndarray,
+                         axis: str, *, block: int = 2048,
+                         wire: str = "int8"
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """In-shard_map: gather per-worker ``[N/n]`` chunks into the full
+    ``[N]`` vector, quantized blockwise on the wire with a server-side
+    residual.  Returns ``(full vector [N], new server residual [N/n])``."""
+    bits = WIRE_BITS[wire]
+    n = lax.axis_size(axis)
+    _check_shapes(chunk.shape[0] * n, n, block, "quantized_all_gather")
+
+    corrected = chunk + server_err
+    codes, scales = _quantize_blocks(corrected, block, bits)
+    recon = _dequantize_blocks(codes, scales, block)
+    new_server_err = corrected - recon
+
+    payload = pack_int4(codes) if wire == "int4" else codes
+    all_payload = lax.all_gather(payload, axis)          # [n, chunk(/2)]
+    all_scales = lax.all_gather(scales, axis)            # [n, chunk/block]
+    acodes = unpack_int4(all_payload.reshape(-1)) if wire == "int4" \
+        else all_payload.reshape(-1)
+    out = _dequantize_blocks(acodes, all_scales.reshape(-1), block)
+    return out, new_server_err
+
+
+def quantized_allreduce(x: jnp.ndarray, worker_err: jnp.ndarray,
+                        server_err: jnp.ndarray, axis: str, *,
+                        block: int = 2048, wire: str = "int8",
+                        mean: bool = True):
+    """The composition: quantized reduce-scatter, then quantized
+    all-gather of the reduced chunks — a full average of ``x`` over
+    ``axis`` that crossed the wire quantized both directions.  Returns
+    ``(out [N], new_worker_err [N], new_server_err [N/n])``."""
+    red, new_we = quantized_reduce_scatter(
+        x, worker_err, axis, block=block, wire=wire, mean=mean)
+    out, new_se = quantized_all_gather(
+        red, server_err, axis, block=block, wire=wire)
+    return out, new_we, new_se
+
+
+# ---------------------------------------------------------- wire accounting
+
+def logical_bytes(total_elems: int) -> int:
+    """Bytes a full-precision (fp32) exchange of ``total_elems`` gradient
+    elements moves across the axis per boundary collapse, both directions
+    (reduce + broadcast legs)."""
+    return 2 * int(total_elems) * 4
+
+
+def wire_bytes(flat: int, block: int, mode: str) -> int:
+    """Actual payload bytes per boundary collapse for ``mode`` on a
+    padded flat size ``flat`` (both directions: stage-1 all_to_all +
+    stage-2 all_gather; per-block fp32 scales included).  ``mean`` is the
+    uncompressed fp32 path; ``onebit`` is the sign+L1-scale collective."""
+    flat = int(flat)
+    scales = (flat // block) * 4
+    per_dir = {
+        "mean": flat * 4,
+        "onebit": flat // 8 + scales,
+        "int8": flat + scales,
+        "int4": flat // 2 + scales,
+    }
+    if mode not in per_dir:
+        raise ValueError(f"unknown collapse mode {mode!r} "
+                         f"(want one of {sorted(per_dir)})")
+    return 2 * per_dir[mode]
+
+
+# ------------------------------------------------------------- tree factory
+
+def quantized_grad_reduce_tree(mesh: Mesh, axis: str, *,
+                               wire: str = "int8", block: int = 2048):
+    """Quantized reduction of PER-WORKER partial gradients over ``axis``
+    (``compressed_grad_reduce_tree``'s contract, int8/int4 wire dtype).
+
+    Input: a pytree whose leaves carry a leading ``[n]`` dim sharded over
+    ``axis`` — worker i's rows are ITS partial sums.  Output: the
+    averaged tree without the leading dim, replicated over ``axis``,
+    having crossed the axis blockwise-quantized both directions.
+
+    Returns ``fn(stacked_tree, worker_err, server_err) ->
+    (avg_tree, new_worker_err, new_server_err)`` with helpers
+    ``fn.flat_size`` / ``fn.world`` / ``fn.ef_shapes()`` /
+    ``fn.wire_bytes(tree)`` / ``fn.logical_bytes(tree)``:
+    ``worker_err`` is ``[n, flat]`` (worker-private, sharded over
+    ``axis``), ``server_err`` is ``[flat]`` laid out so worker j owns its
+    ``flat/n`` server chunk (sharded over ``axis``).
+    """
+    if wire not in WIRE_BITS:
+        raise ValueError(f"wire={wire!r} (want one of {sorted(WIRE_BITS)})")
+    n = int(mesh.shape[axis])
+    if block % 8:
+        raise ValueError(f"block must be a multiple of 8, got {block}")
+    align = n * block
+
+    def flat_size(tree) -> int:
+        total = sum(int(np.prod(l.shape[1:]))
+                    for l in jax.tree_util.tree_leaves(tree))
+        return -(-total // align) * align
+
+    # factory closure: built once per engine (_init_grad_collapse caches it)
+    # dslint: disable=jit-in-hot-path — closure cached by the caller
+    @jax.jit
+    def run(stacked_tree, worker_err, server_err):
+        leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+        sizes = [int(np.prod(l.shape[1:])) for l in leaves]
+        flat = jnp.concatenate([l.reshape(n, -1).astype(jnp.float32)
+                                for l in leaves], axis=1)      # [n, total]
+        pad = worker_err.shape[1] - flat.shape[1]
+        if pad:
+            flat = jnp.pad(flat, ((0, 0), (0, pad)))
+
+        def body(x, we, se):
+            # x/we [1, flat] (this worker's rows), se [flat/n]
+            out, we2, se2 = quantized_allreduce(
+                x[0], we[0], se, axis, block=block, wire=wire)
+            return out, we2[None], se2
+
+        out, new_we, new_se = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(axis), P(axis)),
+            check_vma=False)(flat, worker_err, server_err)
+
+        outs = []
+        offset = 0
+        for leaf, size in zip(leaves, sizes):
+            outs.append(out[offset:offset + size]
+                        .reshape(leaf.shape[1:]).astype(leaf.dtype))
+            offset += size
+        return jax.tree_util.tree_unflatten(treedef, outs), new_we, new_se
+
+    run.flat_size = flat_size
+    run.world = n
+    run.wire = wire
+    run.block = block
+
+    def ef_shapes(tree):
+        f = flat_size(tree)
+        return (n, f), (f,)
+
+    run.ef_shapes = ef_shapes
+    run.wire_bytes = lambda tree: wire_bytes(flat_size(tree), block, wire)
+    run.logical_bytes = lambda tree: logical_bytes(sum(
+        int(np.prod(l.shape[1:])) for l in jax.tree_util.tree_leaves(tree)))
+    return run

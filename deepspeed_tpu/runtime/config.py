@@ -338,17 +338,28 @@ class DeepSpeedConfig:
         self.sequence_parallel_size = sp.get("size", 1) if sp.get("enabled", bool(sp)) else 1
         self.sequence_parallel_mode = sp.get("mode", "ring")
         self.mesh_dims = pd.get(C.MESH, None)
-        # inter-slice (DCN) gradient reduction compression: "none" |
-        # "onebit" — routes the gas-boundary reduction over the slow
-        # 'dcn' mesh axis through the error-feedback 1-bit collective
-        # (the reference's 1-bit comm backends, runtime/comm/nccl.py:51)
+        # inter-slice (DCN) gradient reduction compression: "none" (fp32
+        # mean) | "int8" | "int4" (blockwise-quantized collectives with
+        # device-side error feedback, runtime/comm/quantized.py — the
+        # EQuARX middle rungs) | "onebit" (the aggressive error-feedback
+        # 1-bit collective, reference runtime/comm/nccl.py:51).  All
+        # compressed modes route the gas-boundary reduction over the slow
+        # 'dcn' mesh axis through an explicit shard_map collective.
         dcn = pd.get("dcn", {}) or {}
         self.dcn_grad_compression = str(
             dcn.get("grad_compression", "none")).lower()
-        if self.dcn_grad_compression not in ("none", "onebit"):
+        if self.dcn_grad_compression not in ("none", "onebit", "int8",
+                                             "int4"):
             raise DeepSpeedConfigError(
                 f"dcn.grad_compression={self.dcn_grad_compression!r} "
-                "(want 'none' or 'onebit')")
+                "(want 'none', 'onebit', 'int8' or 'int4')")
+        # elements per fp32 wire scale (and 1-bit block) for the
+        # compressed DCN modes; must be a multiple of 8
+        self.dcn_compression_block = int(dcn.get("compression_block", 2048))
+        if self.dcn_compression_block <= 0 or self.dcn_compression_block % 8:
+            raise DeepSpeedConfigError(
+                f"dcn.compression_block={self.dcn_compression_block!r} "
+                "(want a positive multiple of 8)")
 
         pipe = pd.get(C.PIPELINE, {})
         self.pipeline = pipe

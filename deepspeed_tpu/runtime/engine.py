@@ -139,24 +139,68 @@ class DeepSpeedEngine:
         self._offload_device = _ocfg.device if _ocfg.device != "none" else None
         self._offload_cfg = _ocfg
 
-        # inter-slice (DCN) data parallelism: grads accumulate PER SLICE
-        # (leading [n_dcn] dim) and cross the slow axis only once per
-        # boundary step — full-precision mean, or the error-feedback
-        # 1-bit collective (reference runtime/comm/nccl.py:51) under
-        # dcn.grad_compression="onebit"
+        # Explicit gradient-collapse modes: gradients accumulate as
+        # PER-WORKER partials (leading [n] dim over the collapse axis) and
+        # cross that axis only once per boundary step.
+        #
+        # (a) inter-slice (DCN) data parallelism: full-precision mean, or
+        #     the error-feedback collectives under dcn.grad_compression —
+        #     "onebit" (reference runtime/comm/nccl.py:51) or the
+        #     blockwise-quantized "int8"/"int4" middle rungs
+        #     (runtime/comm/quantized.py, EQuARX);
+        # (b) zero_optimization.quantized_collectives: the intra-slice
+        #     (ICI, 'data' axis) grad reduce as an explicit quantized
+        #     reduce-scatter + all-gather instead of the compiler-implicit
+        #     full-precision psum.
         self._dcn_n = int(self.mesh.shape.get(DCN_AXIS, 1))
-        self._dcn_mode = self._dcn_n > 1
+        dcn_mode = self._dcn_n > 1
         self._dcn_compress = self._config.dcn_grad_compression
-        if self._dcn_compress != "none" and not self._dcn_mode:
+        zq = self._config.zero_config.quantized_collectives
+        if self._dcn_compress != "none" and not dcn_mode:
             raise DeepSpeedConfigError(
                 "dcn.grad_compression needs a multi-slice mesh "
                 "(ParallelDims(dcn=...) > 1)")
-        if self._dcn_mode and self._offload_device is not None:
-            raise DeepSpeedConfigError(
-                "dcn>1 does not compose with offload_optimizer yet")
-        if self._dcn_mode and self.module.meta.get("pipeline"):
-            raise DeepSpeedConfigError(
-                "dcn>1 does not compose with the pipeline engine yet")
+        if zq != "none":
+            if dcn_mode:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.quantized_collectives does not "
+                    "compose with a multi-slice (dcn>1) mesh yet — use "
+                    "dcn.grad_compression for the slow-axis reduce")
+            if int(self.mesh.shape.get(EXPERT_AXIS, 1)) > 1:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.quantized_collectives does not "
+                    "compose with expert parallelism (ep>1) yet")
+            if int(self.mesh.shape.get(DATA_AXIS, 1)) < 2:
+                raise DeepSpeedConfigError(
+                    "zero_optimization.quantized_collectives needs a "
+                    "data-parallel mesh axis (data > 1)")
+        # unified collapse parameters: axis/world/mode/block (None axis =
+        # the classic fully-implicit path)
+        if dcn_mode:
+            self._collapse_axis: Optional[str] = DCN_AXIS
+            self._collapse_n = self._dcn_n
+            self._collapse_mode = self._dcn_compress \
+                if self._dcn_compress != "none" else "mean"
+            self._collapse_block = self._config.dcn_compression_block
+        elif zq != "none":
+            self._collapse_axis = DATA_AXIS
+            self._collapse_n = int(self.mesh.shape[DATA_AXIS])
+            self._collapse_mode = zq
+            self._collapse_block = self._config.zero_config.quantized_block
+        else:
+            self._collapse_axis = None
+            self._collapse_n = 1
+            self._collapse_mode = "mean"
+            self._collapse_block = 0
+        if self._collapse_axis is not None:
+            if self._offload_device is not None:
+                raise DeepSpeedConfigError(
+                    "explicit grad collapse (dcn>1 or quantized_collectives)"
+                    " does not compose with offload_optimizer yet")
+            if self.module.meta.get("pipeline"):
+                raise DeepSpeedConfigError(
+                    "explicit grad collapse (dcn>1 or quantized_collectives)"
+                    " does not compose with the pipeline engine yet")
         self._dcn_reduce = None
 
         self._configure_sharding()
@@ -493,11 +537,11 @@ class DeepSpeedEngine:
                 master = self.module.init_fn(rng)
             master = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), master)
             opt_state = self.optimizer.init(master)
-            if self._dcn_mode:
-                # per-slice partial sums: leading [n_dcn] dim, collapsed
-                # across the slow axis only at the boundary step
+            if self._collapse_axis is not None:
+                # per-worker partial sums: leading [n] dim over the
+                # collapse axis, collapsed only at the boundary step
                 grad_acc = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros((self._dcn_n,) + p.shape,
+                    lambda p: jnp.zeros((self._collapse_n,) + p.shape,
                                         self.grad_accum_dtype), master)
             else:
                 grad_acc = jax.tree_util.tree_map(
@@ -509,11 +553,10 @@ class DeepSpeedEngine:
             return master, opt_state, grad_acc
 
         grads_sh = sh.grads
-        if self._dcn_mode:
+        if self._collapse_axis is not None:
             grads_sh = jax.tree_util.tree_map(
-                lambda ns: NamedSharding(self.mesh,
-                                         P(DCN_AXIS, *tuple(ns.spec))),
-                sh.grads)
+                lambda ns: NamedSharding(
+                    self.mesh, self._stacked_spec(ns.spec)), sh.grads)
         shapes = jax.eval_shape(init_all, rng)
         if separate:
             opt_sh = sh.opt_state_fn(shapes[2])
@@ -542,21 +585,46 @@ class DeepSpeedEngine:
                 lambda _: NamedSharding(self.mesh, P()), self.state["scale"]),
         }
         self._last_global_norm: Optional[float] = None
-        if self._dcn_mode:
-            self._init_dcn_reduce(grad_acc, grads_sh)
+        if self._collapse_axis is not None:
+            self._init_grad_collapse(grad_acc, grads_sh)
 
-    def _init_dcn_reduce(self, grad_acc, grads_sh) -> None:
-        """Boundary-step collapse of the per-slice gradient partials
-        across the slow axis: full-precision mean, or the error-feedback
-        1-bit collective (reference NcclBackend.compressed_allreduce,
-        runtime/comm/nccl.py:51) with per-slice worker error and
-        slice-owned server-chunk error, both device-resident.
+    def _stacked_spec(self, spec) -> P:
+        """Spec for a stacked-partials leaf: leading dim over the
+        collapse axis, inner dims keeping their spec minus that axis (a
+        partial is full-size per worker, so the collapse axis cannot also
+        shard the leaf body — relevant when ZeRO's grad specs claim the
+        'data' axis the zero-q collapse stacks over)."""
+        ax = self._collapse_axis
+
+        def strip(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a != ax)
+                return kept if len(kept) > 1 else (
+                    kept[0] if kept else None)
+            return None if entry == ax else entry
+
+        return P(ax, *(strip(e) for e in tuple(spec)))
+
+    def _init_grad_collapse(self, grad_acc, grads_sh) -> None:
+        """Boundary-step collapse of the per-worker gradient partials
+        across the collapse axis (DCN, or 'data' under
+        zero_optimization.quantized_collectives): full-precision mean,
+        the error-feedback 1-bit collective (reference
+        NcclBackend.compressed_allreduce, runtime/comm/nccl.py:51), or
+        the blockwise-quantized int8/int4 collectives
+        (runtime/comm/quantized.py) — worker error and worker-owned
+        server-chunk error both device-resident, threaded functionally.
 
         Each collapse jit donates the stacked accumulator and returns its
         zeroed alias next to the collapsed grads, so the boundary never
-        holds two stacked trees (the non-dcn path gets the same property
+        holds two stacked trees (the implicit path gets the same property
         from apply_core's zero_acc aliasing)."""
         mesh = self.mesh
+        axis = self._collapse_axis
+        mode = self._collapse_mode
+        prefix = "dcn" if axis == DCN_AXIS else "zero"
         grad_specs = self.zero_partitioner.grad_specs()
 
         def constrain_grads(tree):
@@ -572,45 +640,73 @@ class DeepSpeedEngine:
         def zeroed(stacked):
             return jax.tree_util.tree_map(jnp.zeros_like, stacked)
 
+        # the fp32 mean is always built: it is the primary program in
+        # "mean" mode and the overflow fallback for every compressed mode
         self._dcn_mean_jit = self.compile_registry.register(
-            "dcn.mean", jax.jit(
+            f"{prefix}.mean", jax.jit(
                 lambda acc: (mean_of(acc), zeroed(acc)),
                 donate_argnums=(0,), out_shardings=(None, grads_sh)))
-        if self._dcn_compress == "onebit":
+        # wire accounting (telemetry): logical = fp32 payload both
+        # directions; wire = what the configured mode actually moves
+        from .comm.quantized import logical_bytes, wire_bytes
+        total = sum(int(np.prod(l.shape[1:]))
+                    for l in jax.tree_util.tree_leaves(grad_acc))
+        self._collapse_logical_bytes = logical_bytes(total)
+        if mode == "mean":
+            self._collapse_wire_bytes = self._collapse_logical_bytes
+            return
+        if mode == "onebit":
             from .comm.compressed import compressed_grad_reduce_tree
-            self._dcn_reduce = compressed_grad_reduce_tree(mesh, DCN_AXIS)
-            we_shape, se_shape = self._dcn_reduce.ef_shapes(grad_acc)
-            ef_sh = NamedSharding(mesh, P(DCN_AXIS))
-            self._dcn_we = jax.device_put(
-                jnp.zeros(we_shape, jnp.float32), ef_sh)
-            self._dcn_se = jax.device_put(
-                jnp.zeros(se_shape, jnp.float32), ef_sh)
-            #: loss scale the EF residual is denominated in (the acc is
-            #: loss-scaled; a scale change rescales the residual exactly)
-            self._dcn_ef_scale = float(jax.device_get(
-                self.state["scale"]["loss_scale"])) \
-                if "scale" in getattr(self, "state", {}) else 1.0
-            reduce = self._dcn_reduce
+            self._dcn_reduce = compressed_grad_reduce_tree(
+                mesh, axis, block=self._collapse_block)
+        else:
+            from .comm.quantized import quantized_grad_reduce_tree
+            self._dcn_reduce = quantized_grad_reduce_tree(
+                mesh, axis, wire=mode, block=self._collapse_block)
+        self._collapse_wire_bytes = wire_bytes(
+            self._dcn_reduce.flat_size(grad_acc), self._collapse_block,
+            mode)
+        we_shape, se_shape = self._dcn_reduce.ef_shapes(grad_acc)
+        ef_sh = NamedSharding(mesh, P(axis))
+        self._dcn_we = jax.device_put(
+            jnp.zeros(we_shape, jnp.float32), ef_sh)
+        self._dcn_se = jax.device_put(
+            jnp.zeros(se_shape, jnp.float32), ef_sh)
+        #: loss scale the EF residual is denominated in (the acc is
+        #: loss-scaled; a scale change rescales the residual exactly)
+        self._dcn_ef_scale = float(jax.device_get(
+            self.state["scale"]["loss_scale"])) \
+            if "scale" in getattr(self, "state", {}) else 1.0
+        reduce = self._dcn_reduce
 
-            def onebit_collapse(acc, we, se):
-                collapsed, we2, se2 = reduce(acc, we, se)
-                return constrain_grads(collapsed), zeroed(acc), we2, se2
+        def compressed_collapse(acc, we, se):
+            collapsed, we2, se2 = reduce(acc, we, se)
+            return constrain_grads(collapsed), zeroed(acc), we2, se2
 
-            self._dcn_onebit_jit = self.compile_registry.register(
-                "dcn.onebit", jax.jit(
-                    onebit_collapse, donate_argnums=(0, 1, 2),
-                    out_shardings=(None, grads_sh, ef_sh, ef_sh)))
-            self._dcn_rescale_ef_jit = self.compile_registry.register(
-                "dcn.rescale_ef", jax.jit(
-                    lambda we, se, r: (we * r, se * r),
-                    donate_argnums=(0, 1)))
-            self._dcn_finite_jit = self.compile_registry.register(
-                # the finiteness probe only READS the accumulator; the
-                # dslint: disable=missing-donation — collapse owns donation
-                "dcn.finite", jax.jit(
-                    lambda acc: jnp.isfinite(jnp.asarray(
-                        [jnp.sum(jnp.abs(l.astype(jnp.float32)))
-                         for l in jax.tree_util.tree_leaves(acc)])).all()))
+        self._dcn_compress_jit = self.compile_registry.register(
+            f"{prefix}.{mode}", jax.jit(
+                compressed_collapse, donate_argnums=(0, 1, 2),
+                out_shardings=(None, grads_sh, ef_sh, ef_sh)))
+        self._dcn_rescale_ef_jit = self.compile_registry.register(
+            f"{prefix}.rescale_ef", jax.jit(
+                lambda we, se, r: (we * r, se * r),
+                donate_argnums=(0, 1)))
+
+        def finite_probe(acc):
+            # one flattened reduction: abs-sums are non-negative, so the
+            # scalar total is finite iff every leaf is (inf and NaN both
+            # propagate through the sum) — O(1) outputs and no per-leaf
+            # stacked vector regardless of tree size
+            total = jax.tree_util.tree_reduce(
+                jnp.add, jax.tree_util.tree_map(
+                    lambda l: jnp.sum(jnp.abs(l.astype(jnp.float32))),
+                    acc))
+            return jnp.isfinite(total)
+
+        self._dcn_finite_jit = self.compile_registry.register(
+            # the finiteness probe only READS the accumulator; the
+            # dslint: disable=missing-donation — collapse owns donation
+            f"{prefix}.finite", jax.jit(finite_probe))
 
     def _init_param_spill(self) -> None:
         """ZeRO-Infinity parameter NVMe spill: with
@@ -1136,25 +1232,33 @@ class DeepSpeedEngine:
             new_scale = ls.update_state(scale_state, overflow, scaler_config)
             return new_params, new_master, new_opt, zero_acc, new_scale, norm, overflow
 
-        if self._dcn_mode:
-            # per-slice gradient accumulation: the micro step runs manual
-            # over the slow 'dcn' axis (every other mesh axis stays
-            # compiler-managed), so the backward's gradient psum covers
-            # only the fast intra-slice axes — nothing crosses DCN until
-            # the boundary collapse in _take_model_step
-            dcn_n = self._dcn_n
+        if self._collapse_axis is not None:
+            # per-worker gradient accumulation: the micro step runs
+            # manual over the collapse axis (the slow 'dcn' axis, or
+            # 'data' under zero_optimization.quantized_collectives —
+            # every other mesh axis stays compiler-managed), so the
+            # backward's gradient psum covers only the remaining auto
+            # axes — nothing crosses the collapse axis until the boundary
+            # collapse in _take_model_step
+            collapse_axis = self._collapse_axis
+            collapse_n = self._collapse_n
+
+            def strip(sp):
+                # the inner constraint runs inside shard_map, where the
+                # manual collapse axis must not appear in auto specs
+                return P(None, *(tuple(self._stacked_spec(sp))[1:]))
+
             shifted_grad_specs = jax.tree_util.tree_map(
-                lambda sp: P(None, *tuple(sp)), grad_specs,
-                is_leaf=lambda x: isinstance(x, P))
+                strip, grad_specs, is_leaf=lambda x: isinstance(x, P))
 
             def micro_slice(params, acc, scale_state, b):
                 scale = scale_state["loss_scale"]
                 if isinstance(b, dict) and "_train_rng" in b:
-                    # distinct dropout masks per slice: dcn=1 draws one
+                    # distinct dropout masks per worker: n=1 draws one
                     # mask over the full batch, so replicating the key
-                    # across slices would correlate the gradient noise
+                    # across workers would correlate the gradient noise
                     b = {**b, "_train_rng": jax.random.fold_in(
-                        b["_train_rng"], lax.axis_index(DCN_AXIS))}
+                        b["_train_rng"], lax.axis_index(collapse_axis))}
 
                 def scaled_loss(p):
                     loss = loss_fn(p, b)
@@ -1166,28 +1270,28 @@ class DeepSpeedEngine:
                 new_acc = jax.tree_util.tree_map(
                     lambda a, g: a + g[None], acc, grads)
                 new_acc = constrain(new_acc, shifted_grad_specs)
-                return new_acc, lax.pmean(loss, DCN_AXIS)
+                return new_acc, lax.pmean(loss, collapse_axis)
 
-            def micro_dcn(params, grad_acc, scale_state, batch):
+            def micro_stacked(params, grad_acc, scale_state, batch):
                 leaves = jax.tree_util.tree_leaves(batch)
                 rows = max((x.shape[0] for x in leaves
                             if getattr(x, "ndim", 0) >= 1), default=0)
                 pspec = jax.tree_util.tree_map(lambda _: P(), params)
-                aspec = jax.tree_util.tree_map(lambda _: P(DCN_AXIS),
+                aspec = jax.tree_util.tree_map(lambda _: P(collapse_axis),
                                                grad_acc)
                 sspec = jax.tree_util.tree_map(lambda _: P(), scale_state)
                 bspec = jax.tree_util.tree_map(
-                    lambda x: P(DCN_AXIS)
+                    lambda x: P(collapse_axis)
                     if getattr(x, "ndim", 0) >= 1 and x.shape[0] == rows
-                    and rows % dcn_n == 0 else P(), batch)
+                    and rows % collapse_n == 0 else P(), batch)
                 fn = shard_map(micro_slice, mesh=mesh,
                                in_specs=(pspec, aspec, sspec, bspec),
                                out_specs=(aspec, P()),
-                               axis_names={DCN_AXIS}, check_vma=False)
+                               axis_names={collapse_axis}, check_vma=False)
                 return fn(params, grad_acc, scale_state, batch)
 
             self._micro_jit = self.compile_registry.register(
-                "micro", jax.jit(micro_dcn, donate_argnums=(1,)))
+                "micro", jax.jit(micro_stacked, donate_argnums=(1,)))
         else:
             self._micro_jit = self.compile_registry.register(
                 "micro", jax.jit(micro, donate_argnums=(1,)))
@@ -1710,9 +1814,9 @@ class DeepSpeedEngine:
         s = self.state
         grad_in = s["grad_acc"]
         zeroed_stacked = None
-        if self._dcn_mode:
-            # collapse the per-slice partials across the slow axis: one
-            # crossing per boundary step, 1-bit compressed when configured.
+        if self._collapse_axis is not None:
+            # collapse the per-worker partials across the collapse axis:
+            # one crossing per boundary step, compressed when configured.
             # Compression preflight: an overflowed accumulator must NOT
             # touch the EF state (inf - inf = NaN would poison every later
             # step; the uncompressed mean carries the inf to apply_core,
@@ -1720,29 +1824,46 @@ class DeepSpeedEngine:
             # a loss-scale change re-denominates the carried residual —
             # EF is linear in the gradient scale, so the rescale is exact.
             with self.tracer.span(SpanName.TRAIN_GRAD_SYNC,
-                                  axis="dcn", n=self._dcn_n):
-                use_onebit = self._dcn_reduce is not None
-                if use_onebit and self.scaler_config.enabled:
+                                  axis=self._collapse_axis,
+                                  n=self._collapse_n):
+                use_compressed = self._dcn_reduce is not None
+                if use_compressed and self.scaler_config.enabled:
                     self.compile_registry.note_host_sync("step.dcn_finite")
                     # dslint: disable=host-sync-in-hot-path — one scalar pull
-                    use_onebit = bool(jax.device_get(
+                    use_compressed = bool(jax.device_get(
                         self._dcn_finite_jit(s["grad_acc"])))
-                if use_onebit:
-                    self.compile_registry.note_host_sync("step.ef_scale")
-                    # dslint: disable=host-sync-in-hot-path — one scalar pull
-                    cur_scale = float(jax.device_get(s["scale"]["loss_scale"]))
-                    if cur_scale != self._dcn_ef_scale:
-                        ratio = cur_scale / self._dcn_ef_scale
-                        self._dcn_we, self._dcn_se = self._dcn_rescale_ef_jit(
-                            self._dcn_we, self._dcn_se,
-                            jnp.float32(ratio))
-                        self._dcn_ef_scale = cur_scale
-                    (grad_in, zeroed_stacked, self._dcn_we,
-                     self._dcn_se) = self._dcn_onebit_jit(
-                        s["grad_acc"], self._dcn_we, self._dcn_se)
-                else:
-                    grad_in, zeroed_stacked = self._dcn_mean_jit(
-                        s["grad_acc"])
+                mode = self._collapse_mode if use_compressed else "mean"
+                wire = self._collapse_wire_bytes if use_compressed \
+                    else self._collapse_logical_bytes
+                with self.tracer.span(
+                        SpanName.COMM_REDUCE, mode=mode,
+                        axis=self._collapse_axis,
+                        logical_bytes=self._collapse_logical_bytes,
+                        wire_bytes=wire):
+                    if use_compressed:
+                        self.compile_registry.note_host_sync("step.ef_scale")
+                        scale_dev = s["scale"]["loss_scale"]
+                        # dslint: disable=host-sync-in-hot-path — one scalar pull
+                        cur_scale = float(jax.device_get(scale_dev))
+                        if cur_scale != self._dcn_ef_scale:
+                            ratio = cur_scale / self._dcn_ef_scale
+                            self._dcn_we, self._dcn_se = \
+                                self._dcn_rescale_ef_jit(
+                                    self._dcn_we, self._dcn_se,
+                                    jnp.float32(ratio))
+                            self._dcn_ef_scale = cur_scale
+                        (grad_in, zeroed_stacked, self._dcn_we,
+                         self._dcn_se) = self._dcn_compress_jit(
+                            s["grad_acc"], self._dcn_we, self._dcn_se)
+                    else:
+                        grad_in, zeroed_stacked = self._dcn_mean_jit(
+                            s["grad_acc"])
+                if self.metrics_sampler.enabled:
+                    self.metrics.counter(
+                        MetricName.COMM_LOGICAL_BYTES).inc(
+                        self._collapse_logical_bytes)
+                    self.metrics.counter(
+                        MetricName.COMM_WIRE_BYTES).inc(wire)
         if self._separate_master:
             (new_params, new_master, new_opt, zero_acc, new_scale, norm,
              overflow) = self._apply_jit(
@@ -1755,7 +1876,8 @@ class DeepSpeedEngine:
         s["params"] = new_params
         s["master"] = new_master if self._separate_master else new_params
         s["opt_state"] = new_opt
-        s["grad_acc"] = zeroed_stacked if self._dcn_mode else zero_acc
+        s["grad_acc"] = zeroed_stacked if self._collapse_axis is not None \
+            else zero_acc
         s["scale"] = new_scale
         self._last_global_norm = norm  # device scalar; float() lazily
         self._spill_params()
@@ -1798,9 +1920,9 @@ class DeepSpeedEngine:
             return self._train_batch_fused_inner(batches)
 
     def _train_batch_fused_inner(self, batches):
-        if self._offload_device is not None or self._dcn_mode:
-            # host step (offload) / boundary collapse (dcn) can't live
-            # inside one jit: run the micro loop, step at the boundary
+        if self._offload_device is not None or self._collapse_axis is not None:
+            # host step (offload) / boundary collapse (dcn / zero-q)
+            # can't live inside one jit: micro loop, step at the boundary
             gas = self.gradient_accumulation_steps()
             chunks = jax.tree_util.tree_map(
                 lambda x: np.reshape(np.asarray(x),

@@ -122,6 +122,18 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     ignore_unused_parameters: bool = True
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
+    #: route the intra-slice (ICI) gradient reduce through the explicit
+    #: blockwise-quantized reduce-scatter/all-gather
+    #: (``runtime/comm/quantized.py``) instead of the compiler-implicit
+    #: full-precision psum: "none" | "int8" | "int4".  Gradients then
+    #: accumulate as per-data-rank partials across the gas window and
+    #: cross the 'data' mesh axis once per boundary step, quantized both
+    #: directions with device-resident error feedback.  Costs one full
+    #: (unsharded) gradient tree of accumulator per device during the gas
+    #: window; see docs/performance.md "Quantized collectives".
+    quantized_collectives: str = "none"
+    #: elements per fp32 wire scale for quantized_collectives; multiple of 8
+    quantized_block: int = 2048
 
     offload_param_config: DeepSpeedZeroOffloadParamConfig = dataclasses.field(
         default_factory=DeepSpeedZeroOffloadParamConfig)
@@ -131,6 +143,16 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     def __post_init__(self):
         if not 0 <= self.stage <= 3:
             raise ValueError(f"zero stage must be 0-3, got {self.stage}")
+        self.quantized_collectives = str(self.quantized_collectives).lower()
+        if self.quantized_collectives not in ("none", "int8", "int4"):
+            raise ValueError(
+                f"zero_optimization.quantized_collectives="
+                f"{self.quantized_collectives!r} (want 'none', 'int8' or "
+                "'int4')")
+        if self.quantized_block <= 0 or self.quantized_block % 8:
+            raise ValueError(
+                f"zero_optimization.quantized_block={self.quantized_block!r} "
+                "(want a positive multiple of 8)")
         # booleans arriving through the deprecated cpu_offload path
         if isinstance(self.offload_optimizer, bool):
             self.offload_optimizer = {"device": "cpu"} if self.offload_optimizer else None

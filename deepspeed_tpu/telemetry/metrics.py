@@ -76,6 +76,12 @@ class MetricName:
     SERVE_TTFT_S = "serve.ttft_s"
     #: decode tokens emitted per second over the gateway lifetime
     SERVE_TOKENS_PER_S = "serve.tokens_per_s"
+    #: cumulative bytes the explicit grad-reduce collectives WOULD have
+    #: moved at full precision (fp32 payload, both directions)
+    COMM_LOGICAL_BYTES = "comm.logical_bytes"
+    #: cumulative bytes those collectives actually put on the wire
+    #: (quantized codes + per-block fp32 scales; == logical for fp32 mean)
+    COMM_WIRE_BYTES = "comm.wire_bytes"
     #: divergence rollbacks performed by the run supervisor
     ROLLBACKS = "elastic.rollbacks"
     #: fleet incarnation index (how many whole-group restarts preceded us)

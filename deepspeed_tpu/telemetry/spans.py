@@ -58,6 +58,9 @@ class SpanName:
     TRAIN_BWD = "train.bwd"
     #: cross-slice gradient collapse at the gas boundary (DCN mean/onebit)
     TRAIN_GRAD_SYNC = "train.grad_sync"
+    #: one explicit gradient-reduce collective dispatch (mode, axis,
+    #: logical/wire bytes in args) — nested inside train.grad_sync
+    COMM_REDUCE = "comm.reduce"
     #: gas-boundary optimizer apply (unscale/clip/step/recast dispatch)
     TRAIN_OPTIMIZER = "train.optimizer"
     #: a sanctioned device→host pull on the step path (label in args)

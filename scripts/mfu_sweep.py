@@ -153,11 +153,28 @@ _SHORT = [
     ("dense-mb32", {"BENCH_DENSE_ATTN": "1", "BENCH_MB": "32,24"}, None),
 ]
 
+# quantized-collective rows (CPU fixture — comm_bench forces 2 virtual
+# CPU devices itself, so these rows run anywhere; --no-gate because the
+# sweep records the trajectory, scripts/comm_bench.py owns the gate):
+# one row per collapse mode so regressions bisect per mode in the log,
+# plus the all-modes row that refreshes the full BENCH_COMM picture
+_COMM_BENCH = ["scripts/comm_bench.py", "--no-gate",
+               "--out", "/tmp/BENCH_COMM_sweep.json"]
+_COMM = [
+    ("comm-mean", {}, _COMM_BENCH + ["--modes", "none"]),
+    ("comm-int8", {}, _COMM_BENCH + ["--modes", "none,int8"]),
+    ("comm-int4", {}, _COMM_BENCH + ["--modes", "none,int4"]),
+    ("comm-onebit", {}, _COMM_BENCH + ["--modes", "none,onebit"]),
+    ("comm-zero-int8", {}, _COMM_BENCH + ["--modes", "none,zero_int8"]),
+    ("comm-all", {}, _COMM_BENCH),
+]
+
 CONFIG_SETS = {
     "full": _FULL,
     "remat": _REMAT,
     "round5": _ROUND5,
     "short": _SHORT,
+    "comm": _COMM,
 }
 
 RUN_TIMEOUT_S = 1200
@@ -233,7 +250,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
     configs = CONFIG_SETS[args.config_set]
     path = args.logfile or f"/tmp/mfu_sweep_{args.config_set}.jsonl"
-    if not preflight() and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
+    # the comm set runs the CPU collective fixture — no TPU tunnel needed
+    if args.config_set != "comm" and not preflight() \
+            and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
         sys.exit(1)
     with open(path, "a") as log:
         for label, env_over, row_argv in configs:

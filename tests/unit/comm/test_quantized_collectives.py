@@ -1,0 +1,233 @@
+"""Blockwise int8/int4 quantized collectives (runtime/comm/quantized.py).
+
+Unit surface: nibble packing, quantize→reduce→dequantize parity against
+the true mean (bounded by the per-block absmax quantization step), the
+reduce-scatter / all-gather decomposition, the exact error-feedback
+telescoping identity, the padding/alignment contract, and the wire-byte
+accounting the engine metrics and ``scripts/comm_bench.py`` share.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu  # noqa: F401 — shard_map/axis_size compat shim
+from deepspeed_tpu.parallel.mesh import (DCN_AXIS, ParallelDims,
+                                         initialize_mesh,
+                                         reset_mesh_manager)
+from deepspeed_tpu.runtime.comm.quantized import (
+    logical_bytes, pack_int4, quantized_all_gather, quantized_allreduce,
+    quantized_grad_reduce_tree, quantized_reduce_scatter, unpack_int4,
+    wire_bytes)
+
+
+def _mesh(dcn=2):
+    reset_mesh_manager()
+    return initialize_mesh(ParallelDims(dp=-1, dcn=dcn))
+
+
+# ----------------------------------------------------------- int4 packing
+
+def test_pack_unpack_int4_roundtrip_all_codes():
+    codes = jnp.asarray(np.tile(np.arange(-7, 8, dtype=np.int8), 2))
+    packed = pack_int4(codes)
+    assert packed.dtype == jnp.uint8 and packed.shape == (15,)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(codes))
+
+
+def test_pack_int4_rejects_odd_count():
+    with pytest.raises(ValueError, match="even"):
+        pack_int4(jnp.zeros((7,), jnp.int8))
+
+
+# ------------------------------------------------------------- tree parity
+
+@pytest.mark.parametrize("wire,qmax", [("int8", 127.0), ("int4", 7.0)])
+def test_grad_reduce_tree_parity_vs_true_mean(wire, qmax):
+    mm = _mesh(dcn=2)
+    mesh = mm.mesh
+    red = quantized_grad_reduce_tree(mesh, DCN_AXIS, wire=wire, block=64)
+    rng = np.random.default_rng(0)
+    tree = {"a": rng.standard_normal((2, 4096)).astype(np.float32),
+            "b": rng.standard_normal((2, 32, 32)).astype(np.float32)}
+    sh = NamedSharding(mesh, P(DCN_AXIS))
+    wsh, ssh = red.ef_shapes(tree)
+    we = jax.device_put(jnp.zeros(wsh, jnp.float32), sh)
+    se = jax.device_put(jnp.zeros(ssh, jnp.float32), sh)
+    dev = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    out, we2, se2 = red(dev, we, se)
+    for k in tree:
+        true = tree[k].mean(0)
+        got = np.asarray(jax.device_get(out[k]))
+        # two quantization stages, each bounded by half a code step of the
+        # block absmax scale — 1.5 steps covers worker + server stages
+        bound = np.abs(tree[k]).max() / qmax * 1.5
+        assert np.abs(got - true).max() < bound, (k, wire)
+    # residuals: finite, bounded by a code step, and nonzero (EF engaged)
+    for r in (we2, se2):
+        h = np.asarray(jax.device_get(r))
+        assert np.isfinite(h).all()
+        assert np.abs(h).max() > 0
+
+
+def test_grad_reduce_tree_error_feedback_telescopes():
+    """The exact two-stage telescoping identity (the onebit test's
+    algebra, int8 wire): sum_t out_t = sum_t true_t - (mean_w we_T +
+    se_T).  EF makes the ACCUMULATED quantized reductions track the
+    accumulated true means instead of random-walking."""
+    mm = _mesh(dcn=2)
+    mesh = mm.mesh
+    red = quantized_grad_reduce_tree(mesh, DCN_AXIS, wire="int8", block=64)
+    rng = np.random.default_rng(1)
+    sh = NamedSharding(mesh, P(DCN_AXIS))
+    wsh, ssh = red.ef_shapes({"a": jnp.zeros((2, 8192))})
+    we = jax.device_put(jnp.zeros(wsh, jnp.float32), sh)
+    se = jax.device_put(jnp.zeros(ssh, jnp.float32), sh)
+    acc_out = np.zeros(8192)
+    acc_true = np.zeros(8192)
+    for _ in range(20):
+        tree = {"a": rng.standard_normal((2, 8192)).astype(np.float32)}
+        acc_true += tree["a"].mean(0)
+        dev = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+        out, we, se = red(dev, we, se)
+        acc_out += np.asarray(jax.device_get(out["a"]), np.float64)
+    we_h = np.asarray(jax.device_get(we), np.float64)
+    se_h = np.asarray(jax.device_get(se), np.float64)
+    resid = we_h.mean(0) + se_h
+    np.testing.assert_allclose(acc_out - acc_true, -resid[:8192],
+                               rtol=0, atol=1e-3)
+    c = np.corrcoef(acc_out, acc_true)[0, 1]
+    assert c > 0.99, c
+
+
+def test_grad_reduce_tree_odd_leaf_sizes_and_all_zero_blocks():
+    """Padding contract: leaf counts not divisible by world*block are
+    zero-padded; all-zero inputs (scale floor) come back exactly zero
+    with zero residuals."""
+    mm = _mesh(dcn=2)
+    mesh = mm.mesh
+    red = quantized_grad_reduce_tree(mesh, DCN_AXIS, wire="int4", block=8)
+    sh = NamedSharding(mesh, P(DCN_AXIS))
+    rng = np.random.default_rng(2)
+    tree = {"odd": rng.standard_normal((2, 13)).astype(np.float32),
+            "odder": rng.standard_normal((2, 7, 11)).astype(np.float32)}
+    assert red.flat_size(tree) % (2 * 8) == 0
+    wsh, ssh = red.ef_shapes(tree)
+    we = jax.device_put(jnp.zeros(wsh, jnp.float32), sh)
+    se = jax.device_put(jnp.zeros(ssh, jnp.float32), sh)
+    dev = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    out, we2, se2 = red(dev, we, se)
+    for k in tree:
+        assert out[k].shape == tree[k].shape[1:]
+        bound = np.abs(tree[k]).max() / 7.0 * 1.5
+        assert np.abs(np.asarray(out[k]) - tree[k].mean(0)).max() < bound
+    # all-zero round: exact zeros out, residual tail untouched
+    zeros = jax.tree_util.tree_map(
+        lambda x: jax.device_put(jnp.zeros_like(x), sh), dev)
+    we0 = jax.device_put(jnp.zeros(wsh, jnp.float32), sh)
+    se0 = jax.device_put(jnp.zeros(ssh, jnp.float32), sh)
+    out0, we0, se0 = red(zeros, we0, se0)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out0[k]), 0.0)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(we0)), 0.0)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(se0)), 0.0)
+
+
+# --------------------------------------------------------- rs/ag contract
+
+def test_reduce_scatter_all_gather_compose_to_allreduce():
+    """The composition identity: rs → ag (with zero residuals) equals
+    quantized_allreduce with zero residuals, and the rs output really is
+    this worker's chunk of the blockwise-dequantized mean."""
+    mm = _mesh(dcn=2)
+    mesh = mm.mesh
+    N, block = 512, 64
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, N)).astype(np.float32)
+    sh = NamedSharding(mesh, P(DCN_AXIS))
+    xd = jax.device_put(x, sh)
+    we = jax.device_put(jnp.zeros((2, N), jnp.float32), sh)
+    se = jax.device_put(jnp.zeros((N,), jnp.float32), sh)
+
+    def body_all(v, w, s):
+        out, w2, s2 = quantized_allreduce(v[0], w[0], s, DCN_AXIS,
+                                          block=block, wire="int8")
+        return out, w2[None], s2
+
+    def body_stages(v, w, s):
+        red, w2 = quantized_reduce_scatter(v[0], w[0], DCN_AXIS,
+                                           block=block, wire="int8")
+        out, s2 = quantized_all_gather(red, s, DCN_AXIS,
+                                       block=block, wire="int8")
+        return out, w2[None], s2
+
+    specs = dict(mesh=mesh, in_specs=(P(DCN_AXIS), P(DCN_AXIS), P(DCN_AXIS)),
+                 out_specs=(P(), P(DCN_AXIS), P(DCN_AXIS)), check_vma=False)
+    out_a, we_a, se_a = shard_map(body_all, **specs)(xd, we, se)
+    out_s, we_s, se_s = shard_map(body_stages, **specs)(xd, we, se)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(we_a)),
+                                  np.asarray(jax.device_get(we_s)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(se_a)),
+                                  np.asarray(jax.device_get(se_s)))
+    # parity with the true mean
+    bound = np.abs(x).max() / 127.0 * 1.5
+    assert np.abs(np.asarray(out_a) - x.mean(0)).max() < bound
+
+
+# ------------------------------------------------------ contract failures
+
+def test_factory_rejects_bad_wire_and_block():
+    mm = _mesh(dcn=2)
+    with pytest.raises(ValueError, match="wire"):
+        quantized_grad_reduce_tree(mm.mesh, DCN_AXIS, wire="fp8")
+    with pytest.raises(ValueError, match="multiple of 8"):
+        quantized_grad_reduce_tree(mm.mesh, DCN_AXIS, block=12)
+
+
+def test_misaligned_flat_raises_named_error():
+    mm = _mesh(dcn=2)
+    mesh = mm.mesh
+    sh = NamedSharding(mesh, P(DCN_AXIS))
+    x = jax.device_put(jnp.zeros((2, 24), jnp.float32), sh)
+
+    def body(v):
+        red, _ = quantized_reduce_scatter(v[0], jnp.zeros_like(v[0]),
+                                          DCN_AXIS, block=16, wire="int8")
+        return red[None]
+
+    with pytest.raises(ValueError, match="flat_size"):
+        shard_map(body, mesh=mesh, in_specs=(P(DCN_AXIS),),
+                  out_specs=P(DCN_AXIS), check_vma=False)(x)
+
+
+# --------------------------------------------------------- wire accounting
+
+def test_wire_byte_accounting_ratios():
+    flat = 1 << 20
+    block = 2048
+    logical = logical_bytes(flat)
+    assert logical == 2 * flat * 4
+    ratios = {m: logical / wire_bytes(flat, block, m)
+              for m in ("mean", "int8", "int4", "onebit")}
+    assert ratios["mean"] == 1.0
+    assert ratios["int8"] >= 3.5
+    assert ratios["int4"] >= 7.0
+    assert ratios["onebit"] > ratios["int4"]
+    with pytest.raises(ValueError, match="mode"):
+        wire_bytes(flat, block, "fp8")
+
+
+def test_tree_factory_accounting_matches_module_helpers():
+    mm = _mesh(dcn=2)
+    red = quantized_grad_reduce_tree(mm.mesh, DCN_AXIS, wire="int8",
+                                     block=64)
+    tree = {"a": jnp.zeros((2, 1000)), "b": jnp.zeros((2, 50))}
+    assert red.logical_bytes(tree) == logical_bytes(1050)
+    assert red.wire_bytes(tree) == wire_bytes(red.flat_size(tree), 64,
+                                              "int8")
