@@ -285,6 +285,18 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(f"invalid 'telemetry' section: {e}") from e
         self.telemetry_config_dict = tel_dict
 
+        # serving section (typed: continuous-batching gateway geometry +
+        # the paged-KV / session-tiering "paging" subsection — validated
+        # here so a bad deployment config fails at engine init, not as a
+        # mis-serving gateway)
+        serving_dict = pd.get("serving", {})
+        from ..serving.config import ServingConfig
+        try:
+            self.serving_config = ServingConfig.from_dict(serving_dict)
+        except (TypeError, ValueError) as e:
+            raise DeepSpeedConfigError(f"invalid 'serving' section: {e}") from e
+        self.serving_config_dict = serving_dict
+
         # pld
         pld_dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
         self.pld_enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)

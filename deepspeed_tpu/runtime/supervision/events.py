@@ -74,6 +74,10 @@ class EventKind:
     SERVE_DONE = "serve.done"
     SERVE_EVICT = "serve.evict"
     SERVE_TICK = "serve.tick"
+    SERVE_PARK = "serve.park"
+    SERVE_READMIT = "serve.readmit"
+    SERVE_PAGE_ALLOC = "serve.page_alloc"
+    SERVE_PAGE_EVICT = "serve.page_evict"
     PERF_RECOMPILE = "perf.recompile"
     PERF_HOST_SYNC = "perf.host_sync"
     METRICS_SAMPLE = "metrics.sample"
@@ -145,8 +149,14 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
                               "tokens_out", "queued"),
     EventKind.SERVE_DONE: ("request_id", "slot", "tokens_out", "ttft_ms",
                            "tok_per_s"),
-    EventKind.SERVE_EVICT: ("prefix", "reason", "idle_s"),
+    EventKind.SERVE_EVICT: ("prefix", "session", "reason", "idle_s",
+                            "bytes"),
     EventKind.SERVE_TICK: ("tick", "active", "queue_depth", "tok_per_s"),
+    EventKind.SERVE_PARK: ("session", "tokens", "blocks", "bytes", "tier"),
+    EventKind.SERVE_READMIT: ("session", "tokens_reused", "tokens_new",
+                              "tier", "readmit_ms", "hit"),
+    EventKind.SERVE_PAGE_ALLOC: ("session", "blocks", "free_blocks"),
+    EventKind.SERVE_PAGE_EVICT: ("session", "blocks", "bytes", "reason"),
     EventKind.PERF_RECOMPILE: ("program", "registry", "count", "shapes",
                                "compile_s"),
     EventKind.PERF_HOST_SYNC: ("label", "count"),

@@ -13,6 +13,11 @@ is the production front half:
   the async checkpoint engine): bounded FIFO+priority admission queue,
   per-request budgets/deadlines/seeds, cancellation, LRU prefix pool with
   zero-copy fork dedup of shared system prompts;
+- ``paging``: paged KV blocks + session tiering — a free-list block
+  allocator with copy-on-write sharing, a device block pool (warm tier),
+  and a host RAM/disk park store, so finished conversations keep their
+  KV and follow-up turns re-admit instead of re-prefilling (concurrency
+  is no longer capped at ``slots``);
 - ``metrics`` + supervision ``EventJournal`` ``serve.*`` events: queue
   depth, TTFT, tokens/sec, slot occupancy — the black box and the
   dashboard of the serving plane (``scripts/serve_bench.py`` tracks them
@@ -23,14 +28,18 @@ directly.  Reference: ``docs/serving.md``.
 """
 
 from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
-from .config import SERVING, ServingConfig  # noqa: F401
+from .config import SERVING, PagingConfig, ServingConfig  # noqa: F401
 from .gateway import ServingGateway  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .paging import (BlockAllocator, PagedKVPool, ParkCorruptError,  # noqa: F401
+                     ParkStore, PoolExhaustedError, SessionPager)
 from .request import (QueueFullError, RequestCancelled, RequestFailed,  # noqa: F401
                       RequestHandle, RequestState, RequestTimedOut)
 
 __all__ = [
-    "SERVING", "ServingConfig", "ServingGateway", "ServingMetrics",
-    "SlotBatcher", "PrefixEntry", "RequestHandle", "RequestState",
-    "QueueFullError", "RequestCancelled", "RequestFailed", "RequestTimedOut",
+    "SERVING", "ServingConfig", "PagingConfig", "ServingGateway",
+    "ServingMetrics", "SlotBatcher", "PrefixEntry", "RequestHandle",
+    "RequestState", "QueueFullError", "RequestCancelled", "RequestFailed",
+    "RequestTimedOut", "BlockAllocator", "PagedKVPool", "ParkStore",
+    "SessionPager", "PoolExhaustedError", "ParkCorruptError",
 ]

@@ -16,7 +16,11 @@ Same validated dataclass-model style as ``supervision/config.py``:
         "max_cached_prefixes": 8,
         "prefix_ttl_s": 600.0,
         "journal_every_ticks": 0,
-        "eos_token_id": null
+        "eos_token_id": null,
+        "paging": {"enabled": false, "block_tokens": 16,
+                   "pool_blocks": null, "park_capacity": 64,
+                   "park_dir": null, "park_ttl_s": 600.0,
+                   "park_verify": true}
     }}
 
 ``max_len`` is the per-slot cache length — bucketed to a power of two and
@@ -27,11 +31,57 @@ reference: ``docs/serving.md``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Dict, Optional
 
 from ..runtime.config_utils import DeepSpeedConfigModel
 
 SERVING = "serving"
+
+
+@dataclasses.dataclass
+class PagingConfig(DeepSpeedConfigModel):
+    """The ``"serving"."paging"`` subsection: paged KV blocks + session
+    tiering (``serving/paging.py``, ``docs/serving.md``)."""
+
+    #: switch the gateway from slot-pinned conversations to paged KV +
+    #: session tiering (park finished conversations, re-admit follow-ups)
+    enabled: bool = False
+    #: KV rows per block — a power of two so blocks tile the bucketed
+    #: slot length exactly (clamped to ``max_len`` at gateway build)
+    block_tokens: int = 16
+    #: device block-pool size (the warm tier); None = one slot-cache
+    #: worth of blocks (``slots * max_len / block_tokens``)
+    pool_blocks: Optional[int] = None
+    #: RAM-parked sessions kept before spilling to ``park_dir`` (or
+    #: dropping, when no park_dir is set)
+    park_capacity: int = 64
+    #: disk spill directory for cold parked sessions (atomic npz writes);
+    #: None disables the disk tier
+    park_dir: Optional[str] = None
+    #: a parked session idle longer than this is dropped by the sweep
+    park_ttl_s: float = 600.0
+    #: verify the park-time SHA-256 on re-admission (corrupt KV is
+    #: rejected and re-prefilled, never decoded)
+    park_verify: bool = True
+
+    def __post_init__(self):
+        bt = self.block_tokens
+        if bt < 1 or (bt & (bt - 1)):
+            raise ValueError(
+                f"serving.paging.block_tokens must be a power of two "
+                f">= 1, got {bt}")
+        if self.pool_blocks is not None and self.pool_blocks < 1:
+            raise ValueError(
+                f"serving.paging.pool_blocks must be >= 1, got "
+                f"{self.pool_blocks}")
+        if self.park_capacity < 0:
+            raise ValueError(
+                f"serving.paging.park_capacity must be >= 0, got "
+                f"{self.park_capacity}")
+        if self.park_ttl_s <= 0:
+            raise ValueError(
+                f"serving.paging.park_ttl_s must be > 0, got "
+                f"{self.park_ttl_s}")
 
 
 @dataclasses.dataclass
@@ -74,8 +124,19 @@ class ServingConfig(DeepSpeedConfigModel):
     eos_token_id: Optional[int] = None
     #: scheduler idle wait between queue polls, seconds
     idle_wait_s: float = 0.02
+    #: raw "paging" subsection (typed view: ``paging_config``) — paged
+    #: KV blocks + session tiering; see :class:`PagingConfig`
+    paging: Optional[Dict] = None
+
+    paging_config: PagingConfig = dataclasses.field(
+        default_factory=PagingConfig)
 
     def __post_init__(self):
+        if isinstance(self.paging, dict):
+            self.paging_config = PagingConfig.from_dict(self.paging)
+        elif isinstance(self.paging, PagingConfig):
+            self.paging_config = self.paging
+            self.paging = self.paging_config.to_dict()
         if self.slots < 1:
             raise ValueError(f"serving.slots must be >= 1, got {self.slots}")
         if self.prefill_chunk < 1:

@@ -41,14 +41,25 @@ from ..utils.logging import logger
 from .batcher import PrefixEntry, SlotBatcher
 from .config import ServingConfig
 from .metrics import ServingMetrics
+from .paging import SessionPager, cache_bank_bytes
 from .request import (QueueFullError, RequestCancelled, RequestFailed,
                       RequestHandle, RequestState, RequestTimedOut,
                       ServeRequest)
 
 
 class _PooledPrefix:
-    def __init__(self, entry: PrefixEntry):
+    """One pooled shared prefix.  Unpaged gateways hold the batch-1
+    cache (``entry``) directly; paged ones hold a pool block ``table``
+    instead — N conversations over one system prompt then share the
+    prefix's *blocks* (refcounted, copy-on-write), not just the whole
+    pooled cache."""
+
+    def __init__(self, entry: Optional[PrefixEntry] = None,
+                 table=None, length: int = 0, nbytes: int = 0):
         self.entry = entry
+        self.table = table
+        self.length = int(length if entry is None else entry.length)
+        self.nbytes = int(nbytes)
         self.last_used = time.monotonic()
 
 
@@ -71,6 +82,13 @@ class ServingGateway:
         self._batcher = SlotBatcher(engine, config, tracer=self.tracer)
         self._journal = journal
         self.metrics = ServingMetrics()
+        #: paged KV + session tiering (serving/paging.py) — None keeps
+        #: the PR 6 slot-pinned behavior byte for byte
+        self._pager: Optional[SessionPager] = None
+        if config.paging_config.enabled:
+            self._pager = SessionPager(self._batcher, config.paging_config,
+                                       emit=self._emit,
+                                       metrics=self.metrics)
         # compile-discipline gate: serving programs are shape-stable by
         # construction, so each program's FIRST compile is warmup and any
         # later one is a regression — journaled as perf.recompile and
@@ -107,7 +125,8 @@ class ServingGateway:
                seed: Optional[int] = None, do_sample: bool = False,
                temperature: float = 1.0,
                eos_token_id: Optional[int] = None,
-               prefix_len: int = 0) -> RequestHandle:
+               prefix_len: int = 0,
+               session_id: Optional[str] = None) -> RequestHandle:
         """Enqueue one generation request; returns immediately with a
         :class:`RequestHandle`.
 
@@ -118,8 +137,19 @@ class ServingGateway:
         sampling key; unset, the gateway derives one from its seed
         sequence — two identical sampled requests do NOT return identical
         replies unless they pin the same seed.
+
+        ``session_id`` (paged gateways only) names the conversation:
+        ``tokens`` must then be the FULL history (previous prompt + reply
+        + the new turn).  The finished conversation's KV is retained
+        (block pool → host RAM → disk) and the follow-up turn re-admits
+        it, prefilling only the new tokens — ``serve.readmit`` journals
+        the hit and its latency.
         """
         cfg = self.config
+        if session_id is not None and self._pager is None:
+            raise ValueError(
+                "submit(session_id=...) needs session tiering — enable "
+                'serving config {"paging": {"enabled": true}}')
         seq = self._seq_next()
         rid = f"req-{seq}"
         fault_injection.fire("serve.request", request_id=rid)
@@ -156,7 +186,8 @@ class ServingGateway:
             greedy=not do_sample, temperature=float(temperature),
             eos_token_id=(eos_token_id if eos_token_id is not None
                           else cfg.eos_token_id),
-            handle=handle)
+            handle=handle,
+            session_id=str(session_id) if session_id is not None else None)
         self.metrics.count("submitted")
         with self._cond:
             if self._closed:
@@ -193,6 +224,8 @@ class ServingGateway:
         snap.update(active_slots=active, slots=self.config.slots,
                     cached_prefixes=prefixes,
                     compile_counts=self._batcher.compile_counts())
+        if self._pager is not None:
+            snap["paging"] = self._pager.stats()
         return snap
 
     def attach_metrics(self, sampler) -> None:
@@ -204,12 +237,18 @@ class ServingGateway:
 
     def _metrics_source(self) -> dict:
         snap = self.snapshot()
-        return {
+        out = {
             MetricName.SERVE_QUEUE_DEPTH: snap["queue_depth"],
             MetricName.SERVE_OCCUPANCY: snap["slot_occupancy"],
             MetricName.SERVE_TOKENS_PER_S: snap["tokens_per_s"],
             MetricName.SERVE_TTFT_S: self.metrics.ttft.snapshot(),
         }
+        if self._pager is not None:
+            out[MetricName.SERVE_HBM_BYTES_PER_CONVERSATION] = \
+                snap["hbm_bytes_per_conversation"]
+            out[MetricName.SERVE_READMIT_S] = \
+                self.metrics.readmit.snapshot()
+        return out
 
     def _pull_compile_stats(self) -> None:
         """Fold the CompileWatch's view into the metrics: new post-warmup
@@ -288,6 +327,10 @@ class ServingGateway:
         self._active.pop(row, None)
         self._free_rows.append(row)
         self._batcher.release(row)
+        if self._pager is not None:
+            # no-op when a retire already took the ledger; frees the
+            # block references of cancelled/timed-out/failed rows
+            self._pager.row_released(row)
 
     # ---------------------------------------------------------- scheduler
 
@@ -361,6 +404,8 @@ class ServingGateway:
                 with self._cond:
                     self._active.pop(row, None)
                     self._free_rows.append(row)
+                if self._pager is not None:
+                    self._pager.row_released(row)
                 self.metrics.count("failed")
                 self._emit(EventKind.SERVE_REJECT, request_id=req.rid,
                            reason=f"admission_error: {e}", queue_depth=0)
@@ -374,10 +419,23 @@ class ServingGateway:
             self._admit_one_inner(row, req)
 
     def _admit_one_inner(self, row: int, req: ServeRequest) -> None:
-        fault_injection.fire("serve.admit", request_id=req.rid, slot=row)
         prefix_hit = False
         prefix = None
-        if req.prefix_len > 0 and self.config.max_cached_prefixes > 0:
+        readmit = None
+        shared_prefix: Optional[_PooledPrefix] = None
+        t0 = time.monotonic()
+        if req.session_id is not None:
+            readmit = self._try_readmit(req)
+        if readmit is not None:
+            # the tier copy IS a prefix of the new turn's full history:
+            # re-admission rides the exact prefix-resume admission path.
+            # The row ledger takes the table NOW so a faulted admission
+            # frees the blocks through row_released instead of leaking
+            prefix = PrefixEntry(cache=readmit.cache, length=readmit.reused)
+            self._pager.begin_row(row, req.session_id, readmit.reused,
+                                  table=readmit.table,
+                                  immutable_upto=readmit.immutable_upto)
+        elif req.prefix_len > 0 and self.config.max_cached_prefixes > 0:
             key = np.asarray(req.tokens[:req.prefix_len]).tobytes()
             with self._cond:
                 pooled = self._prefixes.get(key)
@@ -387,20 +445,49 @@ class ServingGateway:
                 pooled.last_used = time.monotonic()
                 with self._cond:
                     self._prefixes.move_to_end(key)
-                prefix = pooled.entry
+                if pooled.table is not None:
+                    prefix = PrefixEntry(
+                        cache=self._pager.gather_prefix(pooled.table,
+                                                        pooled.length),
+                        length=pooled.length)
+                    shared_prefix = pooled
+                else:
+                    prefix = pooled.entry
             else:
                 entry = self._batcher.build_prefix(req.tokens[:req.prefix_len])
                 self.metrics.count("prefix_builds")
+                table = None
+                if self._pager is not None:
+                    # paged pool: hold the prefix as refcounted blocks —
+                    # the batch-1 build cache is dropped, sessions share
+                    # the blocks copy-on-write
+                    table = self._pager.pool_prefix(entry.cache,
+                                                    entry.length)
+                pooled = _PooledPrefix(
+                    entry=entry if table is None else None, table=table,
+                    length=entry.length,
+                    nbytes=(len(table) * self._pager.pool.block_bytes
+                            if table is not None
+                            else cache_bank_bytes(entry.cache)))
                 with self._cond:
                     while len(self._prefixes) >= self.config.max_cached_prefixes:
                         self._evict_prefix(reason="lru")
-                    self._prefixes[key] = _PooledPrefix(entry)
+                    self._prefixes[key] = pooled
                 prefix = entry
+                if table is not None:
+                    shared_prefix = pooled
         elif req.prefix_len > 0:
             # pool disabled: the prefix is just part of the prompt
             prefix = None
-        self._batcher.admit(row, req.tokens, req.key, req.greedy,
-                            req.temperature, prefix=prefix)
+        # fires between the tier/prefix restore and the slot prefill, so
+        # chaos covers the widest admission window (a faulted admission
+        # after a readmit must free the re-admitted blocks via the ledger)
+        fault_injection.fire("serve.admit", request_id=req.rid, slot=row)
+        req.frontier = self._batcher.admit(row, req.tokens, req.key,
+                                           req.greedy, req.temperature,
+                                           prefix=prefix)
+        if req.session_id is not None:
+            self._begin_session_row(row, req, readmit, shared_prefix, t0)
         req.handle.t_admit = time.monotonic()
         req.handle.state = RequestState.DECODING
         with self._cond:
@@ -411,15 +498,74 @@ class ServingGateway:
                    prefix_hit=prefix_hit)
         self.metrics.count("admitted")
 
+    def _try_readmit(self, req: ServeRequest):
+        """Attempt the tiered-KV restore for a session follow-up; any
+        failure (fault point, corrupt park, device error) costs a full
+        re-prefill, never the request."""
+        with self.tracer.span(SpanName.SERVE_READMIT,
+                              session=req.session_id):
+            try:
+                return self._pager.readmit(req.session_id, req.tokens)
+            except Exception as e:
+                logger.warning(
+                    f"[serving] readmit of session {req.session_id!r} "
+                    f"failed ({e}); falling back to a full re-prefill")
+                self._pager.drop_session(req.session_id,
+                                         reason="readmit_failed")
+                return None
+
+    def _begin_session_row(self, row: int, req: ServeRequest, readmit,
+                           shared_prefix: Optional[_PooledPrefix],
+                           t0: float) -> None:
+        """Start block accounting for the session now decoding in
+        ``row`` and journal the readmit outcome + latency (admission
+        wall, including the remainder prefill — the number the bench
+        compares against re-prefill)."""
+        if readmit is not None:
+            # ledger opened at readmit time; grow it to the full prompt
+            self._pager.on_tick(row, req.frontier)
+        elif shared_prefix is not None and shared_prefix.table is not None:
+            table, upto = self._pager.share_prefix(shared_prefix.table,
+                                                   shared_prefix.length)
+            self._pager.begin_row(row, req.session_id, req.frontier,
+                                  table=table, immutable_upto=upto)
+        else:
+            self._pager.begin_row(row, req.session_id, req.frontier)
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        if readmit is not None:
+            self.metrics.count("readmits")
+            self.metrics.record_readmit(ms / 1e3)
+            self._emit(EventKind.SERVE_READMIT, session=req.session_id,
+                       tokens_reused=readmit.reused,
+                       tokens_new=req.prompt_len - readmit.reused,
+                       tier=readmit.tier, readmit_ms=ms, hit=True)
+        else:
+            self.metrics.count("readmit_misses")
+            self._emit(EventKind.SERVE_READMIT, session=req.session_id,
+                       tokens_reused=0, tokens_new=req.prompt_len,
+                       tier=None, readmit_ms=ms, hit=False)
+        self._push_tier_gauges()
+
     def _evict_prefix(self, reason: str) -> None:
-        """cond must be held; pops the LRU entry."""
+        """cond must be held; pops the LRU entry and journals the HBM it
+        reclaims (paged prefixes free refcounted blocks — bytes count
+        only the last-reference releases, blocks still shared by live
+        sessions survive)."""
         key, pooled = self._prefixes.popitem(last=False)
         self.metrics.count("evictions")
+        if pooled.table is not None and self._pager is not None:
+            freed = self._pager.free_table(pooled.table)
+        else:
+            freed = pooled.nbytes
         self._emit(EventKind.SERVE_EVICT, prefix=key.hex()[:16],
-                   reason=reason,
-                   idle_s=round(time.monotonic() - pooled.last_used, 3))
+                   session=None, reason=reason,
+                   idle_s=round(time.monotonic() - pooled.last_used, 3),
+                   bytes=freed)
 
     def _sweep_prefixes(self) -> None:
+        """TTL sweep — runs from the scheduler tick path every loop
+        iteration (idle gateways included), so pooled HBM and parked
+        host memory are released without waiting for the next admission."""
         ttl = self.config.prefix_ttl_s
         now = time.monotonic()
         with self._cond:
@@ -428,6 +574,8 @@ class ServingGateway:
             for k in stale:
                 self._prefixes.move_to_end(k, last=False)
                 self._evict_prefix(reason="ttl")
+        if self._pager is not None:
+            self._pager.sweep(now)
 
     def _decode_tick(self) -> None:
         fault_injection.fire("serve.decode_tick", tick=self._ticks,
@@ -450,6 +598,11 @@ class ServingGateway:
             tok = int(tokens[row])
             req.out.append(tok)
             h.tokens_out = len(req.out)
+            if req.session_id is not None and self._pager is not None:
+                # frontier-crossing block accounting: the token just
+                # decoded wrote KV at frontier+len(out)-1 — allocate the
+                # block covering it before the row can retire
+                self._pager.on_tick(row, req.frontier + len(req.out))
             if h.t_first_token is None:
                 h.t_first_token = now
                 self.metrics.record_ttft(h.ttft_s)
@@ -477,6 +630,11 @@ class ServingGateway:
     def _finish_row(self, row: int, req: ServeRequest, state: str,
                     error: Optional[Exception] = None) -> None:
         h = req.handle
+        if state == RequestState.DONE and req.session_id is not None \
+                and self._pager is not None:
+            # retire BEFORE the slot frees: the row's KV must be
+            # scattered/parked while no new tenant can overwrite it
+            self._retire_session(row, req)
         with self._cond:
             self._release_row(row)
             self._cond.notify_all()
@@ -503,3 +661,43 @@ class ServingGateway:
         else:
             self.metrics.count("failed")
             h._finish(state, error=error)
+
+    def _retire_session(self, row: int, req: ServeRequest) -> None:
+        """Keep a finished conversation's KV for the follow-up turn:
+        scatter into pool blocks, or park to host when the pool can't
+        hold it.  Failure costs only the retention — the reply already
+        belongs to the caller."""
+        full = np.concatenate([np.asarray(req.tokens, np.int32),
+                               np.asarray(req.out, np.int32)])
+        with self.tracer.span(SpanName.SERVE_PARK, slot=row,
+                              session=req.session_id,
+                              tokens=int(full.shape[0])):
+            try:
+                self._pager.retire(row, full)
+            except Exception as e:
+                logger.warning(
+                    f"[serving] retiring session {req.session_id!r} "
+                    f"failed ({e}); its next turn re-prefills")
+                self._pager.row_released(row)
+        self._push_tier_gauges()
+
+    def _push_tier_gauges(self) -> None:
+        """Refresh the tiering gauges after any tier change: held
+        conversations (decoding + pooled + parked), pool occupancy, and
+        the headline serving-HBM-per-conversation number."""
+        p = self._pager
+        if p is None:
+            return
+        st = p.stats()
+        convs = p.conversations()
+        with self._cond:
+            convs += sum(1 for r in self._active.values()
+                         if r.session_id is None)
+        m = self.metrics
+        m.set_value("concurrent_conversations", convs)
+        m.set_max("peak_concurrent_conversations", convs)
+        m.set_value("pool_blocks_used", st["pool_blocks_used"])
+        m.set_value("park_bytes", st["park_bytes"])
+        m.set_value("serving_hbm_bytes", p.hbm_bytes())
+        m.set_value("hbm_bytes_per_conversation",
+                    p.hbm_bytes() / max(1, convs))

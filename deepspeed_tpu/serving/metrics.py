@@ -46,19 +46,49 @@ class ServingMetrics:
         #: sanctioned device→host pulls on the tick loop (noted by the
         #: batcher's registry; ~1 per tick is the design)
         self.host_syncs = 0
+        # ---- paged KV / session tiering (serving/paging.py) ----
+        #: sessions parked to a host tier (RAM or disk)
+        self.parked = 0
+        #: follow-up turns served from a tier copy (no re-prefill)
+        self.readmits = 0
+        #: follow-up turns that fell back to a full re-prefill
+        self.readmit_misses = 0
+        #: pool-pressure evictions (warm tier → host park)
+        self.pool_evictions = 0
+        #: RAM-park capacity spills to the disk tier
+        self.park_spills = 0
+        #: parked sessions dropped (capacity without disk, TTL, corrupt)
+        self.park_drops = 0
+        self.pages_allocated = 0
+        self.pages_freed = 0
+        #: gauges pushed by the gateway after tier changes
+        self.hbm_bytes_per_conversation = 0.0
+        self.concurrent_conversations = 0
+        self.peak_concurrent_conversations = 0
+        self.serving_hbm_bytes = 0
+        self.pool_blocks_used = 0
+        self.park_bytes = 0
         #: time-to-first-token, seconds — the shared telemetry histogram
         #: (count/sum exact, reservoir bounded at :data:`_TTFT_CAP`)
         self.ttft = Histogram(MetricName.SERVE_TTFT_S, cap=_TTFT_CAP)
+        #: re-admission wall seconds (tier read + remainder prefill) —
+        #: the number the bench gates against re-prefill latency
+        self.readmit = Histogram(MetricName.SERVE_READMIT_S, cap=_TTFT_CAP)
 
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
 
-    def set_value(self, field: str, value: int) -> None:
+    def set_value(self, field: str, value) -> None:
         """Absolute update for gauge-style counters fed from an external
         monotonic source (the CompileWatch host-sync totals)."""
         with self._lock:
             setattr(self, field, value)
+
+    def set_max(self, field: str, value) -> None:
+        """High-water-mark update (peak concurrent conversations)."""
+        with self._lock:
+            setattr(self, field, max(getattr(self, field), value))
 
     def record_tick(self, active: int, slots: int, tokens: int) -> None:
         with self._lock:
@@ -69,6 +99,9 @@ class ServingMetrics:
 
     def record_ttft(self, seconds: float) -> None:
         self.ttft.observe(float(seconds))
+
+    def record_readmit(self, seconds: float) -> None:
+        self.readmit.observe(float(seconds))
 
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict:
         """One coherent view: counters, slot occupancy, tokens/sec over
@@ -90,12 +123,29 @@ class ServingMetrics:
                 "tokens_out": self.tokens_out,
                 "recompiles": self.recompiles,
                 "host_syncs": self.host_syncs,
+                "parked": self.parked,
+                "readmits": self.readmits,
+                "readmit_misses": self.readmit_misses,
+                "pool_evictions": self.pool_evictions,
+                "park_spills": self.park_spills,
+                "park_drops": self.park_drops,
+                "pages_allocated": self.pages_allocated,
+                "pages_freed": self.pages_freed,
+                "hbm_bytes_per_conversation":
+                    self.hbm_bytes_per_conversation,
+                "concurrent_conversations": self.concurrent_conversations,
+                "peak_concurrent_conversations":
+                    self.peak_concurrent_conversations,
+                "serving_hbm_bytes": self.serving_hbm_bytes,
+                "pool_blocks_used": self.pool_blocks_used,
+                "park_bytes": self.park_bytes,
                 "elapsed_s": elapsed,
                 "tokens_per_s": self.tokens_out / elapsed,
                 "slot_occupancy": (self.active_slot_ticks / self.slot_ticks
                                    if self.slot_ticks else 0.0),
             }
         snap["ttft_s"] = self.ttft.values()
+        snap["readmit_s"] = self.readmit.values()
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
         return snap

@@ -142,6 +142,13 @@ class ServeRequest:
     temperature: float
     eos_token_id: Optional[int]
     handle: RequestHandle
+    #: session-tiering identity: finished conversations with a session_id
+    #: keep their KV (pool → host RAM → disk) and follow-up turns
+    #: re-admit it instead of re-prefilling (requires serving.paging)
+    session_id: Optional[str] = None
+    #: prompt frontier stamped at admission (prompt length in the slot) —
+    #: the scheduler derives the row's live length as frontier + len(out)
+    frontier: int = 0
     out: list = dataclasses.field(default_factory=list)
 
     @property

@@ -76,6 +76,12 @@ class MetricName:
     SERVE_TTFT_S = "serve.ttft_s"
     #: decode tokens emitted per second over the gateway lifetime
     SERVE_TOKENS_PER_S = "serve.tokens_per_s"
+    #: serving HBM footprint (slot cache + block pool) per concurrently
+    #: held conversation (decoding + pooled + parked) — the paged-KV
+    #: capacity lever the serve bench gates
+    SERVE_HBM_BYTES_PER_CONVERSATION = "serve.hbm_bytes_per_conversation"
+    #: histogram of re-admission wall seconds for parked sessions
+    SERVE_READMIT_S = "serve.readmit_s"
     #: cumulative bytes the explicit grad-reduce collectives WOULD have
     #: moved at full precision (fp32 payload, both directions)
     COMM_LOGICAL_BYTES = "comm.logical_bytes"

@@ -81,6 +81,12 @@ class SpanName:
     SERVE_ADMIT = "serve.admit"
     #: chunked prefill of a prompt/prefix through the fixed-width programs
     SERVE_PREFILL = "serve.prefill"
+    #: restoring a tiered session's KV for a follow-up turn (gather or
+    #: host rehydrate + remainder prefill)
+    SERVE_READMIT = "serve.readmit"
+    #: retiring a finished session's KV out of its slot (pool scatter or
+    #: host park)
+    SERVE_PARK = "serve.park"
 
 
 #: every registered span name, as a frozenset of strings

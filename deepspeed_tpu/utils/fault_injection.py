@@ -105,6 +105,8 @@ FAULT_POINTS = frozenset({
     "serve.request",
     "serve.admit",
     "serve.decode_tick",
+    "serve.park",
+    "serve.readmit",
 })
 
 # points with faults installed; guarded by _lock for install/clear, read

@@ -82,9 +82,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         by = {}
         for e in serve:
             by[e["kind"]] = by.get(e["kind"], 0) + 1
-        print("serving: " + "  ".join(
-            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by)),
-            file=sys.stderr)
+        line = "serving: " + "  ".join(
+            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by))
+        # tiering byte totals: what parking moved to host and what the
+        # re-admit hit rate was (park/readmit/page_* counts are above)
+        parked = sum(e.get("bytes", 0) or 0 for e in serve
+                     if e["kind"] == "serve.park")
+        if parked:
+            line += f"  parked_bytes={parked}"
+        readmits = [e for e in serve if e["kind"] == "serve.readmit"]
+        hits = sum(1 for e in readmits if e.get("hit"))
+        if readmits:
+            line += f"  readmit_hit_rate={hits}/{len(readmits)}"
+        print(line, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:
         by = {}

@@ -169,12 +169,27 @@ _COMM = [
     ("comm-all", {}, _COMM_BENCH),
 ]
 
+# serving rows (CPU fixture — serve_bench drives a tiny random-init GPT,
+# so these run anywhere): the fixed-slot single-turn baseline, the paged
+# long-tail + multi-turn tiering gate run, and a wider-slot variant.
+# serve_bench owns the gates; the sweep records the trajectory.
+_SERVE_BENCH = ["scripts/serve_bench.py", "--print-json",
+                "--out", "/tmp/BENCH_SERVE_sweep.json"]
+_SERVE = [
+    ("serve-fixed-slots", {"JAX_PLATFORMS": "cpu"},
+     _SERVE_BENCH + ["--turns", "1"]),
+    ("serve-paged-longtail", {"JAX_PLATFORMS": "cpu"}, _SERVE_BENCH),
+    ("serve-paged-8slots", {"JAX_PLATFORMS": "cpu"},
+     _SERVE_BENCH + ["--slots", "8", "--conversations", "24"]),
+]
+
 CONFIG_SETS = {
     "full": _FULL,
     "remat": _REMAT,
     "round5": _ROUND5,
     "short": _SHORT,
     "comm": _COMM,
+    "serve": _SERVE,
 }
 
 RUN_TIMEOUT_S = 1200
@@ -250,8 +265,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     configs = CONFIG_SETS[args.config_set]
     path = args.logfile or f"/tmp/mfu_sweep_{args.config_set}.jsonl"
-    # the comm set runs the CPU collective fixture — no TPU tunnel needed
-    if args.config_set != "comm" and not preflight() \
+    # the comm/serve sets run CPU fixtures — no TPU tunnel needed
+    if args.config_set not in ("comm", "serve") and not preflight() \
             and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
         sys.exit(1)
     with open(path, "a") as log:

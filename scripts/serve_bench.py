@@ -7,12 +7,28 @@ seeded Poisson arrival process and mixed prompt/reply lengths, then writes
 reject/timeout counts — so serving perf is a tracked per-PR trajectory
 like ``bench_artifacts/`` (schema: ``docs/serving.md``).
 
+A second phase benchmarks paged KV + session tiering on a **long-tail**
+conversation-length mix with **multi-turn** traffic (follow-up after
+park): the same seeded conversations run once through a paged gateway
+(follow-ups re-admit parked KV) and once through a re-prefill control
+(paging with no retention capacity, so every follow-up pays the full
+prefill).  ``BENCH_SERVE.json`` gains and GATES:
+
+- ``hbm_bytes_per_concurrent_conversation`` — (slot cache + block pool)
+  ÷ peak concurrently-held conversations; must beat the fixed-slot
+  ``cache_bytes / slots`` floor, and peak held conversations must
+  strictly exceed ``slots``;
+- ``readmit_p50_ms`` / ``readmit_p99_ms`` vs ``reprefill_p50_ms`` —
+  re-admission must be faster than re-prefilling the conversation.
+
 Usage:
     python scripts/serve_bench.py [--slots 4] [--requests 32] [--rate 20]
                                   [--seed 0] [--out BENCH_SERVE.json]
+                                  [--conversations 16] [--turns 2]
+                                  [--print-json]
 
-Exit codes: 0 bench completed; 1 any request failed/was rejected
-unexpectedly (rejections are expected only when --queue-capacity binds).
+Exit codes: 0 bench completed + gates hold; 1 any request failed/was
+rejected unexpectedly, a recompile was observed, or a tiering gate broke.
 """
 
 from __future__ import annotations
@@ -21,6 +37,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 from typing import List, Optional
 
@@ -40,6 +57,162 @@ def build_engine(n_layer: int, d_model: int, n_head: int, max_seq_len: int):
     params = gpt.init(cfg, jax.random.PRNGKey(0))
     return deepspeed_tpu.init_inference(model=(cfg, params),
                                         config={"dtype": "float32"})
+
+
+def _longtail_lengths(rng, n, lo, hi):
+    """Heavy-tailed conversation lengths: most chats are short, a few
+    are near the cap — the mix where per-slot ``max_len`` stranding
+    hurts most."""
+    raw = np.exp(rng.normal(np.log(max(lo * 2, 12)), 0.7, size=n))
+    return np.clip(raw.astype(np.int64), lo, hi).astype(np.int64)
+
+
+def _percentiles_ms(samples) -> dict:
+    arr = np.asarray(samples if len(samples) else [0.0], np.float64)
+    return {"p50": round(float(np.percentile(arr, 50)), 3),
+            "p99": round(float(np.percentile(arr, 99)), 3)}
+
+
+def run_tiering_phase(engine, args, retain: bool) -> dict:
+    """One multi-turn long-tail pass.  ``retain=True`` runs the real
+    paged/tiering config (follow-ups re-admit); ``retain=False`` is the
+    re-prefill control: the same machinery with zero retention capacity,
+    so every follow-up journals a ``serve.readmit`` MISS whose
+    ``readmit_ms`` is the honest full-re-prefill admission cost."""
+    from deepspeed_tpu.runtime.supervision.events import (EventJournal,
+                                                          read_events)
+    paging = {"enabled": True, "block_tokens": args.block_tokens}
+    if retain:
+        # size the warm tier for the working set (half the conversations'
+        # full-slot worth — long-tail means most use far fewer blocks);
+        # overflow still exercises the host park tiers
+        paging["pool_blocks"] = (args.conversations *
+                                 (args.tier_max_len // args.block_tokens)
+                                 ) // 2
+    else:
+        paging.update(pool_blocks=1, park_capacity=0)
+    jpath = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"),
+                         "events.jsonl")
+    gw = engine.serve(config={
+        "slots": args.slots, "max_len": args.tier_max_len,
+        "prefill_chunk": args.prefill_chunk,
+        "queue_capacity": args.queue_capacity,
+    } | {"paging": paging}, journal=EventJournal(jpath))
+    rng = np.random.default_rng(args.seed)   # same workload both passes
+    C, T = args.conversations, args.turns
+    # conversation histories long enough that re-prefilling them is the
+    # real cost re-admission avoids (the fixed-slot pain case)
+    plens = _longtail_lengths(rng, C, args.tier_min_prompt,
+                              args.tier_max_prompt)
+    convs = [{"sid": f"conv-{i}", "history": rng.integers(
+        0, 256, (int(plens[i]),)).astype(np.int32)} for i in range(C)]
+    # warmup conversation: pays the one-time program compiles
+    # (page_gather/scatter on the paged pass) OUTSIDE the timed window
+    warm = np.arange(int(plens[0]), dtype=np.int32) % 256
+    for _ in range(2):
+        out = gw.submit(warm, max_new_tokens=4,
+                        session_id="warmup").result(timeout=args.timeout_s)
+        warm = np.concatenate([warm, out,
+                               np.zeros((4,), np.int32)])
+    failed = 0
+    t0 = time.monotonic()
+    for turn in range(T):
+        gaps = rng.exponential(1.0 / args.rate, size=C)
+        handles = []
+        for i, c in enumerate(convs):
+            time.sleep(float(gaps[i]))
+            n_new = int(rng.integers(args.min_new, args.max_new + 1))
+            handles.append((c, n_new,
+                            gw.submit(c["history"], max_new_tokens=n_new,
+                                      session_id=c["sid"])))
+        for c, n_new, h in handles:
+            try:
+                out = h.result(timeout=args.timeout_s)
+                follow = rng.integers(0, 256, (int(rng.integers(
+                    3, 9)),)).astype(np.int32)
+                c["history"] = np.concatenate([c["history"], out, follow])
+            except Exception as e:
+                print(f"  tiering {c['sid']} turn {turn} failed: {e}",
+                      file=sys.stderr)
+                failed += 1
+    wall = time.monotonic() - t0
+    snap = gw.snapshot()
+    gw.shutdown()
+    # follow-up admission latencies from the journal: per session, every
+    # serve.readmit AFTER its first is a follow-up turn (hit: tier
+    # restore + remainder prefill; miss: full re-prefill)
+    seen, follow_hit, follow_miss = set(), [], []
+    for e in read_events(jpath, kind="serve.readmit"):
+        if e["session"] == "warmup":
+            continue
+        if e["session"] not in seen:
+            seen.add(e["session"])
+            continue
+        (follow_hit if e["hit"] else follow_miss).append(e["readmit_ms"])
+    pool_bytes = snap["paging"]["pool_bytes"]
+    slot_bytes = snap["serving_hbm_bytes"] - pool_bytes
+    peak = snap["peak_concurrent_conversations"]
+    return {
+        "retain": retain, "wall_s": round(wall, 3), "failed": failed,
+        "completed": snap["completed"], "readmits": snap["readmits"],
+        "readmit_misses": snap["readmit_misses"],
+        "parked": snap["parked"], "park_spills": snap["park_spills"],
+        "pool_evictions": snap["pool_evictions"],
+        "recompiles": snap["recompiles"],
+        "peak_concurrent_conversations": peak,
+        "slot_cache_bytes": slot_bytes, "pool_bytes": pool_bytes,
+        "hbm_bytes_per_concurrent_conversation": round(
+            (slot_bytes + pool_bytes) / max(1, peak), 1),
+        "follow_up_hit_ms": follow_hit, "follow_up_miss_ms": follow_miss,
+    }
+
+
+def run_tiering_bench(args) -> dict:
+    """Paged vs re-prefill control on the identical seeded long-tail
+    multi-turn workload; returns the gated comparison block."""
+    engine = build_engine(args.layers, args.d_model, args.heads,
+                          max_seq_len=args.tier_max_len)
+    paged = run_tiering_phase(engine, args, retain=True)
+    control = run_tiering_phase(engine, args, retain=False)
+    readmit = _percentiles_ms(paged["follow_up_hit_ms"])
+    reprefill = _percentiles_ms(control["follow_up_miss_ms"])
+    fixed_floor = round(paged["slot_cache_bytes"] / max(1, args.slots), 1)
+    result = {
+        "config": {"conversations": args.conversations,
+                   "turns": args.turns,
+                   "block_tokens": args.block_tokens,
+                   "traffic": "longtail"},
+        "paged": {k: v for k, v in paged.items()
+                  if not k.startswith("follow_up")},
+        "control": {k: v for k, v in control.items()
+                    if not k.startswith("follow_up")},
+        "hbm_bytes_per_concurrent_conversation":
+            paged["hbm_bytes_per_concurrent_conversation"],
+        "hbm_bytes_per_conversation_fixed_slots": fixed_floor,
+        "readmit_p50_ms": readmit["p50"], "readmit_p99_ms": readmit["p99"],
+        "reprefill_p50_ms": reprefill["p50"],
+        "reprefill_p99_ms": reprefill["p99"],
+    }
+    gates = {
+        # tiering holds strictly more conversations than the slot cap
+        "more_conversations_than_slots":
+            paged["peak_concurrent_conversations"] > args.slots,
+        # and pays less HBM per held conversation than fixed slots
+        "hbm_per_conversation_beats_fixed":
+            result["hbm_bytes_per_concurrent_conversation"] < fixed_floor,
+        # re-admission must beat re-prefilling the whole conversation
+        "readmit_faster_than_reprefill":
+            readmit["p50"] < reprefill["p50"],
+        "no_failures": paged["failed"] == 0 and control["failed"] == 0,
+        "no_recompiles": paged["recompiles"] == 0
+            and control["recompiles"] == 0,
+        # every measured follow-up re-admitted (+ the warmup session's)
+        "all_followups_readmitted":
+            paged["readmits"] >= args.conversations * (args.turns - 1),
+    }
+    result["gates"] = gates
+    result["gates_ok"] = all(gates.values())
+    return result
 
 
 def run_bench(args) -> dict:
@@ -118,6 +291,8 @@ def run_bench(args) -> dict:
         "metrics": {k: v for k, v in snap.items()
                     if isinstance(v, (int, float))},
     }
+    if args.turns > 1:
+        result["tiering"] = run_tiering_bench(args)
     return result
 
 
@@ -142,6 +317,21 @@ def main(argv=None) -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--conversations", type=int, default=16,
+                    help="long-tail multi-turn conversations in the "
+                         "tiering phase")
+    ap.add_argument("--turns", type=int, default=2,
+                    help="turns per conversation (1 disables the "
+                         "tiering phase)")
+    ap.add_argument("--block-tokens", type=int, default=16)
+    ap.add_argument("--tier-max-len", type=int, default=256,
+                    help="slot length of the tiering phase (long "
+                         "conversations are where re-prefill hurts)")
+    ap.add_argument("--tier-min-prompt", type=int, default=16)
+    ap.add_argument("--tier-max-prompt", type=int, default=160)
+    ap.add_argument("--print-json", action="store_true",
+                    help="print the result as one JSON line on stdout "
+                         "(mfu_sweep row protocol)")
     ap.add_argument("--out", default="BENCH_SERVE.json")
     args = ap.parse_args(argv)
 
@@ -160,7 +350,26 @@ def main(argv=None) -> int:
           f"rejected {result['rejected']}")
     print(f"  recompiles  {result['recompiles']}   "
           f"host_syncs {result['host_syncs']}")
-    return 1 if result["failed"] or result["recompiles"] else 0
+    tier_ok = True
+    tier = result.get("tiering")
+    if tier is not None:
+        print(f"  tiering     conversations "
+              f"{tier['paged']['peak_concurrent_conversations']} held on "
+              f"{args.slots} slots")
+        print(f"              hbm/conv {tier['hbm_bytes_per_concurrent_conversation']} B "
+              f"(fixed-slot floor "
+              f"{tier['hbm_bytes_per_conversation_fixed_slots']} B)")
+        print(f"              readmit p50 {tier['readmit_p50_ms']} ms  "
+              f"p99 {tier['readmit_p99_ms']} ms   vs re-prefill p50 "
+              f"{tier['reprefill_p50_ms']} ms")
+        if not tier["gates_ok"]:
+            bad = [k for k, v in tier["gates"].items() if not v]
+            print(f"  TIERING GATE FAILED: {bad}", file=sys.stderr)
+            tier_ok = False
+    if args.print_json:
+        print(json.dumps(result))
+    return 1 if result["failed"] or result["recompiles"] \
+        or not tier_ok else 0
 
 
 if __name__ == "__main__":

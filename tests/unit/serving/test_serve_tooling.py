@@ -26,6 +26,7 @@ def test_serve_bench_writes_artifact(tmp_path, capsys):
     rc = serve_bench.main([
         "--requests", "5", "--rate", "50", "--slots", "2",
         "--max-len", "64", "--max-prompt", "16", "--max-new", "8",
+        "--turns", "1",          # tiering phase has its own test below
         "--out", str(out)])
     assert rc == 0
     data = json.loads(out.read_text())
@@ -34,7 +35,39 @@ def test_serve_bench_writes_artifact(tmp_path, capsys):
         assert key in data, key
     assert data["completed"] == 5 and data["failed"] == 0
     assert data["throughput_tok_s"] > 0
+    assert "tiering" not in data
     assert "throughput" in capsys.readouterr().out
+
+
+def test_serve_bench_tiering_block(tmp_path, capsys):
+    """The multi-turn long-tail phase records the paged-vs-control
+    comparison and its DETERMINISTIC gates hold (the readmit-vs-reprefill
+    latency gate is wall-clock — gated at bench time, not under test-suite
+    CPU contention)."""
+    serve_bench = _load("serve_bench")
+    out = tmp_path / "BENCH_SERVE.json"
+    serve_bench.main([
+        "--requests", "2", "--rate", "50", "--slots", "2",
+        "--max-len", "64", "--max-prompt", "16", "--max-new", "8",
+        "--conversations", "4", "--turns", "2",
+        "--tier-max-len", "128", "--tier-min-prompt", "8",
+        "--tier-max-prompt", "48", "--min-new", "3",
+        "--out", str(out)])
+    tier = json.loads(out.read_text())["tiering"]
+    for key in ("hbm_bytes_per_concurrent_conversation",
+                "hbm_bytes_per_conversation_fixed_slots",
+                "readmit_p50_ms", "readmit_p99_ms", "reprefill_p50_ms",
+                "paged", "control", "gates"):
+        assert key in tier, key
+    g = tier["gates"]
+    assert g["more_conversations_than_slots"]
+    assert g["hbm_per_conversation_beats_fixed"]
+    assert g["all_followups_readmitted"]
+    assert g["no_failures"] and g["no_recompiles"]
+    assert tier["paged"]["readmits"] >= 4
+    assert tier["control"]["readmits"] == 0
+    assert tier["readmit_p50_ms"] > 0 and tier["reprefill_p50_ms"] > 0
+    capsys.readouterr()
 
 
 def test_dump_run_events_renders_serve_kinds(tmp_path, capsys):

@@ -27,6 +27,9 @@ SCOPES = (
     # the engine writes into the checkpoint dir too (recovery script,
     # per-rank shard files) — those writes race N ranks on shared storage
     "deepspeed_tpu/runtime/engine.py",
+    # the serving pager's disk-park path persists session KV a follow-up
+    # turn will trust — a torn park file must never be readable as valid
+    "deepspeed_tpu/serving/paging.py",
 )
 
 EXEMPT_FUNCS = {"write_tmp", "_atomic_attempt"}
