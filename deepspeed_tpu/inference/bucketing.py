@@ -25,6 +25,7 @@ BUCKETING_HELPERS = (
     "bucket_max_new_tokens",
     "bucket_cache_len",
     "tile_cache_len",
+    "bucket_draft_k",
 )
 
 
@@ -56,6 +57,18 @@ def bucket_cache_len(n: int, cap: int) -> int:
     if n < 1:
         raise ValueError(f"cache length must be >= 1, got {n}")
     return min(max(next_pow2(n), MIN_BUCKET), int(cap))
+
+
+def bucket_draft_k(k: int, cap: int) -> int:
+    """Round a speculative draft depth so the ``k + 1``-token verify
+    window is a power of two (1→1, 2→3, 3→3, 4→7, …): the verify
+    ``extend`` then shares the chunk kernel's tiling family instead of
+    compiling a bespoke odd-width program per deployment.  Clamped so the
+    window never exceeds ``cap`` positions (the slot overshoot budget)."""
+    if k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {k}")
+    b = next_pow2(int(k) + 1) - 1
+    return max(1, min(b, int(cap) - 1))
 
 
 def tile_cache_len(max_len: int, cap: int) -> int:

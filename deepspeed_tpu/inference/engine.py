@@ -369,16 +369,21 @@ class InferenceEngine:
     # -------------------------------------------------------------- serving
 
     def serve(self, config=None, journal=None, autostart: bool = True,
-              tracer=None):
+              tracer=None, draft=None):
         """A continuous-batching serving gateway over this engine: an
         async request scheduler packing heterogeneous prompts into one
         fixed-geometry ragged-decode slot batch (``serving/``).  ``config``
         is a :class:`~deepspeed_tpu.serving.ServingConfig` or its dict;
         ``journal`` an optional supervision ``EventJournal``; ``tracer``
-        an optional telemetry ``Tracer`` recording the serve.* spans."""
+        an optional telemetry ``Tracer`` recording the serve.* spans.
+        ``draft`` (with ``serving.speculative.enabled``) is the proposal
+        model for speculative tick rounds — a ``(gpt.GPTConfig, params)``
+        tuple or a dense GPT-family :class:`InferenceEngine` sharing this
+        engine's vocabulary; see ``docs/serving.md`` "Speculative tick"."""
         from ..serving import ServingGateway
         return ServingGateway(self, config=config, journal=journal,
-                              autostart=autostart, tracer=tracer)
+                              autostart=autostart, tracer=tracer,
+                              draft=draft)
 
     def _session_programs(self):
         """Jitted prefill/extend/decode shared by ALL of this engine's

@@ -37,6 +37,42 @@ from ..models import gpt, gpt_inference
 
 PyTree = Any
 
+# Per-slot RNG discipline for BATCHED speculation (the serving tick).
+# Each slot's round key splits off its per-tick key chain (the PR 6
+# fold_in contract); the draft steps and the accept/resample draw then
+# fold DISJOINT domain constants into that round key, so the uniforms
+# the rejection rule compares against are independent of the draws that
+# produced the proposals — reusing one stream would correlate u with
+# the draft sample and break the exactness theorem.
+SPEC_DRAFT_DOMAIN = 0x5D000000   # + step index j, draft proposal stream
+SPEC_ACCEPT_DOMAIN = 0x5A000000  # accept/resample stream
+
+
+def spec_draft_keys(round_keys: jax.Array, j) -> jax.Array:
+    """Per-slot draft-step keys: fold step ``j`` into the ``[B, 2]`` round
+    keys under the draft domain (``j`` may be traced — scan index)."""
+    return jax.vmap(jax.random.fold_in,
+                    in_axes=(0, None))(round_keys, SPEC_DRAFT_DOMAIN + j)
+
+
+def spec_accept_keys(round_keys: jax.Array) -> jax.Array:
+    """Per-slot accept/resample keys for the same round — a fold-in
+    sequence disjoint from every :func:`spec_draft_keys` stream."""
+    return jax.vmap(jax.random.fold_in,
+                    in_axes=(0, None))(round_keys, SPEC_ACCEPT_DOMAIN)
+
+
+def spec_accept_batch(keys: jax.Array, d_tokens: jnp.ndarray,
+                      d_probs: jnp.ndarray, t_probs: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched :func:`spec_accept`: one independent rejection rule per
+    slot.  ``keys [B, 2]`` (from :func:`spec_accept_keys`), ``d_tokens
+    [B, K]``, ``d_probs [B, K, V]``, ``t_probs [B, K+1, V]`` →
+    ``(a [B], next_token [B])``.  Each row's emitted marginal equals
+    sampling from ITS target distribution — the distributional unit test
+    checks rows with different distributions simultaneously."""
+    return jax.vmap(spec_accept)(keys, d_tokens, d_probs, t_probs)
+
 
 def spec_accept(key: jax.Array, d_tokens: jnp.ndarray, d_probs: jnp.ndarray,
                 t_probs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -74,6 +74,7 @@ class EventKind:
     SERVE_DONE = "serve.done"
     SERVE_EVICT = "serve.evict"
     SERVE_TICK = "serve.tick"
+    SERVE_SPEC_ROUND = "serve.spec_round"
     SERVE_PARK = "serve.park"
     SERVE_READMIT = "serve.readmit"
     SERVE_PAGE_ALLOC = "serve.page_alloc"
@@ -152,6 +153,8 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_EVICT: ("prefix", "session", "reason", "idle_s",
                             "bytes"),
     EventKind.SERVE_TICK: ("tick", "active", "queue_depth", "tok_per_s"),
+    EventKind.SERVE_SPEC_ROUND: ("tick", "active", "draft_k", "accepted",
+                                 "emitted", "accept_rate"),
     EventKind.SERVE_PARK: ("session", "tokens", "blocks", "bytes", "tier"),
     EventKind.SERVE_READMIT: ("session", "tokens_reused", "tokens_new",
                               "tier", "readmit_ms", "hit"),

@@ -28,7 +28,8 @@ directly.  Reference: ``docs/serving.md``.
 """
 
 from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
-from .config import SERVING, PagingConfig, ServingConfig  # noqa: F401
+from .config import (SERVING, PagingConfig, ServingConfig,  # noqa: F401
+                     SpeculativeConfig)
 from .gateway import ServingGateway  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .paging import (BlockAllocator, PagedKVPool, ParkCorruptError,  # noqa: F401
@@ -37,7 +38,8 @@ from .request import (QueueFullError, RequestCancelled, RequestFailed,  # noqa: 
                       RequestHandle, RequestState, RequestTimedOut)
 
 __all__ = [
-    "SERVING", "ServingConfig", "PagingConfig", "ServingGateway",
+    "SERVING", "ServingConfig", "PagingConfig", "SpeculativeConfig",
+    "ServingGateway",
     "ServingMetrics", "SlotBatcher", "PrefixEntry", "RequestHandle",
     "RequestState", "QueueFullError", "RequestCancelled", "RequestFailed",
     "RequestTimedOut", "BlockAllocator", "PagedKVPool", "ParkStore",

@@ -16,6 +16,23 @@ on a request:
   ragged ``decode_step`` (per-slot frontiers, per-slot RNG keys, per-slot
   greedy/temperature — all traced operands of one compiled program).
 
+With ``serving.speculative`` enabled the tick loop runs BATCHED
+draft/verify rounds instead (``docs/serving.md`` "Speculative tick"): a
+second fixed-geometry slot cache holds a small dense draft model's K/V,
+admitted and released in lockstep with the target.  Each round the draft
+proposes ``draft_k`` tokens per slot (ragged ``decode_step`` scan), ONE
+ragged target ``extend`` verifies all slots' windows at their own
+frontiers, and the per-slot accept counts advance frontiers by
+1..draft_k+1 tokens — rejected positions roll back by the scalar-length
+reset (pad K/V beyond the frontier stays masked and is overwritten by
+the next round's window).  Greedy slots emit the target's own argmax
+chain bit for bit; sampled slots ride the :func:`~deepspeed_tpu.
+inference.speculative.spec_accept` rejection rule, exact against the
+target distribution.  The extra programs (``draft_step``,
+``verify_extend``, ``spec_accept``, the draft admission set) register in
+the same :class:`CompiledProgramRegistry`, so the zero-steady-state-
+recompile contract covers speculation too.
+
 After the first request of each shape class warms the programs up, the
 batcher never compiles again: :meth:`compile_counts` exposes the jit cache
 sizes so tests can assert exactly that.
@@ -32,8 +49,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..inference.bucketing import bucket_cache_len
+from ..inference.bucketing import bucket_cache_len, bucket_draft_k
 from ..inference.sampling import filter_logits
+from ..inference.speculative import (spec_accept_batch, spec_accept_keys,
+                                     spec_draft_keys)
 from ..telemetry.spans import SpanName, Tracer
 from ..utils.compile_watch import CompiledProgramRegistry, hot_path
 from .config import ServingConfig
@@ -53,7 +72,7 @@ class SlotBatcher:
     """Continuous batching over ``config.slots`` decode slots."""
 
     def __init__(self, engine, config: ServingConfig,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None, draft=None):
         #: telemetry tracer shared with the owning gateway (disabled
         #: no-op when serving runs without telemetry)
         self.tracer = tracer if tracer is not None else Tracer(
@@ -78,10 +97,88 @@ class SlotBatcher:
         self.temp = jnp.ones((B,), jnp.float32)
         self.active = jnp.zeros((B,), bool)
         self._last = None          # [B, padded_vocab], set on first admit
+        #: speculative tick state (None/0 fields when speculation is off)
+        self.spec = bool(config.speculative_config.enabled)
+        self.draft_k = 0
+        self._dcfg = None
+        self._dparams = None
+        self.draft_cache = None
+        #: per-slot PENDING token: sampled from the frontier logits but
+        #: not yet cache-written — each spec round emits
+        #: ``[cur, accepted drafts]`` and the accept rule's resample or
+        #: bonus token becomes the next ``cur``
+        self.cur = None
+        if self.spec:
+            self._init_draft(config, draft)
+            self.draft_cache = self._dfam.init_cache(self._dcfg, B,
+                                                     self.max_len)
+            self.cur = jnp.zeros((B,), jnp.int32)
+        #: extra slot positions a speculative round may write past the
+        #: reply budget (the gateway's admission margin)
+        self.spec_overshoot = self.draft_k if self.spec else 0
         #: every program the batcher drives, by name — the serving gate
         #: (gateway CompileWatch, compile_report.py) watches this
         self.registry = CompiledProgramRegistry("serving")
         self._build_programs(config)
+
+    def _init_draft(self, config: ServingConfig, draft) -> None:
+        """Resolve the draft model: an engine / ``(cfg, params)`` tuple
+        passed to ``serve(draft=...)``, or the config's geometry spec
+        (random-init dense GPT over the target's vocabulary — the bench
+        fixture path).  The draft must be dense GPT: its whole point is
+        being small, and the proposal loop rides ``gpt_inference``."""
+        from ..models import gpt, gpt_inference
+        from ..models.gpt_moe import GPTMoEConfig
+        from ..runtime.config import DeepSpeedConfigError
+        cfg = self._cfg
+        spec_cfg = config.speculative_config
+        if draft is None and spec_cfg.draft is None:
+            raise DeepSpeedConfigError(
+                "serving.speculative.enabled needs a draft model: pass "
+                "draft=(GPTConfig, params) / a dense InferenceEngine to "
+                "engine.serve(), or set serving.speculative.draft to a "
+                "geometry spec {n_layer, d_model, n_head[, seed]}")
+        if draft is None:
+            d = spec_cfg.draft
+            dcfg = gpt.GPTConfig(
+                vocab_size=cfg.vocab_size, max_seq_len=cfg.max_seq_len,
+                n_layer=int(d.get("n_layer", 2)),
+                n_head=int(d.get("n_head", cfg.n_head)),
+                d_model=int(d.get("d_model", max(cfg.d_model // 4,
+                                                 cfg.n_head))),
+                dtype=cfg.dtype, vocab_round_to=cfg.vocab_round_to)
+            dparams = gpt.init(dcfg, jax.random.PRNGKey(
+                int(d.get("seed", 0))))
+        elif hasattr(draft, "model_config") and hasattr(draft, "params"):
+            if draft._family is not gpt_inference:
+                raise NotImplementedError(
+                    "the serving draft must be a dense GPT-family engine")
+            dcfg, dparams = draft.model_config, draft.params
+        else:
+            dcfg, dparams = draft
+        if not isinstance(dcfg, gpt.GPTConfig) or \
+                isinstance(dcfg, GPTMoEConfig):
+            raise TypeError(
+                "serving draft must be (gpt.GPTConfig, params) or a dense "
+                f"GPT-family InferenceEngine (got config {type(dcfg)})")
+        if dcfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                "serving draft and target must share a vocabulary "
+                f"({dcfg.vocab_size} vs {cfg.vocab_size})")
+        if dcfg.max_seq_len < self.max_len:
+            raise ValueError(
+                f"serving draft max_seq_len ({dcfg.max_seq_len}) is "
+                f"smaller than the {self.max_len}-token slot")
+        # the draft computes in the target's serving dtype so one
+        # deployment has one numeric story (proposals never change the
+        # emitted distribution either way)
+        self._dcfg = dataclasses.replace(dcfg, dtype=cfg.dtype)
+        self._dparams = jax.tree_util.tree_map(
+            lambda p: p.astype(cfg.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, dparams)
+        self._dfam = gpt_inference
+        self.draft_k = bucket_draft_k(int(spec_cfg.draft_k),
+                                      cap=self.max_len)
 
     # ------------------------------------------------------------ programs
 
@@ -126,6 +223,106 @@ class SlotBatcher:
             "release": jax.jit(release),
             "tick": jax.jit(tick),
         })
+        if self.spec:
+            self._build_spec_programs(config)
+
+    def _build_spec_programs(self, config: ServingConfig) -> None:
+        """The speculative round as three chained device programs (plus
+        the draft admission mirrors of prefill/extend/write_slot and the
+        pending-token seeder) — each registered, each compiled once."""
+        fam, cfg = self._fam, self._cfg
+        dfam, dcfg = self._dfam, self._dcfg
+        top_k, top_p = int(config.top_k), float(config.top_p)
+        vocab = cfg.vocab_size
+        B, K = self.slots, self.draft_k
+        rows = jnp.arange(B)
+
+        def draft_step(dparams, dcache, cur, lengths, keys, greedy, temp):
+            """K ragged draft decodes per slot from its pending token.
+            Splits each slot's key chain once per round; the proposal
+            draws fold the draft domain + step index into the round key
+            (independent of the accept stream — see
+            ``inference/speculative.py``)."""
+            ks = jax.vmap(jax.random.split)(keys)          # [B, 2, 2]
+            next_keys, round_keys = ks[:, 0], ks[:, 1]
+
+            def dstep(carry, j):
+                tok, dc, l = carry
+                lg, dc = dfam.decode_step(dparams, tok, dcfg, dc,
+                                          lengths=l)
+                lg = lg[:, :vocab].astype(jnp.float32)
+                f = filter_logits(lg, temp[:, None], top_k=top_k,
+                                  top_p=top_p)
+                probs = jax.nn.softmax(f, -1)
+                sampled = jax.vmap(jax.random.categorical)(
+                    spec_draft_keys(round_keys, j), f)
+                nxt = jnp.where(greedy, jnp.argmax(lg, -1),
+                                sampled).astype(jnp.int32)
+                return (nxt, dc, l + 1), (nxt, probs)
+
+            (last_d, dcache, _), (drafts, d_probs) = lax.scan(
+                dstep, (cur, dcache, lengths), jnp.arange(K))
+            # feed d_K too, so the draft cache covers a full acceptance
+            _, dcache = dfam.decode_step(dparams, last_d, dcfg, dcache,
+                                         lengths=lengths + K)
+            return drafts, d_probs, dcache, next_keys, round_keys
+
+        def verify_extend(params, cache, cur, drafts, lengths):
+            """ONE ragged target pass scoring every slot's
+            ``[cur, d_1..d_K]`` window at its own frontier."""
+            window = jnp.concatenate([cur[:, None], drafts.T], axis=1)
+            vlg, cache = fam.extend(params, window, cfg, cache,
+                                    lengths=lengths)
+            return window, vlg[..., :vocab].astype(jnp.float32), cache
+
+        def spec_accept(vlg, drafts, d_probs, round_keys, cur, lengths,
+                        greedy, temp, active):
+            """Batched accept/rollback: greedy rows take the longest
+            prefix agreeing with the target argmax chain (plus the
+            target's own next token); sampled rows run the rejection
+            rule.  Frontiers advance by the accepted count + 1 — the
+            rollback IS the arithmetic (rejected K/V sits beyond the new
+            frontier, masked and overwritten next round)."""
+            g = jnp.argmax(vlg, -1).astype(jnp.int32)        # [B, K+1]
+            agree = (drafts.T == g[:, :K]).astype(jnp.int32)
+            a_g = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+            t_f = filter_logits(vlg, temp[:, None, None], top_k=top_k,
+                                top_p=top_p)
+            t_probs = jax.nn.softmax(t_f, -1)                # [B, K+1, V]
+            a_s, nxt_s = spec_accept_batch(
+                spec_accept_keys(round_keys), drafts.T,
+                jnp.swapaxes(d_probs, 0, 1), t_probs)
+            a = jnp.where(greedy, a_g, a_s)
+            nxt = jnp.where(greedy, g[rows, a_g], nxt_s).astype(jnp.int32)
+            adv = jnp.where(active, a + 1, 0).astype(jnp.int32)
+            return adv, lengths + adv, jnp.where(active, nxt, cur)
+
+        def spec_seed(cur, keys, row, vec, g, t):
+            """Seed a slot's pending token from its admission logits —
+            the same split/sample the non-speculative tick would do, so
+            the first emitted token matches it bitwise."""
+            k2 = jax.random.split(keys[row])
+            lg = vec[:vocab]
+            f = filter_logits(lg[None].astype(jnp.float32), t,
+                              top_k=top_k, top_p=top_p)
+            tok = jnp.where(g, jnp.argmax(lg, -1),
+                            jax.random.categorical(k2[1], f[0])
+                            ).astype(jnp.int32)
+            return cur.at[row].set(tok), keys.at[row].set(k2[0])
+
+        self._p_spec = self.registry.register_all({
+            "draft_prefill": jax.jit(
+                lambda p, t, c: dfam.prefill(p, t, dcfg, c)),
+            "draft_extend": jax.jit(
+                lambda p, t, c, l: dfam.extend(p, t, dcfg, c, lengths=l)),
+            "draft_write_slot": jax.jit(
+                lambda c, row, src: dfam.write_slot(c, row, src)),
+            "spec_seed": jax.jit(spec_seed),
+            "draft_step": jax.jit(draft_step),
+            "verify_extend": jax.jit(verify_extend),
+            "spec_accept": jax.jit(spec_accept),
+        })
+        self._p.update(self._p_spec)
 
     def compile_counts(self) -> Dict[str, int]:
         """Cumulative compiles per program — the no-recompile contract is
@@ -209,7 +406,41 @@ class SlotBatcher:
             self.active, row_dev, jnp.asarray(frontier, jnp.int32), vec,
             key, jnp.asarray(bool(greedy)),
             jnp.asarray(float(temperature), jnp.float32))
+        if self.spec:
+            # lockstep draft admission: the draft prefills the FULL
+            # prompt (prefix/readmit shortcuts spare only target work —
+            # the draft is small, that is its whole point) and the slot's
+            # pending token is seeded from the admission logits
+            self.draft_cache = self._p["draft_write_slot"](
+                self.draft_cache, row_dev,
+                self._draft_prefill(np.asarray(tokens)))
+            self.cur, self.keys = self._p["spec_seed"](
+                self.cur, self.keys, row_dev, vec,
+                jnp.asarray(bool(greedy)),
+                jnp.asarray(float(temperature), jnp.float32))
         return frontier
+
+    def _draft_prefill(self, tokens: np.ndarray):
+        """Chunked prefill of a prompt through the draft's fixed-width
+        programs into a fresh batch-1 slot-geometry draft cache."""
+        C = self.chunk
+        S = int(tokens.shape[0])
+        pad = (-S) % C
+        padded = np.concatenate(
+            [np.asarray(tokens, np.int32),
+             np.zeros((pad,), np.int32)]) if pad else np.asarray(
+                 tokens, np.int32)
+        cache = self._dfam.init_cache(self._dcfg, 1, self.max_len)
+        for i, ch in enumerate(padded.reshape(-1, C)):
+            dev = jnp.asarray(ch[None])
+            if i == 0:
+                _, cache = self._p["draft_prefill"](self._dparams, dev,
+                                                    cache)
+            else:
+                _, cache = self._p["draft_extend"](
+                    self._dparams, dev, cache,
+                    jnp.asarray([i * C], jnp.int32))
+        return cache
 
     def release(self, row: int) -> None:
         """Retire a slot: it stops advancing (its tick writes re-hit one
@@ -222,9 +453,14 @@ class SlotBatcher:
     @hot_path
     def tick(self) -> np.ndarray:
         """One continuous-batching decode step for every slot; returns the
-        [B] int32 tokens just emitted (junk in freed slots)."""
+        [B] int32 tokens just emitted (junk in freed slots).  With
+        speculation enabled, one draft/verify ROUND instead: returns
+        ``(window [B, draft_k+1], counts [B])`` — row ``b`` emitted
+        ``window[b, :counts[b]]`` this tick (0 in freed slots)."""
         if self._last is None:
             raise RuntimeError("tick() before any admission")
+        if self.spec:
+            return self._spec_tick()
         with self.tracer.span(SpanName.SERVE_TICK):
             nxt, logits, self.cache, self.lengths, self.keys = \
                 self._p["tick"](
@@ -236,3 +472,26 @@ class SlotBatcher:
             # the emitted tokens ARE the tick's output boundary:
             # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
             return np.asarray(nxt)
+
+    @hot_path
+    def _spec_tick(self):
+        """One speculative round for every slot: draft scan → ragged
+        verify extend → batched accept/rollback, three chained compiled
+        programs, still one host sync at the output boundary."""
+        with self.tracer.span(SpanName.SERVE_TICK):
+            with self.tracer.span(SpanName.SERVE_SPEC,
+                                  draft_k=self.draft_k):
+                drafts, d_probs, self.draft_cache, next_keys, round_keys \
+                    = self._p["draft_step"](
+                        self._dparams, self.draft_cache, self.cur,
+                        self.lengths, self.keys, self.greedy, self.temp)
+                window, vlg, self.cache = self._p["verify_extend"](
+                    self._engine.params, self.cache, self.cur, drafts,
+                    self.lengths)
+                adv, self.lengths, self.cur = self._p["spec_accept"](
+                    vlg, drafts, d_probs, round_keys, self.cur,
+                    self.lengths, self.greedy, self.temp, self.active)
+                self.keys = next_keys
+            self.registry.note_host_sync("serving.tick")
+            # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
+            return np.asarray(window), np.asarray(adv)

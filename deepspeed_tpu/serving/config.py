@@ -20,7 +20,8 @@ Same validated dataclass-model style as ``supervision/config.py``:
         "paging": {"enabled": false, "block_tokens": 16,
                    "pool_blocks": null, "park_capacity": 64,
                    "park_dir": null, "park_ttl_s": 600.0,
-                   "park_verify": true}
+                   "park_verify": true},
+        "speculative": {"enabled": false, "draft_k": 3, "draft": null}
     }}
 
 ``max_len`` is the per-slot cache length — bucketed to a power of two and
@@ -84,6 +85,62 @@ class PagingConfig(DeepSpeedConfigModel):
                 f"{self.park_ttl_s}")
 
 
+#: keys a ``"speculative"."draft"`` geometry spec may carry
+_DRAFT_SPEC_KEYS = ("n_layer", "d_model", "n_head", "seed")
+
+
+@dataclasses.dataclass
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """The ``"serving"."speculative"`` subsection: batched draft/verify
+    speculation in the continuous-batching tick loop (``docs/serving.md``
+    "Speculative tick").  Misconfiguration here raises the named
+    :class:`~deepspeed_tpu.runtime.config.DeepSpeedConfigError` — a wrong
+    draft spec must fail at config time, not as a silently slow (or
+    recompiling) gateway."""
+
+    #: switch the tick loop from one-token decode_step rounds to
+    #: draft_k-token draft/verify rounds (exact output semantics)
+    enabled: bool = False
+    #: draft proposals per round; bucketed so the k+1 verify window is a
+    #: power of two (``bucket_draft_k``)
+    draft_k: int = 3
+    #: draft-model geometry spec ``{"n_layer", "d_model", "n_head",
+    #: "seed"}`` — builds a random-init dense GPT draft over the target's
+    #: vocabulary when no trained draft is passed to ``engine.serve(
+    #: draft=...)``.  None: a draft engine/params MUST be passed.
+    draft: Optional[Dict] = None
+
+    def __post_init__(self):
+        # lazy: runtime.config imports nothing from serving/, but keep
+        # the error type importable without risking a module cycle here
+        from ..runtime.config import DeepSpeedConfigError
+        if not isinstance(self.draft_k, int) or isinstance(self.draft_k, bool) \
+                or not 1 <= self.draft_k <= 64:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.draft_k must be an int in [1, 64], "
+                f"got {self.draft_k!r}")
+        if self.draft is None:
+            return
+        if not isinstance(self.draft, dict):
+            raise DeepSpeedConfigError(
+                "serving.speculative.draft must be a dict draft-model "
+                f"spec with keys {_DRAFT_SPEC_KEYS}, got "
+                f"{type(self.draft).__name__}")
+        unknown = sorted(set(self.draft) - set(_DRAFT_SPEC_KEYS))
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.draft: unknown keys {unknown} "
+                f"(known: {_DRAFT_SPEC_KEYS})")
+        for k in ("n_layer", "d_model", "n_head"):
+            if k in self.draft and (
+                    not isinstance(self.draft[k], int)
+                    or isinstance(self.draft[k], bool)
+                    or self.draft[k] < 1):
+                raise DeepSpeedConfigError(
+                    f"serving.speculative.draft.{k} must be an int >= 1, "
+                    f"got {self.draft[k]!r}")
+
+
 @dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching gateway knobs (see ``docs/serving.md``)."""
@@ -127,9 +184,14 @@ class ServingConfig(DeepSpeedConfigModel):
     #: raw "paging" subsection (typed view: ``paging_config``) — paged
     #: KV blocks + session tiering; see :class:`PagingConfig`
     paging: Optional[Dict] = None
+    #: raw "speculative" subsection (typed view: ``speculative_config``) —
+    #: batched draft/verify in the tick loop; see :class:`SpeculativeConfig`
+    speculative: Optional[Dict] = None
 
     paging_config: PagingConfig = dataclasses.field(
         default_factory=PagingConfig)
+    speculative_config: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig)
 
     def __post_init__(self):
         if isinstance(self.paging, dict):
@@ -137,6 +199,12 @@ class ServingConfig(DeepSpeedConfigModel):
         elif isinstance(self.paging, PagingConfig):
             self.paging_config = self.paging
             self.paging = self.paging_config.to_dict()
+        if isinstance(self.speculative, dict):
+            self.speculative_config = SpeculativeConfig.from_dict(
+                self.speculative)
+        elif isinstance(self.speculative, SpeculativeConfig):
+            self.speculative_config = self.speculative
+            self.speculative = self.speculative_config.to_dict()
         if self.slots < 1:
             raise ValueError(f"serving.slots must be >= 1, got {self.slots}")
         if self.prefill_chunk < 1:

@@ -68,7 +68,7 @@ class ServingGateway:
 
     def __init__(self, engine, config=None, journal: Optional[EventJournal]
                  = None, autostart: bool = True,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None, draft=None):
         if config is None:
             config = ServingConfig()
         elif isinstance(config, dict):
@@ -79,7 +79,12 @@ class ServingGateway:
         #: Callers pass one to record; the default is a disabled no-op.
         self.tracer = tracer if tracer is not None else Tracer(
             enabled=False, name="serving")
-        self._batcher = SlotBatcher(engine, config, tracer=self.tracer)
+        #: speculative decoding in the tick loop (docs/serving.md
+        #: "Speculative tick"); ``draft`` is the proposal model —
+        #: resolved/validated by the batcher
+        self._spec = bool(config.speculative_config.enabled)
+        self._batcher = SlotBatcher(engine, config, tracer=self.tracer,
+                                    draft=draft)
         self._journal = journal
         self.metrics = ServingMetrics()
         #: paged KV + session tiering (serving/paging.py) — None keeps
@@ -168,11 +173,16 @@ class ServingGateway:
                 f"prefix_len {prefix_len} must be in [0, prompt_len"
                 f"={tokens.shape[0]})")
         handle = RequestHandle(rid)
-        if tokens.shape[0] + n_new > self._batcher.max_len:
+        # a speculative round may write draft_k positions past the last
+        # emission (rejected overshoot K/V) — the whole overshoot must
+        # fit the slot, or edge writes would clamp and corrupt
+        margin = self._batcher.spec_overshoot
+        if tokens.shape[0] + n_new + margin > self._batcher.max_len:
             self._reject(rid, handle, "too_long")
             raise ValueError(
-                f"prompt ({tokens.shape[0]}) + max_new_tokens ({n_new}) "
-                f"exceeds the {self._batcher.max_len}-token slot; raise "
+                f"prompt ({tokens.shape[0]}) + max_new_tokens ({n_new})"
+                + (f" + speculative overshoot ({margin})" if margin else "")
+                + f" exceeds the {self._batcher.max_len}-token slot; raise "
                 "serving.max_len or shorten the request")
         deadline_s = deadline_s if deadline_s is not None \
             else cfg.default_deadline_s
@@ -248,6 +258,11 @@ class ServingGateway:
                 snap["hbm_bytes_per_conversation"]
             out[MetricName.SERVE_READMIT_S] = \
                 self.metrics.readmit.snapshot()
+        if self._spec:
+            out[MetricName.SERVE_SPEC_ACCEPT_RATE] = \
+                self.metrics.spec_accept_rate.snapshot()
+            out[MetricName.SERVE_SPEC_TOKENS_PER_TICK] = \
+                self.metrics.spec_tokens_per_tick.snapshot()
         return out
 
     def _pull_compile_stats(self) -> None:
@@ -580,12 +595,19 @@ class ServingGateway:
     def _decode_tick(self) -> None:
         fault_injection.fire("serve.decode_tick", tick=self._ticks,
                              active=len(self._active))
-        tokens = self._batcher.tick()
+        if self._spec:
+            # speculative round: window [B, draft_k+1], counts [B] —
+            # row b emitted window[b, :counts[b]] this tick
+            tokens, counts = self._batcher.tick()
+        else:
+            tokens, counts = self._batcher.tick(), None
         self._ticks += 1
         now = time.monotonic()
         with self._cond:
             live = list(self._active.items())
         n_live = len(live)
+        harvested = 0
+        accepted = 0
         for row, req in live:
             h = req.handle
             if h.cancel_requested:
@@ -595,20 +617,34 @@ class ServingGateway:
                         f"{req.rid} cancelled mid-decode",
                         partial=np.asarray(req.out, np.int32)))
                 continue
-            tok = int(tokens[row])
-            req.out.append(tok)
-            h.tokens_out = len(req.out)
+            if counts is None:
+                toks = [int(tokens[row])]
+            else:
+                toks = [int(t) for t in tokens[row, :int(counts[row])]]
+                accepted += max(int(counts[row]) - 1, 0)
+            finished = False
+            for tok in toks:
+                # eos/budget cut a speculative window short: the tokens
+                # past the cut are discarded (their K/V sits beyond the
+                # retired frontier, never decoded again)
+                req.out.append(tok)
+                harvested += 1
+                h.tokens_out = len(req.out)
+                if h.t_first_token is None:
+                    h.t_first_token = now
+                    self.metrics.record_ttft(h.ttft_s)
+                if (req.eos_token_id is not None
+                        and tok == req.eos_token_id) \
+                        or len(req.out) >= req.max_new_tokens:
+                    finished = True
+                    break
             if req.session_id is not None and self._pager is not None:
-                # frontier-crossing block accounting: the token just
-                # decoded wrote KV at frontier+len(out)-1 — allocate the
-                # block covering it before the row can retire
+                # frontier-crossing block accounting: the tokens just
+                # harvested wrote KV through frontier+len(out)-1 — a
+                # multi-token speculative advance may cross one or more
+                # block boundaries, all allocated inside this call
                 self._pager.on_tick(row, req.frontier + len(req.out))
-            if h.t_first_token is None:
-                h.t_first_token = now
-                self.metrics.record_ttft(h.ttft_s)
-            if req.eos_token_id is not None and tok == req.eos_token_id:
-                self._finish_row(row, req, RequestState.DONE)
-            elif len(req.out) >= req.max_new_tokens:
+            if finished:
                 self._finish_row(row, req, RequestState.DONE)
             elif req.deadline is not None and now > req.deadline:
                 self._finish_row(
@@ -617,7 +653,12 @@ class ServingGateway:
                         f"{req.rid} deadline passed mid-decode",
                         partial=np.asarray(req.out, np.int32)))
         self.metrics.record_tick(active=n_live, slots=self.config.slots,
-                                 tokens=n_live)
+                                 tokens=harvested)
+        if counts is not None and n_live:
+            proposed = n_live * self._batcher.draft_k
+            self.metrics.record_spec_round(accepted=accepted,
+                                           proposed=proposed,
+                                           emitted=harvested)
         every = self.config.journal_every_ticks
         if every and self._ticks % every == 0:
             with self._cond:
@@ -626,6 +667,12 @@ class ServingGateway:
                        active=n_live, queue_depth=depth,
                        tok_per_s=round(
                            self.metrics.snapshot()["tokens_per_s"], 3))
+            if counts is not None and n_live:
+                self._emit(EventKind.SERVE_SPEC_ROUND, tick=self._ticks,
+                           active=n_live, draft_k=self._batcher.draft_k,
+                           accepted=accepted, emitted=harvested,
+                           accept_rate=round(
+                               accepted / max(1, proposed), 4))
 
     def _finish_row(self, row: int, req: ServeRequest, state: str,
                     error: Optional[Exception] = None) -> None:

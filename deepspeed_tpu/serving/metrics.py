@@ -74,6 +74,19 @@ class ServingMetrics:
         #: re-admission wall seconds (tier read + remainder prefill) —
         #: the number the bench gates against re-prefill latency
         self.readmit = Histogram(MetricName.SERVE_READMIT_S, cap=_TTFT_CAP)
+        # ---- speculative decoding (serving/batcher.py spec tick) ----
+        #: speculative draft/verify rounds run
+        self.spec_rounds = 0
+        #: draft proposals accepted / proposed (cumulative, all slots)
+        self.spec_accepted = 0
+        self.spec_proposed = 0
+        #: per-round acceptance rate (accepted/proposed over the round's
+        #: live slots) — the draft-quality signal the bench journals
+        self.spec_accept_rate = Histogram(
+            MetricName.SERVE_SPEC_ACCEPT_RATE, cap=_TTFT_CAP)
+        #: tokens emitted per speculative tick (all live slots)
+        self.spec_tokens_per_tick = Histogram(
+            MetricName.SERVE_SPEC_TOKENS_PER_TICK, cap=_TTFT_CAP)
 
     def count(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -96,6 +109,15 @@ class ServingMetrics:
             self.tokens_out += tokens
             self.active_slot_ticks += active
             self.slot_ticks += slots
+
+    def record_spec_round(self, accepted: int, proposed: int,
+                          emitted: int) -> None:
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_accepted += accepted
+            self.spec_proposed += proposed
+        self.spec_accept_rate.observe(accepted / max(1, proposed))
+        self.spec_tokens_per_tick.observe(float(emitted))
 
     def record_ttft(self, seconds: float) -> None:
         self.ttft.observe(float(seconds))
@@ -139,6 +161,12 @@ class ServingMetrics:
                 "serving_hbm_bytes": self.serving_hbm_bytes,
                 "pool_blocks_used": self.pool_blocks_used,
                 "park_bytes": self.park_bytes,
+                "spec_rounds": self.spec_rounds,
+                "spec_accepted": self.spec_accepted,
+                "spec_proposed": self.spec_proposed,
+                "spec_accept_rate_mean": (
+                    self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0),
                 "elapsed_s": elapsed,
                 "tokens_per_s": self.tokens_out / elapsed,
                 "slot_occupancy": (self.active_slot_ticks / self.slot_ticks

@@ -82,6 +82,12 @@ class MetricName:
     SERVE_HBM_BYTES_PER_CONVERSATION = "serve.hbm_bytes_per_conversation"
     #: histogram of re-admission wall seconds for parked sessions
     SERVE_READMIT_S = "serve.readmit_s"
+    #: histogram of per-round speculative acceptance rate (accepted
+    #: drafts / proposed drafts across the live slots of one tick)
+    SERVE_SPEC_ACCEPT_RATE = "serve.spec_accept_rate"
+    #: histogram of tokens emitted per speculative tick (all live slots;
+    #: 1..draft_k+1 each — the tokens/s lever speculation buys)
+    SERVE_SPEC_TOKENS_PER_TICK = "serve.spec_tokens_per_tick"
     #: cumulative bytes the explicit grad-reduce collectives WOULD have
     #: moved at full precision (fp32 payload, both directions)
     COMM_LOGICAL_BYTES = "comm.logical_bytes"

@@ -77,6 +77,9 @@ class SpanName:
     ELASTIC_ROLLBACK = "elastic.rollback"
     #: one continuous-batching decode tick (all live slots, one token)
     SERVE_TICK = "serve.tick"
+    #: one speculative draft/verify/accept round (nested in serve.tick;
+    #: draft_k in args) — all live slots advance 1..draft_k+1 tokens
+    SERVE_SPEC = "serve.spec"
     #: admission of one request into a free slot (incl. prefill)
     SERVE_ADMIT = "serve.admit"
     #: chunked prefill of a prompt/prefix through the fixed-width programs

@@ -94,6 +94,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         hits = sum(1 for e in readmits if e.get("hit"))
         if readmits:
             line += f"  readmit_hit_rate={hits}/{len(readmits)}"
+        # speculative rounds: the journaled per-round acceptance rate,
+        # averaged — the draft-quality number the A/B bench reports
+        spec = [e for e in serve if e["kind"] == "serve.spec_round"
+                and e.get("accept_rate") is not None]
+        if spec:
+            mean = sum(float(e["accept_rate"]) for e in spec) / len(spec)
+            line += f"  spec_accept_rate={mean:.3f}"
         print(line, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:

@@ -171,8 +171,10 @@ _COMM = [
 
 # serving rows (CPU fixture — serve_bench drives a tiny random-init GPT,
 # so these run anywhere): the fixed-slot single-turn baseline, the paged
-# long-tail + multi-turn tiering gate run, and a wider-slot variant.
-# serve_bench owns the gates; the sweep records the trajectory.
+# long-tail + multi-turn tiering gate run, a wider-slot variant, the
+# speculative-tick A/B gate run, and the informational external-baseline
+# reference row.  serve_bench owns the gates; the sweep records the
+# trajectory.
 _SERVE_BENCH = ["scripts/serve_bench.py", "--print-json",
                 "--out", "/tmp/BENCH_SERVE_sweep.json"]
 _SERVE = [
@@ -181,6 +183,10 @@ _SERVE = [
     ("serve-paged-longtail", {"JAX_PLATFORMS": "cpu"}, _SERVE_BENCH),
     ("serve-paged-8slots", {"JAX_PLATFORMS": "cpu"},
      _SERVE_BENCH + ["--slots", "8", "--conversations", "24"]),
+    ("serve-spec-ab", {"JAX_PLATFORMS": "cpu"},
+     _SERVE_BENCH + ["--turns", "1", "--spec-ab"]),
+    ("serve-gemma-baseline", {"JAX_PLATFORMS": "cpu"},
+     _SERVE_BENCH + ["--turns", "1", "--config", "gemma_tpu_baseline"]),
 ]
 
 CONFIG_SETS = {

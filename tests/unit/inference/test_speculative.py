@@ -460,3 +460,45 @@ def test_batched_speculative_moe_target_matches_per_row():
         want = np.asarray(eng.generate(prompt[b:b + 1], max_new_tokens=N))
         np.testing.assert_array_equal(np.asarray(got)[b], want[0],
                                       err_msg=f"row {b}")
+
+
+def test_spec_accept_batch_per_slot_streams_preserve_target():
+    """The serving tick's batched accept: per-slot round keys fan out
+    into a DRAFT stream (proposal draws, ``SPEC_DRAFT_DOMAIN + j``) and
+    an ACCEPT stream (``SPEC_ACCEPT_DOMAIN``) — disjoint fold-in domains,
+    so the accept uniforms are independent of the proposals they judge.
+    Checked the only way that matters: with rows holding DIFFERENT
+    draft/target pairs and both streams derived from the same round
+    keys, each row's first emitted token is still distributed exactly as
+    its own target row.  Correlated streams or cross-row key bleed would
+    both show up as a skewed marginal."""
+    from deepspeed_tpu.inference.speculative import (spec_accept_batch,
+                                                     spec_accept_keys,
+                                                     spec_draft_keys)
+    V = 4
+    t_rows = jnp.asarray([[0.4, 0.3, 0.2, 0.1],
+                          [0.1, 0.1, 0.1, 0.7],
+                          [0.01, 0.97, 0.01, 0.01]])
+    d_rows = jnp.asarray([[0.35, 0.35, 0.2, 0.1],
+                          [0.7, 0.1, 0.1, 0.1],
+                          [0.97, 0.01, 0.01, 0.01]])
+    B = t_rows.shape[0]
+    t_probs = jnp.concatenate(
+        [t_rows[:, None], jnp.full((B, 1, V), 0.25)], axis=1)  # [B, 2, V]
+    d_probs = d_rows[:, None]                                  # [B, 1, V]
+
+    def one_round(k):
+        round_keys = jax.random.split(k, B)            # per-slot [B, 2]
+        d_tok = jax.vmap(jax.random.categorical)(
+            spec_draft_keys(round_keys, 0), jnp.log(d_rows))
+        a, nxt = spec_accept_batch(spec_accept_keys(round_keys),
+                                   d_tok[:, None].astype(jnp.int32),
+                                   d_probs, t_probs)
+        return jnp.where(a >= 1, d_tok, nxt)           # first emitted [B]
+
+    n = 20_000
+    toks = jax.vmap(one_round)(jax.random.split(jax.random.PRNGKey(3), n))
+    for b in range(B):
+        freq = np.bincount(np.asarray(toks[:, b]), minlength=V) / n
+        np.testing.assert_allclose(freq, np.asarray(t_rows[b]), atol=0.015,
+                                   err_msg=f"slot {b}")

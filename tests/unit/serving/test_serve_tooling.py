@@ -70,6 +70,35 @@ def test_serve_bench_tiering_block(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_serve_bench_spec_block(tmp_path, capsys):
+    """The speculative A/B phase records both arms and its DETERMINISTIC
+    gates hold (the ≥1.3× uplift and TTFT gates are wall-clock — gated at
+    bench time, not under test-suite CPU contention)."""
+    serve_bench = _load("serve_bench")
+    out = tmp_path / "BENCH_SERVE.json"
+    serve_bench.main([
+        "--requests", "2", "--rate", "50", "--slots", "2",
+        "--max-len", "64", "--max-prompt", "16", "--max-new", "8",
+        "--turns", "1", "--spec-ab",
+        "--spec-requests", "3", "--spec-trials", "1",
+        "--spec-layers", "2", "--spec-d-model", "64",
+        "--spec-max-prompt", "12", "--spec-min-new", "8",
+        "--spec-max-new", "16", "--spec-train-steps", "30",
+        "--out", str(out)])
+    spec = json.loads(out.read_text())["spec"]
+    for key in ("off", "on", "tokens_per_s_off", "tokens_per_s_on",
+                "uplift", "accept_rate_mean", "config", "gates"):
+        assert key in spec, key
+    g = spec["gates"]
+    assert g["no_failures"] and g["no_recompiles"]
+    assert g["acceptance_journaled"]
+    assert spec["on"]["spec_rounds"] > 0
+    assert spec["off"]["spec_rounds"] == 0
+    assert 0.0 <= spec["accept_rate_mean"] <= 1.0
+    assert spec["on"]["tokens_out"] == spec["off"]["tokens_out"]
+    capsys.readouterr()
+
+
 def test_dump_run_events_renders_serve_kinds(tmp_path, capsys):
     dump_run_events = _load("dump_run_events")
     j = EventJournal(str(tmp_path / "events.jsonl"))

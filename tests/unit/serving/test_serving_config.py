@@ -36,3 +36,43 @@ def test_from_dict_round_trip():
 def test_invalid_configs_raise(bad):
     with pytest.raises(ValueError):
         ServingConfig.from_dict(bad)
+
+
+# ------------------------------------------------- serving.speculative
+
+def test_speculative_defaults_off():
+    cfg = ServingConfig()
+    assert cfg.speculative_config.enabled is False
+    assert cfg.speculative_config.draft_k == 3
+    assert cfg.speculative_config.draft is None
+
+
+def test_speculative_from_dict_round_trip():
+    cfg = ServingConfig.from_dict({
+        "slots": 2,
+        "speculative": {"enabled": True, "draft_k": 4,
+                        "draft": {"n_layer": 1, "d_model": 32,
+                                  "n_head": 2, "seed": 7}}})
+    sp = cfg.speculative_config
+    assert sp.enabled is True and sp.draft_k == 4
+    assert sp.draft == {"n_layer": 1, "d_model": 32, "n_head": 2, "seed": 7}
+    # the raw dict mirror stays in sync (checkpoint/JSON round trips)
+    assert cfg.speculative["draft_k"] == 4
+
+
+@pytest.mark.parametrize("bad", [
+    {"draft_k": 0},
+    {"draft_k": -3},
+    {"draft_k": 65},
+    {"draft_k": True},
+    {"draft_k": "three"},
+    {"draft": ["n_layer", 2]},
+    {"draft": {"n_layers": 2}},            # unknown key (typo)
+    {"draft": {"n_layer": 0}},
+    {"draft": {"d_model": -1}},
+    {"draft": {"n_head": 0}},
+])
+def test_speculative_invalid_raises_config_error(bad):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+    with pytest.raises(DeepSpeedConfigError):
+        ServingConfig.from_dict({"speculative": bad})
