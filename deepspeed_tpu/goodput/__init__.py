@@ -13,7 +13,11 @@ processes.
 - :mod:`.scenarios` — the seeded, declarative fault-schedule registry;
 - :mod:`.score` — journal-derived goodput / MTTR / wasted-step metrics and
   invariant checks (no split-brain, quarantine honored, bitwise replay);
-- :mod:`.rank_main` — the child-process entry point.
+- :mod:`.rank_main` — the child-process entry point;
+- :mod:`.serve_scenarios` — the SERVING flavor: fault schedules and
+  request-goodput scoring for the disaggregated prefill/decode fleet
+  (``serving/fleet.py``), gated by ``scripts/serve_fleet_bench.py`` into
+  ``BENCH_SERVE_FLEET.json``.
 
 ``scripts/goodput_bench.py`` runs the scenario matrix into
 ``BENCH_GOODPUT.json`` and gates regressions.  Docs: ``docs/goodput.md``.
@@ -24,10 +28,17 @@ from .scenarios import (SCENARIOS, CorruptTagAction, FaultSpec, Scenario,
                         build_scenario, scenario_names)
 from .score import (check_invariants, score_events, score_run,
                     score_scenario_run)
+from .serve_scenarios import (SERVE_SCENARIOS, ServeScenario,
+                              build_serve_scenario, run_serve_scenario,
+                              score_serve_events, score_serve_run,
+                              serve_scenario_names)
 
 __all__ = [
     "FleetConfig", "FleetSupervisor", "run_scenario",
     "SCENARIOS", "CorruptTagAction", "FaultSpec", "Scenario",
     "build_scenario", "scenario_names",
     "check_invariants", "score_events", "score_run", "score_scenario_run",
+    "SERVE_SCENARIOS", "ServeScenario", "build_serve_scenario",
+    "run_serve_scenario", "score_serve_events", "score_serve_run",
+    "serve_scenario_names",
 ]

@@ -222,6 +222,29 @@ def _nan_poisoned_window(seed: int) -> Scenario:
     ).validate()
 
 
+def _preempt_during_rollback(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    start = rng.randint(5, 6)
+    return Scenario(
+        name="preempt_during_rollback",
+        description=f"compound fault: steps [{start},{start + 2}) feed NaN "
+                    "losses AND a SIGTERM lands on the first step re-trained "
+                    "inside the rollback window — the preempt drain must "
+                    "checkpoint the *rolled-back* trajectory (not the "
+                    "poisoned one), and the relaunched fleet must resume "
+                    "from it with the quarantine still honored",
+        world_size=1, target_steps=12, save_interval=2, seed=seed,
+        faults=(FaultSpec("train.loss", "NaNLossWindow",
+                          {"from_step": start, "to_step": start + 2},
+                          ranks=(0,)),
+                FaultSpec("train.step", "SignalAtStep", {"step": start + 1},
+                          ranks=(0,))),
+        expect={"min_goodput": 0.3, "max_mttr_s": 120.0,
+                "expect_kinds": ("rollback", "data.quarantine",
+                                 "preempt.signal", "fleet.restart")},
+    ).validate()
+
+
 def _partial_cluster_restart(seed: int) -> Scenario:
     rng = random.Random(seed)
     step = rng.randint(5, 6)
@@ -249,6 +272,7 @@ SCENARIOS = {
     "corrupt_newest_ckpt": _corrupt_newest_ckpt,
     "straggler_slow_rank": _straggler_slow_rank,
     "nan_poisoned_window": _nan_poisoned_window,
+    "preempt_during_rollback": _preempt_during_rollback,
     "partial_cluster_restart": _partial_cluster_restart,
 }
 

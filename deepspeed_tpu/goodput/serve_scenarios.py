@@ -1,0 +1,397 @@
+"""Seeded fault scenarios + goodput scoring for the SERVING fleet.
+
+The training fleet scores *step* goodput (``score.py``); the serving
+fleet scores *request* goodput — of the requests the gateway accepted,
+how many completed, and what failover cost (TTFT under fault, MTTR)?
+Same contract as ``scenarios.py``: a scenario is data, every free choice
+(victim worker, kill step) is drawn from ``random.Random(seed)``, fault
+plans ride ``DS_FAULT_PLAN`` into real subprocesses, and the score is
+computed purely from ``events.jsonl`` — no cooperation from the scored
+processes, works on a journal recovered from a dead run.
+
+Metrics (prose: ``docs/goodput.md`` "Serving goodput"):
+
+request goodput
+    ``completed_accepted / accepted`` — rejected requests (the bounded
+    queue doing its job) are not goodput losses; *lost* accepted requests
+    are, and the no-lost-accepted-request invariant requires zero.
+TTFT p99 under fault
+    99th-percentile submit→first-token latency over completed requests,
+    faults included — what degradation actually costs the tail.
+MTTR
+    per ``serve.fleet.worker_lost``, seconds from supervisor detection to
+    the first request completion after it.
+
+Gate: ``scripts/serve_fleet_bench.py`` → ``BENCH_SERVE_FLEET.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import random
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from ..runtime.supervision.events import ABORT_KINDS, EventKind, read_events
+from ..utils import fault_injection
+from .scenarios import ALL_RANKS, FaultSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeScenario:
+    """A fully-resolved serving-fleet run: geometry, workload shape,
+    faults, knobs, expectations.  Fault ``ranks`` use fleet ranks: decode
+    engine = 0, prefill workers = 1..n_prefill."""
+
+    name: str
+    description: str
+    seed: int
+    n_prefill: int = 2
+    n_requests: int = 6
+    #: Poisson arrival rate (exponential inter-arrival draws)
+    arrival_rate_hz: float = 1.5
+    prompt_len: Tuple[int, int] = (18, 34)
+    max_new_tokens: Tuple[int, int] = (4, 6)
+    faults: Tuple[FaultSpec, ...] = ()
+    #: :class:`~deepspeed_tpu.serving.fleet.ServeFleetConfig` field
+    #: overrides (queue_capacity, prefill_timeout_s, ...)
+    fleet_overrides: Mapping[str, Any] = dataclasses.field(
+        default_factory=dict)
+    #: scored expectations: min_goodput, max_lost, max_incidents,
+    #: max_mttr_s, max_ttft_p99_ms, min_rejected, expect_kinds,
+    #: allow_abort_kinds
+    expect: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def plan_for(self, rank: int, incarnation: int) -> str:
+        """The serialized ``DS_FAULT_PLAN`` for one spawned worker (''
+        when no fault touches it)."""
+        entries = [f.plan_entry() for f in self.faults
+                   if f.applies_to(rank, incarnation)]
+        if not entries:
+            return ""
+        return fault_injection.serialize_plan(entries)
+
+    def workload(self) -> List[Dict[str, Any]]:
+        """The seeded arrival schedule — deterministic given the seed, so
+        two runs of one scenario admit byte-identical prompts on an
+        identical clock."""
+        rng = random.Random(self.seed * 7919 + 13)
+        items, at = [], 0.0
+        for i in range(self.n_requests):
+            at += rng.expovariate(self.arrival_rate_hz)
+            plen = rng.randint(*self.prompt_len)
+            items.append({
+                "at_s": round(at, 3),
+                "tokens": [rng.randrange(256) for _ in range(plen)],
+                "max_new_tokens": rng.randint(*self.max_new_tokens),
+                "greedy": True, "temperature": 1.0, "seed": i})
+        return items
+
+    def validate(self) -> "ServeScenario":
+        if self.n_prefill < 0:
+            raise ValueError(f"{self.name}: n_prefill must be >= 0")
+        if self.n_requests < 1:
+            raise ValueError(f"{self.name}: n_requests must be >= 1")
+        for f in self.faults:
+            fault_injection.serialize_plan([f.plan_entry()])
+        return self
+
+
+# ------------------------------------------------------------- factories
+
+
+def _fleet_baseline(seed: int) -> ServeScenario:
+    return ServeScenario(
+        name="fleet_baseline",
+        description="no faults: every accepted request prefills remotely, "
+                    "hands off through a page bundle, and completes — the "
+                    "goodput=1.0 anchor",
+        seed=seed,
+        expect={"min_goodput": 0.999, "max_lost": 0, "max_incidents": 0,
+                "expect_kinds": (EventKind.SERVE_FLEET_BUNDLE,
+                                 EventKind.SERVE_DONE)},
+    ).validate()
+
+
+def _kill_prefill_worker(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = 1 + rng.randrange(2)
+    step = rng.randint(2, 4)
+    return ServeScenario(
+        name="kill_prefill_worker",
+        description=f"SIGKILL prefill worker {victim} on its chunk "
+                    f"{step} (mid-prefill, no notice): the supervisor "
+                    "must retry the orphaned prefill on the survivor, "
+                    "respawn the victim, and lose nothing",
+        seed=seed,
+        faults=(FaultSpec("serve.prefill_chunk", "KillAtStep",
+                          {"step": step}, ranks=(victim,)),),
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 120.0,
+                "expect_kinds": (EventKind.SERVE_FLEET_WORKER_LOST,
+                                 EventKind.SERVE_FLEET_RESTART,
+                                 EventKind.SERVE_FLEET_HANDOFF)},
+    ).validate()
+
+
+def _kill_decode_engine(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    step = rng.randint(4, 9)
+    return ServeScenario(
+        name="kill_decode_engine",
+        description=f"SIGKILL the decode engine on tick {step} "
+                    "(mid-decode): decode-resident requests requeue "
+                    "through the spool, the respawned incarnation "
+                    "re-admits them from their bundles, and every "
+                    "accepted request still completes",
+        seed=seed,
+        faults=(FaultSpec("serve.decode_tick", "KillAtStep",
+                          {"step": step}, ranks=(0,)),),
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 180.0,
+                "expect_kinds": (EventKind.SERVE_FLEET_WORKER_LOST,
+                                 EventKind.SERVE_FLEET_RESTART,
+                                 EventKind.SERVE_FLEET_REQUEUE)},
+    ).validate()
+
+
+def _straggler_prefill(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = 1 + rng.randrange(2)
+    return ServeScenario(
+        name="straggler_prefill",
+        description=f"prefill worker {victim} stalls 12s inside a chunk "
+                    "(its host keeps beating — not dead, just slow): the "
+                    "gateway's prefill timeout must hand the request to "
+                    "the survivor, and the straggler's late stale-attempt "
+                    "bundle must be ignored",
+        seed=seed,
+        faults=(FaultSpec("serve.prefill_chunk", "DelaySeconds",
+                          {"seconds": 12.0, "n": 1}, ranks=(victim,)),),
+        fleet_overrides={"prefill_timeout_s": 5.0},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "expect_kinds": (EventKind.SERVE_FLEET_HANDOFF,)},
+    ).validate()
+
+
+def _burst_past_queue(seed: int) -> ServeScenario:
+    return ServeScenario(
+        name="burst_past_queue",
+        description="Poisson burst past queue capacity: the bounded "
+                    "admission queue must reject the overflow loudly "
+                    "(serve.reject) and complete everything it accepted — "
+                    "rejects are not goodput losses, lost accepts are",
+        seed=seed, n_requests=10, arrival_rate_hz=8.0,
+        fleet_overrides={"queue_capacity": 3},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "min_rejected": 1,
+                "expect_kinds": (EventKind.SERVE_REJECT,)},
+    ).validate()
+
+
+def _corrupt_page_bundle(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = 1 + rng.randrange(2)
+    return ServeScenario(
+        name="corrupt_page_bundle",
+        description=f"prefill worker {victim}'s first page bundle bitrots "
+                    "after its digest is taken: the decode engine must "
+                    "reject it (serve.fleet.bundle_reject), never decode "
+                    "from it, and the supervisor must re-prefill the "
+                    "request elsewhere",
+        seed=seed,
+        faults=(FaultSpec("serve.bundle_write", "CorruptRandomBytes",
+                          {"nbytes": 16, "seed": seed}, ranks=(victim,)),),
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "expect_kinds": (EventKind.SERVE_FLEET_BUNDLE_REJECT,
+                                 EventKind.SERVE_FLEET_HANDOFF)},
+    ).validate()
+
+
+#: name → factory(seed); iteration order is the bench matrix order
+SERVE_SCENARIOS = {
+    "fleet_baseline": _fleet_baseline,
+    "kill_prefill_worker": _kill_prefill_worker,
+    "kill_decode_engine": _kill_decode_engine,
+    "straggler_prefill": _straggler_prefill,
+    "burst_past_queue": _burst_past_queue,
+    "corrupt_page_bundle": _corrupt_page_bundle,
+}
+
+
+def serve_scenario_names() -> Tuple[str, ...]:
+    return tuple(SERVE_SCENARIOS)
+
+
+def build_serve_scenario(name: str, seed: int = 0) -> ServeScenario:
+    """Resolve one registered serving scenario at ``seed``."""
+    try:
+        factory = SERVE_SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown serve scenario {name!r} "
+            f"(registered: {', '.join(SERVE_SCENARIOS)})") from None
+    scenario = factory(int(seed))
+    if scenario.name != name:
+        raise ValueError(
+            f"serve scenario factory {name!r} built a scenario named "
+            f"{scenario.name!r} — registry and dataclass must agree")
+    return scenario
+
+
+# --------------------------------------------------------------- scoring
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    v = sorted(values)
+    idx = min(len(v) - 1, max(0, math.ceil(q * len(v)) - 1))
+    return v[idx]
+
+
+def score_serve_events(events: List[dict], *,
+                       name: Optional[str] = None,
+                       expect: Optional[Mapping[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """Score one serving-fleet run's journal into the goodput report."""
+    expect = dict(expect or {})
+
+    def by_kind(kind: str) -> List[dict]:
+        return [e for e in events if e.get("kind") == kind]
+
+    accepted = {e.get("request_id") for e in
+                by_kind(EventKind.SERVE_REQUEST)}
+    done_ts: Dict[str, float] = {}
+    ttfts: List[float] = []
+    for e in by_kind(EventKind.SERVE_DONE):
+        rid = e.get("request_id")
+        if rid in done_ts:
+            continue
+        done_ts[rid] = float(e.get("ts", 0.0))
+        if e.get("ttft_ms") is not None:
+            ttfts.append(float(e["ttft_ms"]))
+    completed = accepted & set(done_ts)
+    lost = sorted(r for r in accepted if r not in done_ts)
+    goodput = (len(completed) / len(accepted)) if accepted else 1.0
+    rejected = len(by_kind(EventKind.SERVE_REJECT))
+
+    # incidents + MTTR: worker-lost detection → first completion after it
+    incidents = by_kind(EventKind.SERVE_FLEET_WORKER_LOST)
+    mttr_all: List[float] = []
+    unrecovered = 0
+    for inc in incidents:
+        detect = float(inc.get("detect_ts") or inc.get("ts", 0.0))
+        after = [t for t in done_ts.values() if t > detect]
+        if after:
+            mttr_all.append(round(min(after) - detect, 3))
+        else:
+            unrecovered += 1
+
+    allowed = set(expect.get("allow_abort_kinds", ()))
+    unexpected_aborts = [e["kind"] for e in events
+                         if e.get("kind") in ABORT_KINDS
+                         and e["kind"] not in allowed]
+
+    kinds: Dict[str, int] = {}
+    for e in events:
+        k = str(e.get("kind", "?"))
+        kinds[k] = kinds.get(k, 0) + 1
+
+    score: Dict[str, Any] = {
+        "scenario": name,
+        "accepted": len(accepted),
+        "completed": len(completed),
+        "rejected": rejected,
+        "lost": len(lost),
+        "lost_ids": lost,
+        "goodput": round(goodput, 4),
+        "ttft_ms": {"p50": _percentile(ttfts, 0.50),
+                    "p99": _percentile(ttfts, 0.99),
+                    "max": max(ttfts) if ttfts else None},
+        "incidents": len(incidents),
+        "unrecovered_incidents": unrecovered,
+        "mttr_s": {"all": mttr_all,
+                   "mean": round(sum(mttr_all) / len(mttr_all), 3)
+                   if mttr_all else None,
+                   "max": max(mttr_all) if mttr_all else None},
+        "handoffs": len(by_kind(EventKind.SERVE_FLEET_HANDOFF)),
+        "requeues": len(by_kind(EventKind.SERVE_FLEET_REQUEUE)),
+        "degraded": len(by_kind(EventKind.SERVE_FLEET_DEGRADED)),
+        "bundle_rejects": len(by_kind(EventKind.SERVE_FLEET_BUNDLE_REJECT)),
+        "restarts": len(by_kind(EventKind.SERVE_FLEET_RESTART)),
+        "unexpected_aborts": unexpected_aborts,
+        "kinds": kinds,
+    }
+    score["ok"], score["failures"] = _judge_serve(score, expect)
+    return score
+
+
+def _judge_serve(score: Dict[str, Any], expect: Mapping[str, Any]):
+    """Fold the scenario's expectations into a verdict.  The no-lost-
+    accepted-request invariant is unconditional: ``max_lost`` defaults to
+    ZERO — a scenario must opt in to losing work, and none does."""
+    failures: List[str] = []
+    max_lost = expect.get("max_lost", 0)
+    if score["lost"] > max_lost:
+        failures.append(
+            f"lost accepted requests: {score['lost_ids']} "
+            f"(> allowed {max_lost})")
+    for kind in score["unexpected_aborts"]:
+        failures.append(f"unexpected abort-class event: {kind}")
+    min_goodput = expect.get("min_goodput")
+    if min_goodput is not None and score["goodput"] < min_goodput:
+        failures.append(
+            f"request goodput {score['goodput']} < expected {min_goodput}")
+    max_incidents = expect.get("max_incidents")
+    if max_incidents is not None and score["incidents"] > max_incidents:
+        failures.append(
+            f"incidents {score['incidents']} > expected {max_incidents}")
+    max_mttr = expect.get("max_mttr_s")
+    if max_mttr is not None:
+        if score["incidents"] and score["unrecovered_incidents"] == \
+                score["incidents"]:
+            failures.append("incident(s) with no completion after: MTTR "
+                            "unmeasurable (the fleet never recovered)")
+        elif score["mttr_s"]["max"] is not None and \
+                score["mttr_s"]["max"] > max_mttr:
+            failures.append(
+                f"MTTR {score['mttr_s']['max']}s > expected {max_mttr}s")
+    max_ttft = expect.get("max_ttft_p99_ms")
+    if max_ttft is not None and score["ttft_ms"]["p99"] is not None \
+            and score["ttft_ms"]["p99"] > max_ttft:
+        failures.append(
+            f"TTFT p99 {score['ttft_ms']['p99']}ms > expected {max_ttft}ms")
+    min_rejected = expect.get("min_rejected")
+    if min_rejected is not None and score["rejected"] < min_rejected:
+        failures.append(
+            f"rejected {score['rejected']} < expected {min_rejected} — "
+            "the bounded queue never pushed back")
+    for kind in expect.get("expect_kinds", ()):
+        if not score["kinds"].get(kind):
+            failures.append(f"expected event kind {kind!r} never journaled")
+    return (not failures), failures
+
+
+def score_serve_run(run_dir: str, scenario: ServeScenario) -> Dict[str, Any]:
+    """Score a serving-fleet run directory against its scenario (reads
+    ``<run_dir>/events.jsonl``; torn trailing lines are skipped)."""
+    path = run_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    return score_serve_events(read_events(path), name=scenario.name,
+                              expect=scenario.expect)
+
+
+def run_serve_scenario(run_dir: str, scenario: ServeScenario,
+                       **config_overrides) -> Dict[str, Any]:
+    """Run one scenario end to end — spawn the fleet, drive the seeded
+    workload, score the journal — and return the score (the supervisor's
+    own run summary rides along under ``"summary"``)."""
+    from ..serving.fleet import ServeFleetConfig, ServeFleetSupervisor
+    config = ServeFleetConfig.from_scenario(scenario, **config_overrides)
+    supervisor = ServeFleetSupervisor(run_dir, config=config,
+                                      scenario=scenario)
+    summary = supervisor.run(scenario.workload())
+    score = score_serve_run(run_dir, scenario)
+    score["summary"] = summary
+    return score

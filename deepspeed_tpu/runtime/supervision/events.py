@@ -79,6 +79,16 @@ class EventKind:
     SERVE_READMIT = "serve.readmit"
     SERVE_PAGE_ALLOC = "serve.page_alloc"
     SERVE_PAGE_EVICT = "serve.page_evict"
+    SERVE_FLEET_SPAWN = "serve.fleet.spawn"
+    SERVE_FLEET_WORKER_LOST = "serve.fleet.worker_lost"
+    SERVE_FLEET_RESTART = "serve.fleet.restart"
+    SERVE_FLEET_HANDOFF = "serve.fleet.handoff"
+    SERVE_FLEET_REQUEUE = "serve.fleet.requeue"
+    SERVE_FLEET_DEGRADED = "serve.fleet.degraded"
+    SERVE_FLEET_BUNDLE = "serve.fleet.bundle"
+    SERVE_FLEET_BUNDLE_REJECT = "serve.fleet.bundle_reject"
+    SERVE_FLEET_DONE = "serve.fleet.done"
+    SERVE_FLEET_ABORT = "serve.fleet.abort"
     PERF_RECOMPILE = "perf.recompile"
     PERF_HOST_SYNC = "perf.host_sync"
     METRICS_SAMPLE = "metrics.sample"
@@ -99,6 +109,7 @@ ABORT_KINDS = frozenset({
     EventKind.CKPT_COMMIT_TIMEOUT,
     EventKind.CKPT_CONSENSUS_FAILURE,
     EventKind.FLEET_ABORT,
+    EventKind.SERVE_FLEET_ABORT,
 })
 
 #: kind → the fields worth a one-liner in ``dump_run_events`` (everything
@@ -159,7 +170,26 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_READMIT: ("session", "tokens_reused", "tokens_new",
                               "tier", "readmit_ms", "hit"),
     EventKind.SERVE_PAGE_ALLOC: ("session", "blocks", "free_blocks"),
-    EventKind.SERVE_PAGE_EVICT: ("session", "blocks", "bytes", "reason"),
+    EventKind.SERVE_PAGE_EVICT: ("session", "blocks", "bytes", "reason",
+                                 "pressure", "watermark"),
+    EventKind.SERVE_FLEET_SPAWN: ("role", "worker", "incarnation", "pid"),
+    EventKind.SERVE_FLEET_WORKER_LOST: ("role", "worker", "incarnation",
+                                        "returncode", "reason", "detect_ts"),
+    EventKind.SERVE_FLEET_RESTART: ("role", "worker", "incarnation",
+                                    "restarts", "budget", "backoff_s",
+                                    "detect_ts"),
+    EventKind.SERVE_FLEET_HANDOFF: ("request_id", "from_worker", "to_worker",
+                                    "attempt", "reason"),
+    EventKind.SERVE_FLEET_REQUEUE: ("request_id", "reason", "incarnation"),
+    EventKind.SERVE_FLEET_DEGRADED: ("request_id", "reason",
+                                     "prefill_alive"),
+    EventKind.SERVE_FLEET_BUNDLE: ("request_id", "worker", "attempt",
+                                   "prefix_len", "nbytes"),
+    EventKind.SERVE_FLEET_BUNDLE_REJECT: ("request_id", "worker", "attempt",
+                                          "reason"),
+    EventKind.SERVE_FLEET_DONE: ("accepted", "completed", "rejected", "lost",
+                                 "wall_s"),
+    EventKind.SERVE_FLEET_ABORT: ("reason", "role", "restarts"),
     EventKind.PERF_RECOMPILE: ("program", "registry", "count", "shapes",
                                "compile_s"),
     EventKind.PERF_HOST_SYNC: ("label", "count"),

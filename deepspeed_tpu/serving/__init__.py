@@ -21,7 +21,13 @@ is the production front half:
 - ``metrics`` + supervision ``EventJournal`` ``serve.*`` events: queue
   depth, TTFT, tokens/sec, slot occupancy — the black box and the
   dashboard of the serving plane (``scripts/serve_bench.py`` tracks them
-  as ``BENCH_SERVE.json``).
+  as ``BENCH_SERVE.json``);
+- ``fleet`` + ``worker_main``: the disaggregated serving fleet — prefill
+  workers and a decode engine as separate supervised OS processes, KV
+  handed off through digest-manifested spool page bundles, health-driven
+  failover (prefill retry, decode-bounce requeue, local-prefill
+  degradation), scored as serving goodput by
+  ``goodput/serve_scenarios.py`` → ``BENCH_SERVE_FLEET.json``.
 
 Entry point: ``InferenceEngine.serve()`` or :class:`ServingGateway`
 directly.  Reference: ``docs/serving.md``.
@@ -30,6 +36,8 @@ directly.  Reference: ``docs/serving.md``.
 from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
 from .config import (SERVING, PagingConfig, ServingConfig,  # noqa: F401
                      SpeculativeConfig)
+from .fleet import (BundleCorruptError, ServeFleetConfig,  # noqa: F401
+                    ServeFleetSupervisor)
 from .gateway import ServingGateway  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .paging import (BlockAllocator, PagedKVPool, ParkCorruptError,  # noqa: F401
@@ -44,4 +52,5 @@ __all__ = [
     "RequestState", "QueueFullError", "RequestCancelled", "RequestFailed",
     "RequestTimedOut", "BlockAllocator", "PagedKVPool", "ParkStore",
     "SessionPager", "PoolExhaustedError", "ParkCorruptError",
+    "ServeFleetConfig", "ServeFleetSupervisor", "BundleCorruptError",
 ]

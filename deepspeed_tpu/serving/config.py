@@ -20,7 +20,7 @@ Same validated dataclass-model style as ``supervision/config.py``:
         "paging": {"enabled": false, "block_tokens": 16,
                    "pool_blocks": null, "park_capacity": 64,
                    "park_dir": null, "park_ttl_s": 600.0,
-                   "park_verify": true},
+                   "park_verify": true, "hbm_high_watermark": null},
         "speculative": {"enabled": false, "draft_k": 3, "draft": null}
     }}
 
@@ -64,6 +64,12 @@ class PagingConfig(DeepSpeedConfigModel):
     #: verify the park-time SHA-256 on re-admission (corrupt KV is
     #: rejected and re-prefilled, never decoded)
     park_verify: bool = True
+    #: HBM pressure watermark in bytes: when the telemetry live-buffer
+    #: census exceeds it, the pager proactively parks pool-LRU sessions
+    #: (journaled ``serve.page_evict`` with the observed pressure) instead
+    #: of waiting for static pool exhaustion.  None disables the census
+    #: path (exhaustion-driven eviction still runs)
+    hbm_high_watermark: Optional[int] = None
 
     def __post_init__(self):
         bt = self.block_tokens
@@ -83,6 +89,11 @@ class PagingConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"serving.paging.park_ttl_s must be > 0, got "
                 f"{self.park_ttl_s}")
+        if self.hbm_high_watermark is not None and \
+                self.hbm_high_watermark < 1:
+            raise ValueError(
+                f"serving.paging.hbm_high_watermark must be >= 1 byte, "
+                f"got {self.hbm_high_watermark}")
 
 
 #: keys a ``"speculative"."draft"`` geometry spec may carry

@@ -1,0 +1,791 @@
+"""Disaggregated serving fleet: prefill/decode split with failover.
+
+One wedged prefill or one killed scheduler must not lose every in-flight
+conversation — so the serving stack gets the same treatment training got
+in the goodput fleet (``goodput/fleet.py``): real OS processes in
+separate failure domains, supervised over a shared run directory.
+
+Roles (spawned as ``python -m deepspeed_tpu.serving.worker_main``):
+
+- **prefill workers** (ranks ``1..n_prefill``) chunked-prefill a prompt's
+  first ``S-1`` tokens and publish the KV as an atomic, SHA-256-manifested
+  *page bundle* in the shared spool — the ``ParkStore`` npz layout
+  (``bank{i}`` + ``tokens`` + ``meta`` + embedded content ``sha``), plus a
+  sidecar manifest carrying the whole-file digest, so bitrot between
+  processes is caught before a single corrupt KV row is decoded;
+- **one decode engine** (rank ``0``) runs the ``SlotBatcher`` tick loop
+  and admits via page re-admission: rebuild the bundle's banks into a
+  batch-1 cache, ride the existing prefix-resume path
+  (``PrefixEntry(cache, S-1)``), prefill only the final prompt token
+  locally — greedy output is bitwise-identical to a local prefill.
+
+The :class:`ServeFleetSupervisor` is the gateway: it admits requests
+(bounded queue, loud rejects), routes prefill work, watches health
+(process exits + a pull-based :class:`HeartbeatMonitor` over per-worker
+beats), and drives the failover state machine —
+
+- a prefill attempt that times out or whose owner dies is **retried on a
+  surviving worker** (exponential backoff, bounded attempts, per-request
+  attribution via attempt-numbered bundles — a straggler's late bundle
+  for a superseded attempt is ignored);
+- a decode-engine bounce **requeues decode-resident requests through the
+  spool**: orders and bundles persist, the respawned incarnation rescans
+  its inbox, skips requests whose results already landed, and re-admits
+  the rest from their bundles;
+- an empty prefill fleet (or an attempt budget exhausted) **degrades to
+  local prefill on the decode engine** — journaled loudly
+  (``serve.fleet.degraded``), never wedged.
+
+Every membership change, handoff, and degradation journals as a
+``serve.fleet.*`` event (rank ``-1`` = the supervisor), so
+``goodput/serve_scenarios.py`` scores request goodput / TTFT-under-fault /
+MTTR purely from ``events.jsonl``.  Docs: ``docs/serving.md``
+"Serving fleet".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime.supervision.events import EventJournal, EventKind
+from ..runtime.supervision.heartbeat import HeartbeatMonitor, heartbeat_path
+from ..utils import fault_injection
+from ..utils.logging import logger
+
+#: journal rank the supervisor writes under (workers use their fleet rank)
+SUPERVISOR_RANK = -1
+#: the decode engine's fleet rank; prefill workers are ``1..n_prefill``
+DECODE_RANK = 0
+#: spool sentinel asking every worker to drain and exit orderly
+STOP_NAME = "stop"
+
+
+class BundleCorruptError(RuntimeError):
+    """A spool page bundle failed its digest / content check — the decode
+    engine must nack it back into a re-prefill, never decode from it."""
+
+
+# ------------------------------------------------------------ page bundles
+
+
+def bundle_file_digest(path: str) -> str:
+    """SHA-256 of the bundle file bytes (the manifest's digest — catches
+    bitrot anywhere in the file, npz structure included)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def bundle_paths(bundles_dir: str, rid: str, attempt: int) -> Tuple[str, str]:
+    """(npz path, manifest path) for one attempt — attempt-numbered so a
+    straggler's late bundle never masquerades as the current attempt's."""
+    stem = os.path.join(bundles_dir, f"{rid}.a{int(attempt)}")
+    return stem + ".npz", stem + ".json"
+
+
+def publish_bundle(bundles_dir: str, rid: str, attempt: int,
+                   banks: List["Any"], tokens: "Any", length: int,
+                   worker: int) -> Dict[str, Any]:
+    """Atomically land one KV page bundle + its manifest; returns the
+    manifest dict.  Layout rides the ``ParkStore`` npz format so the two
+    host tiers share one verification story; the manifest (written LAST,
+    its presence = bundle complete) carries the whole-file digest taken
+    *before* the ``serve.bundle_write`` fault point, so injected bitrot is
+    caught downstream."""
+    import numpy as np
+    from ..runtime.checkpoint_engine.storage import (atomic_write_npz,
+                                                     atomic_write_text)
+    from .paging import _sha_banks
+    arrays: Dict[str, Any] = {f"bank{i}": b for i, b in enumerate(banks)}
+    arrays["tokens"] = np.asarray(tokens, np.int32)
+    arrays["meta"] = np.asarray([int(length)], np.int64)
+    sha = _sha_banks(banks, length)
+    arrays["sha"] = np.frombuffer(bytes.fromhex(sha), np.uint8)
+    npz_path, manifest_path = bundle_paths(bundles_dir, rid, attempt)
+    npz_path = atomic_write_npz(npz_path, arrays)
+    digest = bundle_file_digest(npz_path)
+    fault_injection.fire("serve.bundle_write", path=npz_path)
+    manifest = {"rid": rid, "attempt": int(attempt), "worker": int(worker),
+                "prefix_len": int(length), "sha256": digest,
+                "nbytes": os.path.getsize(npz_path),
+                "bundle": os.path.basename(npz_path)}
+    atomic_write_text(manifest_path, json.dumps(manifest, sort_keys=True))
+    return manifest
+
+
+def load_bundle(npz_path: str, expect_digest: Optional[str] = None):
+    """Read a page bundle back as ``(banks, tokens, length)``; raises
+    :class:`BundleCorruptError` on a file-digest mismatch, a torn/garbage
+    npz, or an embedded content-SHA mismatch."""
+    import numpy as np
+    from .paging import _sha_banks
+    if expect_digest is not None:
+        try:
+            digest = bundle_file_digest(npz_path)
+        except OSError as e:
+            raise BundleCorruptError(f"bundle unreadable: {e}") from e
+        if digest != expect_digest:
+            raise BundleCorruptError(
+                f"bundle digest mismatch for {os.path.basename(npz_path)}: "
+                f"manifest {expect_digest[:12]}.. != file {digest[:12]}..")
+    try:
+        with np.load(npz_path) as z:
+            length = int(z["meta"][0])
+            tokens = np.asarray(z["tokens"], np.int32)
+            keys = sorted((k for k in z.files if k.startswith("bank")),
+                          key=lambda k: int(k[4:]))
+            banks = [z[k] for k in keys]
+            stored = bytes(z["sha"].tobytes()).hex()
+    except (OSError, ValueError, KeyError, EOFError) as e:
+        raise BundleCorruptError(f"bundle unparseable: {e}") from e
+    if _sha_banks(banks, length) != stored:
+        raise BundleCorruptError(
+            f"bundle content SHA mismatch for "
+            f"{os.path.basename(npz_path)}")
+    return banks, tokens, length
+
+
+def rebuild_prefix_cache(batcher, banks: List["Any"], length: int):
+    """Bundle banks (trimmed to ``length`` rows) → a batch-1
+    slot-geometry cache, mirroring ``PagedKVPool.rebuild``: rows past the
+    frontier are zero, masked by per-row visibility exactly like
+    prefill-chunk padding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from .paging import _is_bank
+    fam, cfg = batcher._fam, batcher._cfg
+    template = fam.init_cache(cfg, 1, batcher.max_len,
+                              kv_dtype=batcher._kv_dtype)
+    flat, treedef = jax.tree_util.tree_flatten(template)
+    it = iter(banks)
+    out = []
+    for leaf in flat:
+        if _is_bank(leaf):
+            src = next(it)
+            full = np.zeros(leaf.shape, np.asarray(leaf).dtype)
+            full[:, :, :src.shape[2]] = src
+            out.append(jnp.asarray(full))
+        else:
+            out.append(jnp.asarray(int(length), jnp.int32))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ------------------------------------------------------------------ config
+
+
+@dataclasses.dataclass
+class ServeFleetConfig:
+    """Geometry + policy for one serving-fleet run; serialized to
+    ``serve_fleet.json`` so worker respawns are stateless."""
+
+    n_prefill: int = 2
+    slots: int = 2
+    max_len: int = 64
+    prefill_chunk: int = 8
+    queue_capacity: int = 16
+    # tiny-GPT fixture geometry (every role builds the identical model
+    # from the shared seed — what makes cross-process handoff bitwise)
+    n_layer: int = 1
+    n_head: int = 2
+    d_model: int = 32
+    seed: int = 0
+    # health
+    heartbeat_interval_s: float = 0.2
+    heartbeat_gap_s: float = 3.0
+    # failover policy
+    prefill_timeout_s: float = 15.0
+    max_attempts: int = 3
+    retry_backoff_s: float = 0.25
+    max_restarts: int = 2          # per worker, not whole-fleet
+    respawn_backoff_s: float = 0.2
+    local_prefill_fallback: bool = True
+    # run driver
+    run_timeout_s: float = 300.0
+    poll_s: float = 0.05
+    stop_grace_s: float = 15.0
+    # bounded wait for the first incarnation to finish warmup before the
+    # arrival clock starts: scheduled arrivals (and the TTFT they anchor)
+    # are meaningful against a warm fleet, and a seeded per-worker fault
+    # step can't be dodged by one worker jit-compiling past the whole
+    # workload on a loaded machine (0 = start the clock immediately)
+    warm_barrier_s: float = 120.0
+
+    @classmethod
+    def from_scenario(cls, scenario, **overrides) -> "ServeFleetConfig":
+        base = dict(scenario.fleet_overrides)
+        base.setdefault("n_prefill", scenario.n_prefill)
+        base.setdefault("seed", scenario.seed)
+        base.update(overrides)
+        return cls(**base)
+
+    def child_payload(self, run_dir: str) -> Dict[str, Any]:
+        doc = dataclasses.asdict(self)
+        doc["run_dir"] = run_dir
+        return doc
+
+
+# -------------------------------------------------------------- accounting
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: str
+    tokens: Any                      # np.int32 [S]
+    max_new_tokens: int
+    greedy: bool
+    temperature: float
+    seed: int
+    t_submit: float                  # wall clock (TTFT anchor)
+    state: str = "pending"           # pending|prefilling|routed|done|failed
+    attempt: int = 0
+    worker: Optional[int] = None     # prefill rank owning the live attempt
+    t_assigned: float = 0.0          # monotonic
+    next_eligible: float = 0.0       # monotonic backoff gate
+    retry_reason: Optional[str] = None
+    local: bool = False
+    result: Optional[Dict[str, Any]] = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in ("done", "failed")
+
+
+@dataclasses.dataclass
+class _Worker:
+    role: str                        # "decode" | "prefill"
+    rank: int
+    proc: Optional[subprocess.Popen] = None
+    incarnation: int = 0
+    restarts: int = 0
+    alive: bool = False
+    ready_inc: int = -1              # incarnation whose warmup finished
+    respawn_at: Optional[float] = None
+    pending_detect_ts: Optional[float] = None
+    gone: bool = False               # restart budget exhausted
+
+
+class ServeFleetSupervisor:
+    """Spawn the roles, route admission, watch health, fail over — the
+    disaggregated gateway.  Single-threaded by design: every decision
+    happens in :meth:`poll`, every decision lands in the journal."""
+
+    def __init__(self, run_dir: str,
+                 config: Optional[ServeFleetConfig] = None,
+                 scenario=None):
+        if config is None:
+            if scenario is None:
+                raise ValueError("need a ServeFleetConfig or a scenario")
+            config = ServeFleetConfig.from_scenario(scenario)
+        self.config = config
+        self.scenario = scenario
+        self.run_dir = str(run_dir)
+        self.spool_dir = os.path.join(self.run_dir, "spool")
+        self.heartbeat_dir = os.path.join(self.run_dir, "heartbeats")
+        self.log_dir = os.path.join(self.run_dir, "logs")
+        self.bundles_dir = os.path.join(self.spool_dir, "bundles")
+        self.decode_dir = os.path.join(self.spool_dir, "decode")
+        self.results_dir = os.path.join(self.spool_dir, "results")
+        self.ready_dir = os.path.join(self.spool_dir, "ready")
+        for d in (self.run_dir, self.spool_dir, self.log_dir,
+                  self.bundles_dir, self.decode_dir, self.results_dir,
+                  self.ready_dir):
+            os.makedirs(d, exist_ok=True)
+        for r in range(1, config.n_prefill + 1):
+            os.makedirs(self._prefill_inbox(r), exist_ok=True)
+        self.journal = EventJournal(
+            os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
+        self._config_path = os.path.join(self.run_dir, "serve_fleet.json")
+        from ..runtime.checkpoint_engine.storage import atomic_write_text
+        atomic_write_text(self._config_path,
+                          json.dumps(config.child_payload(self.run_dir),
+                                     indent=1, sort_keys=True))
+        self.workers: Dict[int, _Worker] = {
+            DECODE_RANK: _Worker("decode", DECODE_RANK)}
+        for r in range(1, config.n_prefill + 1):
+            self.workers[r] = _Worker("prefill", r)
+        self.monitor = HeartbeatMonitor(
+            self.heartbeat_dir, gap_s=config.heartbeat_gap_s,
+            journal=self.journal)
+        self.requests: Dict[str, _Request] = {}
+        self._seq = 0
+        self._rejects = 0
+        self._rr = 0                 # round-robin cursor over prefill ranks
+        self._aborted: Optional[str] = None
+        self._log_handles: List[Any] = []
+
+    # --------------------------------------------------------------- paths
+    def _prefill_inbox(self, rank: int) -> str:
+        return os.path.join(self.spool_dir, "prefill", f"w{rank}")
+
+    def _order_path(self, req: _Request) -> str:
+        return os.path.join(self._prefill_inbox(req.worker),
+                            f"{req.rid}.a{req.attempt}.json")
+
+    def _decode_order_path(self, rid: str, attempt: int) -> str:
+        return os.path.join(self.decode_dir, f"{rid}.a{attempt}.json")
+
+    def _result_path(self, rid: str) -> str:
+        return os.path.join(self.results_dir, f"{rid}.json")
+
+    def _nack_path(self, rid: str, attempt: int) -> str:
+        return os.path.join(self.results_dir, f"{rid}.a{attempt}.nack.json")
+
+    def _sentinel_path(self, w: _Worker) -> str:
+        return os.path.join(self.run_dir, f"{w.role}{w.rank}.exit.json")
+
+    def _ready_path(self, w: _Worker) -> str:
+        return os.path.join(self.ready_dir, f"{w.role}{w.rank}.json")
+
+    # --------------------------------------------------------------- spawn
+    def _child_env(self, w: _Worker) -> Dict[str, str]:
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DS_SERVE_CONFIG"] = self._config_path
+        env["DS_SERVE_ROLE"] = w.role
+        env["DS_SERVE_RANK"] = str(w.rank)
+        env["DS_SERVE_INC"] = str(w.incarnation)
+        plan = self.scenario.plan_for(w.rank, w.incarnation) \
+            if self.scenario is not None else ""
+        if plan:
+            env[fault_injection.PLAN_ENV] = plan
+        else:
+            env.pop(fault_injection.PLAN_ENV, None)
+        return env
+
+    def _spawn(self, w: _Worker) -> None:
+        """Spawn one worker incarnation; stale liveness from the previous
+        incarnation (beat, ready marker, sentinel) is removed first so the
+        monitor never reads a corpse as alive."""
+        for path in (heartbeat_path(self.heartbeat_dir, w.rank),
+                     self._ready_path(w), self._sentinel_path(w)):
+            try:
+                os.remove(path)
+            except FileNotFoundError:  # dslint: disable=swallowed-exception — first incarnation has nothing to sweep
+                pass
+        log_path = os.path.join(
+            self.log_dir, f"{w.role}{w.rank}.inc{w.incarnation}.log")
+        log = open(log_path, "ab")
+        self._log_handles.append(log)
+        w.proc = subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.serving.worker_main"],
+            env=self._child_env(w), stdout=log, stderr=subprocess.STDOUT,
+            cwd=self.run_dir)
+        w.alive = True
+        w.respawn_at = None
+        self.journal.emit(EventKind.SERVE_FLEET_SPAWN, role=w.role,
+                          worker=w.rank, incarnation=w.incarnation,
+                          pid=w.proc.pid)
+
+    def start(self) -> None:
+        for w in self.workers.values():
+            self._spawn(w)
+
+    # ----------------------------------------------------------- admission
+    def submit(self, tokens, max_new_tokens: int = 8, greedy: bool = True,
+               temperature: float = 1.0, seed: int = 0) -> Optional[str]:
+        """Admit one request into the fleet (or reject loudly when the
+        bounded queue is full); returns the request id, or None on
+        reject."""
+        import numpy as np
+        tokens = np.asarray(tokens, np.int32)
+        inflight = sum(1 for r in self.requests.values() if not r.terminal)
+        if inflight >= self.config.queue_capacity:
+            self._rejects += 1
+            self.journal.emit(EventKind.SERVE_REJECT,
+                              request_id=f"req-{self._seq:04d}",
+                              reason="queue_full", queue_depth=inflight)
+            return None
+        if int(tokens.shape[0]) + int(max_new_tokens) > self.config.max_len:
+            self._rejects += 1
+            self.journal.emit(EventKind.SERVE_REJECT,
+                              request_id=f"req-{self._seq:04d}",
+                              reason="overflow", queue_depth=inflight)
+            return None
+        rid = f"req-{self._seq:04d}"
+        self._seq += 1
+        self.requests[rid] = _Request(
+            rid=rid, tokens=tokens, max_new_tokens=int(max_new_tokens),
+            greedy=bool(greedy), temperature=float(temperature),
+            seed=int(seed), t_submit=time.time())
+        self.journal.emit(EventKind.SERVE_REQUEST, request_id=rid,
+                          prompt_len=int(tokens.shape[0]),
+                          max_new_tokens=int(max_new_tokens), priority=0,
+                          queue_depth=inflight + 1)
+        return rid
+
+    # -------------------------------------------------------------- health
+    def _alive_prefill(self, ready_only: bool = True) -> List[_Worker]:
+        out = []
+        for w in self.workers.values():
+            if w.role != "prefill" or not w.alive:
+                continue
+            if ready_only and w.ready_inc != w.incarnation:
+                continue
+            out.append(w)
+        return out
+
+    def _prefill_possible(self) -> bool:
+        """Any prefill worker alive or still respawnable?"""
+        return any(w.role == "prefill" and not w.gone
+                   for w in self.workers.values())
+
+    def _check_ready(self) -> None:
+        for w in self.workers.values():
+            if not w.alive or w.ready_inc == w.incarnation:
+                continue
+            try:
+                with open(self._ready_path(w)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if int(doc.get("incarnation", -1)) == w.incarnation:
+                w.ready_inc = w.incarnation
+
+    def _check_processes(self) -> None:
+        stop_requested = os.path.exists(
+            os.path.join(self.spool_dir, STOP_NAME))
+        for w in self.workers.values():
+            if not w.alive or w.proc is None:
+                continue
+            rc = w.proc.poll()
+            if rc is None:
+                continue
+            if stop_requested and rc == 0:
+                w.alive = False       # orderly drain exit
+                continue
+            self._on_worker_death(w, rc, reason="crashed")
+
+    def _check_heartbeats(self) -> None:
+        try:
+            report = self.monitor.check()
+        except Exception as e:  # observability must not kill the fleet
+            logger.warning(f"[serve-fleet] heartbeat check failed: {e!r}")
+            return
+        for item in report.get("stale", ()):
+            w = self.workers.get(int(item["rank"]))
+            # a stale beat from a RUNNING process is a wedged worker (a
+            # dead one is handled by _check_processes); only a worker
+            # that finished warmup has promised a cadence to hold
+            if w is None or not w.alive or w.proc is None \
+                    or w.proc.poll() is not None \
+                    or w.ready_inc != w.incarnation:
+                continue
+            logger.warning(
+                f"[serve-fleet] {w.role}{w.rank} beat is "
+                f"{item['age_s']:.1f}s stale — killing the wedged worker")
+            w.proc.kill()
+            try:
+                w.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    f"[serve-fleet] {w.role}{w.rank} survived SIGKILL "
+                    f"wait — reaping it as dead anyway")
+            self._on_worker_death(w, w.proc.returncode, reason="stale")
+
+    def _on_worker_death(self, w: _Worker, returncode, reason: str) -> None:
+        detect_ts = time.time()
+        w.alive = False
+        self.journal.emit(EventKind.SERVE_FLEET_WORKER_LOST, role=w.role,
+                          worker=w.rank, incarnation=w.incarnation,
+                          returncode=returncode, reason=reason,
+                          detect_ts=detect_ts)
+        if w.role == "prefill":
+            for req in self.requests.values():
+                if req.state == "prefilling" and req.worker == w.rank:
+                    self._retry_prefill(req, reason="worker_lost")
+        else:
+            # decode-resident requests requeue THROUGH THE SPOOL: their
+            # orders and bundles persist, the respawned incarnation
+            # rescans, skips completed results, and re-admits the rest
+            for req in self.requests.values():
+                if req.state == "routed":
+                    self.journal.emit(EventKind.SERVE_FLEET_REQUEUE,
+                                      request_id=req.rid,
+                                      reason="decode_bounce",
+                                      incarnation=w.incarnation + 1)
+        if w.restarts >= self.config.max_restarts:
+            w.gone = True
+            if w.role == "decode":
+                self._abort("decode restart budget exhausted", w)
+            elif not self._prefill_possible():
+                logger.warning(
+                    "[serve-fleet] prefill fleet empty — degrading every "
+                    "pending admission to decode-local prefill")
+            return
+        w.restarts += 1
+        backoff = self.config.respawn_backoff_s * (2 ** (w.restarts - 1))
+        w.respawn_at = time.monotonic() + backoff
+        w.pending_detect_ts = detect_ts
+
+    def _check_respawns(self) -> None:
+        now = time.monotonic()
+        for w in self.workers.values():
+            if w.respawn_at is None or w.gone or now < w.respawn_at:
+                continue
+            w.incarnation += 1
+            backoff = self.config.respawn_backoff_s * (2 ** (w.restarts - 1))
+            self.journal.emit(EventKind.SERVE_FLEET_RESTART, role=w.role,
+                              worker=w.rank, incarnation=w.incarnation,
+                              restarts=w.restarts,
+                              budget=self.config.max_restarts,
+                              backoff_s=round(backoff, 3),
+                              detect_ts=w.pending_detect_ts)
+            w.pending_detect_ts = None
+            self._spawn(w)
+
+    def _abort(self, reason: str, w: Optional[_Worker] = None) -> None:
+        if self._aborted is not None:
+            return
+        self._aborted = reason
+        self.journal.emit(EventKind.SERVE_FLEET_ABORT, reason=reason,
+                          role=None if w is None else w.role,
+                          restarts=None if w is None else w.restarts)
+        for req in self.requests.values():
+            if not req.terminal:
+                req.state = "failed"
+
+    # ------------------------------------------------------------- routing
+    def _atomic_write(self, path: str, doc: Dict[str, Any]) -> None:
+        from ..runtime.checkpoint_engine.storage import atomic_write_text
+        atomic_write_text(path, json.dumps(doc, sort_keys=True))
+
+    def _assign_prefill(self, req: _Request) -> None:
+        """Place a pending request on a live prefill worker (round-robin,
+        avoiding the previous owner on a retry) — or degrade."""
+        if time.monotonic() < req.next_eligible:
+            return
+        if int(req.tokens.shape[0]) < 2 or not self._prefill_possible():
+            self._degrade(req, reason="prefill_fleet_empty"
+                          if int(req.tokens.shape[0]) >= 2
+                          else "prompt_too_short")
+            return
+        candidates = self._alive_prefill(ready_only=True)
+        if not candidates:
+            return  # workers respawning / warming — try next poll
+        if len(candidates) > 1 and req.worker is not None:
+            candidates = [w for w in candidates if w.rank != req.worker] \
+                or candidates
+        target = candidates[self._rr % len(candidates)]
+        self._rr += 1
+        prev = req.worker
+        req.worker = target.rank
+        req.state = "prefilling"
+        req.t_assigned = time.monotonic()
+        self._atomic_write(self._order_path(req), {
+            "rid": req.rid, "attempt": req.attempt,
+            "tokens": [int(t) for t in req.tokens],
+            "t_submit": req.t_submit, "greedy": req.greedy,
+            "temperature": req.temperature, "seed": req.seed})
+        if req.attempt > 0:
+            self.journal.emit(EventKind.SERVE_FLEET_HANDOFF,
+                              request_id=req.rid, from_worker=prev,
+                              to_worker=target.rank, attempt=req.attempt,
+                              reason=req.retry_reason)
+
+    def _retry_prefill(self, req: _Request, reason: str) -> None:
+        """One failed attempt → either the next (backed off, on another
+        worker) or degradation; the stale order file is removed so a
+        respawned owner never re-runs a superseded attempt."""
+        if req.worker is not None:
+            try:
+                os.remove(self._order_path(req))
+            except OSError:  # dslint: disable=swallowed-exception — already consumed or the owner died with it
+                pass
+        if req.attempt + 1 >= self.config.max_attempts:
+            self._degrade(req, reason="attempts_exhausted")
+            return
+        req.attempt += 1
+        req.retry_reason = reason
+        req.state = "pending"
+        backoff = self.config.retry_backoff_s * (2 ** (req.attempt - 1))
+        req.next_eligible = time.monotonic() + backoff
+
+    def _degrade(self, req: _Request, reason: str) -> None:
+        if not self.config.local_prefill_fallback:
+            req.state = "failed"
+            return
+        req.local = True
+        self.journal.emit(EventKind.SERVE_FLEET_DEGRADED,
+                          request_id=req.rid, reason=reason,
+                          prefill_alive=len(self._alive_prefill(
+                              ready_only=False)))
+        self._route_decode(req, manifest=None)
+
+    def _route_decode(self, req: _Request,
+                      manifest: Optional[Dict[str, Any]]) -> None:
+        order = {"rid": req.rid, "attempt": req.attempt,
+                 "tokens": [int(t) for t in req.tokens],
+                 "max_new_tokens": req.max_new_tokens,
+                 "greedy": req.greedy, "temperature": req.temperature,
+                 "seed": req.seed, "t_submit": req.t_submit,
+                 "local": manifest is None, "bundle": None, "sha256": None,
+                 "prefill_worker": None}
+        if manifest is not None:
+            order["bundle"] = manifest["bundle"]
+            order["sha256"] = manifest["sha256"]
+            order["prefill_worker"] = manifest["worker"]
+        self._atomic_write(self._decode_order_path(req.rid, req.attempt),
+                           order)
+        req.state = "routed"
+
+    def _check_spool(self) -> None:
+        now = time.monotonic()
+        for req in self.requests.values():
+            if req.terminal:
+                continue
+            if req.state == "pending":
+                self._assign_prefill(req)
+            elif req.state == "prefilling":
+                _npz, manifest_path = bundle_paths(
+                    self.bundles_dir, req.rid, req.attempt)
+                manifest = self._read_json(manifest_path)
+                if manifest is not None and \
+                        int(manifest.get("attempt", -1)) == req.attempt:
+                    self._route_decode(req, manifest)
+                elif now - req.t_assigned > self.config.prefill_timeout_s:
+                    self._retry_prefill(req, reason="timeout")
+            elif req.state == "routed":
+                result = self._read_json(self._result_path(req.rid))
+                if result is not None:
+                    req.result = result
+                    req.state = "done"
+                    continue
+                nack = self._read_json(
+                    self._nack_path(req.rid, req.attempt))
+                if nack is not None and not req.local:
+                    try:
+                        os.remove(self._decode_order_path(
+                            req.rid, req.attempt))
+                    except OSError:  # dslint: disable=swallowed-exception — decode may race the removal; seen-set dedup covers it
+                        pass
+                    self._retry_prefill(req, reason="bundle_reject")
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # ---------------------------------------------------------------- run
+    def poll(self) -> None:
+        """One supervisor heartbeat: health, membership, routing."""
+        if self._aborted is not None:
+            return
+        self._check_processes()
+        self._check_heartbeats()
+        self._check_ready()
+        self._check_respawns()
+        self._check_spool()
+
+    def _warm_barrier(self) -> None:
+        """Bounded wait (``warm_barrier_s``) until every live worker's
+        current incarnation has finished warmup.  poll() keeps running so
+        a worker that dies *while compiling* is still detected and
+        respawned; on barrier timeout the clock starts anyway — a wedged
+        warmup must not hang the run forever."""
+        if self.config.warm_barrier_s <= 0:
+            return
+        deadline = time.monotonic() + self.config.warm_barrier_s
+        while time.monotonic() < deadline:
+            self.poll()
+            if self._aborted is not None:
+                return
+            live = [w for w in self.workers.values() if w.alive]
+            if live and all(w.ready_inc == w.incarnation for w in live):
+                return
+            time.sleep(self.config.poll_s)
+        logger.warning("[serve-fleet] warm barrier timed out after "
+                       f"{self.config.warm_barrier_s:.0f}s — starting the "
+                       "arrival clock with a partially-warm fleet")
+
+    def run(self, workload: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Drive a seeded workload to completion: submit arrivals on
+        schedule, poll the state machine, drain, summarize.  ``workload``
+        items: ``{"at_s", "tokens", "max_new_tokens", ...}``."""
+        cfg = self.config
+        self.start()
+        arrivals = sorted(workload, key=lambda it: it["at_s"])
+        self._warm_barrier()
+        t0 = time.monotonic()
+        i = 0
+        try:
+            while True:
+                now = time.monotonic() - t0
+                while i < len(arrivals) and arrivals[i]["at_s"] <= now:
+                    it = arrivals[i]
+                    self.submit(it["tokens"],
+                                max_new_tokens=it.get("max_new_tokens", 8),
+                                greedy=it.get("greedy", True),
+                                temperature=it.get("temperature", 1.0),
+                                seed=it.get("seed", 0))
+                    i += 1
+                self.poll()
+                if self._aborted is not None:
+                    break
+                if i == len(arrivals) and all(
+                        r.terminal for r in self.requests.values()):
+                    break
+                if time.monotonic() - t0 > cfg.run_timeout_s:
+                    self._abort("run timeout")
+                    break
+                time.sleep(cfg.poll_s)
+        finally:
+            self._stop_workers()
+        accepted = len(self.requests)
+        completed = sum(1 for r in self.requests.values()
+                        if r.state == "done")
+        lost = accepted - completed
+        wall = time.monotonic() - t0
+        self.journal.emit(EventKind.SERVE_FLEET_DONE, accepted=accepted,
+                          completed=completed, rejected=self._rejects,
+                          lost=lost, wall_s=round(wall, 3))
+        return {"completed": self._aborted is None,
+                "aborted": self._aborted,
+                "accepted": accepted, "done": completed, "lost": lost,
+                "rejected": self._rejects, "wall_s": round(wall, 3),
+                "results": {rid: (r.result or {}).get("tokens")
+                            for rid, r in self.requests.items()
+                            if r.state == "done"}}
+
+    def _stop_workers(self) -> None:
+        from ..runtime.checkpoint_engine.storage import atomic_write_text
+        atomic_write_text(os.path.join(self.spool_dir, STOP_NAME), "stop")
+        deadline = time.monotonic() + self.config.stop_grace_s
+        for w in self.workers.values():
+            if w.proc is None:
+                continue
+            timeout = max(0.1, deadline - time.monotonic())
+            try:
+                w.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                try:
+                    w.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        f"[serve-fleet] {w.role}{w.rank} survived SIGKILL "
+                        f"wait — leaking the process")
+            w.alive = False
+        for h in self._log_handles:
+            try:
+                h.close()
+            except OSError as e:  # a leaked handle must not mask the run
+                logger.warning(f"[serve-fleet] log close failed: {e}")
+        self._log_handles = []

@@ -520,6 +520,9 @@ class SessionPager:
         self.sessions: "OrderedDict[str, TieredSession]" = OrderedDict()
         self.rows: Dict[int, _RowLedger] = {}
         self.slot_bytes = cache_bank_bytes(batcher.cache)
+        #: HBM census watermark (bytes); None = exhaustion-driven only
+        self.hbm_high_watermark = config.hbm_high_watermark
+        self._last_census_t = 0.0
 
     # ---------------------------------------------------------- accounting
 
@@ -649,7 +652,8 @@ class SessionPager:
                 if not self._evict_pool_lru():
                     return None
 
-    def _evict_pool_lru(self) -> bool:
+    def _evict_pool_lru(self, reason: str = "pressure",
+                        **fields: Any) -> bool:
         """Park the least-recently-used pool-tier session to host RAM;
         returns False when nothing is evictable."""
         with self._lock:
@@ -661,7 +665,7 @@ class SessionPager:
         self._emit(EventKind.SERVE_PAGE_EVICT, session=victim.sid,
                    blocks=len(victim.table),
                    bytes=len(victim.table) * self.pool.block_bytes,
-                   reason="pressure")
+                   reason=reason, **fields)
         self._count("pool_evictions")
         # drop the pool-tier record and free its blocks FIRST —
         # _park_arrays re-inserts the session under its host tier
@@ -832,10 +836,43 @@ class SessionPager:
 
     # ----------------------------------------------------------- housekeep
 
+    def pressure_sweep(self, now: Optional[float] = None,
+                       live_bytes: Optional[int] = None,
+                       min_interval_s: float = 1.0,
+                       max_evictions: int = 4) -> int:
+        """HBM-census-driven eviction (``serving.paging.hbm_high_watermark``):
+        when the telemetry live-buffer census exceeds the watermark, park
+        pool-LRU sessions to host — bounded per sweep so one census spike
+        cannot wedge the scheduler loop — journaling ``serve.page_evict``
+        with the observed pressure.  The census walk is rate-limited
+        (``min_interval_s``); ``live_bytes`` overrides it for tests.
+        Returns the number of sessions evicted."""
+        wm = self.hbm_high_watermark
+        if wm is None:
+            return 0
+        now = time.monotonic() if now is None else now
+        if live_bytes is None:
+            if now - self._last_census_t < min_interval_s:
+                return 0
+            self._last_census_t = now
+            from ..telemetry.metrics import live_buffer_bytes
+            live_bytes = live_buffer_bytes()
+        if live_bytes <= wm:
+            return 0
+        evicted = 0
+        while evicted < max_evictions and self._evict_pool_lru(
+                reason="hbm_pressure", pressure=int(live_bytes),
+                watermark=int(wm)):
+            evicted += 1
+        return evicted
+
     def sweep(self, now: Optional[float] = None) -> None:
         """TTL sweep of the park store — runs from the scheduler tick
-        path, so an idle gateway still releases host memory."""
+        path, so an idle gateway still releases host memory; the HBM
+        pressure sweep (census vs ``hbm_high_watermark``) rides the same
+        cadence."""
         now = time.monotonic() if now is None else now
+        self.pressure_sweep(now)
         for sid, nbytes, idle in self.park.sweep(now):
             with self._lock:
                 self.sessions.pop(sid, None)

@@ -1,0 +1,329 @@
+"""Serving-fleet worker entry: ``python -m deepspeed_tpu.serving.worker_main``.
+
+Spawned by :class:`~deepspeed_tpu.serving.fleet.ServeFleetSupervisor`,
+one process per role instance.  Contract via environment:
+
+========================  ====================================================
+``DS_SERVE_CONFIG``       path to the run's ``serve_fleet.json``
+``DS_SERVE_ROLE``         ``"prefill"`` or ``"decode"``
+``DS_SERVE_RANK``         fleet rank (decode = 0, prefill = 1..n_prefill)
+``DS_SERVE_INC``          incarnation number (bumped by each respawn)
+``DS_FAULT_PLAN``         scenario faults, armed at import by
+                          ``fault_injection.install_env_plan``
+========================  ====================================================
+
+Every role builds the *identical* tiny-GPT fixture from the shared seed —
+that determinism is what makes a prefill worker's KV page bundle bitwise
+equivalent to a local prefill on the decode engine.
+
+A **prefill** worker drains its spool inbox: chunked-prefill the prompt's
+first ``S-1`` tokens (firing ``serve.prefill_chunk`` before each chunk —
+the kill/straggler fault point), publish the KV as a digest-manifested
+page bundle, journal ``serve.fleet.bundle``.
+
+The **decode** engine runs the ``SlotBatcher`` tick loop (firing
+``serve.decode_tick`` each round): admit orders from its inbox — bundle
+orders rebuild the pages into a batch-1 cache and ride the prefix-resume
+path; corrupt bundles are nacked back to the supervisor for re-prefill
+(``serve.fleet.bundle_reject``), never decoded; ``local`` orders prefill
+in place (the degraded path).  Results land as spool files; order files
+are never deleted, so a respawned incarnation rescans, skips requests
+whose results already landed, and re-admits the rest — that is the whole
+decode-bounce requeue story.  ``decode.stats.json`` snapshots compile
+counts after warmup and after every completion, so tests can assert
+zero steady-state recompiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _env() -> dict:
+    with open(os.environ["DS_SERVE_CONFIG"]) as f:
+        cfg = json.load(f)
+    cfg["role"] = os.environ["DS_SERVE_ROLE"]
+    cfg["rank"] = int(os.environ["DS_SERVE_RANK"])
+    cfg["incarnation"] = int(os.environ.get("DS_SERVE_INC", "0"))
+    return cfg
+
+
+def _build_batcher(cfg: dict, slots: int):
+    """The shared tiny-GPT fixture + a SlotBatcher over it — identical
+    across processes given the identical config payload."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.serving.batcher import SlotBatcher
+    from deepspeed_tpu.serving.config import ServingConfig
+    model_cfg = gpt.GPTConfig(
+        vocab_size=256, max_seq_len=int(cfg["max_len"]),
+        n_layer=int(cfg["n_layer"]), n_head=int(cfg["n_head"]),
+        d_model=int(cfg["d_model"]), dtype=jnp.float32, vocab_round_to=128)
+    params = gpt.init(model_cfg, jax.random.PRNGKey(int(cfg["seed"])))
+    engine = deepspeed_tpu.init_inference(model=(model_cfg, params),
+                                          config={"dtype": "float32"})
+    scfg = ServingConfig(slots=slots, max_len=int(cfg["max_len"]),
+                         prefill_chunk=int(cfg["prefill_chunk"]))
+    return SlotBatcher(engine, scfg)
+
+
+def _mark_ready(ready_dir: str, role: str, rank: int, inc: int) -> None:
+    from deepspeed_tpu.runtime.checkpoint_engine.storage import \
+        atomic_write_text
+    atomic_write_text(os.path.join(ready_dir, f"{role}{rank}.json"),
+                      json.dumps({"role": role, "rank": rank,
+                                  "incarnation": inc, "ts": time.time()}))
+
+
+def _stop_requested(spool: str) -> bool:
+    from deepspeed_tpu.serving.fleet import STOP_NAME
+    return os.path.exists(os.path.join(spool, STOP_NAME))
+
+
+def _scan_orders(inbox: str):
+    try:
+        names = sorted(os.listdir(inbox))
+    except OSError:
+        return []
+    return [n for n in names if n.endswith(".json")]
+
+
+# ------------------------------------------------------------------ prefill
+
+
+def _prefill_loop(cfg: dict, batcher, journal, spool: str) -> None:
+    import numpy as np
+    from deepspeed_tpu.runtime.supervision.events import EventKind
+    from deepspeed_tpu.serving.fleet import publish_bundle
+    from deepspeed_tpu.serving.paging import _host_banks
+    from deepspeed_tpu.utils import fault_injection
+    rank = cfg["rank"]
+    inbox = os.path.join(spool, "prefill", f"w{rank}")
+    bundles_dir = os.path.join(spool, "bundles")
+    C = batcher.chunk
+    # warm every program this role uses (prefill, extend, take_last)
+    # BEFORE publishing readiness — the supervisor's prefill timeout must
+    # clock prefill work, not first-order compilation
+    batcher.build_prefix(np.arange(2 * C, dtype=np.int32) % 256)
+    _mark_ready(os.path.join(spool, "ready"), "prefill", rank,
+                cfg["incarnation"])
+    seen = set()
+    chunks_done = 0           # worker-global: KillAtStep lands mid-prefill
+    while not _stop_requested(spool):
+        worked = False
+        for name in _scan_orders(inbox):
+            if name in seen:
+                continue
+            try:
+                with open(os.path.join(inbox, name)) as f:
+                    order = json.load(f)
+            except (OSError, ValueError):
+                continue      # torn/being-replaced — next scan gets it
+            seen.add(name)
+            worked = True
+            rid, attempt = order["rid"], int(order["attempt"])
+            tokens = np.asarray(order["tokens"], np.int32)
+            prefix = tokens[:-1]          # last token stays with decode
+            cache, frontier = None, 0
+            for pos in range(0, int(prefix.shape[0]), C):
+                fault_injection.fire("serve.prefill_chunk",
+                                     step=chunks_done, path=rid)
+                cache, _last, frontier = batcher._chunked_prefill(
+                    prefix[pos:pos + C], start_cache=cache, start_len=pos)
+                chunks_done += 1
+            banks = _host_banks(cache, frontier)
+            manifest = publish_bundle(bundles_dir, rid, attempt, banks,
+                                      prefix, frontier, worker=rank)
+            journal.emit(EventKind.SERVE_FLEET_BUNDLE, request_id=rid,
+                         worker=rank, attempt=attempt,
+                         prefix_len=manifest["prefix_len"],
+                         nbytes=manifest["nbytes"])
+        if not worked:
+            time.sleep(0.02)
+
+
+# ------------------------------------------------------------------- decode
+
+
+def _write_stats(run_dir: str, inc: int, warm: dict, batcher,
+                 ticks: int) -> None:
+    from deepspeed_tpu.runtime.checkpoint_engine.storage import \
+        atomic_write_text
+    atomic_write_text(os.path.join(run_dir, "decode.stats.json"),
+                      json.dumps({"incarnation": inc, "warm": warm,
+                                  "now": batcher.compile_counts(),
+                                  "ticks": ticks}, sort_keys=True))
+
+
+def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
+    import jax
+    import numpy as np
+    from deepspeed_tpu.runtime.checkpoint_engine.storage import \
+        atomic_write_text
+    from deepspeed_tpu.runtime.supervision.events import EventKind
+    from deepspeed_tpu.serving.batcher import PrefixEntry
+    from deepspeed_tpu.serving.fleet import (BundleCorruptError, load_bundle,
+                                             rebuild_prefix_cache)
+    from deepspeed_tpu.utils import fault_injection
+    rank, inc = cfg["rank"], cfg["incarnation"]
+    run_dir = cfg["run_dir"]
+    inbox = os.path.join(spool, "decode")
+    bundles_dir = os.path.join(spool, "bundles")
+    results_dir = os.path.join(spool, "results")
+    C, slots = batcher.chunk, int(cfg["slots"])
+
+    # warm EVERY decode-path program (prefill + extend via a 2-chunk
+    # prompt, take_last, write_slot, bind, tick, release) before declaring
+    # ready — steady state must be compile-free, and the stats snapshot
+    # below is what the recompile test pins against
+    warm_tokens = np.arange(C + 2, dtype=np.int32) % 256
+    batcher.admit(0, warm_tokens, jax.random.PRNGKey(0), greedy=True,
+                  temperature=1.0)
+    batcher.tick()
+    batcher.release(0)
+    warm = batcher.compile_counts()
+    _write_stats(run_dir, inc, warm, batcher, 0)
+    _mark_ready(os.path.join(spool, "ready"), "decode", rank, inc)
+
+    free = list(range(slots))
+    active: dict = {}         # row -> request state
+    seen = set()              # (rid, attempt) admitted or nacked this life
+    ticks = 0
+    while True:
+        if _stop_requested(spool) and not active:
+            break
+        # ---- admissions (skip anything already resulted: the respawn-
+        # rescan path — orders persist, completions don't repeat)
+        for name in _scan_orders(inbox):
+            if not free:
+                break
+            try:
+                with open(os.path.join(inbox, name)) as f:
+                    order = json.load(f)
+            except (OSError, ValueError):
+                continue
+            rid, attempt = order["rid"], int(order["attempt"])
+            if (rid, attempt) in seen:
+                continue
+            if os.path.exists(os.path.join(results_dir, f"{rid}.json")):
+                seen.add((rid, attempt))
+                continue
+            seen.add((rid, attempt))
+            tokens = np.asarray(order["tokens"], np.int32)
+            prefix = None
+            if order.get("bundle"):
+                try:
+                    banks, btoks, blen = load_bundle(
+                        os.path.join(bundles_dir, order["bundle"]),
+                        expect_digest=order.get("sha256"))
+                    if blen != int(tokens.shape[0]) - 1 or \
+                            not np.array_equal(btoks[:blen], tokens[:blen]):
+                        raise BundleCorruptError(
+                            f"bundle prefix mismatch for {rid}")
+                    prefix = PrefixEntry(
+                        cache=rebuild_prefix_cache(batcher, banks, blen),
+                        length=blen)
+                except BundleCorruptError as e:
+                    journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
+                                 request_id=rid,
+                                 worker=order.get("prefill_worker"),
+                                 attempt=attempt, reason=str(e)[:200])
+                    atomic_write_text(
+                        os.path.join(results_dir,
+                                     f"{rid}.a{attempt}.nack.json"),
+                        json.dumps({"rid": rid, "attempt": attempt,
+                                    "reason": str(e)[:200]}))
+                    continue
+            row = free.pop()
+            t_admit = time.time()
+            key = jax.random.PRNGKey(int(order.get("seed", 0)))
+            batcher.admit(row, tokens, key,
+                          greedy=bool(order.get("greedy", True)),
+                          temperature=float(order.get("temperature", 1.0)),
+                          prefix=prefix)
+            journal.emit(EventKind.SERVE_ADMIT, request_id=rid, slot=row,
+                         queued_ms=round(
+                             (t_admit - order["t_submit"]) * 1000.0, 1),
+                         prefix_hit=prefix is not None)
+            active[row] = {"rid": rid, "attempt": attempt, "out": [],
+                           "budget": int(order.get("max_new_tokens", 8)),
+                           "t_submit": float(order["t_submit"]),
+                           "t_admit": t_admit, "first_ts": None}
+        # ---- one decode round
+        if not active:
+            time.sleep(0.01)
+            continue
+        fault_injection.fire("serve.decode_tick", step=ticks, tick=ticks,
+                             active=len(active))
+        toks = batcher.tick()
+        ticks += 1
+        now = time.time()
+        for row in list(active):
+            st = active[row]
+            st["out"].append(int(toks[row]))
+            if st["first_ts"] is None:
+                st["first_ts"] = now
+            if len(st["out"]) < st["budget"]:
+                continue
+            ttft_ms = (st["first_ts"] - st["t_submit"]) * 1000.0
+            rate = len(st["out"]) / max(now - st["t_admit"], 1e-9)
+            atomic_write_text(
+                os.path.join(results_dir, f"{st['rid']}.json"),
+                json.dumps({"rid": st["rid"], "attempt": st["attempt"],
+                            "tokens": st["out"],
+                            "ttft_ms": round(ttft_ms, 1),
+                            "t_done": now, "incarnation": inc},
+                           sort_keys=True))
+            journal.emit(EventKind.SERVE_DONE, request_id=st["rid"],
+                         slot=row, tokens_out=len(st["out"]),
+                         ttft_ms=round(ttft_ms, 1),
+                         tok_per_s=round(rate, 1))
+            batcher.release(row)
+            free.append(row)
+            del active[row]
+            _write_stats(run_dir, inc, warm, batcher, ticks)
+
+
+# --------------------------------------------------------------------- main
+
+
+def main() -> int:
+    cfg = _env()
+    from deepspeed_tpu.utils.platform import force_cpu_platform
+    force_cpu_platform(n_devices=1, persistent_cache=False)
+    # importing fault_injection arms DS_FAULT_PLAN for this incarnation
+    from deepspeed_tpu.utils import fault_injection  # noqa: F401
+    from deepspeed_tpu.runtime.checkpoint_engine.storage import \
+        atomic_write_text
+    from deepspeed_tpu.runtime.supervision.events import EventJournal
+    from deepspeed_tpu.runtime.supervision.heartbeat import HeartbeatWriter
+
+    role, rank, inc = cfg["role"], cfg["rank"], cfg["incarnation"]
+    run_dir = cfg["run_dir"]
+    spool = os.path.join(run_dir, "spool")
+    journal = EventJournal(os.path.join(run_dir, "events.jsonl"), rank=rank)
+    writer = HeartbeatWriter(os.path.join(run_dir, "heartbeats"), rank,
+                             interval_s=float(cfg["heartbeat_interval_s"]),
+                             journal=journal).start()
+    try:
+        batcher = _build_batcher(
+            cfg, slots=int(cfg["slots"]) if role == "decode" else 1)
+        if role == "decode":
+            _decode_loop(cfg, batcher, journal, spool)
+        else:
+            _prefill_loop(cfg, batcher, journal, spool)
+    finally:
+        writer.stop()
+    atomic_write_text(os.path.join(run_dir, f"{role}{rank}.exit.json"),
+                      json.dumps({"role": role, "rank": rank,
+                                  "incarnation": inc, "status": "done"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,17 @@ Points currently wired:
                           ctx: ``tick``, ``active`` (``HangFor`` models a
                           wedged tick, ``DelaySeconds`` a slow one —
                           deadline/timeout behavior under pressure)
+``serve.prefill_chunk``   before each prefill chunk a fleet prefill worker
+                          runs; ctx: ``step`` (a worker-global chunk
+                          counter — ``KillAtStep`` kills the worker
+                          mid-prefill), ``path`` (the request id —
+                          ``DelaySeconds``/``HangFor`` with ``match``
+                          model a straggler worker)
+``serve.bundle_write``    after a fleet prefill worker lands a KV page
+                          bundle but before its manifest publishes; ctx:
+                          ``path`` (``CorruptRandomBytes``/
+                          ``TruncateAfterBytes`` model bitrot the decode
+                          engine's digest check must catch)
 ========================  =====================================================
 
 Subprocess fault plans (the goodput fleet's delivery channel): a parent
@@ -107,6 +118,8 @@ FAULT_POINTS = frozenset({
     "serve.decode_tick",
     "serve.park",
     "serve.readmit",
+    "serve.prefill_chunk",
+    "serve.bundle_write",
 })
 
 # points with faults installed; guarded by _lock for install/clear, read

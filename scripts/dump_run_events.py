@@ -102,6 +102,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             mean = sum(float(e["accept_rate"]) for e in spec) / len(spec)
             line += f"  spec_accept_rate={mean:.3f}"
         print(line, file=sys.stderr)
+    sfleet = [e for e in events
+              if str(e.get("kind", "")).startswith("serve.fleet.")]
+    if sfleet and not args.as_json:
+        by = {}
+        for e in sfleet:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        line = "serve-fleet: " + "  ".join(
+            f"{k.split('serve.fleet.', 1)[1]}={by[k]}" for k in sorted(by))
+        # the failover ledger: prefill handoffs, degradations to decode-
+        # local prefill, and — the invariant — accepted requests lost
+        done = [e for e in sfleet if e["kind"] == "serve.fleet.done"]
+        if done:
+            last = done[-1]
+            line += (f"  lost_requests={last.get('lost', '?')}"
+                     f"  completed={last.get('completed', '?')}"
+                     f"/{last.get('accepted', '?')}")
+        print(line, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:
         by = {}

@@ -187,6 +187,12 @@ _SERVE = [
      _SERVE_BENCH + ["--turns", "1", "--spec-ab"]),
     ("serve-gemma-baseline", {"JAX_PLATFORMS": "cpu"},
      _SERVE_BENCH + ["--turns", "1", "--config", "gemma_tpu_baseline"]),
+    # the disaggregated-fleet goodput run: real prefill/decode
+    # subprocesses under seeded faults, scored from the journal
+    # (serve_fleet_bench owns the gate; the sweep records the trajectory)
+    ("serve-fleet-goodput", {"JAX_PLATFORMS": "cpu"},
+     ["scripts/serve_fleet_bench.py", "--print-json",
+      "--out", "/tmp/BENCH_SERVE_FLEET_sweep.json"]),
 ]
 
 CONFIG_SETS = {

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Serving-goodput gate: run the serving fault matrix → BENCH_SERVE_FLEET.json.
+
+Each scenario spawns a real disaggregated serving fleet
+(``deepspeed_tpu/serving/fleet.py``: prefill workers + a decode engine as
+OS subprocesses, KV handed off through digest-manifested spool page
+bundles, fault plans via ``DS_FAULT_PLAN``) and scores request goodput /
+TTFT-under-fault / MTTR purely from the run's ``events.jsonl``
+(``deepspeed_tpu/goodput/serve_scenarios.py``).
+
+The committed artifact makes serving-robustness regressions diffable per
+PR, the same way ``BENCH_GOODPUT.json`` tracks training goodput.  The
+hard line is the no-lost-accepted-request invariant: every scenario
+requires ``lost == 0`` — kill-a-prefill-worker, kill-the-decode-engine,
+straggler, burst past queue capacity, and corrupt-bundle runs must all
+recover without the supervisor aborting.
+
+Request-count metrics (goodput, accepted/completed/rejected/lost,
+handoffs) are deterministic given a scenario seed, so the gate compares
+them tight; wall-clock metrics (TTFT, MTTR) are reported and bounded only
+by each scenario's own generous expectations.
+
+Usage:
+    python scripts/serve_fleet_bench.py [--scenarios a,b,...] [--seed 7]
+                                        [--out BENCH_SERVE_FLEET.json]
+                                        [--baseline BENCH_SERVE_FLEET.json]
+                                        [--goodput-tolerance 0.1]
+                                        [--keep-runs DIR] [--print-json]
+
+Exit codes: 0 every scenario ok and no regression vs the baseline;
+1 any scenario failed its expectations (a lost accepted request, a
+goodput miss, an unexpected abort) or regressed past tolerance (the
+report is still written).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_matrix(args) -> dict:
+    from deepspeed_tpu.goodput import (build_serve_scenario,
+                                       run_serve_scenario,
+                                       serve_scenario_names)
+
+    names = args.scenarios.split(",") if args.scenarios \
+        else list(serve_scenario_names())
+    keep = args.keep_runs
+    base_dir = keep or tempfile.mkdtemp(prefix="serve_fleet_bench_")
+    scores = {}
+    try:
+        for name in names:
+            scenario = build_serve_scenario(name, seed=args.seed)
+            run_dir = os.path.join(base_dir, name)
+            shutil.rmtree(run_dir, ignore_errors=True)
+            print(f"[serve-fleet-bench] {name}: prefill={scenario.n_prefill} "
+                  f"requests={scenario.n_requests} "
+                  f"faults={len(scenario.faults)}", flush=True)
+            score = run_serve_scenario(run_dir, scenario)
+            score.pop("summary", None)
+            scores[name] = score
+            print(f"[serve-fleet-bench]   goodput={score['goodput']} "
+                  f"accepted={score['accepted']} lost={score['lost']} "
+                  f"rejected={score['rejected']} "
+                  f"ttft_p99={score['ttft_ms']['p99']}ms "
+                  f"mttr_max={score['mttr_s']['max']} "
+                  f"handoffs={score['handoffs']} ok={score['ok']}",
+                  flush=True)
+            if not score["ok"]:
+                for f in score["failures"]:
+                    print(f"[serve-fleet-bench]   FAIL: {f}",
+                          file=sys.stderr, flush=True)
+    finally:
+        if not keep:
+            shutil.rmtree(base_dir, ignore_errors=True)
+    return {
+        "config": {"seed": args.seed, "scenarios": names},
+        "scenarios": {
+            name: {k: v for k, v in score.items() if k != "kinds"}
+            for name, score in scores.items()
+        },
+        "summary": {
+            "scenarios": len(scores),
+            "ok": sum(1 for s in scores.values() if s["ok"]),
+            "mean_goodput": round(
+                sum(s["goodput"] for s in scores.values()) / len(scores), 4)
+            if scores else 0.0,
+            "total_lost": sum(s["lost"] for s in scores.values()),
+        },
+    }
+
+
+def gate(result: dict, baseline: dict, tolerance: float) -> list:
+    """Regressions of the new result vs the committed baseline.  Only
+    deterministic request-count metrics gate hard; scenarios new to the
+    matrix pass on their own expectations."""
+    problems = []
+    base_scen = (baseline or {}).get("scenarios", {})
+    for name, score in result["scenarios"].items():
+        if not score["ok"]:
+            problems.append(f"{name}: failed its own expectations: "
+                            + "; ".join(score.get("failures", ())))
+        if score["lost"] > 0:
+            problems.append(
+                f"{name}: {score['lost']} accepted request(s) lost "
+                f"({score['lost_ids']}) — the no-lost-accepted-request "
+                f"invariant is unconditional")
+        base = base_scen.get(name)
+        if base is None:
+            continue
+        if score["goodput"] < base["goodput"] - tolerance:
+            problems.append(
+                f"{name}: goodput {score['goodput']} regressed past "
+                f"baseline {base['goodput']} - {tolerance}")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated scenario names (default: all)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="BENCH_SERVE_FLEET.json")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline artifact to gate against "
+                         "(default: the existing --out file)")
+    ap.add_argument("--goodput-tolerance", type=float, default=0.1)
+    ap.add_argument("--keep-runs", default=None,
+                    help="keep per-scenario run dirs under this directory")
+    ap.add_argument("--print-json", action="store_true",
+                    help="print a one-line JSON summary to stdout first "
+                         "(for sweep drivers)")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or args.out
+    baseline = None
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except ValueError as e:
+            print(f"[serve-fleet-bench] unreadable baseline "
+                  f"{baseline_path}: {e}", file=sys.stderr)
+
+    result = run_matrix(args)
+    problems = gate(result, baseline, args.goodput_tolerance)
+
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    s = result["summary"]
+    if args.print_json:
+        print(json.dumps({"scenarios": s["scenarios"], "ok": s["ok"],
+                          "mean_goodput": s["mean_goodput"],
+                          "total_lost": s["total_lost"],
+                          "regressions": len(problems)}))
+    print(f"wrote {args.out}: {s['ok']}/{s['scenarios']} scenarios ok, "
+          f"mean request goodput {s['mean_goodput']}, "
+          f"{s['total_lost']} lost accepted request(s)")
+    for p in problems:
+        print(f"REGRESSION: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Tier-1 acceptance for the disaggregated serving fleet: SIGKILL a
+prefill worker mid-chunk and the orphaned request must be retried on a
+survivor — with every completed greedy continuation **bitwise-identical**
+to the unfaulted split (chunked prefill → page bundle → prefix-resume)
+replayed in-process on the same seeded fixture, and the decode engine
+reporting zero steady-state recompiles.
+
+This is the serving twin of ``tests/unit/goodput/test_fleet_smoke.py``:
+real OS subprocesses, a real SIGKILL from the fault plan, and the score
+read back purely from the run's event journal.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.goodput import build_serve_scenario, run_serve_scenario
+from deepspeed_tpu.runtime.supervision.events import EventKind, read_events
+
+pytestmark = pytest.mark.chaos
+
+
+def test_kill_prefill_mid_chunk_exact_output_and_no_recompiles(tmp_path):
+    scenario = build_serve_scenario("kill_prefill_worker", seed=7)
+    # trim the tail requests: the failover story has played out long
+    # before request 5, and tier-1 minutes are a budget
+    scenario = dataclasses.replace(scenario, n_requests=4)
+    run_dir = str(tmp_path / "serve_fleet")
+    score = run_serve_scenario(run_dir, scenario)
+
+    # the fleet finished despite losing a prefill worker mid-chunk
+    assert score["ok"], score["failures"]
+    assert score["lost"] == 0, score["lost_ids"]
+    assert score["goodput"] == 1.0, score
+    assert score["incidents"] >= 1          # the injected kill was observed
+    assert score["handoffs"] >= 1           # ...and the prefill retried
+    summary = score["summary"]
+    assert summary["completed"] and summary["done"] == summary["accepted"]
+
+    # ---- the journal tells the story: a prefill worker lost, its
+    # orphaned request handed to a survivor, the victim respawned
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    lost = [e for e in events
+            if e["kind"] == EventKind.SERVE_FLEET_WORKER_LOST]
+    assert any(e["role"] == "prefill" for e in lost), lost
+    assert any(e["kind"] == EventKind.SERVE_FLEET_HANDOFF for e in events)
+    assert any(e["kind"] == EventKind.SERVE_FLEET_RESTART for e in events)
+
+    # ---- bitwise parity: replay every request through the same split
+    # (build_prefix over S-1 tokens, admit with the prefix, greedy ticks)
+    # on the identical seeded fixture — in-process, unfaulted
+    from deepspeed_tpu.serving.fleet import ServeFleetConfig
+    from deepspeed_tpu.serving.worker_main import _build_batcher
+    cfg = ServeFleetConfig.from_scenario(scenario)
+    batcher = _build_batcher(cfg.child_payload(run_dir), slots=cfg.slots)
+    arrivals = sorted(scenario.workload(), key=lambda it: it["at_s"])
+    for i, it in enumerate(arrivals):
+        rid = f"req-{i:04d}"
+        got = summary["results"][rid]
+        tokens = np.asarray(it["tokens"], np.int32)
+        prefix = batcher.build_prefix(tokens[:-1])
+        batcher.admit(0, tokens, jax.random.PRNGKey(it["seed"]),
+                      greedy=True, temperature=1.0, prefix=prefix)
+        want = [int(batcher.tick()[0]) for _ in range(it["max_new_tokens"])]
+        batcher.release(0)
+        assert got == want, (rid, got, want)
+
+    # ---- zero steady-state decode recompiles: the engine's post-run
+    # compile counts must equal its post-warmup snapshot
+    with open(os.path.join(run_dir, "decode.stats.json")) as f:
+        stats = json.load(f)
+    assert stats["ticks"] > 0
+    assert stats["now"] == stats["warm"], stats

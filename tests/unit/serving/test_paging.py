@@ -174,10 +174,18 @@ def test_park_ttl_sweep_removes_disk_file(tmp_path):
     ({"pool_blocks": 0}, "pool_blocks must be >= 1"),
     ({"park_capacity": -1}, "park_capacity must be >= 0"),
     ({"park_ttl_s": 0.0}, "park_ttl_s must be > 0"),
+    ({"hbm_high_watermark": 0}, "hbm_high_watermark must be >= 1"),
+    ({"hbm_high_watermark": -5}, "hbm_high_watermark must be >= 1"),
 ])
 def test_paging_config_validation(bad, msg):
     with pytest.raises(ValueError, match=msg):
         PagingConfig.from_dict(bad)
+
+
+def test_paging_config_watermark_roundtrip():
+    assert PagingConfig.from_dict({}).hbm_high_watermark is None
+    cfg = PagingConfig.from_dict({"hbm_high_watermark": 1 << 20})
+    assert cfg.hbm_high_watermark == 1 << 20
 
 
 def test_serving_config_nested_paging():

@@ -390,3 +390,71 @@ def test_pool_exhaustion_is_survivable(engine):
     for p, out in outs:
         ref, = _reference_turns(engine, [p], [4])
         np.testing.assert_array_equal(out, ref)
+
+
+def test_hbm_pressure_sweep_parks_pool_sessions(engine, tmp_path):
+    """Telemetry-census pressure eviction: a live-buffer census above
+    ``serving.paging.hbm_high_watermark`` parks pool-LRU sessions to
+    host (bounded per sweep), journaling the observed pressure — and the
+    parked conversation still answers its follow-up bitwise.  At or
+    below the watermark (or with no watermark configured) the sweep is
+    a no-op."""
+    # far above any real census: the scheduler tick runs its own sweep
+    # against the process's true live-buffer bytes (which a loaded test
+    # process can push past a small watermark) — keep automatic sweeps
+    # inert so only the explicit ``live_bytes`` overrides below evict
+    wm = 1 << 60
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    gw = _serve(engine, journal=journal, hbm_high_watermark=wm,
+                park_capacity=8)
+    rng = np.random.default_rng(4)
+    convs = []
+    for i in range(3):
+        p = rng.integers(0, 256, (10,)).astype(np.int32)
+        convs.append(
+            {"sid": f"c{i}", "p": p,
+             "out1": gw.submit(p, max_new_tokens=4,
+                               session_id=f"c{i}").result(timeout=60)})
+    pager = gw._pager
+    assert pager.stats()["sessions_pool"] == 3
+
+    # at/below the watermark: nothing moves
+    assert pager.pressure_sweep(live_bytes=wm) == 0
+    assert pager.stats()["sessions_pool"] == 3
+
+    # one over: pool-LRU sessions park to host, bounded by max_evictions
+    assert pager.pressure_sweep(live_bytes=wm + 1, max_evictions=2) == 2
+    st = pager.stats()
+    assert st["sessions_pool"] == 1
+    assert st["sessions_ram"] + st["sessions_disk"] == 2
+    # the next sweep under pressure drains the rest
+    assert pager.pressure_sweep(live_bytes=wm + 1) == 1
+    assert pager.stats()["sessions_pool"] == 0
+
+    evs = [e for e in journal.read()
+           if e["kind"] == EventKind.SERVE_PAGE_EVICT]
+    assert len(evs) == 3
+    assert all(e["reason"] == "hbm_pressure" and e["pressure"] == wm + 1
+               and e["watermark"] == wm for e in evs)
+
+    # a pressure-parked session re-admits from host and matches the
+    # uninterrupted reference bit for bit
+    c = convs[0]
+    t2 = rng.integers(0, 256, (6,)).astype(np.int32)
+    full = np.concatenate([c["p"], c["out1"], t2])
+    out2 = gw.submit(full, max_new_tokens=4,
+                     session_id=c["sid"]).result(timeout=60)
+    gw.shutdown()
+    ref1, ref2 = _reference_turns(engine, [c["p"], t2], [4, 4])
+    np.testing.assert_array_equal(c["out1"], ref1)
+    np.testing.assert_array_equal(out2, ref2)
+
+
+def test_pressure_sweep_noop_without_watermark(engine):
+    gw = _serve(engine, park_capacity=8)
+    p = np.arange(8, dtype=np.int32)
+    gw.submit(p, max_new_tokens=3, session_id="s").result(timeout=60)
+    assert gw._pager.hbm_high_watermark is None
+    assert gw._pager.pressure_sweep(live_bytes=1 << 40) == 0
+    assert gw._pager.stats()["sessions_pool"] == 1
+    gw.shutdown()
