@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional
 
 from ..runtime.supervision.events import EventJournal, EventKind
 from ..runtime.supervision.heartbeat import HeartbeatMonitor
+from ..telemetry.propagate import (TRACE_ENV, child_context, mint_context,
+                                   to_env)
 from ..utils import fault_injection
 from ..utils.logging import logger
 from .scenarios import Scenario
@@ -116,6 +118,9 @@ class FleetSupervisor:
             os.makedirs(d, exist_ok=True)
         self.journal = EventJournal(
             os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
+        # run-level trace context: every fleet lifecycle emit and every
+        # child (via DS_TRACE_CONTEXT) joins the same trace tree
+        self.trace = mint_context()
         self._config_path = os.path.join(self.run_dir, "fleet.json")
         from ..runtime.checkpoint_engine.storage import atomic_write_text
         atomic_write_text(self._config_path,
@@ -135,6 +140,7 @@ class FleetSupervisor:
         env["DS_FLEET_RANK"] = str(rank)
         env["DS_FLEET_WORLD"] = str(self.config.world_size)
         env["DS_FLEET_INC"] = str(incarnation)
+        env[TRACE_ENV] = to_env(child_context(self.trace))
         plan = self.scenario.plan_for(rank, incarnation) \
             if self.scenario is not None else ""
         if plan:
@@ -224,7 +230,8 @@ class FleetSupervisor:
                     self.journal.emit(EventKind.FLEET_DONE,
                                       incarnation=incarnation,
                                       final_step=final_step,
-                                      wall_s=round(wall, 3))
+                                      wall_s=round(wall, 3),
+                                      trace=self.trace.fields())
                     return {"completed": True, "aborted": None,
                             "final_step": final_step,
                             "incarnations": incarnation + 1,
@@ -234,7 +241,8 @@ class FleetSupervisor:
                     self.journal.emit(EventKind.FLEET_ABORT,
                                       incarnation=incarnation,
                                       reason="incarnation timeout",
-                                      restarts=restarts)
+                                      restarts=restarts,
+                                      trace=self.trace.fields())
                     return {"completed": False,
                             "aborted": "incarnation timeout",
                             "final_step": None,
@@ -246,7 +254,8 @@ class FleetSupervisor:
                     self.journal.emit(EventKind.FLEET_ABORT,
                                       incarnation=incarnation,
                                       reason="restart budget exhausted",
-                                      restarts=restarts)
+                                      restarts=restarts,
+                                      trace=self.trace.fields())
                     return {"completed": False,
                             "aborted": "restart budget exhausted",
                             "final_step": None,
@@ -261,7 +270,8 @@ class FleetSupervisor:
                                   restarts=restarts,
                                   budget=cfg.max_restarts,
                                   reason=outcome["verdict"],
-                                  detect_ts=outcome["detect_ts"])
+                                  detect_ts=outcome["detect_ts"],
+                                  trace=self.trace.fields())
         finally:
             for h in self._log_handles:
                 try:
@@ -286,7 +296,8 @@ class FleetSupervisor:
                  for rank in range(cfg.world_size)}
         self.journal.emit(EventKind.FLEET_SPAWN, incarnation=incarnation,
                           world_size=cfg.world_size,
-                          pids=[p.pid for p in procs.values()])
+                          pids=[p.pid for p in procs.values()],
+                          trace=self.trace.fields())
         deadline = time.monotonic() + cfg.incarnation_timeout_s
         statuses: Dict[int, Dict[str, Any]] = {}
         detect_ts: Optional[float] = None
@@ -313,7 +324,8 @@ class FleetSupervisor:
                                   "sentinel": sentinel}
                 self.journal.emit(EventKind.FLEET_RANK_EXIT,
                                   incarnation=incarnation, rank=rank,
-                                  returncode=rc, status=status)
+                                  returncode=rc, status=status,
+                                  trace=self.trace.fields())
                 if status != "done" and detect_ts is None:
                     detect_ts = time.time()
                 if status == "crashed":
@@ -368,7 +380,8 @@ class FleetSupervisor:
                               "sentinel": None}
             self.journal.emit(EventKind.FLEET_RANK_EXIT,
                               incarnation=incarnation, rank=rank,
-                              returncode=proc.returncode, status="bounced")
+                              returncode=proc.returncode, status="bounced",
+                              trace=self.trace.fields())
 
 
 def run_scenario(run_dir: str, scenario: Scenario,

@@ -28,6 +28,7 @@ Gate: ``scripts/serve_fleet_bench.py`` → ``BENCH_SERVE_FLEET.json``.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import os
 import random
@@ -382,11 +383,37 @@ def score_serve_run(run_dir: str, scenario: ServeScenario) -> Dict[str, Any]:
                               expect=scenario.expect)
 
 
+def trace_report(run_dir: str,
+                 events: Optional[List[dict]] = None) -> Dict[str, Any]:
+    """The distributed-tracing health block attached to every scored run:
+    span-chain coverage, the TTFT critical-path reconciliation, and the
+    decode engine's steady-state recompile count (``decode.stats.json``
+    ``now`` minus ``warm`` — must be zero once warm)."""
+    from ..telemetry.critical_path import (span_chain_coverage,
+                                           summarize_ttft)
+    if events is None:
+        events = read_events(os.path.join(run_dir, "events.jsonl"))
+    block: Dict[str, Any] = {
+        "chain": span_chain_coverage(events),
+        "ttft": summarize_ttft(events),
+    }
+    try:
+        with open(os.path.join(run_dir, "decode.stats.json")) as f:
+            st = json.load(f)
+        block["steady_state_recompiles"] = (
+            sum(st["now"].values()) - sum(st["warm"].values()))
+    except (OSError, ValueError, KeyError, TypeError):
+        block["steady_state_recompiles"] = None
+    return block
+
+
 def run_serve_scenario(run_dir: str, scenario: ServeScenario,
                        **config_overrides) -> Dict[str, Any]:
     """Run one scenario end to end — spawn the fleet, drive the seeded
     workload, score the journal — and return the score (the supervisor's
-    own run summary rides along under ``"summary"``)."""
+    own run summary rides along under ``"summary"``; ``"trace"`` carries
+    the span-chain/TTFT-reconciliation block ``serve_fleet_bench.py``
+    gates)."""
     from ..serving.fleet import ServeFleetConfig, ServeFleetSupervisor
     config = ServeFleetConfig.from_scenario(scenario, **config_overrides)
     supervisor = ServeFleetSupervisor(run_dir, config=config,
@@ -394,4 +421,5 @@ def run_serve_scenario(run_dir: str, scenario: ServeScenario,
     summary = supervisor.run(scenario.workload())
     score = score_serve_run(run_dir, scenario)
     score["summary"] = summary
+    score["trace"] = trace_report(run_dir)
     return score

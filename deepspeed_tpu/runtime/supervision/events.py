@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ...utils.jsonl import read_jsonl
 from ...utils.logging import logger
 
 
@@ -80,6 +81,7 @@ class EventKind:
     SERVE_PAGE_ALLOC = "serve.page_alloc"
     SERVE_PAGE_EVICT = "serve.page_evict"
     SERVE_FLEET_SPAWN = "serve.fleet.spawn"
+    SERVE_FLEET_READY = "serve.fleet.ready"
     SERVE_FLEET_WORKER_LOST = "serve.fleet.worker_lost"
     SERVE_FLEET_RESTART = "serve.fleet.restart"
     SERVE_FLEET_HANDOFF = "serve.fleet.handoff"
@@ -173,6 +175,7 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_PAGE_EVICT: ("session", "blocks", "bytes", "reason",
                                  "pressure", "watermark"),
     EventKind.SERVE_FLEET_SPAWN: ("role", "worker", "incarnation", "pid"),
+    EventKind.SERVE_FLEET_READY: ("role", "worker", "incarnation", "warm_s"),
     EventKind.SERVE_FLEET_WORKER_LOST: ("role", "worker", "incarnation",
                                         "returncode", "reason", "detect_ts"),
     EventKind.SERVE_FLEET_RESTART: ("role", "worker", "incarnation",
@@ -250,18 +253,4 @@ def read_events(path: str, kind: Optional[str] = None) -> List[Dict[str, Any]]:
 
     ``kind`` filters to one event kind.
     """
-    out: List[Dict[str, Any]] = []
-    if not os.path.exists(path):
-        return out
-    with open(path, "r") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict) and (kind is None or rec.get("kind") == kind):
-                out.append(rec)
-    return out
+    return read_jsonl(path, kind=kind)

@@ -19,6 +19,9 @@ import json
 import os
 import threading
 import time
+# bound at import so tests that stub this module's `time` (wall-clock
+# advancement) keep a real monotonic source for the clock handshake
+from time import monotonic as _monotonic
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...utils import fault_injection
@@ -74,8 +77,11 @@ class HeartbeatWriter:
             # interval_s rides in the payload so a monitor can judge beat
             # cadence drift (slow-rank detection) without being configured
             # with every writer's interval
+            # ts/mono_ts pair doubles as a per-process clock handshake for
+            # trace merging (wall − monotonic offset is constant per pid)
             payload = {"rank": self.rank, "pid": os.getpid(),
                        "step": self._step, "ts": time.time(),
+                       "mono_ts": _monotonic(),
                        "interval_s": self.interval_s}
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:

@@ -57,6 +57,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..runtime.supervision.events import EventJournal, EventKind
 from ..runtime.supervision.heartbeat import HeartbeatMonitor, heartbeat_path
+from ..telemetry.propagate import (TRACE_ENV, TraceContext, child_context,
+                                   inject, mint_context, to_env)
 from ..utils import fault_injection
 from ..utils.logging import logger
 
@@ -71,6 +73,12 @@ STOP_NAME = "stop"
 class BundleCorruptError(RuntimeError):
     """A spool page bundle failed its digest / content check — the decode
     engine must nack it back into a re-prefill, never decode from it."""
+
+
+def _trace_fields(ctx: Optional[TraceContext]) -> Optional[Dict[str, str]]:
+    """Journal ``trace=`` payload for an optional context (None = untraced
+    row, e.g. a request object constructed before tracing existed)."""
+    return ctx.fields() if ctx is not None else None
 
 
 # ------------------------------------------------------------ page bundles
@@ -95,7 +103,8 @@ def bundle_paths(bundles_dir: str, rid: str, attempt: int) -> Tuple[str, str]:
 
 def publish_bundle(bundles_dir: str, rid: str, attempt: int,
                    banks: List["Any"], tokens: "Any", length: int,
-                   worker: int) -> Dict[str, Any]:
+                   worker: int,
+                   trace: Optional[TraceContext] = None) -> Dict[str, Any]:
     """Atomically land one KV page bundle + its manifest; returns the
     manifest dict.  Layout rides the ``ParkStore`` npz format so the two
     host tiers share one verification story; the manifest (written LAST,
@@ -119,6 +128,7 @@ def publish_bundle(bundles_dir: str, rid: str, attempt: int,
                 "prefix_len": int(length), "sha256": digest,
                 "nbytes": os.path.getsize(npz_path),
                 "bundle": os.path.basename(npz_path)}
+    inject(manifest, trace)
     atomic_write_text(manifest_path, json.dumps(manifest, sort_keys=True))
     return manifest
 
@@ -255,6 +265,7 @@ class _Request:
     retry_reason: Optional[str] = None
     local: bool = False
     result: Optional[Dict[str, Any]] = None
+    ctx: Optional[TraceContext] = None   # per-request trace context
 
     @property
     def terminal(self) -> bool:
@@ -270,6 +281,7 @@ class _Worker:
     restarts: int = 0
     alive: bool = False
     ready_inc: int = -1              # incarnation whose warmup finished
+    spawn_wall: float = 0.0          # wall ts of the current spawn
     respawn_at: Optional[float] = None
     pending_detect_ts: Optional[float] = None
     gone: bool = False               # restart budget exhausted
@@ -305,6 +317,9 @@ class ServeFleetSupervisor:
             os.makedirs(self._prefill_inbox(r), exist_ok=True)
         self.journal = EventJournal(
             os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
+        # fleet-level trace context: lifecycle emits + worker env
+        # (per-request contexts are minted in submit())
+        self.trace = mint_context()
         self._config_path = os.path.join(self.run_dir, "serve_fleet.json")
         from ..runtime.checkpoint_engine.storage import atomic_write_text
         atomic_write_text(self._config_path,
@@ -359,6 +374,7 @@ class ServeFleetSupervisor:
         env["DS_SERVE_ROLE"] = w.role
         env["DS_SERVE_RANK"] = str(w.rank)
         env["DS_SERVE_INC"] = str(w.incarnation)
+        env[TRACE_ENV] = to_env(child_context(self.trace))
         plan = self.scenario.plan_for(w.rank, w.incarnation) \
             if self.scenario is not None else ""
         if plan:
@@ -387,9 +403,10 @@ class ServeFleetSupervisor:
             cwd=self.run_dir)
         w.alive = True
         w.respawn_at = None
+        w.spawn_wall = time.time()
         self.journal.emit(EventKind.SERVE_FLEET_SPAWN, role=w.role,
                           worker=w.rank, incarnation=w.incarnation,
-                          pid=w.proc.pid)
+                          pid=w.proc.pid, trace=self.trace.fields())
 
     def start(self) -> None:
         for w in self.workers.values():
@@ -418,14 +435,17 @@ class ServeFleetSupervisor:
             return None
         rid = f"req-{self._seq:04d}"
         self._seq += 1
-        self.requests[rid] = _Request(
+        ctx = mint_context()   # the request's root trace context
+        req = _Request(
             rid=rid, tokens=tokens, max_new_tokens=int(max_new_tokens),
             greedy=bool(greedy), temperature=float(temperature),
-            seed=int(seed), t_submit=time.time())
+            seed=int(seed), t_submit=time.time(), ctx=ctx)
+        self.requests[rid] = req
         self.journal.emit(EventKind.SERVE_REQUEST, request_id=rid,
                           prompt_len=int(tokens.shape[0]),
                           max_new_tokens=int(max_new_tokens), priority=0,
-                          queue_depth=inflight + 1)
+                          queue_depth=inflight + 1,
+                          t_submit=req.t_submit, trace=ctx.fields())
         return rid
 
     # -------------------------------------------------------------- health
@@ -455,6 +475,13 @@ class ServeFleetSupervisor:
                 continue
             if int(doc.get("incarnation", -1)) == w.incarnation:
                 w.ready_inc = w.incarnation
+                # readiness transition: the MTTR warm-phase boundary
+                warm_s = max(0.0, float(doc.get("ts", w.spawn_wall))
+                             - w.spawn_wall)
+                self.journal.emit(EventKind.SERVE_FLEET_READY, role=w.role,
+                                  worker=w.rank, incarnation=w.incarnation,
+                                  warm_s=round(warm_s, 3),
+                                  trace=self.trace.fields())
 
     def _check_processes(self) -> None:
         stop_requested = os.path.exists(
@@ -503,7 +530,7 @@ class ServeFleetSupervisor:
         self.journal.emit(EventKind.SERVE_FLEET_WORKER_LOST, role=w.role,
                           worker=w.rank, incarnation=w.incarnation,
                           returncode=returncode, reason=reason,
-                          detect_ts=detect_ts)
+                          detect_ts=detect_ts, trace=self.trace.fields())
         if w.role == "prefill":
             for req in self.requests.values():
                 if req.state == "prefilling" and req.worker == w.rank:
@@ -517,7 +544,8 @@ class ServeFleetSupervisor:
                     self.journal.emit(EventKind.SERVE_FLEET_REQUEUE,
                                       request_id=req.rid,
                                       reason="decode_bounce",
-                                      incarnation=w.incarnation + 1)
+                                      incarnation=w.incarnation + 1,
+                                      trace=_trace_fields(req.ctx))
         if w.restarts >= self.config.max_restarts:
             w.gone = True
             if w.role == "decode":
@@ -544,7 +572,8 @@ class ServeFleetSupervisor:
                               restarts=w.restarts,
                               budget=self.config.max_restarts,
                               backoff_s=round(backoff, 3),
-                              detect_ts=w.pending_detect_ts)
+                              detect_ts=w.pending_detect_ts,
+                              trace=self.trace.fields())
             w.pending_detect_ts = None
             self._spawn(w)
 
@@ -554,7 +583,8 @@ class ServeFleetSupervisor:
         self._aborted = reason
         self.journal.emit(EventKind.SERVE_FLEET_ABORT, reason=reason,
                           role=None if w is None else w.role,
-                          restarts=None if w is None else w.restarts)
+                          restarts=None if w is None else w.restarts,
+                          trace=self.trace.fields())
         for req in self.requests.values():
             if not req.terminal:
                 req.state = "failed"
@@ -586,16 +616,17 @@ class ServeFleetSupervisor:
         req.worker = target.rank
         req.state = "prefilling"
         req.t_assigned = time.monotonic()
-        self._atomic_write(self._order_path(req), {
+        self._atomic_write(self._order_path(req), inject({
             "rid": req.rid, "attempt": req.attempt,
             "tokens": [int(t) for t in req.tokens],
             "t_submit": req.t_submit, "greedy": req.greedy,
-            "temperature": req.temperature, "seed": req.seed})
+            "temperature": req.temperature, "seed": req.seed}, req.ctx))
         if req.attempt > 0:
             self.journal.emit(EventKind.SERVE_FLEET_HANDOFF,
                               request_id=req.rid, from_worker=prev,
                               to_worker=target.rank, attempt=req.attempt,
-                              reason=req.retry_reason)
+                              reason=req.retry_reason,
+                              trace=_trace_fields(req.ctx))
 
     def _retry_prefill(self, req: _Request, reason: str) -> None:
         """One failed attempt → either the next (backed off, on another
@@ -623,18 +654,20 @@ class ServeFleetSupervisor:
         self.journal.emit(EventKind.SERVE_FLEET_DEGRADED,
                           request_id=req.rid, reason=reason,
                           prefill_alive=len(self._alive_prefill(
-                              ready_only=False)))
+                              ready_only=False)),
+                          trace=_trace_fields(req.ctx))
         self._route_decode(req, manifest=None)
 
     def _route_decode(self, req: _Request,
                       manifest: Optional[Dict[str, Any]]) -> None:
-        order = {"rid": req.rid, "attempt": req.attempt,
-                 "tokens": [int(t) for t in req.tokens],
-                 "max_new_tokens": req.max_new_tokens,
-                 "greedy": req.greedy, "temperature": req.temperature,
-                 "seed": req.seed, "t_submit": req.t_submit,
-                 "local": manifest is None, "bundle": None, "sha256": None,
-                 "prefill_worker": None}
+        order = inject({"rid": req.rid, "attempt": req.attempt,
+                        "tokens": [int(t) for t in req.tokens],
+                        "max_new_tokens": req.max_new_tokens,
+                        "greedy": req.greedy,
+                        "temperature": req.temperature,
+                        "seed": req.seed, "t_submit": req.t_submit,
+                        "local": manifest is None, "bundle": None,
+                        "sha256": None, "prefill_worker": None}, req.ctx)
         if manifest is not None:
             order["bundle"] = manifest["bundle"]
             order["sha256"] = manifest["sha256"]
@@ -755,7 +788,8 @@ class ServeFleetSupervisor:
         wall = time.monotonic() - t0
         self.journal.emit(EventKind.SERVE_FLEET_DONE, accepted=accepted,
                           completed=completed, rejected=self._rejects,
-                          lost=lost, wall_s=round(wall, 3))
+                          lost=lost, wall_s=round(wall, 3),
+                          trace=self.trace.fields())
         return {"completed": self._aborted is None,
                 "aborted": self._aborted,
                 "accepted": accepted, "done": completed, "lost": lost,

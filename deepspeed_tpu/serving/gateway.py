@@ -34,6 +34,7 @@ import jax
 
 from ..runtime.supervision.events import EventJournal, EventKind
 from ..telemetry.metrics import MetricName
+from ..telemetry.propagate import mint_context
 from ..telemetry.spans import SpanName, Tracer
 from ..utils import fault_injection
 from ..utils.compile_watch import CompileWatch
@@ -173,6 +174,8 @@ class ServingGateway:
                 f"prefix_len {prefix_len} must be in [0, prompt_len"
                 f"={tokens.shape[0]})")
         handle = RequestHandle(rid)
+        # every request is a trace root: workers stitch their spans to it
+        ctx = mint_context()
         # a speculative round may write draft_k positions past the last
         # emission (rejected overshoot K/V) — the whole overshoot must
         # fit the slot, or edge writes would clamp and corrupt
@@ -211,7 +214,8 @@ class ServingGateway:
             heapq.heappush(self._queue, (req.sort_key(), req))
             self._emit(EventKind.SERVE_REQUEST, request_id=rid,
                        prompt_len=req.prompt_len, max_new_tokens=n_new,
-                       priority=req.priority, queue_depth=len(self._queue))
+                       priority=req.priority, queue_depth=len(self._queue),
+                       t_submit=time.time(), trace=ctx.fields())
             self._cond.notify_all()
         return handle
 

@@ -75,9 +75,14 @@ def _build_batcher(cfg: dict, slots: int):
 def _mark_ready(ready_dir: str, role: str, rank: int, inc: int) -> None:
     from deepspeed_tpu.runtime.checkpoint_engine.storage import \
         atomic_write_text
+    from deepspeed_tpu.telemetry.propagate import clock_sync
+    doc = {"role": role, "rank": rank, "incarnation": inc,
+           "ts": time.time()}
+    # wall/monotonic handshake: lets the merge step rebase this process's
+    # monotonic span timestamps onto the shared wall clock
+    doc["clock_sync"] = clock_sync()
     atomic_write_text(os.path.join(ready_dir, f"{role}{rank}.json"),
-                      json.dumps({"role": role, "rank": rank,
-                                  "incarnation": inc, "ts": time.time()}))
+                      json.dumps(doc))
 
 
 def _stop_requested(spool: str) -> bool:
@@ -96,12 +101,16 @@ def _scan_orders(inbox: str):
 # ------------------------------------------------------------------ prefill
 
 
-def _prefill_loop(cfg: dict, batcher, journal, spool: str) -> None:
+def _prefill_loop(cfg: dict, batcher, journal, spool: str,
+                  tracer=None) -> None:
     import numpy as np
     from deepspeed_tpu.runtime.supervision.events import EventKind
     from deepspeed_tpu.serving.fleet import publish_bundle
     from deepspeed_tpu.serving.paging import _host_banks
+    from deepspeed_tpu.telemetry.propagate import extract
+    from deepspeed_tpu.telemetry.spans import SpanName, Tracer
     from deepspeed_tpu.utils import fault_injection
+    tracer = tracer or Tracer(enabled=False)
     rank = cfg["rank"]
     inbox = os.path.join(spool, "prefill", f"w{rank}")
     bundles_dir = os.path.join(spool, "bundles")
@@ -127,22 +136,38 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str) -> None:
             seen.add(name)
             worked = True
             rid, attempt = order["rid"], int(order["attempt"])
+            # absent/malformed context (old spools) → fresh root span
+            ctx = extract(order)
+            tfields = ctx.fields() if ctx is not None else {}
             tokens = np.asarray(order["tokens"], np.int32)
             prefix = tokens[:-1]          # last token stays with decode
             cache, frontier = None, 0
-            for pos in range(0, int(prefix.shape[0]), C):
-                fault_injection.fire("serve.prefill_chunk",
-                                     step=chunks_done, path=rid)
-                cache, _last, frontier = batcher._chunked_prefill(
-                    prefix[pos:pos + C], start_cache=cache, start_len=pos)
-                chunks_done += 1
-            banks = _host_banks(cache, frontier)
-            manifest = publish_bundle(bundles_dir, rid, attempt, banks,
-                                      prefix, frontier, worker=rank)
+            t_start = time.time()
+            with tracer.span(SpanName.SERVE_FLEET_PREFILL, request_id=rid,
+                             attempt=attempt, **tfields):
+                for pos in range(0, int(prefix.shape[0]), C):
+                    fault_injection.fire("serve.prefill_chunk",
+                                         step=chunks_done, path=rid)
+                    cache, _last, frontier = batcher._chunked_prefill(
+                        prefix[pos:pos + C], start_cache=cache,
+                        start_len=pos)
+                    chunks_done += 1
+            t_prefilled = time.time()
+            with tracer.span(SpanName.SERVE_FLEET_PUBLISH, request_id=rid,
+                             attempt=attempt, **tfields):
+                banks = _host_banks(cache, frontier)
+                manifest = publish_bundle(bundles_dir, rid, attempt, banks,
+                                          prefix, frontier, worker=rank,
+                                          trace=ctx)
+            t_published = time.time()
             journal.emit(EventKind.SERVE_FLEET_BUNDLE, request_id=rid,
                          worker=rank, attempt=attempt,
                          prefix_len=manifest["prefix_len"],
-                         nbytes=manifest["nbytes"])
+                         nbytes=manifest["nbytes"],
+                         t_start=t_start,
+                         prefill_s=round(t_prefilled - t_start, 6),
+                         publish_s=round(t_published - t_prefilled, 6),
+                         trace=tfields or None)
         if not worked:
             time.sleep(0.02)
 
@@ -160,7 +185,8 @@ def _write_stats(run_dir: str, inc: int, warm: dict, batcher,
                                   "ticks": ticks}, sort_keys=True))
 
 
-def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
+def _decode_loop(cfg: dict, batcher, journal, spool: str,
+                 tracer=None) -> None:
     import jax
     import numpy as np
     from deepspeed_tpu.runtime.checkpoint_engine.storage import \
@@ -169,7 +195,10 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
     from deepspeed_tpu.serving.batcher import PrefixEntry
     from deepspeed_tpu.serving.fleet import (BundleCorruptError, load_bundle,
                                              rebuild_prefix_cache)
+    from deepspeed_tpu.telemetry.propagate import extract
+    from deepspeed_tpu.telemetry.spans import SpanName, Tracer
     from deepspeed_tpu.utils import fault_injection
+    tracer = tracer or Tracer(enabled=False)
     rank, inc = cfg["rank"], cfg["incarnation"]
     run_dir = cfg["run_dir"]
     inbox = os.path.join(spool, "decode")
@@ -214,25 +243,37 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
                 seen.add((rid, attempt))
                 continue
             seen.add((rid, attempt))
+            t_order = time.time()
+            # absent/malformed context (old spools) → fresh root span
+            ctx = extract(order)
+            tfields = ctx.fields() if ctx is not None else {}
             tokens = np.asarray(order["tokens"], np.int32)
             prefix = None
+            verify_ms = 0.0
             if order.get("bundle"):
                 try:
-                    banks, btoks, blen = load_bundle(
-                        os.path.join(bundles_dir, order["bundle"]),
-                        expect_digest=order.get("sha256"))
-                    if blen != int(tokens.shape[0]) - 1 or \
-                            not np.array_equal(btoks[:blen], tokens[:blen]):
-                        raise BundleCorruptError(
-                            f"bundle prefix mismatch for {rid}")
-                    prefix = PrefixEntry(
-                        cache=rebuild_prefix_cache(batcher, banks, blen),
-                        length=blen)
+                    t_verify = time.time()
+                    with tracer.span(SpanName.SERVE_FLEET_VERIFY,
+                                     request_id=rid, attempt=attempt,
+                                     **tfields):
+                        banks, btoks, blen = load_bundle(
+                            os.path.join(bundles_dir, order["bundle"]),
+                            expect_digest=order.get("sha256"))
+                        if blen != int(tokens.shape[0]) - 1 or \
+                                not np.array_equal(btoks[:blen],
+                                                   tokens[:blen]):
+                            raise BundleCorruptError(
+                                f"bundle prefix mismatch for {rid}")
+                        prefix = PrefixEntry(
+                            cache=rebuild_prefix_cache(batcher, banks, blen),
+                            length=blen)
+                    verify_ms = round((time.time() - t_verify) * 1000.0, 3)
                 except BundleCorruptError as e:
                     journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
                                  request_id=rid,
                                  worker=order.get("prefill_worker"),
-                                 attempt=attempt, reason=str(e)[:200])
+                                 attempt=attempt, reason=str(e)[:200],
+                                 trace=tfields or None)
                     atomic_write_text(
                         os.path.join(results_dir,
                                      f"{rid}.a{attempt}.nack.json"),
@@ -242,25 +283,33 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
             row = free.pop()
             t_admit = time.time()
             key = jax.random.PRNGKey(int(order.get("seed", 0)))
-            batcher.admit(row, tokens, key,
-                          greedy=bool(order.get("greedy", True)),
-                          temperature=float(order.get("temperature", 1.0)),
-                          prefix=prefix)
+            with tracer.span(SpanName.SERVE_ADMIT, request_id=rid,
+                             slot=row, **tfields):
+                batcher.admit(row, tokens, key,
+                              greedy=bool(order.get("greedy", True)),
+                              temperature=float(
+                                  order.get("temperature", 1.0)),
+                              prefix=prefix)
             journal.emit(EventKind.SERVE_ADMIT, request_id=rid, slot=row,
                          queued_ms=round(
                              (t_admit - order["t_submit"]) * 1000.0, 1),
-                         prefix_hit=prefix is not None)
+                         prefix_hit=prefix is not None,
+                         attempt=attempt, t_order=t_order,
+                         verify_ms=verify_ms, trace=tfields or None)
             active[row] = {"rid": rid, "attempt": attempt, "out": [],
                            "budget": int(order.get("max_new_tokens", 8)),
                            "t_submit": float(order["t_submit"]),
-                           "t_admit": t_admit, "first_ts": None}
+                           "t_admit": t_admit, "first_ts": None,
+                           "trace": tfields or None}
         # ---- one decode round
         if not active:
             time.sleep(0.01)
             continue
         fault_injection.fire("serve.decode_tick", step=ticks, tick=ticks,
                              active=len(active))
-        toks = batcher.tick()
+        with tracer.span(SpanName.SERVE_TICK, tick=ticks,
+                         active=len(active)):
+            toks = batcher.tick()
         ticks += 1
         now = time.time()
         for row in list(active):
@@ -282,7 +331,8 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str) -> None:
             journal.emit(EventKind.SERVE_DONE, request_id=st["rid"],
                          slot=row, tokens_out=len(st["out"]),
                          ttft_ms=round(ttft_ms, 1),
-                         tok_per_s=round(rate, 1))
+                         tok_per_s=round(rate, 1),
+                         t_first=st["first_ts"], trace=st["trace"])
             batcher.release(row)
             free.append(row)
             del active[row]
@@ -302,6 +352,9 @@ def main() -> int:
         atomic_write_text
     from deepspeed_tpu.runtime.supervision.events import EventJournal
     from deepspeed_tpu.runtime.supervision.heartbeat import HeartbeatWriter
+    from deepspeed_tpu.telemetry.export import write_trace
+    from deepspeed_tpu.telemetry.propagate import clock_sync
+    from deepspeed_tpu.telemetry.spans import Tracer
 
     role, rank, inc = cfg["role"], cfg["rank"], cfg["incarnation"]
     run_dir = cfg["run_dir"]
@@ -310,15 +363,28 @@ def main() -> int:
     writer = HeartbeatWriter(os.path.join(run_dir, "heartbeats"), rank,
                              interval_s=float(cfg["heartbeat_interval_s"]),
                              journal=journal).start()
+    tracer = Tracer(name=f"{role}{rank}")
     try:
         batcher = _build_batcher(
             cfg, slots=int(cfg["slots"]) if role == "decode" else 1)
         if role == "decode":
-            _decode_loop(cfg, batcher, journal, spool)
+            _decode_loop(cfg, batcher, journal, spool, tracer=tracer)
         else:
-            _prefill_loop(cfg, batcher, journal, spool)
+            _prefill_loop(cfg, batcher, journal, spool, tracer=tracer)
     finally:
         writer.stop()
+        # per-incarnation span export with the wall/monotonic handshake
+        # fleet_report needs to rebase this process onto the shared clock
+        try:
+            write_trace(
+                os.path.join(run_dir, f"trace.{role}{rank}.inc{inc}.json"),
+                tracer,
+                extra={"clockSync": dict(clock_sync(), role=role, rank=rank,
+                                         incarnation=inc)})
+        except (OSError, ValueError) as e:
+            # telemetry must never mask the worker's exit path
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(f"[serve-fleet] trace export failed: {e}")
     atomic_write_text(os.path.join(run_dir, f"{role}{rank}.exit.json"),
                       json.dumps({"role": role, "rank": rank,
                                   "incarnation": inc, "status": "done"}))

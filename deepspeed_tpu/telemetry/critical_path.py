@@ -1,0 +1,601 @@
+"""Critical-path attribution + multi-process trace merging for the fleets.
+
+This is the analysis half of distributed tracing (``propagate.py`` is the
+plumbing half).  Inputs are the artifacts a fleet run already leaves on
+disk — the shared ``events.jsonl`` journal, per-rank
+``metrics.rank*.jsonl`` streams, and per-process ``trace.*.json`` span
+exports with their ``clockSync`` handshakes — and the outputs are:
+
+* **span-chain coverage** (:func:`span_chain_coverage`): the fraction of
+  accepted requests whose journal rows carry one consistent ``trace_id``
+  from ``serve.request`` through admission to completion (the bench gates
+  this at >= 0.95);
+* **TTFT decomposition** (:func:`decompose_request`,
+  :func:`summarize_ttft`): queue-wait → prefill compute → bundle publish
+  → spool latency → digest verify → re-admit → first decode tick, with a
+  per-request residual against the worker-measured end-to-end ``ttft_ms``
+  (the bench gates reconciliation within tolerance);
+* **MTTR attribution** (:func:`decompose_mttr`,
+  :func:`decompose_training_restarts`): detect → respawn → warm →
+  handoff/first-useful-work phases that *telescope* — boundaries are
+  clamped into ``[detect, recovery]`` so the phases sum to the journal's
+  MTTR exactly, by construction;
+* **one merged Perfetto timeline** (:func:`merge_fleet_trace`): every
+  process's spans rebased onto the wall clock via its recorded
+  ``wall_ts - mono_ts`` offset, journal events and metric samples as
+  instant tracks, plus synthesized per-request critical-path and
+  per-incident MTTR tracks.  Validate with
+  ``validate_trace(obj, require_registered_names=False)`` — the
+  synthesized phase events are not (and should not be) ``SpanName``
+  members.
+
+All timings here are wall-clock milliseconds unless the key says
+otherwise; per-phase stats run through tiny :class:`Histogram`
+reservoirs, which is why its percentile edge cases are pinned by tests.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..runtime.supervision.events import EventKind, read_events
+from ..utils.jsonl import read_jsonl
+from .metrics import Histogram
+from .propagate import wall_offset_s
+
+__all__ = [
+    "TTFT_PHASES",
+    "MTTR_PHASES",
+    "request_chains",
+    "span_chain_coverage",
+    "decompose_request",
+    "summarize_ttft",
+    "decompose_mttr",
+    "decompose_training_restarts",
+    "collect_process_traces",
+    "merge_fleet_trace",
+    "missing_worker_telemetry",
+]
+
+#: TTFT phase keys, in causal order along the request's critical path
+TTFT_PHASES = ("queue_wait_ms", "prefill_ms", "publish_ms", "spool_ms",
+               "verify_ms", "readmit_ms", "decode_ms")
+
+#: MTTR phase keys (telescoping: they sum to the incident's MTTR exactly)
+MTTR_PHASES = ("respawn_ms", "warm_ms", "handoff_ms")
+
+#: default reconciliation tolerance: a request's phase sum must land
+#: within max(abs_tol_ms, rel_tol * ttft) of the measured TTFT
+ABS_TOL_MS = 100.0
+REL_TOL = 0.25
+
+
+def _sorted_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    # the shared journal interleaves processes; ts order is the causal one
+    return sorted(events, key=lambda e: float(e.get("ts", 0.0)))
+
+
+def _trace_id(rec: Dict[str, Any]) -> Optional[str]:
+    tr = rec.get("trace")
+    if isinstance(tr, dict):
+        tid = tr.get("trace_id")
+        if isinstance(tid, str) and tid:
+            return tid
+    return None
+
+
+def request_chains(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per accepted request: its journal rows resolved to one chain.
+
+    Returns ``rid -> {request, bundle, admit, done, degraded, trace_id}``
+    where ``done`` is the *first* completion, ``admit`` the last admission
+    at or before it (requeues re-admit), and ``bundle`` the last bundle
+    publish at or before that admission.  Entries are ``None`` when the
+    journal never recorded the hop.
+    """
+    evs = _sorted_events(events)
+    chains: Dict[str, Dict[str, Any]] = {}
+    bundles: Dict[str, List[Dict[str, Any]]] = {}
+    admits: Dict[str, List[Dict[str, Any]]] = {}
+    for e in evs:
+        kind = e.get("kind")
+        rid = e.get("request_id")
+        if rid is None:
+            continue
+        if kind == EventKind.SERVE_REQUEST and rid not in chains:
+            chains[rid] = {"request": e, "trace_id": _trace_id(e),
+                           "bundle": None, "admit": None, "done": None,
+                           "degraded": None}
+        elif kind == EventKind.SERVE_FLEET_BUNDLE:
+            bundles.setdefault(rid, []).append(e)
+        elif kind == EventKind.SERVE_ADMIT:
+            admits.setdefault(rid, []).append(e)
+        elif kind == EventKind.SERVE_FLEET_DEGRADED and rid in chains:
+            chains[rid]["degraded"] = e
+        elif kind == EventKind.SERVE_DONE and rid in chains:
+            if chains[rid]["done"] is None:
+                chains[rid]["done"] = e
+    for rid, ch in chains.items():
+        done = ch["done"]
+        horizon = float(done["ts"]) + 1e-6 if done else float("inf")
+        for a in admits.get(rid, []):
+            if float(a.get("ts", 0.0)) <= horizon:
+                ch["admit"] = a
+        if ch["admit"] is not None:
+            bh = float(ch["admit"].get("ts", 0.0)) + 1e-6
+            for b in bundles.get(rid, []):
+                if float(b.get("ts", 0.0)) <= bh:
+                    ch["bundle"] = b
+    return chains
+
+
+def span_chain_coverage(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fraction of accepted requests with a complete, consistent chain.
+
+    Complete means: the request row minted a trace id and the same id is
+    carried by its admission and completion rows, plus either a bundle
+    publish with the same id or an explicit degraded-to-local record.
+    """
+    chains = request_chains(events)
+    incomplete: List[str] = []
+    for rid, ch in chains.items():
+        tid = ch["trace_id"]
+        ok = (
+            tid is not None
+            and ch["admit"] is not None and _trace_id(ch["admit"]) == tid
+            and ch["done"] is not None and _trace_id(ch["done"]) == tid
+            and ((ch["bundle"] is not None
+                  and _trace_id(ch["bundle"]) == tid)
+                 or ch["degraded"] is not None)
+        )
+        if not ok:
+            incomplete.append(rid)
+    accepted = len(chains)
+    complete = accepted - len(incomplete)
+    return {
+        "accepted": accepted,
+        "complete": complete,
+        "coverage": round(complete / accepted, 4) if accepted else 1.0,
+        "incomplete_ids": sorted(incomplete),
+    }
+
+
+def decompose_request(chain: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """TTFT critical-path phases for one request chain, or ``None`` when
+    the journal predates tracing (missing timing fields) or the request
+    never completed.
+
+    Phase model (all boundaries wall-clock, recorded by the process that
+    owns them):
+
+    - ``queue_wait_ms``: submit → prefill start (or → decode order pickup
+      on the degraded-local path);
+    - ``prefill_ms`` / ``publish_ms``: worker-measured chunk compute and
+      bundle write+digest;
+    - ``spool_ms``: bundle publish journal row → decode order pickup;
+    - ``verify_ms``: digest check + page rebuild;
+    - ``readmit_ms``: remaining pickup→admitted gap (slot wait, admission
+      bookkeeping);
+    - ``decode_ms``: admitted → first emitted token.
+
+    The sum telescopes submit→first-token; ``residual_ms`` is the gap to
+    the worker's end-to-end ``ttft_ms`` (journal-emit overhead between
+    measured segments), which reconciliation bounds.
+    """
+    req, admit, done = chain["request"], chain["admit"], chain["done"]
+    if req is None or admit is None or done is None:
+        return None
+    t_submit = req.get("t_submit")
+    t_order = admit.get("t_order")
+    t_first = done.get("t_first")
+    ttft_ms = done.get("ttft_ms")
+    if t_submit is None or t_order is None or t_first is None \
+            or ttft_ms is None:
+        return None  # pre-tracing journal: no decomposition
+    phases = {k: 0.0 for k in TTFT_PHASES}
+    bundle = chain["bundle"]
+    if bundle is not None and bundle.get("t_start") is not None:
+        t_start = float(bundle["t_start"])
+        phases["queue_wait_ms"] = (t_start - float(t_submit)) * 1e3
+        phases["prefill_ms"] = float(bundle.get("prefill_s", 0.0)) * 1e3
+        phases["publish_ms"] = float(bundle.get("publish_s", 0.0)) * 1e3
+        phases["spool_ms"] = (float(t_order) - float(bundle["ts"])) * 1e3
+    else:
+        # degraded-local: the prompt went straight to the decode inbox
+        phases["queue_wait_ms"] = (float(t_order) - float(t_submit)) * 1e3
+    verify_ms = float(admit.get("verify_ms", 0.0))
+    phases["verify_ms"] = verify_ms
+    phases["readmit_ms"] = (float(admit["ts"]) - float(t_order)) * 1e3 \
+        - verify_ms
+    phases["decode_ms"] = (float(t_first) - float(admit["ts"])) * 1e3
+    total = sum(phases.values())
+    return {
+        "request_id": req.get("request_id"),
+        "trace_id": chain["trace_id"],
+        "ttft_ms": float(ttft_ms),
+        "phases": {k: round(v, 3) for k, v in phases.items()},
+        "phase_sum_ms": round(total, 3),
+        "residual_ms": round(float(ttft_ms) - total, 3),
+    }
+
+
+def summarize_ttft(events: List[Dict[str, Any]],
+                   abs_tol_ms: float = ABS_TOL_MS,
+                   rel_tol: float = REL_TOL) -> Dict[str, Any]:
+    """Decompose every completed request and reconcile against measured
+    TTFT.
+
+    ``ok`` is True when every decomposable request's ``|residual|`` stays
+    within ``max(abs_tol_ms, rel_tol * ttft_ms)`` — the phase sums and the
+    end-to-end measurement agree on where the time went.  Per-phase stats
+    come from small :class:`Histogram` reservoirs (mean/p50/p99).
+    """
+    chains = request_chains(events)
+    decomps = [d for d in (decompose_request(c) for c in chains.values())
+               if d is not None]
+    hists = {k: Histogram() for k in TTFT_PHASES}
+    ttft_h = Histogram()
+    residuals: List[float] = []
+    unreconciled: List[str] = []
+    for d in decomps:
+        for k in TTFT_PHASES:
+            hists[k].observe(d["phases"][k])
+        ttft_h.observe(d["ttft_ms"])
+        residuals.append(abs(d["residual_ms"]))
+        tol = max(float(abs_tol_ms), float(rel_tol) * d["ttft_ms"])
+        if abs(d["residual_ms"]) > tol:
+            unreconciled.append(d["request_id"])
+    n = len(decomps)
+    return {
+        "requests": n,
+        "ok": not unreconciled,
+        "unreconciled_ids": sorted(unreconciled),
+        "abs_tol_ms": float(abs_tol_ms),
+        "rel_tol": float(rel_tol),
+        "max_abs_residual_ms": round(max(residuals), 3) if residuals else None,
+        "mean_ttft_ms": round(ttft_h.sum / n, 3) if n else None,
+        "phases": {
+            k: {"mean_ms": round(h.sum / n, 3) if n else None,
+                "p50_ms": h.percentile(50), "p99_ms": h.percentile(99)}
+            for k, h in hists.items()
+        },
+    }
+
+
+def _clamped_phases(detect: float, boundaries: List[Optional[float]],
+                    t_rec: float) -> List[float]:
+    """Telescope ``detect -> b... -> t_rec`` into phase durations (ms).
+
+    Missing boundaries collapse their phase to 0; every boundary is
+    clamped into ``[previous, t_rec]`` so the durations are non-negative
+    and sum exactly to ``t_rec - detect``.
+    """
+    out: List[float] = []
+    prev = detect
+    for b in boundaries:
+        cut = prev if b is None else min(max(float(b), prev), t_rec)
+        out.append((cut - prev) * 1e3)
+        prev = cut
+    out.append((t_rec - prev) * 1e3)
+    return out
+
+
+def decompose_mttr(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per recovered serving incident: detect→respawn→warm→handoff phases.
+
+    Anchors match ``score_serve_events``'s MTTR definition exactly —
+    ``detect_ts`` from the ``worker_lost`` row to the first completion
+    after it — so ``sum(phases)/1000 == mttr_s`` up to rounding.  Interior
+    boundaries are the replacement incarnation's spawn and ready rows,
+    clamped into the incident window (a fast handoff to a survivor can
+    finish before the replacement even spawns; the clamp then attributes
+    the whole window to respawn, matching reality: recovery never waited
+    on warmup).
+    """
+    evs = _sorted_events(events)
+    done_ts = [float(e["ts"]) for e in evs
+               if e.get("kind") == EventKind.SERVE_DONE]
+    out: List[Dict[str, Any]] = []
+    for lost in evs:
+        if lost.get("kind") != EventKind.SERVE_FLEET_WORKER_LOST:
+            continue
+        detect = float(lost.get("detect_ts") or lost.get("ts", 0.0))
+        after = [t for t in done_ts if t > detect]
+        rec: Dict[str, Any] = {
+            "role": lost.get("role"),
+            "worker": lost.get("worker"),
+            "incarnation": lost.get("incarnation"),
+            "detect_ts": detect,
+            "detect_lag_ms": round((float(lost.get("ts", detect)) - detect)
+                                   * 1e3, 3),
+            "recovered": bool(after),
+        }
+        if not after:
+            rec["mttr_s"] = None
+            rec["phases"] = None
+            out.append(rec)
+            continue
+        t_rec = min(after)
+        next_inc = (lost.get("incarnation") or 0) + 1
+        spawn_ts = ready_ts = None
+        for e in evs:
+            if (e.get("role") == lost.get("role")
+                    and e.get("worker") == lost.get("worker")
+                    and e.get("incarnation") == next_inc):
+                if e.get("kind") == EventKind.SERVE_FLEET_SPAWN \
+                        and spawn_ts is None:
+                    spawn_ts = float(e["ts"])
+                elif e.get("kind") == EventKind.SERVE_FLEET_READY \
+                        and ready_ts is None:
+                    ready_ts = float(e["ts"])
+        respawn, warm, handoff = _clamped_phases(
+            detect, [spawn_ts, ready_ts], t_rec)
+        rec["mttr_s"] = round(t_rec - detect, 3)
+        rec["phases"] = {"respawn_ms": round(respawn, 3),
+                         "warm_ms": round(warm, 3),
+                         "handoff_ms": round(handoff, 3)}
+        out.append(rec)
+    return out
+
+
+def decompose_training_restarts(
+        events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per training-fleet restart: detect→respawn→warm→first-useful-work.
+
+    Same telescoping model as :func:`decompose_mttr` on the training
+    journal kinds: recovery is the first ``data.batch`` after the
+    replacement incarnation spawned; warm ends at the new incarnation's
+    first journal row from any rank (process up and journaling).
+    """
+    evs = _sorted_events(events)
+    out: List[Dict[str, Any]] = []
+    for restart in evs:
+        if restart.get("kind") != EventKind.FLEET_RESTART:
+            continue
+        detect = float(restart.get("detect_ts") or restart.get("ts", 0.0))
+        spawn_ts = first_rank_ts = t_rec = None
+        for e in evs:
+            ts = float(e.get("ts", 0.0))
+            if ts <= float(restart.get("ts", 0.0)):
+                continue
+            kind = e.get("kind", "")
+            if kind == EventKind.FLEET_SPAWN and spawn_ts is None:
+                spawn_ts = ts
+            elif spawn_ts is not None and first_rank_ts is None \
+                    and int(e.get("rank", -1)) >= 0:
+                first_rank_ts = ts
+            if spawn_ts is not None and kind == EventKind.DATA_BATCH:
+                t_rec = ts
+                break
+        rec: Dict[str, Any] = {
+            "incarnation": restart.get("incarnation"),
+            "reason": restart.get("reason"),
+            "detect_ts": detect,
+            "recovered": t_rec is not None,
+        }
+        if t_rec is None:
+            rec["mttr_s"] = None
+            rec["phases"] = None
+            out.append(rec)
+            continue
+        respawn, warm, work = _clamped_phases(
+            detect, [spawn_ts, first_rank_ts], t_rec)
+        rec["mttr_s"] = round(t_rec - detect, 3)
+        rec["phases"] = {"respawn_ms": round(respawn, 3),
+                         "warm_ms": round(warm, 3),
+                         "handoff_ms": round(work, 3)}
+        out.append(rec)
+    return out
+
+
+# ------------------------------------------------------- trace merging
+
+def collect_process_traces(run_dir: str) -> List[Dict[str, Any]]:
+    """Load every ``trace.*.json`` export under ``run_dir``.
+
+    Each entry is ``{path, trace, clock}`` where ``clock`` is the
+    exporter's ``clockSync`` handshake (empty dict when absent — such a
+    source can't be wall-aligned).  Unreadable files are skipped: a
+    SIGKILLed incarnation legitimately never wrote its export.
+    """
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "trace.*.json"))):
+        try:
+            with open(path, "r") as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("traceEvents"), list):
+            clock = obj.get("clockSync")
+            out.append({"path": path, "trace": obj,
+                        "clock": clock if isinstance(clock, dict) else {}})
+    return out
+
+
+def _instant(name: str, ts_us: int, pid: int, tid: int,
+             args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    ev: Dict[str, Any] = {"name": name, "cat": name.split(".", 1)[0],
+                          "ph": "X", "ts": ts_us, "dur": 1,
+                          "pid": pid, "tid": tid}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _proc_meta(pid: int, name: str) -> Dict[str, Any]:
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def merge_fleet_trace(run_dir: str,
+                      events: Optional[List[Dict[str, Any]]] = None
+                      ) -> Dict[str, Any]:
+    """One multi-pid, wall-aligned Perfetto object for a whole fleet run.
+
+    Tracks:
+
+    - pid 0 ``journal``: every ``events.jsonl`` row as an instant event on
+      its emitting rank's tid;
+    - pid 1.. : each process's exported spans, ``ts`` rebased by its
+      recorded ``wall_ts - mono_ts`` offset (sources without a
+      ``clockSync`` are listed in ``fleetMeta.unaligned`` and excluded);
+    - one ``metrics`` pid per ``metrics*.jsonl`` stream (instant samples);
+    - a ``ttft-critical-path`` pid: per completed request, its phase
+      decomposition laid end-to-end from submit;
+    - an ``mttr`` pid: per recovered incident, the respawn/warm/handoff
+      phases laid end-to-end from detection.
+
+    The whole timeline is shifted so the earliest event sits at ts 0.
+    Validate with ``require_registered_names=False`` — synthesized phase
+    names are intentionally not ``SpanName`` members.
+    """
+    if events is None:
+        events = read_events(os.path.join(run_dir, "events.jsonl"))
+    evs = _sorted_events(events)
+    merged: List[Dict[str, Any]] = [_proc_meta(0, "journal")]
+    meta: Dict[str, Any] = {"run_dir": run_dir, "sources": [],
+                            "unaligned": []}
+
+    for rec in evs:
+        args = {k: rec[k] for k in ("request_id", "role", "worker", "reason")
+                if rec.get(k) is not None}
+        tid = _trace_id(rec)
+        if tid:
+            args["trace_id"] = tid
+        merged.append(_instant(str(rec.get("kind", "event")),
+                               int(float(rec.get("ts", 0.0)) * 1e6),
+                               0, int(rec.get("rank", 0)), args or None))
+
+    pid = 1
+    for src in collect_process_traces(run_dir):
+        off = wall_offset_s(src["clock"])
+        if off is None:
+            meta["unaligned"].append(os.path.basename(src["path"]))
+            continue
+        off_us = int(off * 1e6)
+        n_spans = 0
+        for ev in src["trace"]["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "X" and isinstance(ev.get("ts"), int):
+                ev["ts"] = ev["ts"] + off_us
+                n_spans += 1
+            merged.append(ev)
+        meta["sources"].append({"path": os.path.basename(src["path"]),
+                                "pid": pid, "spans": n_spans,
+                                "offset_s": round(off, 6)})
+        pid += 1
+
+    for mpath in sorted(glob.glob(os.path.join(run_dir, "metrics*.jsonl"))):
+        rows = read_jsonl(mpath)
+        if not rows:
+            continue
+        merged.append(_proc_meta(pid, os.path.basename(mpath)))
+        for row in rows:
+            merged.append(_instant("metrics.sample",
+                                   int(float(row.get("ts", 0.0)) * 1e6),
+                                   pid, int(row.get("rank", 0))))
+        pid += 1
+
+    chains = request_chains(evs)
+    decomps = [d for d in (decompose_request(c) for c in chains.values())
+               if d is not None]
+    if decomps:
+        merged.append(_proc_meta(pid, "ttft-critical-path"))
+        for tid_i, d in enumerate(sorted(decomps,
+                                         key=lambda x: x["request_id"])):
+            ch = chains[d["request_id"]]
+            cursor = float(ch["request"]["t_submit"]) * 1e6
+            for k in TTFT_PHASES:
+                dur_us = d["phases"][k] * 1e3
+                if dur_us <= 0:
+                    cursor += max(dur_us, 0.0)
+                    continue
+                merged.append({
+                    "name": "ttft." + k[:-3], "cat": "ttft", "ph": "X",
+                    "ts": int(cursor), "dur": max(1, int(dur_us)),
+                    "pid": pid, "tid": tid_i,
+                    "args": {"request_id": d["request_id"],
+                             "trace_id": d["trace_id"]},
+                })
+                cursor += dur_us
+        pid += 1
+
+    incidents = [m for m in decompose_mttr(evs) if m["recovered"]]
+    incidents += [m for m in decompose_training_restarts(evs)
+                  if m["recovered"]]
+    if incidents:
+        merged.append(_proc_meta(pid, "mttr"))
+        for tid_i, m in enumerate(incidents):
+            cursor = float(m["detect_ts"]) * 1e6
+            for k in MTTR_PHASES:
+                dur_us = m["phases"][k] * 1e3
+                if dur_us <= 0:
+                    continue
+                merged.append({
+                    "name": "mttr." + k[:-3], "cat": "mttr", "ph": "X",
+                    "ts": int(cursor), "dur": max(1, int(dur_us)),
+                    "pid": pid, "tid": tid_i,
+                    "args": {"role": m.get("role"),
+                             "worker": m.get("worker")},
+                })
+                cursor += dur_us
+        pid += 1
+
+    xs = [e["ts"] for e in merged
+          if e.get("ph") == "X" and isinstance(e.get("ts"), int)]
+    t0 = min(xs) if xs else 0
+    for e in merged:
+        if e.get("ph") == "X" and isinstance(e.get("ts"), int):
+            e["ts"] -= t0
+    meta["t0_wall_us"] = t0
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "fleetMeta": meta}
+
+
+def missing_worker_telemetry(run_dir: str,
+                             events: Optional[List[Dict[str, Any]]] = None
+                             ) -> List[str]:
+    """Telemetry a fleet run dir *should* contain but doesn't.
+
+    Serving fleets: every worker that exited cleanly (left its
+    ``<role><rank>.exit.json`` sentinel) must have exported a span trace,
+    and at least one process trace must exist overall.  Training fleets:
+    every rank of the largest spawned world must have a
+    ``metrics.rank*.jsonl`` stream.  SIGKILLed incarnations are exempt —
+    their absence is the fault being measured, and the journal already
+    records it.
+    """
+    problems: List[str] = []
+    if events is None:
+        events = read_events(os.path.join(run_dir, "events.jsonl"))
+    if not events:
+        return [f"no readable events.jsonl under {run_dir}"]
+    kinds = {str(e.get("kind", "")) for e in events}
+    serving = any(k.startswith("serve.fleet.") for k in kinds)
+    training = any(k.startswith("fleet.") for k in kinds)
+    if serving:
+        if not collect_process_traces(run_dir):
+            problems.append("serving fleet run has no trace.*.json exports")
+        for spath in sorted(glob.glob(os.path.join(run_dir, "*.exit.json"))):
+            stem = os.path.basename(spath)[:-len(".exit.json")]
+            if not glob.glob(os.path.join(run_dir,
+                                          f"trace.{stem}.inc*.json")):
+                problems.append(
+                    f"worker {stem} exited cleanly but left no "
+                    f"trace.{stem}.inc*.json export")
+    if training:
+        worlds = [int(e.get("world_size", 0)) for e in events
+                  if e.get("kind") == EventKind.FLEET_SPAWN]
+        for rank in range(max(worlds) if worlds else 0):
+            if not os.path.exists(os.path.join(
+                    run_dir, f"metrics.rank{rank}.jsonl")):
+                problems.append(
+                    f"training fleet rank {rank} left no "
+                    f"metrics.rank{rank}.jsonl stream")
+    return problems

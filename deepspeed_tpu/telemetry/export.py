@@ -72,11 +72,21 @@ def trace_events(tracers: Union[Tracer, Sequence[Tracer]],
 
 
 def write_trace(path: str, tracers: Union[Tracer, Sequence[Tracer]],
-                journal=None) -> Dict[str, Any]:
+                journal=None,
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Export ``tracers`` to ``path`` (atomic tmp+replace) and return the
     object written; journals a ``trace.export`` event when given a
-    journal."""
+    journal.
+
+    ``extra`` merges additional top-level keys into the object — fleet
+    workers use it to record their ``clockSync`` handshake (wall/monotonic
+    pair) so the merge step can rebase spans onto the wall clock.  Extra
+    keys are legal in the Trace Event Format's JSON-object form and
+    ignored by :func:`validate_trace`.
+    """
     obj = trace_events(tracers)
+    if extra:
+        obj.update(extra)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -26,11 +26,13 @@ against a hand-computed fixture.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.jsonl import read_jsonl
 from ..utils.logging import logger
 
 __all__ = [
@@ -192,13 +194,20 @@ class Histogram:
             return list(self._samples)
 
     def percentile(self, q: float) -> Optional[float]:
-        """Nearest-rank percentile over the reservoir; None when empty."""
+        """Nearest-rank percentile (``ceil(q/100 · n)``-th sample) over the
+        reservoir; None when empty.
+
+        Defined for every reservoir size: one sample answers every ``q``
+        with itself, two samples split at the median (p50 → lower, p99 →
+        upper) — no index errors and no banker's-rounding surprises on the
+        tiny per-phase histograms critical-path stats are built from.
+        """
         with self._lock:
             if not self._samples:
                 return None
             s = sorted(self._samples)
-        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
-        return s[idx]
+        rank = math.ceil(min(100.0, max(0.0, float(q))) / 100.0 * len(s))
+        return s[min(len(s) - 1, max(0, rank - 1))]
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -344,21 +353,7 @@ class MetricsSampler:
 def read_metrics(path: str) -> List[Dict[str, Any]]:
     """Parse a ``metrics.jsonl``; torn/garbage lines are skipped, not
     fatal (the ``read_events`` contract)."""
-    out: List[Dict[str, Any]] = []
-    if not os.path.exists(path):
-        return out
-    with open(path, "r") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict):
-                out.append(rec)
-    return out
+    return read_jsonl(path)
 
 
 # ------------------------------------------------------------- online MFU

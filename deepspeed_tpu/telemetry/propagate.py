@@ -1,0 +1,160 @@
+"""Cross-process trace-context propagation for the fleets.
+
+One request's life crosses several OS processes: the supervisor mints an
+order, a prefill worker computes and publishes a KV page bundle, the
+decode engine verifies and re-admits it.  Each process runs its own
+:class:`~deepspeed_tpu.telemetry.spans.Tracer`; to stitch their spans into
+one request tree we thread a tiny context — ``trace_id`` plus
+``parent_span_id`` — through every hop:
+
+* **spool documents** (order files, bundle manifests, decode orders) carry
+  the two fields as top-level keys via :func:`inject` / :func:`extract`;
+* **child processes** inherit a fleet-level context through the
+  ``DS_TRACE_CONTEXT`` env var (same shape as ``DS_FAULT_PLAN``) via
+  :func:`to_env` / :func:`from_env`;
+* **journal emits** attach ``trace=ctx.fields()`` so ``events.jsonl``
+  rows join the same tree (the ``untraced-fleet-event`` dslint rule keeps
+  fleet emit sites honest).
+
+Degradation is deliberate: :func:`extract` returns ``None`` on absent or
+malformed context, so pre-tracing spool files stay readable and a worker
+simply starts a fresh root span.
+
+Clock alignment: span timestamps are ``time.monotonic`` per process, while
+journal rows are wall-clock.  Each worker records a
+:func:`clock_sync` handshake — a ``(wall_ts, mono_ts)`` pair sampled
+back-to-back — in its ready file, heartbeats, and exported trace file.
+The merge step rebases every span by ``wall_ts - mono_ts``
+(:func:`wall_offset_s`), putting all processes on one wall timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Mapping, Optional
+
+__all__ = [
+    "TRACE_ENV",
+    "TRACE_FIELDS",
+    "TraceContext",
+    "mint_context",
+    "child_context",
+    "inject",
+    "extract",
+    "to_env",
+    "from_env",
+    "clock_sync",
+    "wall_offset_s",
+]
+
+#: Env var carrying the fleet-level context into spawned workers,
+#: mirroring the ``DS_FAULT_PLAN`` convention.
+TRACE_ENV = "DS_TRACE_CONTEXT"
+
+#: Top-level keys a spool document gains when a context is injected.
+TRACE_FIELDS = ("trace_id", "parent_span_id")
+
+_ID_HEX_LEN = 16
+
+
+def _new_id() -> str:
+    return os.urandom(_ID_HEX_LEN // 2).hex()
+
+
+def _valid_id(value: Any) -> bool:
+    if not isinstance(value, str) or len(value) != _ID_HEX_LEN:
+        return False
+    try:
+        int(value, 16)
+    except ValueError:
+        return False
+    return True
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Immutable ``(trace_id, parent_span_id)`` pair; ids are 16 hex chars."""
+
+    trace_id: str
+    parent_span_id: str
+
+    def fields(self) -> Dict[str, str]:
+        """The two propagated fields as a plain dict (for emits/manifests)."""
+        return {"trace_id": self.trace_id, "parent_span_id": self.parent_span_id}
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh parent span id — one hop down the tree."""
+        return TraceContext(trace_id=self.trace_id, parent_span_id=_new_id())
+
+
+def mint_context() -> TraceContext:
+    """Mint a fresh root context (new trace id, new root span id)."""
+    return TraceContext(trace_id=_new_id(), parent_span_id=_new_id())
+
+
+def child_context(parent: Optional[TraceContext]) -> TraceContext:
+    """A child of ``parent``, or a fresh root when there is no parent."""
+    return parent.child() if parent is not None else mint_context()
+
+
+def inject(doc: Dict[str, Any], ctx: Optional[TraceContext]) -> Dict[str, Any]:
+    """Add the context fields to a spool document in place (and return it)."""
+    if ctx is not None:
+        doc["trace_id"] = ctx.trace_id
+        doc["parent_span_id"] = ctx.parent_span_id
+    return doc
+
+
+def extract(doc: Any) -> Optional[TraceContext]:
+    """Recover a context from a spool document or journal ``trace`` dict.
+
+    Returns ``None`` for absent or malformed fields so old spools written
+    before tracing existed degrade to a fresh root span, never an error.
+    """
+    if not isinstance(doc, Mapping):
+        return None
+    tid = doc.get("trace_id")
+    psid = doc.get("parent_span_id")
+    if not (_valid_id(tid) and _valid_id(psid)):
+        return None
+    return TraceContext(trace_id=tid, parent_span_id=psid)
+
+
+def to_env(ctx: TraceContext) -> str:
+    """Serialize a context for the ``DS_TRACE_CONTEXT`` env var."""
+    return json.dumps(ctx.fields(), sort_keys=True)
+
+
+def from_env(environ: Optional[Mapping[str, str]] = None) -> Optional[TraceContext]:
+    """Parse ``DS_TRACE_CONTEXT`` from ``environ`` (default ``os.environ``)."""
+    env = os.environ if environ is None else environ
+    raw = env.get(TRACE_ENV)
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    return extract(doc)
+
+
+def clock_sync() -> Dict[str, float]:
+    """Sample the wall/monotonic clock pair for merge-time alignment.
+
+    The offset ``wall_ts - mono_ts`` is constant for the life of a process
+    (both clocks tick at the same rate), so a single handshake recorded at
+    spawn, heartbeat, or export time suffices.
+    """
+    return {"wall_ts": time.time(), "mono_ts": time.monotonic(), "pid": os.getpid()}
+
+
+def wall_offset_s(sync: Mapping[str, Any]) -> Optional[float]:
+    """``wall - monotonic`` offset from a :func:`clock_sync` record."""
+    wall = sync.get("wall_ts")
+    mono = sync.get("mono_ts")
+    if not isinstance(wall, (int, float)) or not isinstance(mono, (int, float)):
+        return None
+    return float(wall) - float(mono)

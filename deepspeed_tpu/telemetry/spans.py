@@ -90,6 +90,15 @@ class SpanName:
     #: retiring a finished session's KV out of its slot (pool scatter or
     #: host park)
     SERVE_PARK = "serve.park"
+    #: one remote prefill order end-to-end on a prefill worker (chunk loop
+    #: through the fixed-width programs; trace_id/parent_span_id in args)
+    SERVE_FLEET_PREFILL = "serve.fleet.prefill"
+    #: publishing one KV page bundle + manifest into the spool (host bank
+    #: pull + npz write + digest)
+    SERVE_FLEET_PUBLISH = "serve.fleet.publish"
+    #: decode-side bundle verification (digest + prefix agreement) and
+    #: page rebuild before re-admission
+    SERVE_FLEET_VERIFY = "serve.fleet.verify"
 
 
 #: every registered span name, as a frozenset of strings

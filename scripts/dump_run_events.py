@@ -119,6 +119,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                      f"  completed={last.get('completed', '?')}"
                      f"/{last.get('accepted', '?')}")
         print(line, file=sys.stderr)
+        # TTFT critical path: where an average first token's latency went
+        # (only journals carrying the tracing timing fields decompose)
+        from deepspeed_tpu.telemetry.critical_path import summarize_ttft
+        tt = summarize_ttft(events)
+        if tt["requests"]:
+            phases = "  ".join(
+                f"{k[:-3]}={tt['phases'][k]['mean_ms']}ms"
+                for k in tt["phases"])
+            print(f"ttft-critical-path: requests={tt['requests']} "
+                  f"mean={tt['mean_ttft_ms']}ms reconciled={tt['ok']}  "
+                  + phases, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:
         by = {}

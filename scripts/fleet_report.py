@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Fleet trace merge + critical-path report for one fleet run dir.
+
+Joins everything a serving- or training-fleet run leaves behind — the
+shared ``events.jsonl`` journal, per-process ``trace.*.json`` span
+exports (with their ``clockSync`` wall/monotonic handshakes), and
+``metrics*.jsonl`` streams — into:
+
+* one multi-pid, wall-aligned Perfetto trace (``--out``, default
+  ``<run_dir>/fleet_trace.json``) you can open in ui.perfetto.dev:
+  journal rows, every process's spans rebased onto the wall clock,
+  metric samples, and synthesized per-request TTFT critical-path and
+  per-incident MTTR tracks;
+* a report (``--json`` for machine form): span-chain coverage, the
+  per-phase TTFT decomposition summary with its reconciliation verdict,
+  and per-incident MTTR attribution (detect → respawn → warm →
+  handoff/first-useful-work) for both serving incidents and training
+  restarts.
+
+Usage:
+    python scripts/fleet_report.py RUN_DIR [--out FILE] [--json]
+
+Exit codes: 0 ok; 1 missing worker telemetry or an invalid merged
+trace; 2 usage / no run dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir",
+                    help="fleet run dir holding events.jsonl + "
+                         "trace.*.json exports")
+    ap.add_argument("--out", default=None,
+                    help="merged Perfetto trace path "
+                         "(default: <run_dir>/fleet_trace.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the report as JSON")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.runtime.supervision.events import read_events
+    from deepspeed_tpu.telemetry.critical_path import (
+        decompose_mttr, decompose_training_restarts, merge_fleet_trace,
+        missing_worker_telemetry, span_chain_coverage, summarize_ttft)
+    from deepspeed_tpu.telemetry.export import validate_trace
+
+    run_dir = args.run_dir
+    if not os.path.isdir(run_dir):
+        print(f"error: no run dir at {run_dir}", file=sys.stderr)
+        return 2
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    problems = list(missing_worker_telemetry(run_dir, events=events))
+
+    merged = merge_fleet_trace(run_dir, events=events)
+    # synthesized phase/journal names are deliberately not SpanNames
+    schema = validate_trace(merged, require_registered_names=False)
+    problems.extend(f"merged trace: {p}" for p in schema)
+    out_path = args.out or os.path.join(run_dir, "fleet_trace.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out_path)
+
+    kinds = {str(e.get("kind", "")) for e in events}
+    report = {
+        "run_dir": run_dir,
+        "mode": ("serving" if any(k.startswith("serve.") for k in kinds)
+                 else "training"),
+        "trace_out": out_path,
+        "merged_events": len(merged["traceEvents"]),
+        "sources": merged["fleetMeta"]["sources"],
+        "unaligned": merged["fleetMeta"]["unaligned"],
+        "chain": span_chain_coverage(events),
+        "ttft": summarize_ttft(events),
+        "mttr": decompose_mttr(events),
+        "training_restarts": decompose_training_restarts(events),
+        "problems": problems,
+    }
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        ch, tt = report["chain"], report["ttft"]
+        print(f"fleet run {run_dir} ({report['mode']}): "
+              f"{report['merged_events']} merged events from "
+              f"{len(report['sources'])} aligned trace source(s) "
+              f"-> {out_path}")
+        print(f"  span chains: {ch['complete']}/{ch['accepted']} complete "
+              f"(coverage {ch['coverage']})")
+        if tt["requests"]:
+            print(f"  ttft: {tt['requests']} decomposed, mean "
+                  f"{tt['mean_ttft_ms']}ms, reconciled={tt['ok']} "
+                  f"(max |residual| {tt['max_abs_residual_ms']}ms)")
+        for m in report["mttr"] + report["training_restarts"]:
+            who = (f"{m.get('role')}{m.get('worker')}"
+                   if m.get("role") is not None
+                   else f"restart inc{m.get('incarnation')}")
+            if m["recovered"]:
+                ph = m["phases"]
+                print(f"  mttr {who}: {m['mttr_s']}s = respawn "
+                      f"{ph['respawn_ms']}ms + warm {ph['warm_ms']}ms + "
+                      f"handoff {ph['handoff_ms']}ms")
+            else:
+                print(f"  mttr {who}: never recovered")
+        for p in problems:
+            print(f"  PROBLEM: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,7 @@ schema validity, metrics-stream field presence, and zero recompiles.
 Usage:
     python scripts/run_report.py RUN_DIR [--expect-rank-metrics N]
                                  [--trace FILE] [--json]
+    python scripts/run_report.py --fleet-dir DIR [--json]
     python scripts/run_report.py --bench [--out BENCH_TELEMETRY.json]
                                  [--baseline FILE] [--steps 5] [--warmup 2]
                                  [--repeats 3]
@@ -100,6 +101,16 @@ def report(args) -> int:
         }
     out["metrics"] = ranks
 
+    # fleet telemetry ----------------------------------------------------
+    if args.fleet_dir:
+        from deepspeed_tpu.telemetry.critical_path import (
+            missing_worker_telemetry, span_chain_coverage)
+        out["fleet"] = {
+            "chain": span_chain_coverage(events),
+            "missing": missing_worker_telemetry(run_dir, events=events),
+        }
+        problems.extend(f"fleet: {p}" for p in out["fleet"]["missing"])
+
     # trace -------------------------------------------------------------
     if args.trace:
         try:
@@ -132,6 +143,10 @@ def report(args) -> int:
                   f"{r['last_step']}, step p50 "
                   f"{p50 if p50 is None else round(p50, 4)}s, "
                   f"mfu {r['mfu']}")
+        if "fleet" in out:
+            ch = out["fleet"]["chain"]
+            print(f"  fleet: span-chain coverage {ch['coverage']} "
+                  f"({ch['complete']}/{ch['accepted']})")
         if "trace" in out:
             print(f"  trace: {out['trace']['spans']} spans over "
                   f"{len(out['trace']['by_name'])} names")
@@ -350,6 +365,11 @@ def main(argv=None) -> int:
                          "every rank i < N")
     ap.add_argument("--trace", default=None,
                     help="Perfetto trace JSON to validate + summarize")
+    ap.add_argument("--fleet-dir", default=None, metavar="DIR",
+                    help="treat DIR as a fleet run dir: report span-chain "
+                         "coverage and fail on missing worker telemetry "
+                         "(trace.*.json exports, per-rank metrics); "
+                         "scripts/fleet_report.py does the full merge")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--bench", action="store_true",
                     help="run the CPU fixtures and gate BENCH_TELEMETRY.json")
@@ -365,8 +385,11 @@ def main(argv=None) -> int:
 
     if args.bench:
         return bench(args)
+    if args.run_dir is None and args.fleet_dir is not None:
+        args.run_dir = args.fleet_dir
     if args.run_dir is None:
-        print("error: RUN_DIR or --bench required", file=sys.stderr)
+        print("error: RUN_DIR, --fleet-dir, or --bench required",
+              file=sys.stderr)
         return 2
     return report(args)
 

@@ -66,12 +66,15 @@ def run_matrix(args) -> dict:
             score = run_serve_scenario(run_dir, scenario)
             score.pop("summary", None)
             scores[name] = score
+            trace = score.get("trace") or {}
             print(f"[serve-fleet-bench]   goodput={score['goodput']} "
                   f"accepted={score['accepted']} lost={score['lost']} "
                   f"rejected={score['rejected']} "
                   f"ttft_p99={score['ttft_ms']['p99']}ms "
                   f"mttr_max={score['mttr_s']['max']} "
-                  f"handoffs={score['handoffs']} ok={score['ok']}",
+                  f"handoffs={score['handoffs']} "
+                  f"span_chain={(trace.get('chain') or {}).get('coverage')} "
+                  f"ok={score['ok']}",
                   flush=True)
             if not score["ok"]:
                 for f in score["failures"]:
@@ -100,7 +103,11 @@ def run_matrix(args) -> dict:
 def gate(result: dict, baseline: dict, tolerance: float) -> list:
     """Regressions of the new result vs the committed baseline.  Only
     deterministic request-count metrics gate hard; scenarios new to the
-    matrix pass on their own expectations."""
+    matrix pass on their own expectations.  The ``trace`` block gates
+    absolutely: ≥95% of accepted requests must carry a complete span
+    chain, every decomposed TTFT must reconcile with the measured
+    end-to-end TTFT within tolerance, and the decode engine must stay
+    recompile-free in steady state."""
     problems = []
     base_scen = (baseline or {}).get("scenarios", {})
     for name, score in result["scenarios"].items():
@@ -112,6 +119,28 @@ def gate(result: dict, baseline: dict, tolerance: float) -> list:
                 f"{name}: {score['lost']} accepted request(s) lost "
                 f"({score['lost_ids']}) — the no-lost-accepted-request "
                 f"invariant is unconditional")
+        trace = score.get("trace") or {}
+        chain = trace.get("chain") or {}
+        if score["accepted"] > 0 and \
+                float(chain.get("coverage") or 0.0) < 0.95:
+            problems.append(
+                f"{name}: span-chain coverage {chain.get('coverage')} "
+                f"< 0.95 (incomplete: {chain.get('incomplete_ids')})")
+        ttft = trace.get("ttft") or {}
+        if score["completed"] > 0:
+            if not ttft.get("requests"):
+                problems.append(
+                    f"{name}: completed requests but zero decomposable "
+                    "TTFT chains — trace context never reached decode")
+            elif not ttft.get("ok"):
+                problems.append(
+                    f"{name}: TTFT phase sums fail to reconcile with "
+                    f"measured TTFT (unreconciled: "
+                    f"{ttft.get('unreconciled_ids')})")
+        recompiles = trace.get("steady_state_recompiles")
+        if recompiles is not None and recompiles != 0:
+            problems.append(
+                f"{name}: {recompiles} steady-state decode recompile(s)")
         base = base_scen.get(name)
         if base is None:
             continue

@@ -76,3 +76,36 @@ def test_kill_prefill_mid_chunk_exact_output_and_no_recompiles(tmp_path):
         stats = json.load(f)
     assert stats["ticks"] > 0
     assert stats["now"] == stats["warm"], stats
+
+    # ---- distributed tracing: every request's context survived the
+    # kill/retry/handoff and stitched an end-to-end span chain
+    from deepspeed_tpu.telemetry.critical_path import (decompose_mttr,
+                                                       merge_fleet_trace,
+                                                       span_chain_coverage,
+                                                       summarize_ttft)
+    from deepspeed_tpu.telemetry.export import validate_trace
+    chain = span_chain_coverage(events)
+    assert chain["coverage"] >= 0.95, chain
+
+    # TTFT decomposes into phases that reconcile with the journaled TTFT
+    tt = summarize_ttft(events)
+    assert tt["requests"] > 0 and tt["ok"], tt
+
+    # MTTR phases sum exactly to the journal-derived MTTR, and the
+    # incidents match the score's numbers
+    incidents = decompose_mttr(events)
+    recovered = [i for i in incidents if i["recovered"]]
+    assert recovered, incidents
+    for inc in recovered:
+        phase_sum_s = sum(inc["phases"].values()) / 1000.0
+        assert abs(phase_sum_s - inc["mttr_s"]) < 0.005, inc
+    assert score["mttr_s"]["all"], score["mttr_s"]
+    for want in score["mttr_s"]["all"]:
+        assert any(abs(i["mttr_s"] - want) < 0.005 for i in recovered), \
+            (incidents, score["mttr_s"])
+
+    # the merged Perfetto timeline validates and includes worker clocks
+    merged = merge_fleet_trace(run_dir, events=events)
+    assert validate_trace(merged, require_registered_names=False) == []
+    assert len(merged["fleetMeta"]["sources"]) >= 2, merged["fleetMeta"]
+    assert not merged["fleetMeta"]["unaligned"], merged["fleetMeta"]

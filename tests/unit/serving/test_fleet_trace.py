@@ -1,0 +1,127 @@
+"""Trace propagation through the fleet's real spool artifacts — no
+subprocesses.  The supervisor is constructed without ``start()`` (the
+constructor only lays out directories + journal), workers are marked
+ready by hand, and the order files / bundle manifests / child env it
+produces are checked for bitwise context round-trips and graceful
+degradation on context-free documents."""
+
+import json
+import os
+
+import numpy as np
+
+from deepspeed_tpu.serving.fleet import (ServeFleetConfig,
+                                         ServeFleetSupervisor,
+                                         publish_bundle)
+from deepspeed_tpu.telemetry.propagate import (TRACE_ENV, extract, from_env,
+                                               mint_context)
+from deepspeed_tpu.utils.jsonl import read_jsonl
+
+
+def _supervisor(tmp_path) -> ServeFleetSupervisor:
+    sup = ServeFleetSupervisor(str(tmp_path / "run"),
+                               config=ServeFleetConfig(n_prefill=1))
+    # hand-mark the prefill worker live+warm so _assign_prefill routes
+    # to it instead of waiting on a real subprocess
+    w = sup.workers[1]
+    w.alive = True
+    w.ready_inc = w.incarnation
+    return sup
+
+
+def test_submit_mints_root_context_and_journals_it(tmp_path):
+    sup = _supervisor(tmp_path)
+    rid = sup.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+    req = sup.requests[rid]
+    assert req.ctx is not None
+    rows = [r for r in read_jsonl(sup.journal.path)
+            if r["kind"] == "serve.request"]
+    assert rows[-1]["trace"] == req.ctx.fields()
+    assert isinstance(rows[-1]["t_submit"], float)
+    # each request is its own trace root, distinct from the fleet's
+    assert req.ctx.trace_id != sup.trace.trace_id
+    rid2 = sup.submit(np.arange(4, dtype=np.int32))
+    assert sup.requests[rid2].ctx.trace_id != req.ctx.trace_id
+
+
+def test_prefill_order_file_roundtrips_context_bitwise(tmp_path):
+    sup = _supervisor(tmp_path)
+    rid = sup.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+    req = sup.requests[rid]
+    sup._assign_prefill(req)
+    assert req.state == "prefilling" and req.worker == 1
+    with open(sup._order_path(req)) as f:
+        order = json.load(f)
+    got = extract(order)
+    assert got == req.ctx
+    assert order["trace_id"] == req.ctx.trace_id
+    assert order["parent_span_id"] == req.ctx.parent_span_id
+    # the order payload itself is untouched by injection
+    assert order["rid"] == rid and order["tokens"] == list(range(6))
+    assert order["t_submit"] == req.t_submit
+
+
+def test_decode_order_carries_context_on_both_paths(tmp_path):
+    sup = _supervisor(tmp_path)
+    rid = sup.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+    req = sup.requests[rid]
+    # remote path: manifest → decode order
+    manifest = {"bundle": "b.npz", "sha256": "0" * 64, "worker": 1}
+    sup._route_decode(req, manifest=manifest)
+    with open(sup._decode_order_path(rid, req.attempt)) as f:
+        order = json.load(f)
+    assert extract(order) == req.ctx
+    assert order["bundle"] == "b.npz" and not order["local"]
+    # degraded-local path: same context, no bundle
+    rid2 = sup.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+    req2 = sup.requests[rid2]
+    sup._route_decode(req2, manifest=None)
+    with open(sup._decode_order_path(rid2, req2.attempt)) as f:
+        order2 = json.load(f)
+    assert extract(order2) == req2.ctx
+    assert order2["local"] and order2["bundle"] is None
+
+
+def test_contextless_request_degrades_order_to_no_trace(tmp_path):
+    # a request minted by an old (pre-tracing) supervisor: ctx is None,
+    # the order file simply has no trace keys, extract degrades to None
+    sup = _supervisor(tmp_path)
+    rid = sup.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+    req = sup.requests[rid]
+    req.ctx = None
+    sup._assign_prefill(req)
+    with open(sup._order_path(req)) as f:
+        order = json.load(f)
+    assert "trace_id" not in order and "parent_span_id" not in order
+    assert extract(order) is None
+
+
+def test_bundle_manifest_roundtrips_context(tmp_path):
+    banks = [np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)]
+    ctx = mint_context()
+    manifest = publish_bundle(str(tmp_path), "req-0000", 0, banks,
+                              tokens=np.arange(2, dtype=np.int32),
+                              length=2, worker=1, trace=ctx)
+    assert extract(manifest) == ctx
+    # and the on-disk manifest (what the decode worker actually reads)
+    with open(os.path.join(str(tmp_path), "req-0000.a0.json")) as f:
+        on_disk = json.load(f)
+    assert extract(on_disk) == ctx
+    assert on_disk["sha256"] == manifest["sha256"]
+    # contextless publish degrades, never poisons
+    m2 = publish_bundle(str(tmp_path), "req-0001", 0, banks,
+                        tokens=np.arange(2, dtype=np.int32),
+                        length=2, worker=1, trace=None)
+    assert extract(m2) is None
+
+
+def test_child_env_carries_fleet_child_context(tmp_path):
+    sup = _supervisor(tmp_path)
+    env = sup._child_env(sup.workers[1])
+    ctx = from_env(env)
+    assert ctx is not None
+    # workers join the fleet's trace as children: same trace_id, a span
+    # of their own
+    assert ctx.trace_id == sup.trace.trace_id
+    assert ctx.parent_span_id != sup.trace.parent_span_id
+    assert env[TRACE_ENV]

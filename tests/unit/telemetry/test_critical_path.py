@@ -1,0 +1,255 @@
+"""Critical-path units over hand-built journals: span-chain coverage,
+TTFT phase telescoping + reconciliation, MTTR clamped attribution, and
+the multi-pid Perfetto merge — no subprocesses, pure arithmetic."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.runtime.supervision.events import EventKind
+from deepspeed_tpu.telemetry.critical_path import (MTTR_PHASES, TTFT_PHASES,
+                                                   decompose_mttr,
+                                                   decompose_request,
+                                                   decompose_training_restarts,
+                                                   merge_fleet_trace,
+                                                   missing_worker_telemetry,
+                                                   request_chains,
+                                                   span_chain_coverage,
+                                                   summarize_ttft)
+from deepspeed_tpu.telemetry.export import validate_trace
+
+T0 = 1_700_000_000.0
+TR = {"trace_id": "ab" * 8, "parent_span_id": "cd" * 8}
+
+
+def _traced_request(rid="req-0", t0=T0, trace=TR):
+    """One fully-instrumented remote-prefill request journal."""
+    return [
+        {"kind": EventKind.SERVE_REQUEST, "request_id": rid, "ts": t0,
+         "t_submit": t0, "trace": trace, "rank": -1},
+        {"kind": EventKind.SERVE_FLEET_BUNDLE, "request_id": rid,
+         "ts": t0 + 0.50, "t_start": t0 + 0.10, "prefill_s": 0.30,
+         "publish_s": 0.10, "worker": 1, "attempt": 0, "trace": trace,
+         "rank": 1},
+        {"kind": EventKind.SERVE_ADMIT, "request_id": rid,
+         "ts": t0 + 0.75, "t_order": t0 + 0.60, "verify_ms": 50.0,
+         "attempt": 0, "trace": trace, "rank": 0},
+        {"kind": EventKind.SERVE_DONE, "request_id": rid,
+         "ts": t0 + 1.00, "t_first": t0 + 0.95, "ttft_ms": 950.0,
+         "trace": trace, "rank": 0},
+    ]
+
+
+# ------------------------------------------------------------- chains
+def test_request_chain_resolution_and_coverage():
+    events = _traced_request()
+    chains = request_chains(events)
+    assert set(chains) == {"req-0"}
+    ch = chains["req-0"]
+    assert ch["trace_id"] == TR["trace_id"]
+    assert ch["bundle"] is not None and ch["done"] is not None
+    cov = span_chain_coverage(events)
+    assert cov == {"accepted": 1, "complete": 1, "coverage": 1.0,
+                   "incomplete_ids": []}
+
+
+def test_coverage_incomplete_without_trace_or_bundle():
+    # same journal, trace stripped from the admit row → chain broken
+    events = _traced_request()
+    events[2] = dict(events[2])
+    del events[2]["trace"]
+    cov = span_chain_coverage(events)
+    assert cov["coverage"] == 0.0
+    assert cov["incomplete_ids"] == ["req-0"]
+    # degraded-local path: no bundle, but the degraded row completes it
+    ev2 = [e for e in _traced_request(rid="req-1")
+           if e["kind"] != EventKind.SERVE_FLEET_BUNDLE]
+    ev2.insert(1, {"kind": EventKind.SERVE_FLEET_DEGRADED,
+                   "request_id": "req-1", "ts": T0 + 0.2, "trace": TR})
+    assert span_chain_coverage(ev2)["coverage"] == 1.0
+
+
+def test_coverage_empty_journal_is_vacuously_full():
+    assert span_chain_coverage([])["coverage"] == 1.0
+
+
+def test_requeued_request_uses_last_admit_before_done():
+    # a decode bounce: first admit at +0.75 dies, re-admit at +2.0 wins
+    events = _traced_request()
+    readmit = dict(events[2], ts=T0 + 2.0, t_order=T0 + 1.8)
+    done = dict(events[3], ts=T0 + 2.5, t_first=T0 + 2.4, ttft_ms=2400.0)
+    events = events[:3] + [readmit, done]
+    ch = request_chains(events)["req-0"]
+    assert ch["admit"]["ts"] == T0 + 2.0
+    assert ch["done"]["ts"] == T0 + 2.5
+
+
+# --------------------------------------------------------------- TTFT
+def test_decompose_request_phases_telescope():
+    d = decompose_request(request_chains(_traced_request())["req-0"])
+    assert d is not None and d["trace_id"] == TR["trace_id"]
+    ph = d["phases"]
+    assert ph["queue_wait_ms"] == pytest.approx(100.0)
+    assert ph["prefill_ms"] == pytest.approx(300.0)
+    assert ph["publish_ms"] == pytest.approx(100.0)
+    assert ph["spool_ms"] == pytest.approx(100.0)   # bundle ts → t_order
+    assert ph["verify_ms"] == pytest.approx(50.0)
+    assert ph["readmit_ms"] == pytest.approx(100.0)  # 150ms gap − verify
+    assert ph["decode_ms"] == pytest.approx(200.0)
+    assert d["phase_sum_ms"] == pytest.approx(950.0)
+    assert d["residual_ms"] == pytest.approx(0.0)
+    assert set(ph) == set(TTFT_PHASES)
+
+
+def test_decompose_request_none_on_pretracing_journal():
+    # strip the new timing fields: an old journal must yield None, not
+    # garbage numbers
+    events = _traced_request()
+    for e in events:
+        for k in ("t_submit", "t_order", "t_first"):
+            e.pop(k, None)
+    assert decompose_request(request_chains(events)["req-0"]) is None
+    s = summarize_ttft(events)
+    assert s["requests"] == 0 and s["ok"] is True
+
+
+def test_summarize_ttft_reconciliation_gate():
+    ok = summarize_ttft(_traced_request())
+    assert ok["requests"] == 1 and ok["ok"] is True
+    assert ok["max_abs_residual_ms"] == pytest.approx(0.0)
+    assert ok["phases"]["prefill_ms"]["mean_ms"] == pytest.approx(300.0)
+    # blow the measured TTFT far past the phase sum → unreconciled
+    bad = _traced_request()
+    bad[3] = dict(bad[3], ttft_ms=5000.0)
+    s = summarize_ttft(bad)
+    assert s["ok"] is False and s["unreconciled_ids"] == ["req-0"]
+
+
+# --------------------------------------------------------------- MTTR
+def test_decompose_mttr_phases_sum_exactly():
+    events = _traced_request() + [
+        {"kind": EventKind.SERVE_FLEET_WORKER_LOST, "role": "prefill",
+         "worker": 1, "incarnation": 0, "detect_ts": T0 + 2.0,
+         "ts": T0 + 2.01, "trace": TR},
+        {"kind": EventKind.SERVE_FLEET_SPAWN, "role": "prefill",
+         "worker": 1, "incarnation": 1, "ts": T0 + 2.3, "trace": TR},
+        {"kind": EventKind.SERVE_FLEET_READY, "role": "prefill",
+         "worker": 1, "incarnation": 1, "warm_s": 0.4, "ts": T0 + 2.7,
+         "trace": TR},
+        {"kind": EventKind.SERVE_DONE, "request_id": "req-9",
+         "ts": T0 + 3.0, "trace": TR},
+    ]
+    incidents = decompose_mttr(events)
+    assert len(incidents) == 1
+    m = incidents[0]
+    assert m["recovered"] and m["mttr_s"] == pytest.approx(1.0)
+    assert set(m["phases"]) == set(MTTR_PHASES)
+    assert m["phases"]["respawn_ms"] == pytest.approx(300.0)
+    assert m["phases"]["warm_ms"] == pytest.approx(400.0)
+    assert m["phases"]["handoff_ms"] == pytest.approx(300.0)
+    # the defining invariant: phases sum to the journal MTTR exactly
+    assert sum(m["phases"].values()) == pytest.approx(m["mttr_s"] * 1e3)
+
+
+def test_decompose_mttr_fast_handoff_clamps_to_respawn():
+    # recovery lands BEFORE the replacement spawns: clamping attributes
+    # the whole window to respawn, warm/handoff collapse to 0
+    events = [
+        {"kind": EventKind.SERVE_FLEET_WORKER_LOST, "role": "prefill",
+         "worker": 2, "incarnation": 0, "detect_ts": T0, "ts": T0,
+         "trace": TR},
+        {"kind": EventKind.SERVE_DONE, "request_id": "r", "ts": T0 + 0.1},
+        {"kind": EventKind.SERVE_FLEET_SPAWN, "role": "prefill",
+         "worker": 2, "incarnation": 1, "ts": T0 + 0.5, "trace": TR},
+    ]
+    m = decompose_mttr(events)[0]
+    assert m["mttr_s"] == pytest.approx(0.1)
+    assert m["phases"]["respawn_ms"] == pytest.approx(100.0)
+    assert m["phases"]["warm_ms"] == 0.0
+    assert m["phases"]["handoff_ms"] == 0.0
+
+
+def test_decompose_mttr_unrecovered():
+    events = [{"kind": EventKind.SERVE_FLEET_WORKER_LOST, "role": "decode",
+               "worker": 0, "incarnation": 0, "detect_ts": T0, "ts": T0}]
+    m = decompose_mttr(events)[0]
+    assert m["recovered"] is False and m["mttr_s"] is None
+
+
+def test_decompose_training_restarts_sums():
+    events = [
+        {"kind": EventKind.FLEET_RESTART, "incarnation": 1, "restarts": 1,
+         "reason": "rank_crashed", "detect_ts": T0, "ts": T0 + 0.05,
+         "rank": -1, "trace": TR},
+        {"kind": EventKind.FLEET_SPAWN, "incarnation": 1, "world_size": 2,
+         "ts": T0 + 0.4, "rank": -1, "trace": TR},
+        {"kind": "ckpt.load", "rank": 0, "ts": T0 + 0.9},
+        {"kind": EventKind.DATA_BATCH, "rank": 0, "ts": T0 + 1.5},
+    ]
+    m = decompose_training_restarts(events)[0]
+    assert m["recovered"] and m["mttr_s"] == pytest.approx(1.5)
+    assert m["phases"]["respawn_ms"] == pytest.approx(400.0)
+    assert m["phases"]["warm_ms"] == pytest.approx(500.0)
+    assert m["phases"]["handoff_ms"] == pytest.approx(600.0)
+    assert sum(m["phases"].values()) == pytest.approx(m["mttr_s"] * 1e3)
+
+
+# -------------------------------------------------------------- merge
+def test_merge_fleet_trace_aligns_and_validates(tmp_path):
+    run_dir = str(tmp_path)
+    events = _traced_request()
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    # one aligned span export (mono clock ~0, wall = T0 → offset T0) and
+    # one export with no clockSync (must be excluded, not guessed)
+    aligned = {"traceEvents": [
+        {"name": "serve.fleet.prefill", "cat": "serve", "ph": "X",
+         "ts": int(0.10e6), "dur": int(0.30e6), "pid": 0, "tid": 1}],
+        "clockSync": {"wall_ts": T0, "mono_ts": 0.0, "pid": 42}}
+    with open(os.path.join(run_dir, "trace.prefill1.inc0.json"), "w") as f:
+        json.dump(aligned, f)
+    with open(os.path.join(run_dir, "trace.decode0.inc0.json"), "w") as f:
+        json.dump({"traceEvents": []}, f)
+
+    merged = merge_fleet_trace(run_dir, events=events)
+    assert validate_trace(merged, require_registered_names=False) == []
+    meta = merged["fleetMeta"]
+    assert meta["unaligned"] == ["trace.decode0.inc0.json"]
+    assert [s["path"] for s in meta["sources"]] == \
+        ["trace.prefill1.inc0.json"]
+    assert meta["sources"][0]["offset_s"] == pytest.approx(T0)
+    names = {e["name"] for e in merged["traceEvents"]}
+    # journal rows, the rebased span, and the synthesized TTFT track
+    assert EventKind.SERVE_DONE in names
+    assert "serve.fleet.prefill" in names
+    assert "ttft.queue_wait" in names and "ttft.decode" in names
+    # wall alignment: the rebased prefill span starts 100ms after the
+    # submit instant (t0 was shifted to the earliest X event)
+    by_name = {e["name"]: e for e in merged["traceEvents"]}
+    prefill = by_name["serve.fleet.prefill"]
+    submit = by_name[EventKind.SERVE_REQUEST]
+    assert prefill["ts"] - submit["ts"] == pytest.approx(0.10e6, abs=2)
+
+
+def test_missing_worker_telemetry(tmp_path):
+    run_dir = str(tmp_path)
+    events = [{"kind": EventKind.SERVE_FLEET_SPAWN, "role": "decode",
+               "worker": 0, "incarnation": 0, "ts": T0}]
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    # a cleanly-exited worker with no trace export: two problems (no
+    # exports at all + the per-worker gap)
+    with open(os.path.join(run_dir, "decode0.exit.json"), "w") as f:
+        json.dump({"role": "decode", "rank": 0, "status": "done"}, f)
+    problems = missing_worker_telemetry(run_dir, events=events)
+    assert any("decode0" in p for p in problems)
+    # writing the export clears it
+    with open(os.path.join(run_dir, "trace.decode0.inc0.json"), "w") as f:
+        json.dump({"traceEvents": [],
+                   "clockSync": {"wall_ts": T0, "mono_ts": 0.0}}, f)
+    assert missing_worker_telemetry(run_dir, events=events) == []
+    assert missing_worker_telemetry(str(tmp_path / "nope")) \
+        == [f"no readable events.jsonl under {tmp_path / 'nope'}"]

@@ -1,0 +1,32 @@
+"""The shared torn-line JSONL reader: every telemetry consumer (journal
+scoring, metrics merge, the fleet report) reads through this one
+contract, so its skip semantics are pinned here."""
+
+import json
+
+from deepspeed_tpu.utils.jsonl import read_jsonl
+
+
+def test_read_jsonl_skips_torn_garbage_and_non_dict_rows(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    a = {"kind": "serve.request", "seq": 1}
+    b = {"kind": "serve.done", "seq": 2}
+    with open(path, "w") as f:
+        f.write(json.dumps(a) + "\n")
+        f.write("\n")                         # blank line
+        f.write("not json at all\n")          # interleaved garbage
+        f.write("[1, 2, 3]\n")                # parseable but not a dict
+        f.write(json.dumps(b) + "\n")
+        f.write(json.dumps(a)[:10])           # SIGKILL mid-write: torn tail
+    rows = read_jsonl(path)
+    assert rows == [a, b]
+
+
+def test_read_jsonl_kind_filter_and_missing_file(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        for k in ("serve.request", "serve.done", "serve.request"):
+            f.write(json.dumps({"kind": k}) + "\n")
+    assert len(read_jsonl(path, kind="serve.request")) == 2
+    assert read_jsonl(path, kind="nope") == []
+    assert read_jsonl(str(tmp_path / "absent.jsonl")) == []

@@ -48,6 +48,31 @@ def test_histogram_percentiles_and_reservoir_bound():
     assert Histogram("e").percentile(50) is None
 
 
+def test_histogram_percentile_tiny_reservoirs():
+    # 0-, 1-, 2-sample reservoirs must return defined values (nearest-rank
+    # ceil model), never raise — critical_path summarizes per-phase stats
+    # over journals with a single decomposable request
+    assert Histogram("0").percentile(50) is None
+    assert Histogram("0").percentile(99) is None
+    one = Histogram("1")
+    one.observe(7.0)
+    assert one.percentile(0) == 7.0
+    assert one.percentile(50) == 7.0
+    assert one.percentile(99) == 7.0
+    assert one.percentile(100) == 7.0
+    two = Histogram("2")
+    two.observe(10.0)
+    two.observe(20.0)
+    assert two.percentile(0) == 10.0
+    assert two.percentile(50) == 10.0
+    assert two.percentile(51) == 20.0
+    assert two.percentile(99) == 20.0
+    assert two.percentile(100) == 20.0
+    # out-of-range quantiles clamp instead of indexing out of bounds
+    assert two.percentile(-5) == 10.0
+    assert two.percentile(250) == 20.0
+
+
 def test_histogram_thread_safety():
     h = Histogram("t", cap=10000)
     threads = [threading.Thread(

@@ -1,0 +1,94 @@
+"""Trace-context propagation units: mint/child semantics, bitwise
+inject/extract round-trips through JSON (spool order files and bundle
+manifests are exactly this), env-var transport, graceful degradation on
+old/malformed documents, and the clock-sync handshake."""
+
+import json
+import time
+
+from deepspeed_tpu.telemetry.propagate import (TRACE_ENV, TraceContext,
+                                               child_context, clock_sync,
+                                               extract, from_env, inject,
+                                               mint_context, to_env,
+                                               wall_offset_s)
+
+
+# ------------------------------------------------------------- minting
+def test_mint_context_shape_and_uniqueness():
+    seen = set()
+    for _ in range(64):
+        ctx = mint_context()
+        for v in (ctx.trace_id, ctx.parent_span_id):
+            assert isinstance(v, str) and len(v) == 16
+            int(v, 16)  # must parse as hex
+        seen.add(ctx.trace_id)
+    assert len(seen) == 64
+
+
+def test_child_keeps_trace_id_fresh_span():
+    root = mint_context()
+    child = child_context(root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id != root.parent_span_id
+    # no parent → a fresh root (worker spawned outside any request)
+    orphan = child_context(None)
+    assert orphan.trace_id != root.trace_id
+
+
+# ------------------------------------------- document inject / extract
+def test_inject_extract_bitwise_roundtrip_through_json():
+    ctx = mint_context()
+    doc = inject({"rid": "req-0", "attempt": 1}, ctx)
+    # through the exact serialization the spool uses
+    wire = json.loads(json.dumps(doc, sort_keys=True))
+    got = extract(wire)
+    assert got == ctx
+    assert wire["trace_id"] == ctx.trace_id
+    assert wire["parent_span_id"] == ctx.parent_span_id
+    # payload keys untouched
+    assert wire["rid"] == "req-0" and wire["attempt"] == 1
+
+
+def test_inject_none_context_is_noop():
+    doc = {"rid": "req-1"}
+    assert inject(doc, None) is doc
+    assert "trace_id" not in doc
+
+
+def test_extract_degrades_to_none_on_old_or_malformed_docs():
+    # pre-tracing spool file: no context keys at all
+    assert extract({"rid": "req-2", "tokens": [1, 2]}) is None
+    # malformed ids must not produce a poisoned context
+    assert extract({"trace_id": "xyz", "parent_span_id": "0" * 16}) is None
+    assert extract({"trace_id": "0" * 16, "parent_span_id": 12345}) is None
+    assert extract({"trace_id": "0" * 8, "parent_span_id": "0" * 16}) is None
+    assert extract(None) is None
+    assert extract("not-a-dict") is None
+
+
+# ------------------------------------------------------- env transport
+def test_env_roundtrip(monkeypatch):
+    ctx = mint_context()
+    monkeypatch.setenv(TRACE_ENV, to_env(ctx))
+    assert from_env() == ctx
+    monkeypatch.setenv(TRACE_ENV, "{broken json")
+    assert from_env() is None
+    monkeypatch.delenv(TRACE_ENV)
+    assert from_env() is None
+
+
+def test_from_env_explicit_mapping():
+    ctx = TraceContext(trace_id="ab" * 8, parent_span_id="cd" * 8)
+    assert from_env({TRACE_ENV: to_env(ctx)}) == ctx
+
+
+# --------------------------------------------------------- clock sync
+def test_clock_sync_offset_model():
+    sync = clock_sync()
+    assert set(sync) >= {"wall_ts", "mono_ts", "pid"}
+    off = wall_offset_s(sync)
+    # wall − monotonic must reproduce the current wall clock to within
+    # the time it took to take the two samples
+    assert abs((off + time.monotonic()) - time.time()) < 1.0
+    assert wall_offset_s({}) is None
+    assert wall_offset_s({"wall_ts": "nan?", "mono_ts": 1.0}) is None

@@ -539,3 +539,57 @@ def test_telemetry_name_skips_the_registry_modules_and_suppresses():
         tracer.span("nope")
     """)
     assert findings == []
+
+
+# -------------------------------------------------------- untraced-fleet-event
+FLEET_PROJECT = Project(
+    event_kind_map={"SERVE_FLEET_SPAWN": "serve.fleet.spawn",
+                    "SERVE_FLEET_DEGRADED": "serve.fleet.degraded",
+                    "FLEET_RESTART": "fleet.restart",
+                    "FLEET_SPAWN": "fleet.spawn",
+                    "SERVE_REQUEST": "serve.request",
+                    "DATA_BATCH": "data.batch"},
+    fault_points=set(),
+    bucketing_helpers=set(),
+)
+
+
+def flint(src, relpath=SERVE):
+    return lint_source(textwrap.dedent(src), relpath, FLEET_PROJECT)
+
+
+def test_untraced_fleet_event_fires_on_literal_and_attribute_kinds():
+    findings = flint("""
+        journal.emit("serve.fleet.spawn", role="prefill", worker=1)
+        self._emit(EventKind.FLEET_RESTART, incarnation=2)
+    """)
+    assert rules_of(findings) == ["untraced-fleet-event"] * 2
+    assert "trace" in findings[0].message
+
+
+def test_untraced_fleet_event_quiet_with_trace_kwarg_even_none():
+    findings = flint("""
+        journal.emit("serve.fleet.spawn", worker=1, trace=ctx.fields())
+        journal.emit(EventKind.SERVE_FLEET_DEGRADED, trace=None)
+    """)
+    assert findings == []
+
+
+def test_untraced_fleet_event_ignores_non_fleet_kinds():
+    findings = flint("""
+        journal.emit("serve.request", request_id="r")
+        journal.emit(EventKind.DATA_BATCH, step=1)
+        journal.emit(kind_variable, step=1)   # dynamic: passes uninspected
+        emit("serve.fleet.spawn")             # bare call, not a method
+    """)
+    assert findings == []
+
+
+def test_untraced_fleet_event_scoped_and_suppressible():
+    bad = 'journal.emit("fleet.spawn", pids=[1])\n'
+    assert flint(bad, "tests/unit/fixture.py") == []
+    findings = flint("""
+        # dslint: disable=untraced-fleet-event — fixture without context
+        journal.emit("fleet.spawn", pids=[1])
+    """)
+    assert findings == []

@@ -15,6 +15,7 @@ from .unbucketed_static_arg import UnbucketedStaticArg  # noqa: F401
 from .host_sync import HostSyncInHotPath  # noqa: F401
 from .missing_donation import MissingDonation  # noqa: F401
 from .telemetry_names import UnregisteredTelemetryName  # noqa: F401
+from .untraced_fleet_event import UntracedFleetEvent  # noqa: F401
 
 ALL_RULES = (
     SwallowedException,
@@ -28,4 +29,5 @@ ALL_RULES = (
     HostSyncInHotPath,
     MissingDonation,
     UnregisteredTelemetryName,
+    UntracedFleetEvent,
 )

@@ -1,0 +1,67 @@
+"""untraced-fleet-event: every fleet-lifecycle journal emit must carry the
+trace context.
+
+The fleets stitch per-process spans into one request tree by propagating
+``trace_id``/``parent_span_id`` through every hop (spool orders, bundle
+manifests, ``DS_TRACE_CONTEXT`` env — ``deepspeed_tpu/telemetry/
+propagate.py``), and the journal rows are where the chain is *observed*:
+``span_chain_coverage`` and the TTFT/MTTR decompositions in
+``critical_path.py`` match rows by their ``trace`` field.  A
+``serve.fleet.*`` or ``fleet.*`` emit without a ``trace=`` kwarg is a hop
+the merged timeline silently loses — the coverage gate then fails on
+requests that actually completed fine.
+
+Checked call shapes: ``<journal>.emit(<kind>, ...)`` / ``self._emit(...)``
+where ``<kind>`` is a ``serve.fleet.*`` / ``fleet.*`` string literal or
+the corresponding ``EventKind.SERVE_FLEET_*`` / ``EventKind.FLEET_*``
+attribute.  Passing ``trace=None`` explicitly is fine — it documents a
+hop that genuinely has no request context (e.g. supervisor-lifecycle
+rows), which the chain matcher treats as absent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+
+EMIT_NAMES = {"emit", "_emit"}
+KIND_PREFIXES = ("serve.fleet.", "fleet.")
+ATTR_PREFIXES = ("SERVE_FLEET_", "FLEET_")
+
+
+def _is_fleet_kind(arg: ast.expr) -> bool:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value.startswith(KIND_PREFIXES)
+    if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+            and arg.value.id == "EventKind":
+        return arg.attr.startswith(ATTR_PREFIXES)
+    return False
+
+
+class UntracedFleetEvent(Rule):
+    id = "untraced-fleet-event"
+    description = ("serve.fleet.*/fleet.* journal emits must pass the "
+                   "trace context (trace=...)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/"))
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in EMIT_NAMES and node.args):
+                continue
+            if not _is_fleet_kind(node.args[0]):
+                continue
+            if any(kw.arg == "trace" for kw in node.keywords):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "fleet-lifecycle emit without trace context — pass "
+                "trace=<ctx>.fields() (or trace=None for a hop that "
+                "genuinely has no request context) so critical_path's "
+                "span-chain coverage can stitch it")
