@@ -43,25 +43,32 @@ from .scenarios import ALL_RANKS, FaultSpec
 class ServeScenario:
     """A fully-resolved serving-fleet run: geometry, workload shape,
     faults, knobs, expectations.  Fault ``ranks`` use fleet ranks: decode
-    engine = 0, prefill workers = 1..n_prefill."""
+    engines = ``0..n_decode-1``, prefill workers =
+    ``n_decode..n_decode+n_prefill-1``."""
 
     name: str
     description: str
     seed: int
     n_prefill: int = 2
+    n_decode: int = 1
     n_requests: int = 6
     #: Poisson arrival rate (exponential inter-arrival draws)
     arrival_rate_hz: float = 1.5
     prompt_len: Tuple[int, int] = (18, 34)
     max_new_tokens: Tuple[int, int] = (4, 6)
+    #: per-request session ids (routing keys); requests past the tuple's
+    #: length default their session to the request id.  Factories craft
+    #: these against the seeded hash ring to steer placement (hot-spot /
+    #: victim-owns-first-arrival setups).
+    sessions: Tuple[str, ...] = ()
     faults: Tuple[FaultSpec, ...] = ()
     #: :class:`~deepspeed_tpu.serving.fleet.ServeFleetConfig` field
     #: overrides (queue_capacity, prefill_timeout_s, ...)
     fleet_overrides: Mapping[str, Any] = dataclasses.field(
         default_factory=dict)
     #: scored expectations: min_goodput, max_lost, max_incidents,
-    #: max_mttr_s, max_ttft_p99_ms, min_rejected, expect_kinds,
-    #: allow_abort_kinds
+    #: max_mttr_s, max_ttft_p99_ms, min_rejected, min_migrations,
+    #: expect_kinds, allow_abort_kinds
     expect: Mapping[str, Any] = dataclasses.field(default_factory=dict)
 
     def plan_for(self, rank: int, incarnation: int) -> str:
@@ -86,12 +93,16 @@ class ServeScenario:
                 "at_s": round(at, 3),
                 "tokens": [rng.randrange(256) for _ in range(plen)],
                 "max_new_tokens": rng.randint(*self.max_new_tokens),
-                "greedy": True, "temperature": 1.0, "seed": i})
+                "greedy": True, "temperature": 1.0, "seed": i,
+                "session": (self.sessions[i]
+                            if i < len(self.sessions) else None)})
         return items
 
     def validate(self) -> "ServeScenario":
         if self.n_prefill < 0:
             raise ValueError(f"{self.name}: n_prefill must be >= 0")
+        if self.n_decode < 1:
+            raise ValueError(f"{self.name}: n_decode must be >= 1")
         if self.n_requests < 1:
             raise ValueError(f"{self.name}: n_requests must be >= 1")
         for f in self.faults:
@@ -208,6 +219,126 @@ def _corrupt_page_bundle(seed: int) -> ServeScenario:
     ).validate()
 
 
+def _craft_sessions(n_decode: int, want: Tuple[int, ...], *,
+                    route_seed: int = 0, replicas: int = 32,
+                    salt: str = "s") -> Tuple[str, ...]:
+    """Craft session ids whose seeded hash-ring owners are exactly
+    ``want`` (one engine rank per request).  Placement under quiet load
+    follows the ring owner, so factories use this to guarantee e.g. "the
+    victim owns the first arrival" or "every session hashes to one hot
+    engine" — deterministically, for any seed."""
+    from ..serving.routing import HashRing
+    ring = HashRing(range(n_decode), seed=route_seed, replicas=replicas)
+    out: List[str] = []
+    j = 0
+    for target in want:
+        while True:
+            name = f"{salt}{j}"
+            j += 1
+            if ring.lookup(name) == target:
+                out.append(name)
+                break
+    return tuple(out)
+
+
+def _kill_one_of_n_decodes(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(2)
+    step = rng.randint(3, 6)
+    survivor = 1 - victim
+    return ServeScenario(
+        name="kill_one_of_n_decodes",
+        description=f"two decode engines; SIGKILL engine {victim} on its "
+                    f"tick {step} (mid-decode): its resident sessions must "
+                    "fail over to the survivor from their durable bundles "
+                    "(serve.fleet.requeue) while the survivor's own "
+                    "sessions never stall, and the victim respawns",
+        seed=seed, n_decode=2, arrival_rate_hz=4.0,
+        sessions=_craft_sessions(2, (victim, survivor, victim, survivor,
+                                     victim, survivor)),
+        faults=(FaultSpec("serve.decode_tick", "KillAtStep",
+                          {"step": step}, ranks=(victim,)),),
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 180.0,
+                "expect_kinds": (EventKind.SERVE_FLEET_WORKER_LOST,
+                                 EventKind.SERVE_FLEET_RESTART,
+                                 EventKind.SERVE_FLEET_REQUEUE)},
+    ).validate()
+
+
+def _hot_spot_rebalance(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    hot = rng.randrange(2)
+    cold = 1 - hot
+    return ServeScenario(
+        name="hot_spot_rebalance",
+        description=f"pure-ring routing plus crafted sessions pile every "
+                    f"request onto engine {hot}: the supervisor's "
+                    "rebalancer must live-migrate sessions to the idle "
+                    f"engine {cold} (park → spool transfer → verify → "
+                    "readmit), and the one bundle that bitrots in transit "
+                    "must be rejected at admit and re-prefilled — never "
+                    "decoded from",
+        seed=seed, n_decode=2, n_requests=6, arrival_rate_hz=8.0,
+        max_new_tokens=(10, 14),
+        sessions=_craft_sessions(2, (hot,) * 6),
+        faults=(FaultSpec("serve.decode_tick", "DelaySeconds",
+                          {"seconds": 0.03, "n": 200}, ranks=(hot,)),
+                FaultSpec("serve.migrate_admit", "CorruptRandomBytes",
+                          {"nbytes": 16, "seed": seed}, ranks=(cold,))),
+        fleet_overrides={"route_policy": "ring", "rebalance": True,
+                         "rebalance_gap": 2, "slots": 2},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "min_migrations": 1,
+                "expect_kinds": (EventKind.SERVE_FLEET_MIGRATE,
+                                 EventKind.SERVE_FLEET_MIGRATE_REJECT)},
+    ).validate()
+
+
+def _rolling_restart_drain(seed: int) -> ServeScenario:
+    return ServeScenario(
+        name="rolling_restart_drain",
+        description="rolling restart of both decode engines mid-traffic: "
+                    "each engine is drained (its live sessions migrated to "
+                    "a peer), stopped on purpose, respawned, and rewarmed "
+                    "before the next goes — zero lost conversations, no "
+                    "incident ever declared",
+        seed=seed, n_decode=2, arrival_rate_hz=2.5,
+        max_new_tokens=(12, 16),
+        sessions=_craft_sessions(2, (0, 1, 0, 1, 0, 1)),
+        faults=(FaultSpec("serve.decode_tick", "DelaySeconds",
+                          {"seconds": 0.05, "n": 500}, ranks=(0, 1)),),
+        fleet_overrides={"rolling_restart_at_s": 1.0},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "expect_kinds": (EventKind.SERVE_FLEET_DRAIN,
+                                 EventKind.SERVE_FLEET_MIGRATE,
+                                 EventKind.SERVE_FLEET_RESTART)},
+    ).validate()
+
+
+def _decode_death_during_handoff(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(2)
+    survivor = 1 - victim
+    return ServeScenario(
+        name="decode_death_during_handoff",
+        description=f"compound fault: decode engine {victim} is SIGKILLed "
+                    "at its first admission — a prefilled page bundle is "
+                    "in flight to it: the supervisor must re-route the "
+                    "orphaned order to the survivor from the same durable "
+                    "bundle (no re-prefill), and the respawned victim "
+                    "must ignore the superseded straggler order",
+        seed=seed, n_decode=2, arrival_rate_hz=4.0,
+        sessions=_craft_sessions(2, (victim, survivor, victim, survivor,
+                                     victim, survivor)),
+        faults=(FaultSpec("serve.admit", "KillAtStep",
+                          {"step": 0}, ranks=(victim,)),),
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 180.0,
+                "expect_kinds": (EventKind.SERVE_FLEET_WORKER_LOST,
+                                 EventKind.SERVE_FLEET_RESTART,
+                                 EventKind.SERVE_FLEET_REQUEUE)},
+    ).validate()
+
+
 #: name → factory(seed); iteration order is the bench matrix order
 SERVE_SCENARIOS = {
     "fleet_baseline": _fleet_baseline,
@@ -216,6 +347,10 @@ SERVE_SCENARIOS = {
     "straggler_prefill": _straggler_prefill,
     "burst_past_queue": _burst_past_queue,
     "corrupt_page_bundle": _corrupt_page_bundle,
+    "kill_one_of_n_decodes": _kill_one_of_n_decodes,
+    "hot_spot_rebalance": _hot_spot_rebalance,
+    "rolling_restart_drain": _rolling_restart_drain,
+    "decode_death_during_handoff": _decode_death_during_handoff,
 }
 
 
@@ -288,6 +423,9 @@ def score_serve_events(events: List[dict], *,
         else:
             unrecovered += 1
 
+    exported = [e for e in by_kind(EventKind.SERVE_FLEET_MIGRATE)
+                if e.get("state") == "exported"]
+
     allowed = set(expect.get("allow_abort_kinds", ()))
     unexpected_aborts = [e["kind"] for e in events
                          if e.get("kind") in ABORT_KINDS
@@ -319,6 +457,12 @@ def score_serve_events(events: List[dict], *,
         "requeues": len(by_kind(EventKind.SERVE_FLEET_REQUEUE)),
         "degraded": len(by_kind(EventKind.SERVE_FLEET_DEGRADED)),
         "bundle_rejects": len(by_kind(EventKind.SERVE_FLEET_BUNDLE_REJECT)),
+        "migrations": len(exported),
+        "migrate_rejects": len(by_kind(EventKind.SERVE_FLEET_MIGRATE_REJECT)),
+        "migrated_bytes": sum(int(e.get("nbytes") or 0) for e in exported),
+        "drains": len(by_kind(EventKind.SERVE_FLEET_DRAIN)),
+        "drained_sessions": sum(int(e.get("sessions") or 0)
+                                for e in by_kind(EventKind.SERVE_FLEET_DRAIN)),
         "restarts": len(by_kind(EventKind.SERVE_FLEET_RESTART)),
         "unexpected_aborts": unexpected_aborts,
         "kinds": kinds,
@@ -362,6 +506,11 @@ def _judge_serve(score: Dict[str, Any], expect: Mapping[str, Any]):
             and score["ttft_ms"]["p99"] > max_ttft:
         failures.append(
             f"TTFT p99 {score['ttft_ms']['p99']}ms > expected {max_ttft}ms")
+    min_migrations = expect.get("min_migrations")
+    if min_migrations is not None and score["migrations"] < min_migrations:
+        failures.append(
+            f"migrations {score['migrations']} < expected {min_migrations} "
+            "— no session was ever live-migrated")
     min_rejected = expect.get("min_rejected")
     if min_rejected is not None and score["rejected"] < min_rejected:
         failures.append(
@@ -387,8 +536,9 @@ def trace_report(run_dir: str,
                  events: Optional[List[dict]] = None) -> Dict[str, Any]:
     """The distributed-tracing health block attached to every scored run:
     span-chain coverage, the TTFT critical-path reconciliation, and the
-    decode engine's steady-state recompile count (``decode.stats.json``
-    ``now`` minus ``warm`` — must be zero once warm)."""
+    per-engine steady-state recompile counts (``decode.stats.r<N>.json``
+    ``now`` minus ``warm`` — must be zero on every engine once warm)."""
+    import glob as _glob
     from ..telemetry.critical_path import (span_chain_coverage,
                                            summarize_ttft)
     if events is None:
@@ -397,13 +547,19 @@ def trace_report(run_dir: str,
         "chain": span_chain_coverage(events),
         "ttft": summarize_ttft(events),
     }
-    try:
-        with open(os.path.join(run_dir, "decode.stats.json")) as f:
-            st = json.load(f)
-        block["steady_state_recompiles"] = (
-            sum(st["now"].values()) - sum(st["warm"].values()))
-    except (OSError, ValueError, KeyError, TypeError):
-        block["steady_state_recompiles"] = None
+    per_engine: Dict[str, int] = {}
+    for path in sorted(_glob.glob(
+            os.path.join(run_dir, "decode.stats.r*.json"))):
+        try:
+            with open(path) as f:
+                st = json.load(f)
+            per_engine[f"r{st.get('rank', '?')}"] = (
+                sum(st["now"].values()) - sum(st["warm"].values()))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    block["steady_state_recompiles"] = (
+        sum(per_engine.values()) if per_engine else None)
+    block["steady_state_recompiles_per_engine"] = per_engine or None
     return block
 
 

@@ -89,6 +89,9 @@ class EventKind:
     SERVE_FLEET_DEGRADED = "serve.fleet.degraded"
     SERVE_FLEET_BUNDLE = "serve.fleet.bundle"
     SERVE_FLEET_BUNDLE_REJECT = "serve.fleet.bundle_reject"
+    SERVE_FLEET_MIGRATE = "serve.fleet.migrate"
+    SERVE_FLEET_MIGRATE_REJECT = "serve.fleet.migrate_reject"
+    SERVE_FLEET_DRAIN = "serve.fleet.drain"
     SERVE_FLEET_DONE = "serve.fleet.done"
     SERVE_FLEET_ABORT = "serve.fleet.abort"
     PERF_RECOMPILE = "perf.recompile"
@@ -190,6 +193,11 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
                                    "prefix_len", "nbytes"),
     EventKind.SERVE_FLEET_BUNDLE_REJECT: ("request_id", "worker", "attempt",
                                           "reason"),
+    EventKind.SERVE_FLEET_MIGRATE: ("request_id", "from_worker", "to_worker",
+                                    "mig", "state", "nbytes", "reason"),
+    EventKind.SERVE_FLEET_MIGRATE_REJECT: ("request_id", "worker", "mig",
+                                           "reason"),
+    EventKind.SERVE_FLEET_DRAIN: ("role", "worker", "sessions", "reason"),
     EventKind.SERVE_FLEET_DONE: ("accepted", "completed", "rejected", "lost",
                                  "wall_s"),
     EventKind.SERVE_FLEET_ABORT: ("reason", "role", "restarts"),

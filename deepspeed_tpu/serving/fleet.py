@@ -7,40 +7,60 @@ separate failure domains, supervised over a shared run directory.
 
 Roles (spawned as ``python -m deepspeed_tpu.serving.worker_main``):
 
-- **prefill workers** (ranks ``1..n_prefill``) chunked-prefill a prompt's
-  first ``S-1`` tokens and publish the KV as an atomic, SHA-256-manifested
-  *page bundle* in the shared spool — the ``ParkStore`` npz layout
-  (``bank{i}`` + ``tokens`` + ``meta`` + embedded content ``sha``), plus a
-  sidecar manifest carrying the whole-file digest, so bitrot between
-  processes is caught before a single corrupt KV row is decoded;
-- **one decode engine** (rank ``0``) runs the ``SlotBatcher`` tick loop
-  and admits via page re-admission: rebuild the bundle's banks into a
-  batch-1 cache, ride the existing prefix-resume path
-  (``PrefixEntry(cache, S-1)``), prefill only the final prompt token
-  locally — greedy output is bitwise-identical to a local prefill.
+- **decode engines** (ranks ``0..n_decode-1``) each run a ``SlotBatcher``
+  tick loop over a private inbox (``spool/decode/d<rank>``) and admit via
+  page re-admission: rebuild a bundle's banks into a batch-1 cache, ride
+  the existing prefix-resume path (``PrefixEntry(cache, S-1)``), prefill
+  only the final token locally — greedy output is bitwise-identical to a
+  local prefill;
+- **prefill workers** (ranks ``n_decode..n_decode+n_prefill-1``)
+  chunked-prefill a prompt's first ``S-1`` tokens and publish the KV as
+  an atomic, SHA-256-manifested *page bundle* in the shared spool — the
+  ``ParkStore`` npz layout (``bank{i}`` + ``tokens`` + ``meta`` +
+  embedded content ``sha``), plus a sidecar manifest carrying the
+  whole-file digest, so bitrot between processes is caught before a
+  single corrupt KV row is decoded.
 
 The :class:`ServeFleetSupervisor` is the gateway: it admits requests
-(bounded queue, loud rejects), routes prefill work, watches health
-(process exits + a pull-based :class:`HeartbeatMonitor` over per-worker
-beats), and drives the failover state machine —
+(bounded queue, loud rejects), routes work, watches health (process
+exits + a pull-based :class:`HeartbeatMonitor` over per-worker beats),
+and drives the failover state machine —
 
+- decode placement is **session-affine**: a seeded consistent-hash ring
+  (``serving/routing.py``) keeps a session on the engine holding its
+  paged blocks; NEW sessions go to the least-loaded live engine (load
+  tailed from each engine's ``metrics.rank<N>.jsonl`` stream, merged
+  with the supervisor's own booking);
 - a prefill attempt that times out or whose owner dies is **retried on a
   surviving worker** (exponential backoff, bounded attempts, per-request
   attribution via attempt-numbered bundles — a straggler's late bundle
   for a superseded attempt is ignored);
-- a decode-engine bounce **requeues decode-resident requests through the
-  spool**: orders and bundles persist, the respawned incarnation rescans
-  its inbox, skips requests whose results already landed, and re-admits
-  the rest from their bundles;
+- **live session migration** (drain, hot-spot rebalance, rolling
+  restart) is park-on-source → spool-transfer → readmit-on-target: the
+  source engine exports the slot's KV as a migration bundle (same
+  digest-manifested format), the target verifies before admitting, and a
+  failed verify nacks into a full re-prefill — bitrot costs a retry,
+  never a wrong answer (``serve.fleet.migrate`` /
+  ``serve.fleet.migrate_reject``);
+- a decode-engine death **re-routes its sessions to survivors** from
+  their prefill bundles (``serve.fleet.requeue`` reason
+  ``decode_failover``); with no survivor the orders persist in the
+  engine's inbox and the respawned incarnation rescans, skipping
+  requests whose results already landed and any order superseded by a
+  newer route marker (``spool/decode/routes/``);
+- a **rolling restart** (``rolling_restart_at_s``) drains each engine in
+  turn (``serve.fleet.drain``), migrates its sessions away, restarts it
+  via a per-engine stop file, and moves on once it re-warms — zero lost
+  conversations;
 - an empty prefill fleet (or an attempt budget exhausted) **degrades to
-  local prefill on the decode engine** — journaled loudly
+  local prefill on a decode engine** — journaled loudly
   (``serve.fleet.degraded``), never wedged.
 
-Every membership change, handoff, and degradation journals as a
-``serve.fleet.*`` event (rank ``-1`` = the supervisor), so
+Every membership change, handoff, migration, and degradation journals as
+a ``serve.fleet.*`` event (rank ``-1`` = the supervisor), so
 ``goodput/serve_scenarios.py`` scores request goodput / TTFT-under-fault /
 MTTR purely from ``events.jsonl``.  Docs: ``docs/serving.md``
-"Serving fleet".
+"Serving fleet" and "Decode fleet & live migration".
 """
 
 from __future__ import annotations
@@ -64,7 +84,8 @@ from ..utils.logging import logger
 
 #: journal rank the supervisor writes under (workers use their fleet rank)
 SUPERVISOR_RANK = -1
-#: the decode engine's fleet rank; prefill workers are ``1..n_prefill``
+#: the first decode engine's fleet rank; engines are ``0..n_decode-1``
+#: and prefill workers follow at ``n_decode..n_decode+n_prefill-1``
 DECODE_RANK = 0
 #: spool sentinel asking every worker to drain and exit orderly
 STOP_NAME = "stop"
@@ -94,23 +115,29 @@ def bundle_file_digest(path: str) -> str:
     return h.hexdigest()
 
 
-def bundle_paths(bundles_dir: str, rid: str, attempt: int) -> Tuple[str, str]:
+def bundle_paths(bundles_dir: str, rid: str, attempt: int,
+                 tag: str = "a") -> Tuple[str, str]:
     """(npz path, manifest path) for one attempt — attempt-numbered so a
-    straggler's late bundle never masquerades as the current attempt's."""
-    stem = os.path.join(bundles_dir, f"{rid}.a{int(attempt)}")
+    straggler's late bundle never masquerades as the current attempt's.
+    ``tag`` namespaces the counter: ``a`` = prefill attempt, ``m`` =
+    migration number (a park/readmit move of a live session)."""
+    stem = os.path.join(bundles_dir, f"{rid}.{tag}{int(attempt)}")
     return stem + ".npz", stem + ".json"
 
 
 def publish_bundle(bundles_dir: str, rid: str, attempt: int,
                    banks: List["Any"], tokens: "Any", length: int,
                    worker: int,
-                   trace: Optional[TraceContext] = None) -> Dict[str, Any]:
+                   trace: Optional[TraceContext] = None,
+                   tag: str = "a",
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Atomically land one KV page bundle + its manifest; returns the
     manifest dict.  Layout rides the ``ParkStore`` npz format so the two
     host tiers share one verification story; the manifest (written LAST,
     its presence = bundle complete) carries the whole-file digest taken
     *before* the ``serve.bundle_write`` fault point, so injected bitrot is
-    caught downstream."""
+    caught downstream.  Migration bundles (``tag="m"``) carry their resume
+    state (tokens emitted so far, first-token ts) in ``extra``."""
     import numpy as np
     from ..runtime.checkpoint_engine.storage import (atomic_write_npz,
                                                      atomic_write_text)
@@ -120,7 +147,7 @@ def publish_bundle(bundles_dir: str, rid: str, attempt: int,
     arrays["meta"] = np.asarray([int(length)], np.int64)
     sha = _sha_banks(banks, length)
     arrays["sha"] = np.frombuffer(bytes.fromhex(sha), np.uint8)
-    npz_path, manifest_path = bundle_paths(bundles_dir, rid, attempt)
+    npz_path, manifest_path = bundle_paths(bundles_dir, rid, attempt, tag)
     npz_path = atomic_write_npz(npz_path, arrays)
     digest = bundle_file_digest(npz_path)
     fault_injection.fire("serve.bundle_write", path=npz_path)
@@ -128,6 +155,8 @@ def publish_bundle(bundles_dir: str, rid: str, attempt: int,
                 "prefix_len": int(length), "sha256": digest,
                 "nbytes": os.path.getsize(npz_path),
                 "bundle": os.path.basename(npz_path)}
+    if extra:
+        manifest.update(extra)
     inject(manifest, trace)
     atomic_write_text(manifest_path, json.dumps(manifest, sort_keys=True))
     return manifest
@@ -200,10 +229,29 @@ class ServeFleetConfig:
     ``serve_fleet.json`` so worker respawns are stateless."""
 
     n_prefill: int = 2
+    n_decode: int = 1
     slots: int = 2
     max_len: int = 64
     prefill_chunk: int = 8
     queue_capacity: int = 16
+    # decode routing (serving/routing.py): sessions stick to the engine
+    # holding their paged blocks via a seeded consistent-hash ring; new
+    # sessions go least-loaded ("affinity") or pure-ring ("ring")
+    route_policy: str = "affinity"
+    route_seed: int = 0
+    ring_replicas: int = 32
+    # live-migration policy: hot-spot rebalance moves a session off an
+    # engine booked >= rebalance_gap deeper than the coolest one;
+    # rolling_restart_at_s > 0 drains + restarts every engine in turn
+    # once the run clock passes it
+    rebalance: bool = False
+    rebalance_gap: int = 2
+    rebalance_interval_s: float = 0.5
+    rolling_restart_at_s: float = 0.0
+    migrate_timeout_s: float = 10.0
+    # decode engines stream load samples (metrics.rank<N>.jsonl) on this
+    # cadence — the router's queue-depth/occupancy signal
+    metrics_interval_s: float = 0.2
     # tiny-GPT fixture geometry (every role builds the identical model
     # from the shared seed — what makes cross-process handoff bitwise)
     n_layer: int = 1
@@ -235,6 +283,7 @@ class ServeFleetConfig:
     def from_scenario(cls, scenario, **overrides) -> "ServeFleetConfig":
         base = dict(scenario.fleet_overrides)
         base.setdefault("n_prefill", scenario.n_prefill)
+        base.setdefault("n_decode", getattr(scenario, "n_decode", 1))
         base.setdefault("seed", scenario.seed)
         base.update(overrides)
         return cls(**base)
@@ -257,7 +306,9 @@ class _Request:
     temperature: float
     seed: int
     t_submit: float                  # wall clock (TTFT anchor)
-    state: str = "pending"           # pending|prefilling|routed|done|failed
+    session: str = ""                # routing key (multi-turn affinity)
+    # pending|prefilling|decode_wait|routed|migrating|done|failed
+    state: str = "pending"
     attempt: int = 0
     worker: Optional[int] = None     # prefill rank owning the live attempt
     t_assigned: float = 0.0          # monotonic
@@ -266,6 +317,15 @@ class _Request:
     local: bool = False
     result: Optional[Dict[str, Any]] = None
     ctx: Optional[TraceContext] = None   # per-request trace context
+    # decode-tier routing state
+    engine: Optional[int] = None     # decode rank owning the live route
+    d: int = 0                       # decode routing attempt (route marker)
+    routed_via: str = "bundle"       # bundle|local|migrate
+    manifest: Optional[Dict[str, Any]] = None  # last good prefill manifest
+    # live-migration state
+    mig: int = 0                     # migration counter
+    mig_target: Optional[int] = None
+    mig_deadline: float = 0.0        # monotonic fallback gate
 
     @property
     def terminal(self) -> bool:
@@ -285,6 +345,8 @@ class _Worker:
     respawn_at: Optional[float] = None
     pending_detect_ts: Optional[float] = None
     gone: bool = False               # restart budget exhausted
+    draining: bool = False           # rolling restart: no new placements
+    planned_stop: bool = False       # per-engine stop file written
 
 
 class ServeFleetSupervisor:
@@ -311,9 +373,14 @@ class ServeFleetSupervisor:
         self.ready_dir = os.path.join(self.spool_dir, "ready")
         for d in (self.run_dir, self.spool_dir, self.log_dir,
                   self.bundles_dir, self.decode_dir, self.results_dir,
-                  self.ready_dir):
+                  self.ready_dir, os.path.join(self.decode_dir, "routes")):
             os.makedirs(d, exist_ok=True)
-        for r in range(1, config.n_prefill + 1):
+        self.decode_ranks = tuple(range(config.n_decode))
+        self.prefill_ranks = tuple(range(
+            config.n_decode, config.n_decode + config.n_prefill))
+        for r in self.decode_ranks:
+            os.makedirs(self._decode_inbox(r), exist_ok=True)
+        for r in self.prefill_ranks:
             os.makedirs(self._prefill_inbox(r), exist_ok=True)
         self.journal = EventJournal(
             os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
@@ -325,10 +392,15 @@ class ServeFleetSupervisor:
         atomic_write_text(self._config_path,
                           json.dumps(config.child_payload(self.run_dir),
                                      indent=1, sort_keys=True))
-        self.workers: Dict[int, _Worker] = {
-            DECODE_RANK: _Worker("decode", DECODE_RANK)}
-        for r in range(1, config.n_prefill + 1):
+        self.workers: Dict[int, _Worker] = {}
+        for r in self.decode_ranks:
+            self.workers[r] = _Worker("decode", r)
+        for r in self.prefill_ranks:
             self.workers[r] = _Worker("prefill", r)
+        from .routing import DecodeRouter
+        self.router = DecodeRouter(
+            self.decode_ranks, seed=config.route_seed,
+            replicas=config.ring_replicas, policy=config.route_policy)
         self.monitor = HeartbeatMonitor(
             self.heartbeat_dir, gap_s=config.heartbeat_gap_s,
             journal=self.journal)
@@ -338,23 +410,44 @@ class ServeFleetSupervisor:
         self._rr = 0                 # round-robin cursor over prefill ranks
         self._aborted: Optional[str] = None
         self._log_handles: List[Any] = []
+        self._t0: Optional[float] = None   # run clock (monotonic)
+        self._rolling: Optional[Dict[str, Any]] = None
+        self._rolling_done = config.rolling_restart_at_s <= 0
+        self._last_rebalance = 0.0
 
     # --------------------------------------------------------------- paths
     def _prefill_inbox(self, rank: int) -> str:
         return os.path.join(self.spool_dir, "prefill", f"w{rank}")
 
+    def _decode_inbox(self, rank: int) -> str:
+        return os.path.join(self.decode_dir, f"d{rank}")
+
     def _order_path(self, req: _Request) -> str:
         return os.path.join(self._prefill_inbox(req.worker),
                             f"{req.rid}.a{req.attempt}.json")
 
-    def _decode_order_path(self, rid: str, attempt: int) -> str:
-        return os.path.join(self.decode_dir, f"{rid}.a{attempt}.json")
+    def _decode_order_path(self, rid: str, d: int, engine: int) -> str:
+        return os.path.join(self._decode_inbox(engine),
+                            f"{rid}.d{d}.json")
+
+    def _park_path(self, rid: str, mig: int, engine: int) -> str:
+        return os.path.join(self._decode_inbox(engine),
+                            f"{rid}.park{mig}.json")
+
+    def _mig_ack_path(self, rid: str, mig: int) -> str:
+        return bundle_paths(self.bundles_dir, rid, mig, tag="m")[1]
 
     def _result_path(self, rid: str) -> str:
         return os.path.join(self.results_dir, f"{rid}.json")
 
     def _nack_path(self, rid: str, attempt: int) -> str:
         return os.path.join(self.results_dir, f"{rid}.a{attempt}.nack.json")
+
+    def _mig_nack_path(self, rid: str, mig: int) -> str:
+        return os.path.join(self.results_dir, f"{rid}.m{mig}.nack.json")
+
+    def _engine_stop_path(self, rank: int) -> str:
+        return os.path.join(self.spool_dir, f"{STOP_NAME}.decode{rank}")
 
     def _sentinel_path(self, w: _Worker) -> str:
         return os.path.join(self.run_dir, f"{w.role}{w.rank}.exit.json")
@@ -414,10 +507,14 @@ class ServeFleetSupervisor:
 
     # ----------------------------------------------------------- admission
     def submit(self, tokens, max_new_tokens: int = 8, greedy: bool = True,
-               temperature: float = 1.0, seed: int = 0) -> Optional[str]:
+               temperature: float = 1.0, seed: int = 0,
+               session: Optional[str] = None) -> Optional[str]:
         """Admit one request into the fleet (or reject loudly when the
         bounded queue is full); returns the request id, or None on
-        reject."""
+        reject.  ``session`` is the routing key — turns of one
+        conversation share it and land on the engine holding its paged
+        blocks; it defaults to the request id (every request its own
+        session)."""
         import numpy as np
         tokens = np.asarray(tokens, np.int32)
         inflight = sum(1 for r in self.requests.values() if not r.terminal)
@@ -439,12 +536,13 @@ class ServeFleetSupervisor:
         req = _Request(
             rid=rid, tokens=tokens, max_new_tokens=int(max_new_tokens),
             greedy=bool(greedy), temperature=float(temperature),
-            seed=int(seed), t_submit=time.time(), ctx=ctx)
+            seed=int(seed), t_submit=time.time(),
+            session=str(session) if session is not None else rid, ctx=ctx)
         self.requests[rid] = req
         self.journal.emit(EventKind.SERVE_REQUEST, request_id=rid,
                           prompt_len=int(tokens.shape[0]),
                           max_new_tokens=int(max_new_tokens), priority=0,
-                          queue_depth=inflight + 1,
+                          queue_depth=inflight + 1, session=req.session,
                           t_submit=req.t_submit, trace=ctx.fields())
         return rid
 
@@ -463,6 +561,48 @@ class ServeFleetSupervisor:
         """Any prefill worker alive or still respawnable?"""
         return any(w.role == "prefill" and not w.gone
                    for w in self.workers.values())
+
+    def _live_decodes(self, include_draining: bool = False) -> List[_Worker]:
+        """Decode engines that can take a placement right now: alive,
+        warmed, not budget-exhausted, and (unless asked) not draining."""
+        return [w for w in self.workers.values()
+                if w.role == "decode" and w.alive and not w.gone
+                and w.ready_inc == w.incarnation
+                and (include_draining or not w.draining)]
+
+    def _decode_possible(self) -> bool:
+        """Any decode engine alive or still respawnable?"""
+        return any(w.role == "decode" and not w.gone
+                   for w in self.workers.values())
+
+    def _booked(self) -> Dict[int, int]:
+        """Supervisor-side load booking: non-terminal requests currently
+        placed on (or migrating from) each decode engine."""
+        booked = {r: 0 for r in self.decode_ranks}
+        for req in self.requests.values():
+            if not req.terminal and req.engine in booked \
+                    and req.state in ("routed", "migrating"):
+                booked[req.engine] += 1
+        return booked
+
+    def _engine_loads(self) -> Dict[int, float]:
+        """Router load signal per engine: the max of the supervisor's own
+        booking and the engine's self-reported queue-depth/occupancy from
+        its ``metrics.rank<N>.jsonl`` stream (stale rows ignored)."""
+        from .routing import read_engine_loads
+        booked = self._booked()
+        rows = read_engine_loads(self.run_dir, self.decode_ranks,
+                                 stale_s=4 * self.config.metrics_interval_s
+                                 + 1.0)
+        loads: Dict[int, float] = {}
+        for rank in self.decode_ranks:
+            reported = 0.0
+            row = rows.get(rank)
+            if row is not None:
+                reported = float(row.get("active", 0)) \
+                    + float(row.get("queue_depth", 0))
+            loads[rank] = max(float(booked.get(rank, 0)), reported)
+        return loads
 
     def _check_ready(self) -> None:
         for w in self.workers.values():
@@ -492,8 +632,8 @@ class ServeFleetSupervisor:
             rc = w.proc.poll()
             if rc is None:
                 continue
-            if stop_requested and rc == 0:
-                w.alive = False       # orderly drain exit
+            if (stop_requested or w.planned_stop) and rc == 0:
+                w.alive = False       # orderly (global or rolling) drain
                 continue
             self._on_worker_death(w, rc, reason="crashed")
 
@@ -536,11 +676,31 @@ class ServeFleetSupervisor:
                 if req.state == "prefilling" and req.worker == w.rank:
                     self._retry_prefill(req, reason="worker_lost")
         else:
-            # decode-resident requests requeue THROUGH THE SPOOL: their
-            # orders and bundles persist, the respawned incarnation
-            # rescans, skips completed results, and re-admits the rest
+            w.draining = False
+            survivors = [s for s in self._live_decodes()
+                         if s.rank != w.rank]
             for req in self.requests.values():
-                if req.state == "routed":
+                if req.terminal or req.engine != w.rank \
+                        or req.state not in ("routed", "migrating"):
+                    continue
+                if survivors:
+                    # failover: re-route the dead engine's sessions onto
+                    # survivors from their durable prefill bundles — they
+                    # re-admit and never stall on the respawn
+                    self.journal.emit(EventKind.SERVE_FLEET_REQUEUE,
+                                      request_id=req.rid,
+                                      reason="decode_failover",
+                                      incarnation=w.incarnation,
+                                      trace=_trace_fields(req.ctx))
+                    self._reroute_from_manifest(req)
+                else:
+                    # no survivor: requeue THROUGH THE SPOOL — orders and
+                    # bundles persist in the engine's inbox, the respawned
+                    # incarnation rescans, skips completed results and
+                    # superseded route markers, re-admits the rest
+                    if req.state == "migrating":
+                        self._abandon_migration(req)
+                    req.state = "routed"
                     self.journal.emit(EventKind.SERVE_FLEET_REQUEUE,
                                       request_id=req.rid,
                                       reason="decode_bounce",
@@ -549,7 +709,8 @@ class ServeFleetSupervisor:
         if w.restarts >= self.config.max_restarts:
             w.gone = True
             if w.role == "decode":
-                self._abort("decode restart budget exhausted", w)
+                if not self._decode_possible():
+                    self._abort("decode restart budget exhausted", w)
             elif not self._prefill_possible():
                 logger.warning(
                     "[serve-fleet] prefill fleet empty — degrading every "
@@ -651,6 +812,7 @@ class ServeFleetSupervisor:
             req.state = "failed"
             return
         req.local = True
+        req.manifest = None
         self.journal.emit(EventKind.SERVE_FLEET_DEGRADED,
                           request_id=req.rid, reason=reason,
                           prefill_alive=len(self._alive_prefill(
@@ -658,24 +820,239 @@ class ServeFleetSupervisor:
                           trace=_trace_fields(req.ctx))
         self._route_decode(req, manifest=None)
 
+    def _pick_engine(self, req: _Request,
+                     prefer: Optional[int] = None) -> Optional[int]:
+        candidates = [w.rank for w in self._live_decodes()]
+        if prefer is not None and prefer in candidates:
+            self.router.pin(req.session, prefer)
+            return prefer
+        return self.router.route(req.session, candidates,
+                                 self._engine_loads())
+
     def _route_decode(self, req: _Request,
-                      manifest: Optional[Dict[str, Any]]) -> None:
+                      manifest: Optional[Dict[str, Any]],
+                      migration: Optional[Dict[str, Any]] = None,
+                      prefer: Optional[int] = None) -> bool:
+        """Place ``req`` on a decode engine: pick one (session-affine,
+        load-aware), publish the route marker, then land the order in the
+        engine's inbox.  ``migration`` is the source engine's exported-ack
+        manifest — the order then carries the migration bundle + resume
+        state instead of the prefill bundle.  With no engine available
+        the request parks in ``decode_wait`` and is retried every poll."""
+        engine = self._pick_engine(req, prefer=prefer)
+        if engine is None:
+            req.manifest = manifest if migration is None else req.manifest
+            req.state = "decode_wait"
+            return False
+        from .routing import write_route_marker
+        req.d += 1
+        req.engine = engine
+        tokens = [int(t) for t in req.tokens]
         order = inject({"rid": req.rid, "attempt": req.attempt,
-                        "tokens": [int(t) for t in req.tokens],
+                        "d": req.d, "session": req.session,
+                        "tokens": tokens,
                         "max_new_tokens": req.max_new_tokens,
                         "greedy": req.greedy,
                         "temperature": req.temperature,
                         "seed": req.seed, "t_submit": req.t_submit,
-                        "local": manifest is None, "bundle": None,
-                        "sha256": None, "prefill_worker": None}, req.ctx)
-        if manifest is not None:
+                        "local": manifest is None and migration is None,
+                        "bundle": None, "sha256": None,
+                        "prefill_worker": None,
+                        "mig": None, "resume": None}, req.ctx)
+        if migration is not None:
+            # readmit-on-target: prompt + tokens already emitted; the
+            # bundle holds the first F-1 KV rows, the target re-prefills
+            # only the final token (regenerates the sampling logits)
+            resume = migration.get("resume") or {}
+            order["tokens"] = tokens + [int(t)
+                                        for t in resume.get("out", [])]
+            order["bundle"] = migration["bundle"]
+            order["sha256"] = migration["sha256"]
+            order["mig"] = req.mig
+            order["resume"] = resume
+            req.routed_via = "migrate"
+        elif manifest is not None:
             order["bundle"] = manifest["bundle"]
             order["sha256"] = manifest["sha256"]
             order["prefill_worker"] = manifest["worker"]
-        self._atomic_write(self._decode_order_path(req.rid, req.attempt),
-                           order)
+            req.manifest = manifest
+            req.routed_via = "bundle"
+        else:
+            req.routed_via = "local"
+        write_route_marker(self.decode_dir, req.rid, engine, req.d)
+        self._atomic_write(
+            self._decode_order_path(req.rid, req.d, engine), order)
         req.state = "routed"
+        return True
 
+    def _reroute_from_manifest(self, req: _Request) -> None:
+        """Fail a request's decode placement over to another engine from
+        its durable prefill bundle (or degraded-local order) — the
+        recovery path for engine death and abandoned migrations."""
+        if req.state == "migrating":
+            self._abandon_migration(req)
+        self._route_decode(req, req.manifest)
+
+    # ----------------------------------------------------------- migration
+    def _abandon_migration(self, req: _Request) -> None:
+        """Withdraw an in-flight park order so a (re)spawned source never
+        honors it after the supervisor has fallen back to re-routing."""
+        if req.engine is None:
+            return
+        try:
+            os.remove(self._park_path(req.rid, req.mig, req.engine))
+        except OSError:  # dslint: disable=swallowed-exception — already consumed by the source or never landed
+            pass
+        req.mig_target = None
+        req.mig_deadline = 0.0
+
+    def _start_migration(self, req: _Request, target: int,
+                         reason: str) -> None:
+        """Park-on-source: ask the engine holding ``req`` to export its
+        slot as a digest-manifested migration bundle.  The supervisor
+        finishes the move in :meth:`_check_migrations` when the ack
+        lands; a wedged source falls back to a bundle re-route at
+        ``migrate_timeout_s``."""
+        req.mig += 1
+        req.mig_target = target
+        req.mig_deadline = time.monotonic() + self.config.migrate_timeout_s
+        req.state = "migrating"
+        self.router.pin(req.session, target)
+        self._atomic_write(
+            self._park_path(req.rid, req.mig, req.engine),
+            inject({"cmd": "park", "rid": req.rid, "mig": req.mig,
+                    "d": req.d, "reason": reason,
+                    "to_worker": int(target)}, req.ctx))
+
+    def _check_migrations(self) -> None:
+        now = time.monotonic()
+        for req in self.requests.values():
+            if req.state != "migrating":
+                continue
+            ack = self._read_json(self._mig_ack_path(req.rid, req.mig))
+            if ack is not None and int(ack.get("mig", -1)) == req.mig:
+                state = ack.get("state")
+                if state == "exported":
+                    # spool-transfer done — readmit on the target (or the
+                    # best live engine if the target died meanwhile)
+                    self._route_decode(req, req.manifest, migration=ack,
+                                       prefer=req.mig_target)
+                elif state == "done":
+                    req.state = "routed"   # raced completion: result landed
+                else:   # "unheld": source never held it — route afresh
+                    self._route_decode(req, req.manifest,
+                                       prefer=req.mig_target)
+                req.mig_target = None
+                req.mig_deadline = 0.0
+            elif now > req.mig_deadline:
+                # wedged source: withdraw the park, fall back to the
+                # durable prefill bundle — a lost migration costs a
+                # re-admit, never the conversation
+                self._reroute_from_manifest(req)
+
+    def _check_rebalance(self) -> None:
+        """Hot-spot drain: when one engine is booked ``rebalance_gap``
+        deeper than the coolest live one, migrate its oldest session
+        over — one move at a time, rate-limited."""
+        cfg = self.config
+        now = time.monotonic()
+        if not cfg.rebalance \
+                or now - self._last_rebalance < cfg.rebalance_interval_s:
+            return
+        live = {w.rank for w in self._live_decodes()}
+        if len(live) < 2:
+            return
+        if any(r.state == "migrating" for r in self.requests.values()):
+            return   # let the in-flight move land first
+        booked = {k: v for k, v in self._booked().items() if k in live}
+        hot = max(booked, key=lambda k: (booked[k], -k))
+        cold = min(booked, key=lambda k: (booked[k], k))
+        if booked[hot] - booked[cold] < cfg.rebalance_gap:
+            return
+        movable = sorted((r for r in self.requests.values()
+                          if r.state == "routed" and r.engine == hot),
+                         key=lambda r: r.rid)
+        if movable:
+            self._last_rebalance = now
+            self._start_migration(movable[0], cold, reason="hot_spot")
+
+    def _check_rolling(self) -> None:
+        """Rolling-restart state machine: drain one engine (migrating its
+        sessions to peers when any are live), stop it orderly via its
+        per-engine stop file, respawn, wait for warmup, move to the next
+        — zero lost conversations by construction."""
+        cfg = self.config
+        if self._rolling_done or self._t0 is None:
+            return
+        if self._rolling is None:
+            if time.monotonic() - self._t0 < cfg.rolling_restart_at_s:
+                return
+            self._rolling = {"queue": [w.rank
+                                       for w in self.workers.values()
+                                       if w.role == "decode"
+                                       and not w.gone],
+                             "rank": None, "phase": None}
+        st = self._rolling
+        if st["rank"] is None:
+            if not st["queue"]:
+                self._rolling = None
+                self._rolling_done = True
+                return
+            st["rank"] = st["queue"].pop(0)
+            st["phase"] = "drain"
+            w = self.workers[st["rank"]]
+            w.draining = True
+            held = [r for r in self.requests.values()
+                    if not r.terminal and r.engine == w.rank]
+            self.journal.emit(EventKind.SERVE_FLEET_DRAIN, role=w.role,
+                              worker=w.rank, sessions=len(held),
+                              reason="rolling_restart",
+                              trace=self.trace.fields())
+            peers = [x.rank for x in self._live_decodes()]
+            for r in held:
+                if r.state == "routed" and peers:
+                    target = self.router.route(r.session, peers,
+                                               self._engine_loads())
+                    self._start_migration(r, target, reason="drain")
+        w = self.workers[st["rank"]]
+        if w.gone:   # budget died under us — give up on this engine
+            st["rank"] = None
+            return
+        if st["phase"] == "drain":
+            if w.respawn_at is not None or not w.alive:
+                st["phase"] = "warming"   # crashed mid-drain: the death
+                return                    # machinery owns the respawn
+            held = [r for r in self.requests.values()
+                    if not r.terminal and r.engine == w.rank]
+            if not held:
+                from ..runtime.checkpoint_engine.storage import \
+                    atomic_write_text
+                atomic_write_text(self._engine_stop_path(w.rank), "stop")
+                w.planned_stop = True
+                st["phase"] = "stopping"
+        elif st["phase"] == "stopping":
+            if w.alive:
+                return
+            try:
+                os.remove(self._engine_stop_path(w.rank))
+            except OSError:  # dslint: disable=swallowed-exception — nothing to sweep on a crash-during-stop
+                pass
+            w.planned_stop = False
+            w.incarnation += 1
+            self.journal.emit(EventKind.SERVE_FLEET_RESTART, role=w.role,
+                              worker=w.rank, incarnation=w.incarnation,
+                              restarts=w.restarts,
+                              budget=self.config.max_restarts,
+                              backoff_s=0.0, detect_ts=None,
+                              trace=self.trace.fields())
+            self._spawn(w)
+            st["phase"] = "warming"
+        elif st["phase"] == "warming":
+            if w.alive and w.ready_inc == w.incarnation:
+                w.draining = False
+                st["rank"] = None
+
+    # --------------------------------------------------------------- spool
     def _check_spool(self) -> None:
         now = time.monotonic()
         for req in self.requests.values():
@@ -692,21 +1069,36 @@ class ServeFleetSupervisor:
                     self._route_decode(req, manifest)
                 elif now - req.t_assigned > self.config.prefill_timeout_s:
                     self._retry_prefill(req, reason="timeout")
+            elif req.state == "decode_wait":
+                # bundle in hand, no engine was live — retry placement
+                self._route_decode(req, req.manifest)
             elif req.state == "routed":
                 result = self._read_json(self._result_path(req.rid))
                 if result is not None:
                     req.result = result
                     req.state = "done"
                     continue
+                if req.routed_via == "migrate":
+                    nack = self._read_json(
+                        self._mig_nack_path(req.rid, req.mig))
+                    if nack is not None:
+                        # migration bundle failed verify on the target —
+                        # bitrot costs a full re-prefill, never a wrong
+                        # answer (greedy decode reconverges bitwise)
+                        self._remove_decode_order(req)
+                        self._retry_prefill(req, reason="migrate_reject")
+                    continue
                 nack = self._read_json(
                     self._nack_path(req.rid, req.attempt))
                 if nack is not None and not req.local:
-                    try:
-                        os.remove(self._decode_order_path(
-                            req.rid, req.attempt))
-                    except OSError:  # dslint: disable=swallowed-exception — decode may race the removal; seen-set dedup covers it
-                        pass
+                    self._remove_decode_order(req)
                     self._retry_prefill(req, reason="bundle_reject")
+
+    def _remove_decode_order(self, req: _Request) -> None:
+        try:
+            os.remove(self._decode_order_path(req.rid, req.d, req.engine))
+        except OSError:  # dslint: disable=swallowed-exception — decode may race the removal; seen-set dedup covers it
+            pass
 
     @staticmethod
     def _read_json(path: str) -> Optional[Dict[str, Any]]:
@@ -725,6 +1117,9 @@ class ServeFleetSupervisor:
         self._check_heartbeats()
         self._check_ready()
         self._check_respawns()
+        self._check_rolling()
+        self._check_rebalance()
+        self._check_migrations()
         self._check_spool()
 
     def _warm_barrier(self) -> None:
@@ -757,6 +1152,7 @@ class ServeFleetSupervisor:
         arrivals = sorted(workload, key=lambda it: it["at_s"])
         self._warm_barrier()
         t0 = time.monotonic()
+        self._t0 = t0
         i = 0
         try:
             while True:
@@ -767,12 +1163,13 @@ class ServeFleetSupervisor:
                                 max_new_tokens=it.get("max_new_tokens", 8),
                                 greedy=it.get("greedy", True),
                                 temperature=it.get("temperature", 1.0),
-                                seed=it.get("seed", 0))
+                                seed=it.get("seed", 0),
+                                session=it.get("session"))
                     i += 1
                 self.poll()
                 if self._aborted is not None:
                     break
-                if i == len(arrivals) and all(
+                if i == len(arrivals) and self._rolling_done and all(
                         r.terminal for r in self.requests.values()):
                     break
                 if time.monotonic() - t0 > cfg.run_timeout_s:

@@ -188,6 +188,19 @@ def _host_banks(cache, pad_len: int) -> List[np.ndarray]:
     return out
 
 
+def _slot_banks(cache, row: int, length: int) -> List[np.ndarray]:
+    """Device→host pull of ONE slot's banks out of a batched cache
+    ``[L, B, S, H, D]``, as batch-1 arrays trimmed to the first
+    ``length`` rows — the export half of live session migration (the
+    target rebuilds them via ``rebuild_prefix_cache``)."""
+    out = []
+    for leaf in jax.tree_util.tree_leaves(cache):
+        if _is_bank(leaf):
+            arr = np.asarray(leaf)[:, row:row + 1, :length]
+            out.append(np.ascontiguousarray(arr))
+    return out
+
+
 def _sha_banks(arrays: List[np.ndarray], length: int) -> str:
     h = hashlib.sha256()
     h.update(str(int(length)).encode())

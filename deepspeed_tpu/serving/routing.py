@@ -1,0 +1,269 @@
+"""Session routing for the N-engine decode tier.
+
+The serving fleet (``fleet.py``) scales decode horizontally; this module
+answers the one question that creates: *which engine owns a session?*
+Three cooperating pieces:
+
+- :class:`HashRing` — a seeded consistent-hash ring (SHA-256 virtual
+  nodes).  Placement is bit-identical for a given ``(seed, membership)``,
+  one engine joining or leaving moves only ~``1/N`` of the keyspace, and
+  a respawned engine re-added at the same rank reclaims exactly its old
+  arcs — multi-turn affinity survives a bounce.
+- :class:`DecodeRouter` — policy over the ring.  A *returning* session is
+  sticky to its pinned engine while that engine is live; a *new* session
+  is placed on the least-loaded live engine (load read from each engine's
+  ``metrics.rank<N>.jsonl`` stream, see :func:`read_engine_loads`, merged
+  with the supervisor's own booking), with the ring's clockwise
+  preference order as the deterministic tie-break.  ``policy="ring"``
+  skips the load signal and uses pure ring placement (what the hot-spot
+  scenarios use to *create* an imbalance on purpose).
+- route markers + :func:`order_is_current` — the per-request
+  ``spool/decode/routes/<rid>.json`` marker records the current
+  ``(engine, d)`` routing decision.  Decode order files are never
+  deleted, so when a request is re-routed (engine death, migration,
+  drain) the superseded order left in a dead engine's inbox must be
+  *ignored* on rescan, not double-decoded — the marker is how a respawned
+  incarnation knows an order in its own inbox no longer belongs to it.
+
+Docs: ``docs/serving.md`` "Decode fleet & live migration".
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
+
+__all__ = [
+    "HashRing",
+    "DecodeRouter",
+    "read_engine_loads",
+    "route_marker_path",
+    "write_route_marker",
+    "read_route_marker",
+    "order_is_current",
+]
+
+
+class HashRing:
+    """Seeded consistent-hash ring over opaque node ids.
+
+    Each node contributes ``replicas`` virtual points hashed from
+    ``(seed, node, replica)``; keys hash the same way and land on the
+    first virtual point clockwise.  Everything is SHA-256 over stable
+    strings, so placement is bit-identical across processes and Python
+    versions — no ``hash()`` randomization in sight.
+    """
+
+    def __init__(self, nodes: Iterable[Any] = (), *, seed: int = 0,
+                 replicas: int = 64):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self._seed = int(seed)
+        self._replicas = int(replicas)
+        self._points: List[int] = []      # sorted virtual-point hashes
+        self._owners: List[Any] = []      # owner node per point (aligned)
+        self._nodes: Dict[Any, List[int]] = {}
+        for n in nodes:
+            self.add(n)
+
+    def _h(self, s: str) -> int:
+        digest = hashlib.sha256(f"{self._seed}|{s}".encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    @property
+    def nodes(self) -> List[Any]:
+        return sorted(self._nodes, key=str)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: Any) -> bool:
+        return node in self._nodes
+
+    def add(self, node: Any) -> None:
+        if node in self._nodes:
+            raise ValueError(f"node {node!r} already on the ring")
+        pts = []
+        for i in range(self._replicas):
+            p = self._h(f"n|{node}|{i}")
+            idx = bisect.bisect_left(self._points, p)
+            self._points.insert(idx, p)
+            self._owners.insert(idx, node)
+            pts.append(p)
+        self._nodes[node] = pts
+
+    def remove(self, node: Any) -> None:
+        pts = self._nodes.pop(node)
+        for p in pts:
+            idx = bisect.bisect_left(self._points, p)
+            # virtual points can collide across nodes; walk to ours
+            while self._owners[idx] != node or self._points[idx] != p:
+                idx += 1
+            del self._points[idx]
+            del self._owners[idx]
+
+    def lookup(self, key: str) -> Any:
+        """The node owning ``key`` (first virtual point clockwise)."""
+        if not self._points:
+            raise LookupError("empty ring")
+        idx = bisect.bisect_right(self._points, self._h(f"k|{key}"))
+        return self._owners[idx % len(self._points)]
+
+    def preference(self, key: str,
+                   candidates: Optional[Sequence[Any]] = None) -> List[Any]:
+        """Distinct nodes in clockwise order from ``key``'s hash —
+        the consistent-hashing fallback order.  ``candidates`` filters
+        (and never reorders) the walk."""
+        if not self._points:
+            return []
+        allowed = None if candidates is None else set(candidates)
+        start = bisect.bisect_right(self._points, self._h(f"k|{key}"))
+        out: List[Any] = []
+        seen = set()
+        n = len(self._points)
+        for i in range(n):
+            node = self._owners[(start + i) % n]
+            if node in seen:
+                continue
+            seen.add(node)
+            if allowed is None or node in allowed:
+                out.append(node)
+        return out
+
+
+def read_engine_loads(run_dir: str, ranks: Iterable[int],
+                      stale_s: float = 3.0,
+                      now: Optional[float] = None) -> Dict[int, Optional[dict]]:
+    """Tail each decode engine's ``metrics.rank<N>.jsonl`` stream for its
+    latest load sample (``active`` slots, ``free_slots``, ``queue_depth``).
+
+    Returns ``{rank: row-or-None}``; a row older than ``stale_s`` (or a
+    missing/torn stream) reads as ``None`` — the caller falls back to its
+    own booking.  Only the file tail is read, so polling this every
+    supervisor tick stays cheap as streams grow.
+    """
+    import time as _time
+    now = _time.time() if now is None else float(now)
+    out: Dict[int, Optional[dict]] = {}
+    for rank in ranks:
+        rank = int(rank)
+        out[rank] = None
+        path = os.path.join(run_dir, f"metrics.rank{rank}.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 4096))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn tail line — try the one before it
+            if isinstance(row, dict) and row.get("ts") is not None:
+                if now - float(row["ts"]) <= stale_s:
+                    out[rank] = row
+                break
+    return out
+
+
+class DecodeRouter:
+    """Session → decode-engine placement policy over a :class:`HashRing`.
+
+    ``policy="affinity"`` (default): a session already pinned to a live
+    candidate stays there; otherwise it goes to the least-loaded
+    candidate, ties broken by the ring's clockwise preference from the
+    session's hash, and the decision is pinned for the session's next
+    turn.  ``policy="ring"`` ignores loads entirely — pure consistent
+    hashing (deterministically concentrable, which the hot-spot scenario
+    exploits).
+    """
+
+    POLICIES = ("affinity", "ring")
+
+    def __init__(self, nodes: Iterable[int] = (), *, seed: int = 0,
+                 replicas: int = 64, policy: str = "affinity"):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown route policy {policy!r} "
+                f"(expected one of {self.POLICIES})")
+        self.ring = HashRing(nodes, seed=seed, replicas=replicas)
+        self.policy = policy
+        self._pins: Dict[str, int] = {}
+
+    def pinned(self, session: str) -> Optional[int]:
+        return self._pins.get(str(session))
+
+    def pin(self, session: str, engine: int) -> None:
+        self._pins[str(session)] = int(engine)
+
+    def route(self, session: str, candidates: Sequence[int],
+              loads: Optional[Mapping[int, float]] = None) -> Optional[int]:
+        """Place ``session`` on one of ``candidates`` (live, ready,
+        non-draining engines); returns ``None`` when there are none."""
+        if not candidates:
+            return None
+        session = str(session)
+        pinned = self._pins.get(session)
+        if pinned in candidates:
+            return pinned
+        order = self.ring.preference(session, candidates)
+        # engines not (yet) on the ring still count as last-resort targets
+        order += [c for c in candidates if c not in order]
+        if self.policy == "affinity" and loads:
+            best = min(order, key=lambda r: float(loads.get(r, 0.0)))
+        else:
+            best = order[0]
+        self._pins[session] = int(best)
+        return int(best)
+
+
+# ------------------------------------------------------- route markers
+
+def route_marker_path(decode_dir: str, rid: str) -> str:
+    return os.path.join(decode_dir, "routes", f"{rid}.json")
+
+
+def write_route_marker(decode_dir: str, rid: str, engine: int,
+                       d: int) -> None:
+    """Atomically publish the CURRENT ``(engine, d)`` routing decision for
+    one request — written *before* the order file lands, so an engine can
+    never observe an order newer than its marker."""
+    from ..runtime.checkpoint_engine.storage import atomic_write_text
+    path = route_marker_path(decode_dir, rid)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    atomic_write_text(path, json.dumps(
+        {"rid": rid, "engine": int(engine), "d": int(d)}, sort_keys=True))
+
+
+def read_route_marker(decode_dir: str, rid: str) -> Optional[dict]:
+    try:
+        with open(route_marker_path(decode_dir, rid)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def order_is_current(decode_dir: str, rid: str, d: int, engine: int) -> bool:
+    """Is the order ``(rid, d)`` sitting in ``engine``'s inbox still the
+    live routing decision?  A superseded straggler order (the request was
+    re-routed or migrated away while this engine was dead) must be ignored
+    on rescan, never double-decoded.  A missing/torn marker reads as
+    current — the result-exists and seen-set checks still dedup."""
+    marker = read_route_marker(decode_dir, rid)
+    if marker is None:
+        return True
+    try:
+        return int(marker["engine"]) == int(engine) \
+            and int(marker["d"]) == int(d)
+    except (KeyError, TypeError, ValueError):
+        return True

@@ -6,7 +6,8 @@ one process per role instance.  Contract via environment:
 ========================  ====================================================
 ``DS_SERVE_CONFIG``       path to the run's ``serve_fleet.json``
 ``DS_SERVE_ROLE``         ``"prefill"`` or ``"decode"``
-``DS_SERVE_RANK``         fleet rank (decode = 0, prefill = 1..n_prefill)
+``DS_SERVE_RANK``         fleet rank (decode engines = ``0..n_decode-1``,
+                          prefill = ``n_decode..n_decode+n_prefill-1``)
 ``DS_SERVE_INC``          incarnation number (bumped by each respawn)
 ``DS_FAULT_PLAN``         scenario faults, armed at import by
                           ``fault_injection.install_env_plan``
@@ -21,17 +22,27 @@ first ``S-1`` tokens (firing ``serve.prefill_chunk`` before each chunk —
 the kill/straggler fault point), publish the KV as a digest-manifested
 page bundle, journal ``serve.fleet.bundle``.
 
-The **decode** engine runs the ``SlotBatcher`` tick loop (firing
-``serve.decode_tick`` each round): admit orders from its inbox — bundle
-orders rebuild the pages into a batch-1 cache and ride the prefix-resume
-path; corrupt bundles are nacked back to the supervisor for re-prefill
+A **decode** engine runs the ``SlotBatcher`` tick loop (firing
+``serve.decode_tick`` each round) over its private inbox
+(``spool/decode/d<rank>``): admit orders — bundle orders rebuild the
+pages into a batch-1 cache and ride the prefix-resume path; corrupt
+bundles are nacked back to the supervisor for re-prefill
 (``serve.fleet.bundle_reject``), never decoded; ``local`` orders prefill
-in place (the degraded path).  Results land as spool files; order files
-are never deleted, so a respawned incarnation rescans, skips requests
-whose results already landed, and re-admits the rest — that is the whole
-decode-bounce requeue story.  ``decode.stats.json`` snapshots compile
-counts after warmup and after every completion, so tests can assert
-zero steady-state recompiles.
+in place (the degraded path); **migration** orders (``mig`` set) verify
+and readmit a session another engine parked, seeding its already-emitted
+tokens so the conversation resumes bitwise mid-decode.  ``park``
+commands export a held session's KV as a digest-manifested migration
+bundle (``serve.fleet.migrate``) and release the slot; a corrupt
+migration bundle nacks as ``serve.fleet.migrate_reject``.  Results land
+as spool files; order files are never deleted, so a respawned
+incarnation rescans, skips requests whose results already landed *and*
+any order superseded by a newer route marker
+(``spool/decode/routes/``), and re-admits the rest — that is the whole
+decode-bounce requeue story.  ``decode.stats.r<rank>.json`` snapshots
+compile counts after warmup and after every completion, so tests can
+assert zero steady-state recompiles per engine; a
+``metrics.rank<rank>.jsonl`` stream publishes slot occupancy /
+queue depth — the router's load signal for placing new sessions.
 """
 
 from __future__ import annotations
@@ -85,9 +96,14 @@ def _mark_ready(ready_dir: str, role: str, rank: int, inc: int) -> None:
                       json.dumps(doc))
 
 
-def _stop_requested(spool: str) -> bool:
+def _stop_requested(spool: str, role: str = "", rank: int = -1) -> bool:
+    """Global fleet stop — or, for a decode engine, its per-engine stop
+    file (the rolling-restart drain signal)."""
     from deepspeed_tpu.serving.fleet import STOP_NAME
-    return os.path.exists(os.path.join(spool, STOP_NAME))
+    if os.path.exists(os.path.join(spool, STOP_NAME)):
+        return True
+    return role == "decode" and os.path.exists(
+        os.path.join(spool, f"{STOP_NAME}.decode{rank}"))
 
 
 def _scan_orders(inbox: str):
@@ -175,14 +191,28 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str,
 # ------------------------------------------------------------------- decode
 
 
-def _write_stats(run_dir: str, inc: int, warm: dict, batcher,
+def _write_stats(run_dir: str, rank: int, inc: int, warm: dict, batcher,
                  ticks: int) -> None:
     from deepspeed_tpu.runtime.checkpoint_engine.storage import \
         atomic_write_text
-    atomic_write_text(os.path.join(run_dir, "decode.stats.json"),
-                      json.dumps({"incarnation": inc, "warm": warm,
+    atomic_write_text(os.path.join(run_dir, f"decode.stats.r{rank}.json"),
+                      json.dumps({"rank": rank, "incarnation": inc,
+                                  "warm": warm,
                                   "now": batcher.compile_counts(),
                                   "ticks": ticks}, sort_keys=True))
+
+
+def _append_metrics(run_dir: str, rank: int, inc: int, active: int,
+                    free_slots: int, queue_depth: int, ticks: int) -> None:
+    """One load sample on the engine's ``metrics.rank<N>.jsonl`` stream —
+    what the supervisor's router tails to place new sessions (and what
+    ``fleet_report`` renders as a metrics track)."""
+    row = {"ts": time.time(), "rank": rank, "role": "decode",
+           "incarnation": inc, "active": active, "free_slots": free_slots,
+           "queue_depth": queue_depth, "ticks": ticks}
+    with open(os.path.join(run_dir, f"metrics.rank{rank}.jsonl"),
+              "a", encoding="utf-8") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
 
 
 def _decode_loop(cfg: dict, batcher, journal, spool: str,
@@ -193,18 +223,24 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
         atomic_write_text
     from deepspeed_tpu.runtime.supervision.events import EventKind
     from deepspeed_tpu.serving.batcher import PrefixEntry
-    from deepspeed_tpu.serving.fleet import (BundleCorruptError, load_bundle,
+    from deepspeed_tpu.serving.fleet import (BundleCorruptError,
+                                             bundle_paths, load_bundle,
+                                             publish_bundle,
                                              rebuild_prefix_cache)
+    from deepspeed_tpu.serving.paging import _slot_banks
+    from deepspeed_tpu.serving.routing import order_is_current
     from deepspeed_tpu.telemetry.propagate import extract
     from deepspeed_tpu.telemetry.spans import SpanName, Tracer
     from deepspeed_tpu.utils import fault_injection
     tracer = tracer or Tracer(enabled=False)
     rank, inc = cfg["rank"], cfg["incarnation"]
     run_dir = cfg["run_dir"]
-    inbox = os.path.join(spool, "decode")
+    decode_root = os.path.join(spool, "decode")
+    inbox = os.path.join(decode_root, f"d{rank}")
     bundles_dir = os.path.join(spool, "bundles")
     results_dir = os.path.join(spool, "results")
     C, slots = batcher.chunk, int(cfg["slots"])
+    metrics_interval = float(cfg.get("metrics_interval_s", 0.2))
 
     # warm EVERY decode-path program (prefill + extend via a 2-chunk
     # prompt, take_last, write_slot, bind, tick, release) before declaring
@@ -216,34 +252,119 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
     batcher.tick()
     batcher.release(0)
     warm = batcher.compile_counts()
-    _write_stats(run_dir, inc, warm, batcher, 0)
+    _write_stats(run_dir, rank, inc, warm, batcher, 0)
     _mark_ready(os.path.join(spool, "ready"), "decode", rank, inc)
 
     free = list(range(slots))
     active: dict = {}         # row -> request state
-    seen = set()              # (rid, attempt) admitted or nacked this life
+    seen = set()              # (rid, d) admitted/nacked, parks this life
     ticks = 0
+    admits = 0                # serve.admit fault-step counter
+    next_metrics = 0.0
+
+    def _nack(path: str, doc: dict) -> None:
+        atomic_write_text(path, json.dumps(doc, sort_keys=True))
+
+    def _park(order: dict) -> None:
+        """Handle one park command: export the held session's KV as a
+        migration bundle (+ resume state) and release the slot — or ack
+        ``done``/``unheld`` so the supervisor can finish or re-route."""
+        rid, mig = order["rid"], int(order["mig"])
+        key = (rid, "park", mig)
+        if key in seen:
+            return
+        # a stale park (the supervisor re-routed past this engine) is
+        # ignored without an ack — its mig ack path was abandoned too
+        if not order_is_current(decode_root, rid, int(order.get("d", 0)),
+                                rank):
+            seen.add(key)
+            return
+        ctx = extract(order)
+        tfields = ctx.fields() if ctx is not None else {}
+        ack_path = bundle_paths(bundles_dir, rid, mig, tag="m")[1]
+        if os.path.exists(os.path.join(results_dir, f"{rid}.json")):
+            seen.add(key)
+            _nack(ack_path, {"rid": rid, "mig": mig, "state": "done"})
+            return
+        row = next((r for r, st in active.items() if st["rid"] == rid),
+                   None)
+        if row is None:
+            seen.add(key)
+            _nack(ack_path, {"rid": rid, "mig": mig, "state": "unheld"})
+            return
+        seen.add(key)
+        st = active[row]
+        fault_injection.fire("serve.migrate_export", request_id=rid,
+                             mig=mig)
+        t_park = time.time()
+        with tracer.span(SpanName.SERVE_PARK, request_id=rid, mig=mig,
+                         **tfields):
+            # frontier F = prompt + tokens emitted so far; export the
+            # first F-1 KV rows — the target re-prefills the final token,
+            # regenerating the sampling logits bitwise
+            full = np.concatenate(
+                [st["tokens"], np.asarray(st["out"], np.int32)])
+            F = int(full.shape[0])
+            banks = _slot_banks(batcher.cache, row, F - 1)
+            manifest = publish_bundle(
+                bundles_dir, rid, mig, banks, full[:F - 1], F - 1,
+                worker=rank, trace=ctx, tag="m",
+                extra={"state": "exported", "mig": mig, "t_park": t_park,
+                       "resume": {"out": list(st["out"]),
+                                  "t_first": st["first_ts"]}})
+        journal.emit(EventKind.SERVE_FLEET_MIGRATE, request_id=rid,
+                     from_worker=rank,
+                     to_worker=order.get("to_worker"), mig=mig,
+                     state="exported", nbytes=manifest["nbytes"],
+                     reason=order.get("reason"), t_park=t_park,
+                     export_s=round(time.time() - t_park, 6),
+                     trace=tfields or None)
+        batcher.release(row)
+        free.append(row)
+        del active[row]
+
     while True:
-        if _stop_requested(spool) and not active:
+        if _stop_requested(spool, "decode", rank) and not active:
             break
-        # ---- admissions (skip anything already resulted: the respawn-
-        # rescan path — orders persist, completions don't repeat)
+        now_wall = time.time()
+        if now_wall >= next_metrics:
+            _append_metrics(run_dir, rank, inc, len(active), len(free),
+                            0, ticks)
+            next_metrics = now_wall + metrics_interval
+        # ---- admissions (skip anything already resulted or superseded
+        # by a newer route marker: the respawn-rescan path — orders
+        # persist, completions and re-routed stragglers don't repeat)
+        waiting = 0
         for name in _scan_orders(inbox):
-            if not free:
-                break
             try:
                 with open(os.path.join(inbox, name)) as f:
                     order = json.load(f)
             except (OSError, ValueError):
+                continue      # torn/being-replaced — next scan gets it
+            if order.get("cmd") == "park":
+                _park(order)
                 continue
-            rid, attempt = order["rid"], int(order["attempt"])
-            if (rid, attempt) in seen:
+            rid, d = order["rid"], int(order.get("d", 0))
+            if (rid, d) in seen:
                 continue
             if os.path.exists(os.path.join(results_dir, f"{rid}.json")):
-                seen.add((rid, attempt))
+                seen.add((rid, d))
                 continue
-            seen.add((rid, attempt))
+            if not order_is_current(decode_root, rid, d, rank):
+                # superseded straggler (re-routed or migrated away while
+                # this engine was down) — never double-decode it
+                seen.add((rid, d))
+                continue
+            if not free:
+                waiting += 1
+                continue      # revisit once a slot frees up
+            seen.add((rid, d))
+            attempt = int(order["attempt"])
+            mig = order.get("mig")
             t_order = time.time()
+            fault_injection.fire("serve.admit", step=admits,
+                                 request_id=rid, slot=None)
+            admits += 1
             # absent/malformed context (old spools) → fresh root span
             ctx = extract(order)
             tfields = ctx.fields() if ctx is not None else {}
@@ -251,14 +372,18 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
             prefix = None
             verify_ms = 0.0
             if order.get("bundle"):
+                npz_path = os.path.join(bundles_dir, order["bundle"])
+                if mig is not None:
+                    fault_injection.fire("serve.migrate_admit",
+                                         path=npz_path, request_id=rid,
+                                         mig=int(mig))
                 try:
                     t_verify = time.time()
                     with tracer.span(SpanName.SERVE_FLEET_VERIFY,
                                      request_id=rid, attempt=attempt,
                                      **tfields):
                         banks, btoks, blen = load_bundle(
-                            os.path.join(bundles_dir, order["bundle"]),
-                            expect_digest=order.get("sha256"))
+                            npz_path, expect_digest=order.get("sha256"))
                         if blen != int(tokens.shape[0]) - 1 or \
                                 not np.array_equal(btoks[:blen],
                                                    tokens[:blen]):
@@ -269,16 +394,27 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                             length=blen)
                     verify_ms = round((time.time() - t_verify) * 1000.0, 3)
                 except BundleCorruptError as e:
-                    journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
-                                 request_id=rid,
-                                 worker=order.get("prefill_worker"),
-                                 attempt=attempt, reason=str(e)[:200],
-                                 trace=tfields or None)
-                    atomic_write_text(
-                        os.path.join(results_dir,
-                                     f"{rid}.a{attempt}.nack.json"),
-                        json.dumps({"rid": rid, "attempt": attempt,
-                                    "reason": str(e)[:200]}))
+                    if mig is not None:
+                        # migration bitrot → nack into a re-prefill: a
+                        # retry, never a wrong answer
+                        journal.emit(EventKind.SERVE_FLEET_MIGRATE_REJECT,
+                                     request_id=rid, worker=rank,
+                                     mig=int(mig), reason=str(e)[:200],
+                                     trace=tfields or None)
+                        _nack(os.path.join(
+                            results_dir, f"{rid}.m{int(mig)}.nack.json"),
+                            {"rid": rid, "mig": int(mig),
+                             "reason": str(e)[:200]})
+                    else:
+                        journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
+                                     request_id=rid,
+                                     worker=order.get("prefill_worker"),
+                                     attempt=attempt, reason=str(e)[:200],
+                                     trace=tfields or None)
+                        _nack(os.path.join(
+                            results_dir, f"{rid}.a{attempt}.nack.json"),
+                            {"rid": rid, "attempt": attempt,
+                             "reason": str(e)[:200]})
                     continue
             row = free.pop()
             t_admit = time.time()
@@ -295,12 +431,26 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                              (t_admit - order["t_submit"]) * 1000.0, 1),
                          prefix_hit=prefix is not None,
                          attempt=attempt, t_order=t_order,
-                         verify_ms=verify_ms, trace=tfields or None)
-            active[row] = {"rid": rid, "attempt": attempt, "out": [],
+                         verify_ms=verify_ms, mig=mig,
+                         trace=tfields or None)
+            resume = order.get("resume") or {}
+            r_out = [int(t) for t in resume.get("out", [])]
+            # a migration order's tokens = prompt + tokens already out;
+            # keep only the prompt so a re-park recomputes the frontier
+            # from prompt + live out without double-counting
+            prompt = tokens[:int(tokens.shape[0]) - len(r_out)] \
+                if r_out else tokens
+            active[row] = {"rid": rid, "attempt": attempt,
+                           "tokens": prompt, "out": r_out,
                            "budget": int(order.get("max_new_tokens", 8)),
                            "t_submit": float(order["t_submit"]),
-                           "t_admit": t_admit, "first_ts": None,
+                           "t_admit": t_admit,
+                           "first_ts": resume.get("t_first"),
                            "trace": tfields or None}
+        if waiting:
+            _append_metrics(run_dir, rank, inc, len(active), len(free),
+                            waiting, ticks)
+            next_metrics = time.time() + metrics_interval
         # ---- one decode round
         if not active:
             time.sleep(0.01)
@@ -336,7 +486,7 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
             batcher.release(row)
             free.append(row)
             del active[row]
-            _write_stats(run_dir, inc, warm, batcher, ticks)
+            _write_stats(run_dir, rank, inc, warm, batcher, ticks)
 
 
 # --------------------------------------------------------------------- main

@@ -15,6 +15,10 @@ exports with their ``clockSync`` handshakes — and the outputs are:
   → spool latency → digest verify → re-admit → first decode tick, with a
   per-request residual against the worker-measured end-to-end ``ttft_ms``
   (the bench gates reconciliation within tolerance);
+* **migration decomposition** (:func:`decompose_migrations`): per
+  exported live migration, park → spool transfer → digest verify →
+  readmit, anchored on the source's ``serve.fleet.migrate`` row and the
+  target's matching ``serve.admit``;
 * **MTTR attribution** (:func:`decompose_mttr`,
   :func:`decompose_training_restarts`): detect → respawn → warm →
   handoff/first-useful-work phases that *telescope* — boundaries are
@@ -49,11 +53,13 @@ from .propagate import wall_offset_s
 __all__ = [
     "TTFT_PHASES",
     "MTTR_PHASES",
+    "MIGRATION_PHASES",
     "request_chains",
     "span_chain_coverage",
     "decompose_request",
     "summarize_ttft",
     "decompose_mttr",
+    "decompose_migrations",
     "decompose_training_restarts",
     "collect_process_traces",
     "merge_fleet_trace",
@@ -66,6 +72,10 @@ TTFT_PHASES = ("queue_wait_ms", "prefill_ms", "publish_ms", "spool_ms",
 
 #: MTTR phase keys (telescoping: they sum to the incident's MTTR exactly)
 MTTR_PHASES = ("respawn_ms", "warm_ms", "handoff_ms")
+
+#: live-migration phase keys: park/export on the source engine, spool
+#: transfer of the page bundle, digest verify on the target, re-admission
+MIGRATION_PHASES = ("park_ms", "transfer_ms", "verify_ms", "readmit_ms")
 
 #: default reconciliation tolerance: a request's phase sum must land
 #: within max(abs_tol_ms, rel_tol * ttft) of the measured TTFT
@@ -120,7 +130,14 @@ def request_chains(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 chains[rid]["done"] = e
     for rid, ch in chains.items():
         done = ch["done"]
-        horizon = float(done["ts"]) + 1e-6 if done else float("inf")
+        # horizon at the FIRST TOKEN, not completion: a live migration
+        # after the first token re-admits the session on another engine
+        # before the done row lands, and that later admit must not become
+        # the chain's admit (it would date decode_ms negative).
+        if done is not None:
+            horizon = float(done.get("t_first") or done["ts"]) + 1e-6
+        else:
+            horizon = float("inf")
         for a in admits.get(rid, []):
             if float(a.get("ts", 0.0)) <= horizon:
                 ch["admit"] = a
@@ -341,6 +358,59 @@ def decompose_mttr(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+def decompose_migrations(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per exported live migration: park→transfer→verify→readmit phases.
+
+    Anchors: the source engine's ``serve.fleet.migrate`` row (``t_park``,
+    ``export_s``, ``nbytes``) matched to the target's ``serve.admit`` row
+    carrying the same ``(request_id, mig)`` — park is the source-measured
+    export, transfer is the spool gap from the migrate row to the target's
+    order pickup, verify is the target-measured digest check, readmit the
+    remaining pickup→admitted gap.  ``readmitted`` is False (phases None)
+    when no matching admit landed — the migration was abandoned (deadline
+    lapse, target death) and the session re-routed elsewhere.
+    """
+    evs = _sorted_events(events)
+    admits = [e for e in evs if e.get("kind") == EventKind.SERVE_ADMIT
+              and e.get("mig") is not None]
+    out: List[Dict[str, Any]] = []
+    for m in evs:
+        if m.get("kind") != EventKind.SERVE_FLEET_MIGRATE \
+                or m.get("state") != "exported":
+            continue
+        rid, mig = m.get("request_id"), m.get("mig")
+        adm = next((a for a in admits
+                    if a.get("request_id") == rid and a.get("mig") == mig
+                    and float(a.get("ts", 0.0)) >= float(m.get("ts", 0.0))),
+                   None)
+        rec: Dict[str, Any] = {
+            "request_id": rid,
+            "mig": mig,
+            "from_worker": m.get("from_worker"),
+            "to_worker": m.get("to_worker"),
+            "nbytes": m.get("nbytes"),
+            "t_park": m.get("t_park"),
+            "ts": m.get("ts"),
+            "readmitted": adm is not None,
+        }
+        if adm is None:
+            rec["phases"] = None
+            out.append(rec)
+            continue
+        t_order = float(adm.get("t_order") or adm.get("ts", 0.0))
+        verify_ms = float(adm.get("verify_ms") or 0.0)
+        park_ms = float(m.get("export_s") or 0.0) * 1e3
+        transfer_ms = max(0.0, (t_order - float(m.get("ts", 0.0))) * 1e3)
+        readmit_ms = max(0.0, (float(adm.get("ts", 0.0)) - t_order) * 1e3
+                         - verify_ms)
+        rec["phases"] = {"park_ms": round(park_ms, 3),
+                         "transfer_ms": round(transfer_ms, 3),
+                         "verify_ms": round(verify_ms, 3),
+                         "readmit_ms": round(readmit_ms, 3)}
+        out.append(rec)
+    return out
+
+
 def decompose_training_restarts(
         events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Per training-fleet restart: detect→respawn→warm→first-useful-work.
@@ -445,6 +515,8 @@ def merge_fleet_trace(run_dir: str,
     - one ``metrics`` pid per ``metrics*.jsonl`` stream (instant samples);
     - a ``ttft-critical-path`` pid: per completed request, its phase
       decomposition laid end-to-end from submit;
+    - a ``migrations`` pid: per exported live migration, the
+      park→transfer→verify→readmit phases laid end-to-end from the park;
     - an ``mttr`` pid: per recovered incident, the respawn/warm/handoff
       phases laid end-to-end from detection.
 
@@ -522,6 +594,28 @@ def merge_fleet_trace(run_dir: str,
                     "pid": pid, "tid": tid_i,
                     "args": {"request_id": d["request_id"],
                              "trace_id": d["trace_id"]},
+                })
+                cursor += dur_us
+        pid += 1
+
+    migs = [m for m in decompose_migrations(evs) if m["phases"]]
+    if migs:
+        merged.append(_proc_meta(pid, "migrations"))
+        for tid_i, m in enumerate(migs):
+            cursor = float(m.get("t_park") or m.get("ts") or 0.0) * 1e6
+            for k in MIGRATION_PHASES:
+                dur_us = m["phases"][k] * 1e3
+                if dur_us <= 0:
+                    continue
+                merged.append({
+                    "name": "migrate." + k[:-3], "cat": "migrate",
+                    "ph": "X", "ts": int(cursor),
+                    "dur": max(1, int(dur_us)), "pid": pid, "tid": tid_i,
+                    "args": {"request_id": m["request_id"],
+                             "mig": m.get("mig"),
+                             "from_worker": m.get("from_worker"),
+                             "to_worker": m.get("to_worker"),
+                             "nbytes": m.get("nbytes")},
                 })
                 cursor += dur_us
         pid += 1

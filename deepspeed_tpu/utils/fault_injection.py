@@ -74,6 +74,16 @@ Points currently wired:
                           ``path`` (``CorruptRandomBytes``/
                           ``TruncateAfterBytes`` model bitrot the decode
                           engine's digest check must catch)
+``serve.migrate_export``  in the source decode engine, before it parks a
+                          session and exports its KV banks as a migration
+                          bundle; ctx: ``request_id``, ``mig``
+                          (``KillAtStep``-style faults model an engine
+                          dying mid-drain; ``DelaySeconds`` a slow export)
+``serve.migrate_admit``   in the target decode engine, before the digest
+                          verify of an inbound migration bundle; ctx:
+                          ``path``, ``request_id``, ``mig``
+                          (``CorruptRandomBytes`` models in-transit bitrot
+                          — the verify must nack, never admit)
 ========================  =====================================================
 
 Subprocess fault plans (the goodput fleet's delivery channel): a parent
@@ -120,6 +130,8 @@ FAULT_POINTS = frozenset({
     "serve.readmit",
     "serve.prefill_chunk",
     "serve.bundle_write",
+    "serve.migrate_export",
+    "serve.migrate_admit",
 })
 
 # points with faults installed; guarded by _lock for install/clear, read

@@ -118,6 +118,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             line += (f"  lost_requests={last.get('lost', '?')}"
                      f"  completed={last.get('completed', '?')}"
                      f"/{last.get('accepted', '?')}")
+        # live-migration ledger: sessions moved between decode engines
+        # (park → transfer → verify → readmit), bytes carried, verify
+        # rejections (bitrot costs a retry, never a wrong answer), and
+        # rolling-restart drains
+        migs = [e for e in sfleet if e["kind"] == "serve.fleet.migrate"
+                and e.get("state") == "exported"]
+        if migs:
+            moved = sum(int(e.get("nbytes") or 0) for e in migs)
+            line += f"  migrations={len(migs)}  migrated_bytes={moved}"
+        mig_rej = sum(1 for e in sfleet
+                      if e["kind"] == "serve.fleet.migrate_reject")
+        if mig_rej:
+            line += f"  migrate_rejects={mig_rej}"
+        drains = [e for e in sfleet if e["kind"] == "serve.fleet.drain"]
+        if drains:
+            drained = sum(int(e.get("sessions") or 0) for e in drains)
+            line += f"  drains={len(drains)}  drained_sessions={drained}"
         print(line, file=sys.stderr)
         # TTFT critical path: where an average first token's latency went
         # (only journals carrying the tracing timing fields decompose)

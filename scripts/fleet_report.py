@@ -9,11 +9,12 @@ exports (with their ``clockSync`` wall/monotonic handshakes), and
 * one multi-pid, wall-aligned Perfetto trace (``--out``, default
   ``<run_dir>/fleet_trace.json``) you can open in ui.perfetto.dev:
   journal rows, every process's spans rebased onto the wall clock,
-  metric samples, and synthesized per-request TTFT critical-path and
-  per-incident MTTR tracks;
+  metric samples, and synthesized per-request TTFT critical-path,
+  per-migration, and per-incident MTTR tracks;
 * a report (``--json`` for machine form): span-chain coverage, the
   per-phase TTFT decomposition summary with its reconciliation verdict,
-  and per-incident MTTR attribution (detect → respawn → warm →
+  per-migration park→transfer→verify→readmit attribution, and
+  per-incident MTTR attribution (detect → respawn → warm →
   handoff/first-useful-work) for both serving incidents and training
   restarts.
 
@@ -48,8 +49,9 @@ def main(argv=None) -> int:
 
     from deepspeed_tpu.runtime.supervision.events import read_events
     from deepspeed_tpu.telemetry.critical_path import (
-        decompose_mttr, decompose_training_restarts, merge_fleet_trace,
-        missing_worker_telemetry, span_chain_coverage, summarize_ttft)
+        decompose_migrations, decompose_mttr, decompose_training_restarts,
+        merge_fleet_trace, missing_worker_telemetry, span_chain_coverage,
+        summarize_ttft)
     from deepspeed_tpu.telemetry.export import validate_trace
 
     run_dir = args.run_dir
@@ -81,6 +83,7 @@ def main(argv=None) -> int:
         "unaligned": merged["fleetMeta"]["unaligned"],
         "chain": span_chain_coverage(events),
         "ttft": summarize_ttft(events),
+        "migrations": decompose_migrations(events),
         "mttr": decompose_mttr(events),
         "training_restarts": decompose_training_restarts(events),
         "problems": problems,
@@ -99,6 +102,17 @@ def main(argv=None) -> int:
             print(f"  ttft: {tt['requests']} decomposed, mean "
                   f"{tt['mean_ttft_ms']}ms, reconciled={tt['ok']} "
                   f"(max |residual| {tt['max_abs_residual_ms']}ms)")
+        for m in report["migrations"]:
+            who = (f"{m['request_id']} d{m.get('from_worker')}"
+                   f"->d{m.get('to_worker')}")
+            if m["readmitted"]:
+                ph = m["phases"]
+                print(f"  migration {who}: {m.get('nbytes')}B = park "
+                      f"{ph['park_ms']}ms + transfer {ph['transfer_ms']}ms "
+                      f"+ verify {ph['verify_ms']}ms + readmit "
+                      f"{ph['readmit_ms']}ms")
+            else:
+                print(f"  migration {who}: abandoned (never readmitted)")
         for m in report["mttr"] + report["training_restarts"]:
             who = (f"{m.get('role')}{m.get('worker')}"
                    if m.get("role") is not None

@@ -72,7 +72,7 @@ def test_kill_prefill_mid_chunk_exact_output_and_no_recompiles(tmp_path):
 
     # ---- zero steady-state decode recompiles: the engine's post-run
     # compile counts must equal its post-warmup snapshot
-    with open(os.path.join(run_dir, "decode.stats.json")) as f:
+    with open(os.path.join(run_dir, "decode.stats.r0.json")) as f:
         stats = json.load(f)
     assert stats["ticks"] > 0
     assert stats["now"] == stats["warm"], stats

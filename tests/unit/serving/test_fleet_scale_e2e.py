@@ -1,0 +1,153 @@
+"""Tier-1 acceptance for the N-engine decode tier: kill one of two
+decode engines mid-decode and every session must fail over to the
+survivor — with every completed greedy continuation **bitwise-identical**
+to the unfaulted run replayed in-process, zero steady-state recompiles
+on every engine, and nobody double-decoded; then a rolling restart of
+both engines must drain/migrate/respawn with zero lost conversations
+and a park→transfer→verify→readmit critical path in the merged trace.
+
+Scale twin of ``test_fleet_e2e.py`` — same philosophy: real OS
+subprocesses, a real SIGKILL from the fault plan, scores read back
+purely from the run's event journal.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.goodput import build_serve_scenario, run_serve_scenario
+from deepspeed_tpu.runtime.supervision.events import EventKind, read_events
+
+pytestmark = pytest.mark.chaos
+
+
+def _replay_unfaulted(run_dir, scenario, summary):
+    """Replay every request through the identical seeded fixture in one
+    process (build_prefix → admit → greedy ticks) — the bitwise oracle a
+    failed-over or migrated session must still match."""
+    from deepspeed_tpu.serving.fleet import ServeFleetConfig
+    from deepspeed_tpu.serving.worker_main import _build_batcher
+    cfg = ServeFleetConfig.from_scenario(scenario)
+    batcher = _build_batcher(cfg.child_payload(run_dir), slots=cfg.slots)
+    arrivals = sorted(scenario.workload(), key=lambda it: it["at_s"])
+    for i, it in enumerate(arrivals):
+        rid = f"req-{i:04d}"
+        got = summary["results"][rid]
+        tokens = np.asarray(it["tokens"], np.int32)
+        prefix = batcher.build_prefix(tokens[:-1])
+        batcher.admit(0, tokens, jax.random.PRNGKey(it["seed"]),
+                      greedy=True, temperature=1.0, prefix=prefix)
+        want = [int(batcher.tick()[0]) for _ in range(it["max_new_tokens"])]
+        batcher.release(0)
+        assert got == want, (rid, got, want)
+
+
+def _per_engine_recompiles(run_dir, n_decode):
+    out = {}
+    for rank in range(n_decode):
+        with open(os.path.join(run_dir,
+                               f"decode.stats.r{rank}.json")) as f:
+            stats = json.load(f)
+        out[rank] = (sum(stats["now"].values())
+                     - sum(stats["warm"].values()))
+    return out
+
+
+def test_kill_one_of_two_decodes_bitwise_failover(tmp_path):
+    scenario = build_serve_scenario("kill_one_of_n_decodes", seed=7)
+    scenario = dataclasses.replace(scenario, n_requests=4)
+    run_dir = str(tmp_path / "scale")
+    score = run_serve_scenario(run_dir, scenario)
+
+    assert score["ok"], score["failures"]
+    assert score["lost"] == 0, score["lost_ids"]
+    assert score["goodput"] == 1.0, score
+    assert score["incidents"] >= 1
+    assert score["requeues"] >= 1           # the failover was journaled
+
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    lost = [e for e in events
+            if e["kind"] == EventKind.SERVE_FLEET_WORKER_LOST]
+    assert any(e["role"] == "decode" for e in lost), lost
+    victim = next(e["worker"] for e in lost if e["role"] == "decode")
+    # the failover re-routed the victim's sessions, and the survivor
+    # (not the respawned victim) completed them
+    requeued = {e["request_id"] for e in events
+                if e["kind"] == EventKind.SERVE_FLEET_REQUEUE
+                and e.get("reason") == "decode_failover"}
+    assert requeued
+    done_workers = {e["request_id"]: e.get("worker") for e in events
+                    if e["kind"] == EventKind.SERVE_DONE}
+    for rid in requeued:
+        assert done_workers[rid] != victim, (rid, done_workers)
+    # nobody was double-decoded: the superseded straggler order in the
+    # victim's inbox is ignored on respawn (route-marker supersession)
+    rids = [e["request_id"] for e in events
+            if e["kind"] == EventKind.SERVE_DONE]
+    assert len(rids) == len(set(rids)), rids
+
+    # bitwise parity vs the unfaulted single-process replay
+    _replay_unfaulted(run_dir, scenario, score["summary"])
+
+    # zero steady-state recompiles on EVERY engine (incl. the respawn)
+    rec = _per_engine_recompiles(run_dir, scenario.n_decode)
+    assert all(v == 0 for v in rec.values()), rec
+
+    from deepspeed_tpu.telemetry.critical_path import span_chain_coverage
+    chain = span_chain_coverage(events)
+    assert chain["coverage"] >= 0.95, chain
+
+
+def test_rolling_restart_drains_both_engines_zero_loss(tmp_path):
+    scenario = build_serve_scenario("rolling_restart_drain", seed=7)
+    scenario = dataclasses.replace(scenario, n_requests=4)
+    run_dir = str(tmp_path / "rolling")
+    score = run_serve_scenario(run_dir, scenario)
+
+    assert score["ok"], score["failures"]
+    assert score["lost"] == 0, score["lost_ids"]
+    assert score["goodput"] == 1.0, score
+    assert score["incidents"] == 0, score   # planned stops, no incident
+    assert score["drains"] == scenario.n_decode, score
+    assert score["restarts"] == scenario.n_decode, score
+    assert score["migrations"] >= 1, score
+
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    # every engine was drained then restarted into incarnation 1
+    restarted = {e["worker"] for e in events
+                 if e["kind"] == EventKind.SERVE_FLEET_RESTART}
+    assert restarted == set(range(scenario.n_decode)), restarted
+    assert not any(e["kind"] == EventKind.SERVE_FLEET_WORKER_LOST
+                   for e in events)
+
+    # bitwise parity: a migrated session resumes its old tokens and
+    # greedy-continues exactly as if it had never moved
+    _replay_unfaulted(run_dir, scenario, score["summary"])
+    rec = _per_engine_recompiles(run_dir, scenario.n_decode)
+    assert all(v == 0 for v in rec.values()), rec
+
+    # the migration critical path: park → transfer → verify → readmit
+    # decomposes, and the merged timeline renders it as its own track
+    from deepspeed_tpu.telemetry.critical_path import (MIGRATION_PHASES,
+                                                       decompose_migrations,
+                                                       merge_fleet_trace,
+                                                       span_chain_coverage)
+    from deepspeed_tpu.telemetry.export import validate_trace
+    migs = [m for m in decompose_migrations(events) if m["readmitted"]]
+    assert migs, "no readmitted migration decomposed"
+    for m in migs:
+        assert set(m["phases"]) == set(MIGRATION_PHASES)
+        assert all(v >= 0.0 for v in m["phases"].values()), m
+        assert m["nbytes"] > 0
+    chain = span_chain_coverage(events)
+    assert chain["coverage"] >= 0.95, chain
+    merged = merge_fleet_trace(run_dir, events=events)
+    assert validate_trace(merged, require_registered_names=False) == []
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert {"migrate.park", "migrate.transfer"} <= names, \
+        sorted(n for n in names if isinstance(n, str))[:40]

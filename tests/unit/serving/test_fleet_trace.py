@@ -21,11 +21,12 @@ from deepspeed_tpu.utils.jsonl import read_jsonl
 def _supervisor(tmp_path) -> ServeFleetSupervisor:
     sup = ServeFleetSupervisor(str(tmp_path / "run"),
                                config=ServeFleetConfig(n_prefill=1))
-    # hand-mark the prefill worker live+warm so _assign_prefill routes
-    # to it instead of waiting on a real subprocess
-    w = sup.workers[1]
-    w.alive = True
-    w.ready_inc = w.incarnation
+    # hand-mark both workers live+warm so _assign_prefill/_route_decode
+    # place work instead of waiting on real subprocesses
+    for rank in (0, 1):
+        w = sup.workers[rank]
+        w.alive = True
+        w.ready_inc = w.incarnation
     return sup
 
 
@@ -68,7 +69,7 @@ def test_decode_order_carries_context_on_both_paths(tmp_path):
     # remote path: manifest → decode order
     manifest = {"bundle": "b.npz", "sha256": "0" * 64, "worker": 1}
     sup._route_decode(req, manifest=manifest)
-    with open(sup._decode_order_path(rid, req.attempt)) as f:
+    with open(sup._decode_order_path(rid, req.d, req.engine)) as f:
         order = json.load(f)
     assert extract(order) == req.ctx
     assert order["bundle"] == "b.npz" and not order["local"]
@@ -76,7 +77,7 @@ def test_decode_order_carries_context_on_both_paths(tmp_path):
     rid2 = sup.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
     req2 = sup.requests[rid2]
     sup._route_decode(req2, manifest=None)
-    with open(sup._decode_order_path(rid2, req2.attempt)) as f:
+    with open(sup._decode_order_path(rid2, req2.d, req2.engine)) as f:
         order2 = json.load(f)
     assert extract(order2) == req2.ctx
     assert order2["local"] and order2["bundle"] is None

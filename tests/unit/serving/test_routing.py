@@ -1,0 +1,146 @@
+"""Unit tests for the decode tier's session routing (serving/routing.py):
+the seeded consistent-hash ring (bit-identical placement, bounded remap
+on membership change, respawn-at-same-slot affinity), the load-aware
+router policy, the metrics-tail load reader, and the route markers that
+keep a superseded straggler order from being double-decoded."""
+
+import json
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.serving.routing import (DecodeRouter, HashRing,
+                                           order_is_current,
+                                           read_engine_loads,
+                                           read_route_marker,
+                                           write_route_marker)
+
+KEYS = [f"sess-{i}" for i in range(1000)]
+
+
+def test_ring_placement_is_bit_identical_per_seed():
+    a = HashRing([0, 1, 2, 3], seed=7, replicas=32)
+    b = HashRing([0, 1, 2, 3], seed=7, replicas=32)
+    assert [a.lookup(k) for k in KEYS] == [b.lookup(k) for k in KEYS]
+    # the seed is load-bearing: a different seed is a different ring
+    c = HashRing([0, 1, 2, 3], seed=8, replicas=32)
+    assert [a.lookup(k) for k in KEYS] != [c.lookup(k) for k in KEYS]
+    # and placement uses all the nodes
+    assert {a.lookup(k) for k in KEYS} == {0, 1, 2, 3}
+
+
+def test_one_leave_remaps_only_the_victims_keys():
+    n = 4
+    ring = HashRing(range(n), seed=0, replicas=64)
+    before = {k: ring.lookup(k) for k in KEYS}
+    ring.remove(2)
+    moved = [k for k in KEYS if ring.lookup(k) != before[k]]
+    # ONLY keys the departed node owned may move...
+    assert all(before[k] == 2 for k in moved)
+    # ...and that is ~1/N of the keyspace, never a wholesale reshuffle
+    assert 0 < len(moved) <= 2 * len(KEYS) // n
+
+
+def test_one_join_remaps_at_most_its_share():
+    n = 4
+    ring = HashRing(range(n), seed=0, replicas=64)
+    before = {k: ring.lookup(k) for k in KEYS}
+    ring.add(n)
+    moved = [k for k in KEYS if ring.lookup(k) != before[k]]
+    # every moved key moved TO the joiner, and the joiner took ~1/(N+1)
+    assert all(ring.lookup(k) == n for k in moved)
+    assert 0 < len(moved) <= 2 * len(KEYS) // (n + 1)
+
+
+def test_respawn_at_same_slot_reclaims_exactly_its_arcs():
+    ring = HashRing([0, 1, 2], seed=3, replicas=32)
+    before = {k: ring.lookup(k) for k in KEYS}
+    ring.remove(1)            # the engine dies...
+    ring.add(1)               # ...and respawns at the same rank
+    assert {k: ring.lookup(k) for k in KEYS} == before
+
+
+def test_preference_walk_is_clockwise_distinct_and_filterable():
+    ring = HashRing([0, 1, 2, 3], seed=0, replicas=32)
+    for k in KEYS[:50]:
+        order = ring.preference(k)
+        assert sorted(order) == [0, 1, 2, 3]          # every node, once
+        assert order[0] == ring.lookup(k)             # owner leads
+        # candidates filter but never reorder the walk
+        filtered = ring.preference(k, candidates=[1, 3])
+        assert filtered == [x for x in order if x in (1, 3)]
+    assert ring.preference("x", candidates=[]) == []
+
+
+def test_ring_rejects_duplicates_and_empty_lookup():
+    ring = HashRing([0], seed=0, replicas=8)
+    with pytest.raises(ValueError):
+        ring.add(0)
+    ring.remove(0)
+    with pytest.raises(LookupError):
+        ring.lookup("k")
+
+
+def test_router_affinity_pins_and_prefers_least_loaded():
+    router = DecodeRouter([0, 1], seed=0, replicas=32)
+    # a new session under equal load lands on its ring owner
+    owner = router.ring.lookup("sess-a")
+    assert router.route("sess-a", [0, 1], {0: 0.0, 1: 0.0}) == owner
+    # ...and is now pinned: even if the peer empties out, it stays put
+    peer = 1 - owner
+    assert router.route("sess-a", [0, 1], {owner: 9.0, peer: 0.0}) == owner
+    assert router.pinned("sess-a") == owner
+    # a new session avoids the hot engine regardless of ring ownership
+    for i in range(20):
+        assert router.route(f"new-{i}", [0, 1],
+                            {owner: 9.0, peer: 0.0}) == peer
+    # the pin melts only when its engine leaves the candidate set —
+    # engine death re-routes, respawn-at-same-slot would re-pin
+    assert router.route("sess-a", [peer], {peer: 0.0}) == peer
+    assert router.pinned("sess-a") == peer
+
+
+def test_router_ring_policy_ignores_loads():
+    router = DecodeRouter([0, 1], seed=0, replicas=32, policy="ring")
+    owner = router.ring.lookup("sess-b")
+    assert router.route("sess-b", [0, 1],
+                        {owner: 99.0, 1 - owner: 0.0}) == owner
+    with pytest.raises(ValueError):
+        DecodeRouter([0, 1], policy="bogus")
+    assert router.route("sess-b", []) is None
+
+
+def test_read_engine_loads_tail_stale_and_torn(tmp_path):
+    run = str(tmp_path)
+    now = time.time()
+    with open(os.path.join(run, "metrics.rank0.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": now - 60.0, "rank": 0, "active": 9}))
+        f.write("\n")
+        f.write(json.dumps({"ts": now, "rank": 0, "active": 2,
+                            "queue_depth": 1}) + "\n")
+    with open(os.path.join(run, "metrics.rank1.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": now - 60.0, "rank": 1, "active": 3}))
+        f.write("\n")
+    with open(os.path.join(run, "metrics.rank2.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": now, "rank": 2, "active": 1}) + "\n")
+        f.write('{"ts": 123, "torn')     # crash mid-append: no newline
+    loads = read_engine_loads(run, [0, 1, 2, 3], stale_s=3.0, now=now)
+    assert loads[0]["active"] == 2       # latest row wins
+    assert loads[1] is None              # stale → caller uses booking
+    assert loads[2]["active"] == 1       # torn tail → previous line
+    assert loads[3] is None              # no stream at all
+
+
+def test_route_marker_supersedes_straggler_orders(tmp_path):
+    decode_dir = str(tmp_path / "decode")
+    write_route_marker(decode_dir, "req-0", engine=0, d=1)
+    assert read_route_marker(decode_dir, "req-0") == {
+        "rid": "req-0", "engine": 0, "d": 1}
+    assert order_is_current(decode_dir, "req-0", d=1, engine=0)
+    # the request is re-routed (engine death / migration): old order stale
+    write_route_marker(decode_dir, "req-0", engine=1, d=2)
+    assert not order_is_current(decode_dir, "req-0", d=1, engine=0)
+    assert order_is_current(decode_dir, "req-0", d=2, engine=1)
+    # a missing marker reads as current (pre-marker spools stay usable)
+    assert order_is_current(decode_dir, "req-9", d=1, engine=0)
