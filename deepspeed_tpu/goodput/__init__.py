@@ -17,7 +17,11 @@ processes.
 - :mod:`.serve_scenarios` — the SERVING flavor: fault schedules and
   request-goodput scoring for the disaggregated prefill/decode fleet
   (``serving/fleet.py``), gated by ``scripts/serve_fleet_bench.py`` into
-  ``BENCH_SERVE_FLEET.json``.
+  ``BENCH_SERVE_FLEET.json``;
+- :mod:`.traffic` — seeded OPEN-LOOP traffic mixes (heavy-tail prompts,
+  diurnal bursts, priority classes, sessions at scale) for overload
+  benchmarking, gated by ``scripts/overload_bench.py`` into
+  ``BENCH_OVERLOAD.json``.
 
 ``scripts/goodput_bench.py`` runs the scenario matrix into
 ``BENCH_GOODPUT.json`` and gates regressions.  Docs: ``docs/goodput.md``.
@@ -32,6 +36,8 @@ from .serve_scenarios import (SERVE_SCENARIOS, ServeScenario,
                               build_serve_scenario, run_serve_scenario,
                               score_serve_events, score_serve_run,
                               serve_scenario_names)
+from .traffic import (TRAFFIC_MIXES, TrafficMix, build_traffic_mix,
+                      drive_open_loop, traffic_mix_names)
 
 __all__ = [
     "FleetConfig", "FleetSupervisor", "run_scenario",
@@ -41,4 +47,6 @@ __all__ = [
     "SERVE_SCENARIOS", "ServeScenario", "build_serve_scenario",
     "run_serve_scenario", "score_serve_events", "score_serve_run",
     "serve_scenario_names",
+    "TRAFFIC_MIXES", "TrafficMix", "build_traffic_mix", "drive_open_loop",
+    "traffic_mix_names",
 ]

@@ -61,6 +61,15 @@ class ServeScenario:
     #: these against the seeded hash ring to steer placement (hot-spot /
     #: victim-owns-first-arrival setups).
     sessions: Tuple[str, ...] = ()
+    #: open-loop traffic composition: a registered
+    #: :mod:`~deepspeed_tpu.goodput.traffic` mix name.  When set, the
+    #: workload comes from ``build_traffic_mix(traffic, seed,
+    #: **traffic_overrides).arrivals()`` — heavy-tail prompts, diurnal
+    #: bursts, and priority classes instead of the plain Poisson draw
+    #: (``n_requests``/``arrival_rate_hz``/``sessions`` are ignored).
+    traffic: Optional[str] = None
+    traffic_overrides: Mapping[str, Any] = dataclasses.field(
+        default_factory=dict)
     faults: Tuple[FaultSpec, ...] = ()
     #: :class:`~deepspeed_tpu.serving.fleet.ServeFleetConfig` field
     #: overrides (queue_capacity, prefill_timeout_s, ...)
@@ -83,7 +92,13 @@ class ServeScenario:
     def workload(self) -> List[Dict[str, Any]]:
         """The seeded arrival schedule — deterministic given the seed, so
         two runs of one scenario admit byte-identical prompts on an
-        identical clock."""
+        identical clock.  Traffic-composed scenarios delegate to the
+        open-loop generator instead."""
+        if self.traffic:
+            from .traffic import build_traffic_mix
+            mix = build_traffic_mix(self.traffic, self.seed,
+                                    **dict(self.traffic_overrides))
+            return mix.arrivals()
         rng = random.Random(self.seed * 7919 + 13)
         items, at = [], 0.0
         for i in range(self.n_requests):
@@ -105,6 +120,12 @@ class ServeScenario:
             raise ValueError(f"{self.name}: n_decode must be >= 1")
         if self.n_requests < 1:
             raise ValueError(f"{self.name}: n_requests must be >= 1")
+        if self.traffic:
+            from .traffic import TRAFFIC_MIXES
+            if self.traffic not in TRAFFIC_MIXES:
+                raise ValueError(
+                    f"{self.name}: unknown traffic mix {self.traffic!r} "
+                    f"(registered: {', '.join(TRAFFIC_MIXES)})")
         for f in self.faults:
             fault_injection.serialize_plan([f.plan_entry()])
         return self
@@ -339,6 +360,66 @@ def _decode_death_during_handoff(seed: int) -> ServeScenario:
     ).validate()
 
 
+def _fault_storm_burst(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(2)
+    return ServeScenario(
+        name="fault_storm_burst",
+        description=f"compound fault storm under open-loop burst traffic: "
+                    f"decode engine {victim} is SIGKILLed at its first "
+                    "admission — prefilled page bundles in flight to it — "
+                    "while a diurnal burst keeps arriving with heavy-tail "
+                    "prompts and mixed priorities: the survivor absorbs "
+                    "the requeues from durable bundles, the victim "
+                    "respawns, and every accepted request completes",
+        seed=seed, n_decode=2, n_prefill=2,
+        traffic="diurnal_burst",
+        traffic_overrides={"duration_s": 6.0, "rate_hz": 2.5,
+                           "burst_every_s": 3.0, "burst_len_s": 1.2,
+                           "burst_factor": 3.0, "prompt_len": (8, 24),
+                           "prompt_sigma": 0.7, "max_new_tokens": (3, 5),
+                           "n_sessions": 8},
+        faults=(FaultSpec("serve.admit", "KillAtStep",
+                          {"step": 0}, ranks=(victim,)),),
+        fleet_overrides={"queue_capacity": 48, "slots": 3},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 180.0,
+                "expect_kinds": (EventKind.SERVE_FLEET_WORKER_LOST,
+                                 EventKind.SERVE_FLEET_RESTART,
+                                 EventKind.SERVE_FLEET_REQUEUE)},
+    ).validate()
+
+
+def _prefill_autoscale_burst(seed: int) -> ServeScenario:
+    return ServeScenario(
+        name="prefill_autoscale_burst",
+        description="undersized prefill tier under a burst: one prefill "
+                    "worker, every chunk slowed by an injected delay, so "
+                    "queue_wait (not prefill_s) dominates decomposed TTFT "
+                    "— the supervisor must spawn extra prefill capacity "
+                    "(serve.fleet.scale action=up) within its budget, and "
+                    "lose nothing while doing it",
+        seed=seed, n_decode=1, n_prefill=1,
+        traffic="steady",
+        traffic_overrides={"duration_s": 5.0, "rate_hz": 2.5,
+                           "burst_every_s": 2.5, "burst_len_s": 1.0,
+                           "burst_factor": 3.0, "prompt_len": (10, 26),
+                           "prompt_sigma": 0.6, "max_new_tokens": (3, 5),
+                           "n_sessions": 4},
+        # the delay hits only the ORIGINAL prefill rank (1); the worker
+        # the autoscaler spawns (rank 2+) runs at full speed, so the
+        # scale-up visibly drains the backlog
+        faults=(FaultSpec("serve.prefill_chunk", "DelaySeconds",
+                          {"seconds": 0.35, "n": 500}, ranks=(1,)),),
+        fleet_overrides={"queue_capacity": 48, "slots": 3,
+                         "autoscale": True, "autoscale_max_prefill": 3,
+                         "autoscale_up_queue_wait_s": 0.25,
+                         "prefill_timeout_s": 30.0},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
+                "min_scale_ups": 1,
+                "expect_kinds": (EventKind.SERVE_FLEET_SCALE,)},
+    ).validate()
+
+
 #: name → factory(seed); iteration order is the bench matrix order
 SERVE_SCENARIOS = {
     "fleet_baseline": _fleet_baseline,
@@ -351,6 +432,8 @@ SERVE_SCENARIOS = {
     "hot_spot_rebalance": _hot_spot_rebalance,
     "rolling_restart_drain": _rolling_restart_drain,
     "decode_death_during_handoff": _decode_death_during_handoff,
+    "fault_storm_burst": _fault_storm_burst,
+    "prefill_autoscale_burst": _prefill_autoscale_burst,
 }
 
 
@@ -426,6 +509,13 @@ def score_serve_events(events: List[dict], *,
     exported = [e for e in by_kind(EventKind.SERVE_FLEET_MIGRATE)
                 if e.get("state") == "exported"]
 
+    scales = by_kind(EventKind.SERVE_FLEET_SCALE)
+    sheds = by_kind(EventKind.SERVE_SHED)
+    shed_by_cls: Dict[str, int] = {}
+    for e in sheds:
+        c = str(e.get("cls") or "?")
+        shed_by_cls[c] = shed_by_cls.get(c, 0) + 1
+
     allowed = set(expect.get("allow_abort_kinds", ()))
     unexpected_aborts = [e["kind"] for e in events
                          if e.get("kind") in ABORT_KINDS
@@ -464,6 +554,11 @@ def score_serve_events(events: List[dict], *,
         "drained_sessions": sum(int(e.get("sessions") or 0)
                                 for e in by_kind(EventKind.SERVE_FLEET_DRAIN)),
         "restarts": len(by_kind(EventKind.SERVE_FLEET_RESTART)),
+        "scale_ups": sum(1 for e in scales if e.get("action") == "up"),
+        "scale_downs": sum(1 for e in scales if e.get("action") == "down"),
+        "shed": len(sheds),
+        "shed_by_cls": shed_by_cls,
+        "degrade_transitions": len(by_kind(EventKind.SERVE_DEGRADE)),
         "unexpected_aborts": unexpected_aborts,
         "kinds": kinds,
     }
@@ -516,6 +611,11 @@ def _judge_serve(score: Dict[str, Any], expect: Mapping[str, Any]):
         failures.append(
             f"rejected {score['rejected']} < expected {min_rejected} — "
             "the bounded queue never pushed back")
+    min_scale_ups = expect.get("min_scale_ups")
+    if min_scale_ups is not None and score["scale_ups"] < min_scale_ups:
+        failures.append(
+            f"scale_ups {score['scale_ups']} < expected {min_scale_ups} — "
+            "the autoscaler never added prefill capacity")
     for kind in expect.get("expect_kinds", ()):
         if not score["kinds"].get(kind):
             failures.append(f"expected event kind {kind!r} never journaled")

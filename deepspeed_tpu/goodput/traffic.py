@@ -1,0 +1,231 @@
+"""Open-loop traffic generation for overload benchmarking.
+
+The fault scenarios in :mod:`.serve_scenarios` drive a handful of
+requests through a *closed* loop — each arrival waits politely for the
+fleet to keep up.  Overload robustness needs the opposite: an **open
+loop** that submits on the wall clock no matter how far behind the
+server falls, because that is what production traffic does.  This module
+is the seeded generator for that loop:
+
+- **heavy-tail prompt/turn mixes** — lognormal prompt lengths clamped to
+  a range (most prompts short, a fat tail of long ones), multi-turn
+  sessions whose turn counts draw from the same family;
+- **diurnal bursts** — a base Poisson arrival rate modulated by a
+  square-wave "burst" factor (thinning construction, so the process is
+  still exactly Poisson at every instant's rate);
+- **priority classes** — a seeded interactive/batch coin per arrival,
+  mapped to the admission controller's priority floor;
+- **sessions at scale** — hundreds-to-thousands of concurrent session
+  ids, so routing affinity and KV tiering see realistic key cardinality.
+
+Everything is a pure function of ``TrafficMix`` + seed: two runs of one
+mix produce byte-identical schedules (`arrivals()` is data, like
+``ServeScenario.workload()``), and the open-loop driver
+(:func:`drive_open_loop`) injects clocks so tests run it in fake time.
+
+Consumed by ``scripts/overload_bench.py`` → ``BENCH_OVERLOAD.json`` and
+the compound fault-storm scenario in :mod:`.serve_scenarios`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficMix:
+    """A fully-resolved open-loop traffic shape.  All randomness is drawn
+    from ``random.Random(seed)`` — the schedule is deterministic data."""
+
+    name: str
+    seed: int
+    #: schedule horizon, seconds (arrivals past it are not generated)
+    duration_s: float = 10.0
+    #: base Poisson arrival rate, requests/second (off-burst)
+    rate_hz: float = 20.0
+    #: burst square wave: every ``burst_every_s`` seconds the rate is
+    #: multiplied by ``burst_factor`` for ``burst_len_s`` seconds — the
+    #: compressed "diurnal" peak.  ``burst_factor=1`` disables bursts.
+    burst_every_s: float = 4.0
+    burst_len_s: float = 1.5
+    burst_factor: float = 3.0
+    #: prompt lengths: exp(Normal(mu, sigma)) clamped to [lo, hi] — a
+    #: lognormal body with mass near ``lo`` and a tail pinned at ``hi``
+    prompt_len: Tuple[int, int] = (4, 48)
+    prompt_sigma: float = 0.8
+    max_new_tokens: Tuple[int, int] = (2, 8)
+    #: fraction of arrivals in the interactive class (the rest are batch)
+    interactive_fraction: float = 0.3
+    interactive_priority: int = 5
+    batch_priority: int = 0
+    #: session-id pool size: each arrival picks one of ``n_sessions``
+    #: seeded session keys (0 disables sessions — every request fresh);
+    #: turn counts per session emerge from the draws, heavy-tailed
+    n_sessions: int = 0
+    #: per-class relative deadline, seconds after submit (None = none)
+    interactive_deadline_s: Optional[float] = None
+    batch_deadline_s: Optional[float] = None
+    vocab: int = 256
+
+    def validate(self) -> "TrafficMix":
+        if self.duration_s <= 0:
+            raise ValueError(f"{self.name}: duration_s must be > 0")
+        if self.rate_hz <= 0:
+            raise ValueError(f"{self.name}: rate_hz must be > 0")
+        if self.burst_factor < 1.0:
+            raise ValueError(f"{self.name}: burst_factor must be >= 1 "
+                             "(thinning needs a peak-rate envelope)")
+        if not (0.0 <= self.interactive_fraction <= 1.0):
+            raise ValueError(f"{self.name}: interactive_fraction must be "
+                             "within [0, 1]")
+        lo, hi = self.prompt_len
+        if not (1 <= lo <= hi):
+            raise ValueError(f"{self.name}: prompt_len must satisfy "
+                             f"1 <= lo <= hi, got {self.prompt_len}")
+        return self
+
+    # ----------------------------------------------------------- schedule
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate at schedule time ``t``."""
+        if self.burst_factor <= 1.0 or self.burst_every_s <= 0:
+            return self.rate_hz
+        if (t % self.burst_every_s) < self.burst_len_s:
+            return self.rate_hz * self.burst_factor
+        return self.rate_hz
+
+    def arrivals(self) -> List[Dict[str, Any]]:
+        """The seeded open-loop schedule, sorted by ``at_s``.  Each item
+        carries everything a submit call needs: ``at_s``, ``tokens``,
+        ``max_new_tokens``, ``priority``, ``cls``, ``session``, ``seed``,
+        ``deadline_s`` (relative; None when classless)."""
+        self.validate()
+        rng = random.Random(self.seed * 6151 + 29)
+        peak = self.rate_hz * self.burst_factor
+        items: List[Dict[str, Any]] = []
+        t, i = 0.0, 0
+        while True:
+            # thinning: draw at the peak rate, keep with prob rate(t)/peak
+            t += rng.expovariate(peak)
+            if t >= self.duration_s:
+                break
+            if rng.random() * peak > self.rate_at(t):
+                continue
+            interactive = rng.random() < self.interactive_fraction
+            plen = self._draw_len(rng, self.prompt_len, self.prompt_sigma)
+            session = (f"{self.name}-s{rng.randrange(self.n_sessions)}"
+                       if self.n_sessions > 0 else None)
+            items.append({
+                "at_s": round(t, 4),
+                "tokens": [rng.randrange(self.vocab) for _ in range(plen)],
+                "max_new_tokens": rng.randint(*self.max_new_tokens),
+                "priority": (self.interactive_priority if interactive
+                             else self.batch_priority),
+                "cls": "interactive" if interactive else "batch",
+                "deadline_s": (self.interactive_deadline_s if interactive
+                               else self.batch_deadline_s),
+                "session": session,
+                "greedy": True, "temperature": 1.0, "seed": i,
+            })
+            i += 1
+        return items
+
+    @staticmethod
+    def _draw_len(rng: random.Random, bounds: Tuple[int, int],
+                  sigma: float) -> int:
+        lo, hi = bounds
+        if lo == hi or sigma <= 0:
+            return lo
+        # body anchored one sigma above the floor so the median stays
+        # short while exp() supplies the fat tail, clamped at hi
+        mu = math.log(lo) + sigma
+        return max(lo, min(hi, int(round(rng.lognormvariate(mu, sigma)))))
+
+
+# ----------------------------------------------------------- mix registry
+
+
+def _steady(seed: int) -> TrafficMix:
+    return TrafficMix(
+        name="steady", seed=seed, duration_s=8.0, rate_hz=12.0,
+        burst_factor=1.0, interactive_fraction=0.3).validate()
+
+
+def _diurnal_burst(seed: int) -> TrafficMix:
+    return TrafficMix(
+        name="diurnal_burst", seed=seed, duration_s=12.0, rate_hz=10.0,
+        burst_every_s=4.0, burst_len_s=1.5, burst_factor=4.0,
+        interactive_fraction=0.3, n_sessions=64).validate()
+
+
+def _heavy_tail_sessions(seed: int) -> TrafficMix:
+    return TrafficMix(
+        name="heavy_tail_sessions", seed=seed, duration_s=10.0,
+        rate_hz=25.0, burst_every_s=5.0, burst_len_s=2.0, burst_factor=3.0,
+        prompt_len=(4, 96), prompt_sigma=1.1, interactive_fraction=0.25,
+        n_sessions=512).validate()
+
+
+#: name → factory(seed), like SERVE_SCENARIOS
+TRAFFIC_MIXES: Dict[str, Callable[[int], TrafficMix]] = {
+    "steady": _steady,
+    "diurnal_burst": _diurnal_burst,
+    "heavy_tail_sessions": _heavy_tail_sessions,
+}
+
+
+def build_traffic_mix(name: str, seed: int = 0, **overrides) -> TrafficMix:
+    """Resolve a registered mix at ``seed`` (field overrides allowed —
+    the bench scales ``rate_hz`` to multiples of measured capacity)."""
+    try:
+        factory = TRAFFIC_MIXES[name]
+    except KeyError:
+        raise KeyError(f"unknown traffic mix {name!r} "
+                       f"(registered: {', '.join(TRAFFIC_MIXES)})") from None
+    mix = factory(int(seed))
+    if overrides:
+        mix = dataclasses.replace(mix, **overrides).validate()
+    return mix
+
+
+def traffic_mix_names() -> Tuple[str, ...]:
+    return tuple(TRAFFIC_MIXES)
+
+
+# ------------------------------------------------------- open-loop driver
+
+
+def drive_open_loop(submit: Callable[[Dict[str, Any]], Any],
+                    arrivals: List[Dict[str, Any]], *,
+                    now_fn: Callable[[], float] = time.monotonic,
+                    sleep_fn: Callable[[float], None] = time.sleep
+                    ) -> List[Dict[str, Any]]:
+    """Fire ``arrivals`` at their scheduled ``at_s`` offsets regardless
+    of what came back — the open loop.  ``submit`` is called with the
+    arrival dict and may return anything (a handle) or raise (a shed /
+    queue-full rejection); either way the loop keeps the schedule.
+
+    Returns one record per arrival: the arrival itself plus
+    ``t_submit`` (driver-clock offset), and exactly one of ``handle`` or
+    ``error``.  Never raises on behalf of the server.
+    """
+    t0 = now_fn()
+    records: List[Dict[str, Any]] = []
+    for item in arrivals:
+        delay = item["at_s"] - (now_fn() - t0)
+        if delay > 0:
+            sleep_fn(delay)
+        rec: Dict[str, Any] = dict(item)
+        rec["t_submit"] = round(now_fn() - t0, 4)
+        try:
+            rec["handle"] = submit(item)
+            rec["error"] = None
+        except Exception as exc:          # the server saying no IS data
+            rec["handle"] = None
+            rec["error"] = exc
+        records.append(rec)
+    return records

@@ -80,6 +80,8 @@ class EventKind:
     SERVE_READMIT = "serve.readmit"
     SERVE_PAGE_ALLOC = "serve.page_alloc"
     SERVE_PAGE_EVICT = "serve.page_evict"
+    SERVE_SHED = "serve.shed"
+    SERVE_DEGRADE = "serve.degrade"
     SERVE_FLEET_SPAWN = "serve.fleet.spawn"
     SERVE_FLEET_READY = "serve.fleet.ready"
     SERVE_FLEET_WORKER_LOST = "serve.fleet.worker_lost"
@@ -92,6 +94,7 @@ class EventKind:
     SERVE_FLEET_MIGRATE = "serve.fleet.migrate"
     SERVE_FLEET_MIGRATE_REJECT = "serve.fleet.migrate_reject"
     SERVE_FLEET_DRAIN = "serve.fleet.drain"
+    SERVE_FLEET_SCALE = "serve.fleet.scale"
     SERVE_FLEET_DONE = "serve.fleet.done"
     SERVE_FLEET_ABORT = "serve.fleet.abort"
     PERF_RECOMPILE = "perf.recompile"
@@ -177,6 +180,10 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_PAGE_ALLOC: ("session", "blocks", "free_blocks"),
     EventKind.SERVE_PAGE_EVICT: ("session", "blocks", "bytes", "reason",
                                  "pressure", "watermark"),
+    EventKind.SERVE_SHED: ("request_id", "priority", "cls", "reason",
+                           "phase", "est_ttft_ms", "slo_ms", "queue_depth"),
+    EventKind.SERVE_DEGRADE: ("rung", "action", "phase", "pressure",
+                              "dwell_ticks", "level"),
     EventKind.SERVE_FLEET_SPAWN: ("role", "worker", "incarnation", "pid"),
     EventKind.SERVE_FLEET_READY: ("role", "worker", "incarnation", "warm_s"),
     EventKind.SERVE_FLEET_WORKER_LOST: ("role", "worker", "incarnation",
@@ -198,6 +205,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_FLEET_MIGRATE_REJECT: ("request_id", "worker", "mig",
                                            "reason"),
     EventKind.SERVE_FLEET_DRAIN: ("role", "worker", "sessions", "reason"),
+    EventKind.SERVE_FLEET_SCALE: ("action", "role", "worker", "n_prefill",
+                                  "reason", "queue_wait_ms", "prefill_ms",
+                                  "budget"),
     EventKind.SERVE_FLEET_DONE: ("accepted", "completed", "rejected", "lost",
                                  "wall_s"),
     EventKind.SERVE_FLEET_ABORT: ("reason", "role", "restarts"),

@@ -34,23 +34,26 @@ directly.  Reference: ``docs/serving.md``.
 """
 
 from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
-from .config import (SERVING, PagingConfig, ServingConfig,  # noqa: F401
-                     SpeculativeConfig)
+from .config import (SERVING, OverloadConfig, PagingConfig,  # noqa: F401
+                     PriorityClass, ServingConfig, SpeculativeConfig)
 from .fleet import (BundleCorruptError, ServeFleetConfig,  # noqa: F401
                     ServeFleetSupervisor)
 from .gateway import ServingGateway  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .overload import (AdmissionController, DegradationLadder)  # noqa: F401
 from .paging import (BlockAllocator, PagedKVPool, ParkCorruptError,  # noqa: F401
                      ParkStore, PoolExhaustedError, SessionPager)
 from .request import (QueueFullError, RequestCancelled, RequestFailed,  # noqa: F401
-                      RequestHandle, RequestState, RequestTimedOut)
+                      RequestHandle, RequestShed, RequestState,
+                      RequestTimedOut)
 
 __all__ = [
     "SERVING", "ServingConfig", "PagingConfig", "SpeculativeConfig",
-    "ServingGateway",
+    "OverloadConfig", "PriorityClass", "AdmissionController",
+    "DegradationLadder", "ServingGateway",
     "ServingMetrics", "SlotBatcher", "PrefixEntry", "RequestHandle",
-    "RequestState", "QueueFullError", "RequestCancelled", "RequestFailed",
-    "RequestTimedOut", "BlockAllocator", "PagedKVPool", "ParkStore",
-    "SessionPager", "PoolExhaustedError", "ParkCorruptError",
+    "RequestState", "QueueFullError", "RequestShed", "RequestCancelled",
+    "RequestFailed", "RequestTimedOut", "BlockAllocator", "PagedKVPool",
+    "ParkStore", "SessionPager", "PoolExhaustedError", "ParkCorruptError",
     "ServeFleetConfig", "ServeFleetSupervisor", "BundleCorruptError",
 ]

@@ -87,6 +87,13 @@ class SlotBatcher:
                                         cfg.max_seq_len)
         # a chunk wider than the slot cannot even land its first write
         self.chunk = min(int(config.prefill_chunk), self.max_len)
+        #: degraded-mode prefill chunk (the ladder's ``chunk_widen``
+        #: rung): double width = half the per-chunk dispatch overhead at
+        #: the cost of more pad compute.  Runs through its OWN registered
+        #: programs (``prefill_wide``/``extend_wide``) — re-tracing the
+        #: normal ones at a new shape would count as a recompile.
+        self.chunk_wide = min(self.chunk * 2, self.max_len)
+        self._wide = False
         fam = self._fam
         B = self.slots
         self.cache = fam.init_cache(cfg, B, self.max_len,
@@ -108,11 +115,22 @@ class SlotBatcher:
         #: ``[cur, accepted drafts]`` and the accept rule's resample or
         #: bonus token becomes the next ``cur``
         self.cur = None
+        #: degradation-ladder level for speculation: 0 = full ``draft_k``
+        #: rounds, 1 = shrunk ``draft_k2`` rounds, 2 = paused (plain
+        #: one-token ticks).  Output semantics are exact at every level —
+        #: the accept rule is exact for any proposal, and pause/resume
+        #: flush/reseed the pending token through the same split/sample
+        #: the plain tick performs.
+        self.spec_level = 0
+        self.draft_k2 = 0
+        #: True while paused ticking: ``cur`` is stale, ``_last`` is live
+        self._paused = False
         if self.spec:
             self._init_draft(config, draft)
             self.draft_cache = self._dfam.init_cache(self._dcfg, B,
                                                      self.max_len)
             self.cur = jnp.zeros((B,), jnp.int32)
+            self.draft_k2 = max(1, self.draft_k // 2)
         #: extra slot positions a speculative round may write past the
         #: reply budget (the gateway's admission margin)
         self.spec_overshoot = self.draft_k if self.spec else 0
@@ -214,7 +232,19 @@ class SlotBatcher:
             "prefill": jax.jit(lambda p, t, c: fam.prefill(p, t, cfg, c)),
             "extend": jax.jit(
                 lambda p, t, c, l: fam.extend(p, t, cfg, c, lengths=l)),
+            # the chunk_widen rung's separate jit objects: same functions,
+            # compiled lazily at the wide chunk shape on first degraded
+            # prefill (a first compile per NAME is free under the
+            # CompileWatch contract; pushing a wide chunk through
+            # "prefill" would journal perf.recompile)
+            "prefill_wide": jax.jit(
+                lambda p, t, c: fam.prefill(p, t, cfg, c)),
+            "extend_wide": jax.jit(
+                lambda p, t, c, l: fam.extend(p, t, cfg, c, lengths=l)),
             "take_last": jax.jit(
+                lambda lg, i: lax.dynamic_index_in_dim(lg[0], i, 0,
+                                                       keepdims=False)),
+            "take_last_wide": jax.jit(
                 lambda lg, i: lax.dynamic_index_in_dim(lg[0], i, 0,
                                                        keepdims=False)),
             "write_slot": jax.jit(
@@ -229,73 +259,115 @@ class SlotBatcher:
     def _build_spec_programs(self, config: ServingConfig) -> None:
         """The speculative round as three chained device programs (plus
         the draft admission mirrors of prefill/extend/write_slot and the
-        pending-token seeder) — each registered, each compiled once."""
+        pending-token seeder) — each registered, each compiled once.  The
+        degradation ladder gets its own program sets: the round trio
+        again at ``draft_k2`` (the ``draft_k`` rung — K is compiled into
+        the scan/window shapes, so a shrunk round is a different
+        program), and the pause/resume pair ``spec_flush``/``spec_reseed``
+        (the ``spec_pause`` rung)."""
         fam, cfg = self._fam, self._cfg
         dfam, dcfg = self._dfam, self._dcfg
         top_k, top_p = int(config.top_k), float(config.top_p)
         vocab = cfg.vocab_size
-        B, K = self.slots, self.draft_k
+        B = self.slots
         rows = jnp.arange(B)
 
-        def draft_step(dparams, dcache, cur, lengths, keys, greedy, temp):
-            """K ragged draft decodes per slot from its pending token.
-            Splits each slot's key chain once per round; the proposal
-            draws fold the draft domain + step index into the round key
-            (independent of the accept stream — see
-            ``inference/speculative.py``)."""
-            ks = jax.vmap(jax.random.split)(keys)          # [B, 2, 2]
-            next_keys, round_keys = ks[:, 0], ks[:, 1]
+        def make_round(K):
+            """The three chained round programs at proposal depth K (the
+            scan length and the [B, K+1] verify window compile K in, so
+            the shrunk-``draft_k`` rung is a distinct program set)."""
 
-            def dstep(carry, j):
-                tok, dc, l = carry
-                lg, dc = dfam.decode_step(dparams, tok, dcfg, dc,
-                                          lengths=l)
-                lg = lg[:, :vocab].astype(jnp.float32)
-                f = filter_logits(lg, temp[:, None], top_k=top_k,
-                                  top_p=top_p)
-                probs = jax.nn.softmax(f, -1)
-                sampled = jax.vmap(jax.random.categorical)(
-                    spec_draft_keys(round_keys, j), f)
-                nxt = jnp.where(greedy, jnp.argmax(lg, -1),
-                                sampled).astype(jnp.int32)
-                return (nxt, dc, l + 1), (nxt, probs)
+            def draft_step(dparams, dcache, cur, lengths, keys, greedy,
+                           temp):
+                """K ragged draft decodes per slot from its pending
+                token.  Splits each slot's key chain once per round; the
+                proposal draws fold the draft domain + step index into
+                the round key (independent of the accept stream — see
+                ``inference/speculative.py``)."""
+                ks = jax.vmap(jax.random.split)(keys)      # [B, 2, 2]
+                next_keys, round_keys = ks[:, 0], ks[:, 1]
 
-            (last_d, dcache, _), (drafts, d_probs) = lax.scan(
-                dstep, (cur, dcache, lengths), jnp.arange(K))
-            # feed d_K too, so the draft cache covers a full acceptance
-            _, dcache = dfam.decode_step(dparams, last_d, dcfg, dcache,
-                                         lengths=lengths + K)
-            return drafts, d_probs, dcache, next_keys, round_keys
+                def dstep(carry, j):
+                    tok, dc, l = carry
+                    lg, dc = dfam.decode_step(dparams, tok, dcfg, dc,
+                                              lengths=l)
+                    lg = lg[:, :vocab].astype(jnp.float32)
+                    f = filter_logits(lg, temp[:, None], top_k=top_k,
+                                      top_p=top_p)
+                    probs = jax.nn.softmax(f, -1)
+                    sampled = jax.vmap(jax.random.categorical)(
+                        spec_draft_keys(round_keys, j), f)
+                    nxt = jnp.where(greedy, jnp.argmax(lg, -1),
+                                    sampled).astype(jnp.int32)
+                    return (nxt, dc, l + 1), (nxt, probs)
 
-        def verify_extend(params, cache, cur, drafts, lengths):
-            """ONE ragged target pass scoring every slot's
-            ``[cur, d_1..d_K]`` window at its own frontier."""
-            window = jnp.concatenate([cur[:, None], drafts.T], axis=1)
-            vlg, cache = fam.extend(params, window, cfg, cache,
-                                    lengths=lengths)
-            return window, vlg[..., :vocab].astype(jnp.float32), cache
+                (last_d, dcache, _), (drafts, d_probs) = lax.scan(
+                    dstep, (cur, dcache, lengths), jnp.arange(K))
+                # feed d_K too, so the draft cache covers a full acceptance
+                _, dcache = dfam.decode_step(dparams, last_d, dcfg, dcache,
+                                             lengths=lengths + K)
+                return drafts, d_probs, dcache, next_keys, round_keys
 
-        def spec_accept(vlg, drafts, d_probs, round_keys, cur, lengths,
-                        greedy, temp, active):
-            """Batched accept/rollback: greedy rows take the longest
-            prefix agreeing with the target argmax chain (plus the
-            target's own next token); sampled rows run the rejection
-            rule.  Frontiers advance by the accepted count + 1 — the
-            rollback IS the arithmetic (rejected K/V sits beyond the new
-            frontier, masked and overwritten next round)."""
-            g = jnp.argmax(vlg, -1).astype(jnp.int32)        # [B, K+1]
-            agree = (drafts.T == g[:, :K]).astype(jnp.int32)
-            a_g = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
-            t_f = filter_logits(vlg, temp[:, None, None], top_k=top_k,
-                                top_p=top_p)
-            t_probs = jax.nn.softmax(t_f, -1)                # [B, K+1, V]
-            a_s, nxt_s = spec_accept_batch(
-                spec_accept_keys(round_keys), drafts.T,
-                jnp.swapaxes(d_probs, 0, 1), t_probs)
-            a = jnp.where(greedy, a_g, a_s)
-            nxt = jnp.where(greedy, g[rows, a_g], nxt_s).astype(jnp.int32)
-            adv = jnp.where(active, a + 1, 0).astype(jnp.int32)
-            return adv, lengths + adv, jnp.where(active, nxt, cur)
+            def verify_extend(params, cache, cur, drafts, lengths):
+                """ONE ragged target pass scoring every slot's
+                ``[cur, d_1..d_K]`` window at its own frontier."""
+                window = jnp.concatenate([cur[:, None], drafts.T], axis=1)
+                vlg, cache = fam.extend(params, window, cfg, cache,
+                                        lengths=lengths)
+                return window, vlg[..., :vocab].astype(jnp.float32), cache
+
+            def spec_accept(vlg, drafts, d_probs, round_keys, cur, lengths,
+                            greedy, temp, active):
+                """Batched accept/rollback: greedy rows take the longest
+                prefix agreeing with the target argmax chain (plus the
+                target's own next token); sampled rows run the rejection
+                rule.  Frontiers advance by the accepted count + 1 — the
+                rollback IS the arithmetic (rejected K/V sits beyond the
+                new frontier, masked and overwritten next round)."""
+                g = jnp.argmax(vlg, -1).astype(jnp.int32)    # [B, K+1]
+                agree = (drafts.T == g[:, :K]).astype(jnp.int32)
+                a_g = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+                t_f = filter_logits(vlg, temp[:, None, None], top_k=top_k,
+                                    top_p=top_p)
+                t_probs = jax.nn.softmax(t_f, -1)            # [B, K+1, V]
+                a_s, nxt_s = spec_accept_batch(
+                    spec_accept_keys(round_keys), drafts.T,
+                    jnp.swapaxes(d_probs, 0, 1), t_probs)
+                a = jnp.where(greedy, a_g, a_s)
+                nxt = jnp.where(greedy, g[rows, a_g],
+                                nxt_s).astype(jnp.int32)
+                adv = jnp.where(active, a + 1, 0).astype(jnp.int32)
+                return adv, lengths + adv, jnp.where(active, nxt, cur)
+
+            out: Dict[str, Any] = {}
+            out["draft_step"] = jax.jit(draft_step)
+            out["verify_extend"] = jax.jit(verify_extend)
+            out["spec_accept"] = jax.jit(spec_accept)
+            return out
+
+        def spec_flush(params, cache, cur, lengths, active):
+            """Entering the spec_pause rung: the pending token is
+            emitted and cache-written through one plain decode step, so
+            ``_last`` lands at the frontier and the plain tick program
+            can carry the chain (bitwise the same greedy chain; sampled
+            rows keep drawing from the exact target distribution)."""
+            logits, cache = fam.decode_step(params, cur, cfg, cache,
+                                            lengths=lengths)
+            return cur, logits, cache, jnp.where(active, lengths + 1,
+                                                 lengths)
+
+        def spec_reseed(last, keys, greedy, temp):
+            """Leaving the pause: re-draw every slot's pending token from
+            its frontier logits — the same split/sample a plain tick
+            would perform, so resuming is a valid continuation."""
+            lg = last[:, :vocab]
+            ks = jax.vmap(jax.random.split)(keys)
+            next_keys, subkeys = ks[:, 0], ks[:, 1]
+            f = filter_logits(lg, temp[:, None], top_k=top_k, top_p=top_p)
+            sampled = jax.vmap(jax.random.categorical)(subkeys, f)
+            cur = jnp.where(greedy, jnp.argmax(lg, -1),
+                            sampled).astype(jnp.int32)
+            return cur, next_keys
 
         def spec_seed(cur, keys, row, vec, g, t):
             """Seed a slot's pending token from its admission logits —
@@ -310,18 +382,21 @@ class SlotBatcher:
                             ).astype(jnp.int32)
             return cur.at[row].set(tok), keys.at[row].set(k2[0])
 
-        self._p_spec = self.registry.register_all({
-            "draft_prefill": jax.jit(
-                lambda p, t, c: dfam.prefill(p, t, dcfg, c)),
-            "draft_extend": jax.jit(
-                lambda p, t, c, l: dfam.extend(p, t, dcfg, c, lengths=l)),
-            "draft_write_slot": jax.jit(
-                lambda c, row, src: dfam.write_slot(c, row, src)),
-            "spec_seed": jax.jit(spec_seed),
-            "draft_step": jax.jit(draft_step),
-            "verify_extend": jax.jit(verify_extend),
-            "spec_accept": jax.jit(spec_accept),
-        })
+        progs: Dict[str, Any] = {}
+        progs["draft_prefill"] = jax.jit(
+            lambda p, t, c: dfam.prefill(p, t, dcfg, c))
+        progs["draft_extend"] = jax.jit(
+            lambda p, t, c, l: dfam.extend(p, t, dcfg, c, lengths=l))
+        progs["draft_write_slot"] = jax.jit(
+            lambda c, row, src: dfam.write_slot(c, row, src))
+        progs["spec_seed"] = jax.jit(spec_seed)
+        progs["spec_flush"] = jax.jit(spec_flush)
+        progs["spec_reseed"] = jax.jit(spec_reseed)
+        progs.update(make_round(self.draft_k))
+        if self.draft_k2 != self.draft_k:
+            progs.update({f"{name}_k2": prog for name, prog
+                          in make_round(self.draft_k2).items()})
+        self._p_spec = self.registry.register_all(progs)
         self._p.update(self._p_spec)
 
     def compile_counts(self) -> Dict[str, int]:
@@ -330,6 +405,57 @@ class SlotBatcher:
         re-registered/un-cached program keeps counting: see
         ``CompiledProgramRegistry``)."""
         return self.registry.counts()
+
+    # ------------------------------------------------- degradation ladder
+
+    def set_chunk_wide(self, wide: bool) -> None:
+        """Engage/release the ``chunk_widen`` rung: subsequent prefills
+        run ``chunk_wide``-token chunks through the wide program pair.
+        Admission-path only — a prefill in flight finishes at the width
+        it started."""
+        self._wide = bool(wide) and self.chunk_wide != self.chunk
+
+    def set_spec_level(self, level: int) -> None:
+        """Engage/release the speculative rungs: 0 = full ``draft_k``
+        rounds, 1 = shrunk ``draft_k2`` rounds, 2 = paused (plain
+        one-token ticks).  No-op on a non-speculative batcher."""
+        if level not in (0, 1, 2):
+            raise ValueError(f"spec level must be 0, 1, or 2, got {level}")
+        if self.spec:
+            self.spec_level = int(level)
+
+    @property
+    def round_draft_k(self) -> int:
+        """Proposals per round at the current ladder level (0 = plain
+        one-token ticks: speculation off or paused)."""
+        if not self.spec or self.spec_level >= 2:
+            return 0
+        return self.draft_k2 if self.spec_level == 1 else self.draft_k
+
+    def prewarm(self) -> None:
+        """Compile every program a storm can reach BEFORE traffic
+        arrives: prefill/extend at both chunk widths, the tick at every
+        speculative ladder level, admission bind and release.  The
+        degradation ladder exists to shed work under pressure — a rung
+        whose first engage pays an XLA compile would add seconds of
+        stall at the worst possible moment, so ``serving.warm_start``
+        front-loads them all here.  Runs a throwaway prompt through
+        slot 0 and releases it; call before any real admission."""
+        key = jax.random.PRNGKey(0)
+        n = min(self.chunk + 1, self.max_len)   # cross one chunk boundary
+        self.admit(0, np.zeros((n,), np.int32), key, True, 1.0)
+        self.tick()
+        if self.spec:
+            for level in (1, 2, 0):   # shrunk round, pause flush, resume
+                self.set_spec_level(level)
+                self.tick()
+        self.release(0)
+        if self.chunk_wide != self.chunk:
+            self.set_chunk_wide(True)
+            nw = min(self.chunk_wide + 1, self.max_len)
+            self.admit(0, np.zeros((nw,), np.int32), key, True, 1.0)
+            self.set_chunk_wide(False)
+            self.release(0)
 
     # ------------------------------------------------------------- prefill
 
@@ -342,10 +468,13 @@ class SlotBatcher:
         (chunk padding sits beyond the frontier, masked by per-row
         visibility and overwritten as decode advances)."""
         fam, cfg = self._fam, self._cfg
-        C = self.chunk
+        wide = self._wide
+        C = self.chunk_wide if wide else self.chunk
+        p_first, p_rest = ("prefill_wide", "extend_wide") if wide \
+            else ("prefill", "extend")
         S = int(tokens.shape[0])
         with self.tracer.span(SpanName.SERVE_PREFILL, tokens=S,
-                              start=start_len):
+                              start=start_len, chunk=C):
             pad = (-S) % C
             padded = np.concatenate(
                 [np.asarray(tokens, np.int32),
@@ -360,12 +489,13 @@ class SlotBatcher:
                 dev = jnp.asarray(ch[None])
                 pos = start_len + i * C
                 if pos == 0:
-                    lg, cache = self._p["prefill"](params, dev, cache)
+                    lg, cache = self._p[p_first](params, dev, cache)
                 else:
-                    lg, cache = self._p["extend"](
+                    lg, cache = self._p[p_rest](
                         params, dev, cache, jnp.asarray([pos], jnp.int32))
             idx = S - 1 - (len(chunks) - 1) * C
-            vec = self._p["take_last"](lg, jnp.asarray(idx, jnp.int32))
+            p_last = "take_last_wide" if wide else "take_last"
+            vec = self._p[p_last](lg, jnp.asarray(idx, jnp.int32))
         return cache, vec, start_len + S
 
     def build_prefix(self, tokens: np.ndarray) -> PrefixEntry:
@@ -454,12 +584,23 @@ class SlotBatcher:
     def tick(self) -> np.ndarray:
         """One continuous-batching decode step for every slot; returns the
         [B] int32 tokens just emitted (junk in freed slots).  With
-        speculation enabled, one draft/verify ROUND instead: returns
-        ``(window [B, draft_k+1], counts [B])`` — row ``b`` emitted
-        ``window[b, :counts[b]]`` this tick (0 in freed slots)."""
+        speculation enabled (and not paused by the ladder), one
+        draft/verify ROUND instead: returns ``(window [B, k+1], counts
+        [B])`` — row ``b`` emitted ``window[b, :counts[b]]`` this tick
+        (0 in freed slots).  Callers dispatch on the return TYPE (tuple =
+        speculative round), not on config — the spec_pause rung switches
+        a speculative gateway to plain [B] ticks at runtime."""
         if self._last is None:
             raise RuntimeError("tick() before any admission")
         if self.spec:
+            if self.spec_level >= 2:
+                return self._paused_tick()
+            if self._paused:
+                # leaving the pause: re-draw every pending token from the
+                # frontier logits before the next round
+                self.cur, self.keys = self._p["spec_reseed"](
+                    self._last, self.keys, self.greedy, self.temp)
+                self._paused = False
             return self._spec_tick()
         with self.tracer.span(SpanName.SERVE_TICK):
             nxt, logits, self.cache, self.lengths, self.keys = \
@@ -477,21 +618,52 @@ class SlotBatcher:
     def _spec_tick(self):
         """One speculative round for every slot: draft scan → ragged
         verify extend → batched accept/rollback, three chained compiled
-        programs, still one host sync at the output boundary."""
+        programs, still one host sync at the output boundary.  At ladder
+        level 1 the round runs the ``draft_k2`` program set instead."""
+        shrunk = self.spec_level == 1 and self.draft_k2 != self.draft_k
+        sfx = "_k2" if shrunk else ""
         with self.tracer.span(SpanName.SERVE_TICK):
             with self.tracer.span(SpanName.SERVE_SPEC,
-                                  draft_k=self.draft_k):
+                                  draft_k=self.round_draft_k):
                 drafts, d_probs, self.draft_cache, next_keys, round_keys \
-                    = self._p["draft_step"](
+                    = self._p["draft_step" + sfx](
                         self._dparams, self.draft_cache, self.cur,
                         self.lengths, self.keys, self.greedy, self.temp)
-                window, vlg, self.cache = self._p["verify_extend"](
+                window, vlg, self.cache = self._p["verify_extend" + sfx](
                     self._engine.params, self.cache, self.cur, drafts,
                     self.lengths)
-                adv, self.lengths, self.cur = self._p["spec_accept"](
+                adv, self.lengths, self.cur = self._p["spec_accept" + sfx](
                     vlg, drafts, d_probs, round_keys, self.cur,
                     self.lengths, self.greedy, self.temp, self.active)
                 self.keys = next_keys
             self.registry.note_host_sync("serving.tick")
             # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
             return np.asarray(window), np.asarray(adv)
+
+    @hot_path
+    def _paused_tick(self) -> np.ndarray:
+        """One-token ticking while the spec_pause rung is engaged.  The
+        first paused tick FLUSHES the pending token (one decode step
+        writes its K/V and leaves ``_last`` at the frontier); later ones
+        run the plain tick program.  The draft cache is not advanced
+        while paused — rows alive across the pause carry a hole in their
+        draft history that only degrades proposal quality after resume
+        (the accept rule stays exact); rows admitted later prefill a
+        fresh draft cache and are unaffected."""
+        with self.tracer.span(SpanName.SERVE_TICK):
+            if not self._paused:
+                nxt, self._last, self.cache, self.lengths = \
+                    self._p["spec_flush"](
+                        self._engine.params, self.cache, self.cur,
+                        self.lengths, self.active)
+                self._paused = True
+            else:
+                nxt, logits, self.cache, self.lengths, self.keys = \
+                    self._p["tick"](
+                        self._engine.params, self.cache, self.lengths,
+                        self._last, self.keys, self.greedy, self.temp,
+                        self.active)
+                self._last = logits
+            self.registry.note_host_sync("serving.tick")
+            # dslint: disable=host-sync-in-hot-path — one d2h pull per tick
+            return np.asarray(nxt)

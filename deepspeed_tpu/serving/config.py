@@ -96,6 +96,118 @@ class PagingConfig(DeepSpeedConfigModel):
                 f"got {self.hbm_high_watermark}")
 
 
+#: keys a ``"overload"."classes"`` entry may carry
+_PRIORITY_CLASS_KEYS = ("name", "min_priority", "ttft_slo_ms",
+                        "queue_share")
+
+
+@dataclasses.dataclass(frozen=True)
+class PriorityClass:
+    """One admission priority class (``docs/serving.md`` "Overload &
+    admission").  A request belongs to the class with the highest
+    ``min_priority`` not exceeding its priority."""
+
+    name: str
+    #: lowest request priority that lands in this class
+    min_priority: int
+    #: the class's TTFT SLO budget; None = best-effort (never sheds on
+    #: the SLO estimate, only on its queue share)
+    ttft_slo_ms: Optional[float]
+    #: fraction of ``queue_capacity`` this class may fill before its
+    #: submissions shed (1.0 = only the hard queue_full bound applies)
+    queue_share: float
+
+
+@dataclasses.dataclass
+class OverloadConfig(DeepSpeedConfigModel):
+    """The ``"serving"."overload"`` subsection: SLO-driven admission
+    (priority shedding) + the hysteretic degradation ladder."""
+
+    #: turn on the admission controller and degradation ladder
+    enabled: bool = False
+    #: priority classes, highest ``min_priority`` first after sorting;
+    #: None = two defaults (interactive ≥1 w/ 2000ms SLO, batch ≥0
+    #: best-effort at half the queue)
+    classes: Optional[list] = None
+    #: shed on the SLO estimate only past ``est_ttft > factor * slo``
+    shed_slo_factor: float = 1.0
+    #: EWMA smoothing for the queue-wait/prefill/first-token samples
+    #: feeding the TTFT estimate and the dominant-phase attribution
+    ewma_alpha: float = 0.3
+    #: ladder hysteresis: consecutive scheduler iterations above/below
+    #: the pressure watermarks before a rung engages/releases
+    engage_ticks: int = 3
+    release_ticks: int = 6
+    #: queue pressure (depth / queue_capacity) watermarks
+    pressure_high: float = 0.5
+    pressure_low: float = 0.1
+    #: reply-budget cap while the ``max_tokens`` rung is engaged
+    #: (applied to NEW admissions only — accepted requests are never
+    #: dropped, they just finish sooner)
+    max_new_tokens_cap: int = 16
+
+    def __post_init__(self):
+        from ..runtime.config import DeepSpeedConfigError
+        if self.classes is None:
+            self.classes = [
+                {"name": "interactive", "min_priority": 1,
+                 "ttft_slo_ms": 2000.0, "queue_share": 1.0},
+                {"name": "batch", "min_priority": 0,
+                 "ttft_slo_ms": None, "queue_share": 0.5},
+            ]
+        if not isinstance(self.classes, list) or not self.classes:
+            raise DeepSpeedConfigError(
+                "serving.overload.classes must be a non-empty list of "
+                f"class specs with keys {_PRIORITY_CLASS_KEYS}")
+        for spec in self.classes:
+            if not isinstance(spec, dict):
+                raise DeepSpeedConfigError(
+                    "serving.overload.classes entries must be dicts, got "
+                    f"{type(spec).__name__}")
+            unknown = sorted(set(spec) - set(_PRIORITY_CLASS_KEYS))
+            if unknown:
+                raise DeepSpeedConfigError(
+                    f"serving.overload.classes: unknown keys {unknown} "
+                    f"(known: {_PRIORITY_CLASS_KEYS})")
+            share = spec.get("queue_share", 1.0)
+            if not 0.0 < float(share) <= 1.0:
+                raise DeepSpeedConfigError(
+                    "serving.overload.classes queue_share must be in "
+                    f"(0, 1], got {share!r}")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.overload.ewma_alpha must be in (0, 1], got "
+                f"{self.ewma_alpha}")
+        if self.engage_ticks < 1 or self.release_ticks < 1:
+            raise DeepSpeedConfigError(
+                "serving.overload engage_ticks/release_ticks must be "
+                f">= 1, got {self.engage_ticks}/{self.release_ticks}")
+        if not 0.0 <= self.pressure_low < self.pressure_high:
+            raise DeepSpeedConfigError(
+                "serving.overload needs 0 <= pressure_low < "
+                f"pressure_high, got {self.pressure_low}/"
+                f"{self.pressure_high}")
+        if self.max_new_tokens_cap < 1:
+            raise DeepSpeedConfigError(
+                "serving.overload.max_new_tokens_cap must be >= 1, got "
+                f"{self.max_new_tokens_cap}")
+        if self.shed_slo_factor <= 0:
+            raise DeepSpeedConfigError(
+                "serving.overload.shed_slo_factor must be > 0, got "
+                f"{self.shed_slo_factor}")
+
+    def priority_classes(self) -> tuple:
+        """Typed classes, highest ``min_priority`` first."""
+        return tuple(sorted(
+            (PriorityClass(
+                name=str(s["name"]), min_priority=int(s["min_priority"]),
+                ttft_slo_ms=(float(s["ttft_slo_ms"])
+                             if s.get("ttft_slo_ms") is not None else None),
+                queue_share=float(s.get("queue_share", 1.0)))
+             for s in self.classes),
+            key=lambda c: -c.min_priority))
+
+
 #: keys a ``"speculative"."draft"`` geometry spec may carry
 _DRAFT_SPEC_KEYS = ("n_layer", "d_model", "n_head", "seed")
 
@@ -192,17 +304,29 @@ class ServingConfig(DeepSpeedConfigModel):
     eos_token_id: Optional[int] = None
     #: scheduler idle wait between queue polls, seconds
     idle_wait_s: float = 0.02
+    #: compile every serving program (both prefill chunk widths, every
+    #: speculative ladder level) at construction instead of lazily on
+    #: first use — overload robustness: a degradation rung engaging
+    #: mid-storm must never stall the tick loop behind its first XLA
+    #: compile
+    warm_start: bool = False
     #: raw "paging" subsection (typed view: ``paging_config``) — paged
     #: KV blocks + session tiering; see :class:`PagingConfig`
     paging: Optional[Dict] = None
     #: raw "speculative" subsection (typed view: ``speculative_config``) —
     #: batched draft/verify in the tick loop; see :class:`SpeculativeConfig`
     speculative: Optional[Dict] = None
+    #: raw "overload" subsection (typed view: ``overload_config``) —
+    #: SLO-driven admission + degradation ladder; see
+    #: :class:`OverloadConfig`
+    overload: Optional[Dict] = None
 
     paging_config: PagingConfig = dataclasses.field(
         default_factory=PagingConfig)
     speculative_config: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
+    overload_config: OverloadConfig = dataclasses.field(
+        default_factory=OverloadConfig)
 
     def __post_init__(self):
         if isinstance(self.paging, dict):
@@ -210,6 +334,11 @@ class ServingConfig(DeepSpeedConfigModel):
         elif isinstance(self.paging, PagingConfig):
             self.paging_config = self.paging
             self.paging = self.paging_config.to_dict()
+        if isinstance(self.overload, dict):
+            self.overload_config = OverloadConfig.from_dict(self.overload)
+        elif isinstance(self.overload, OverloadConfig):
+            self.overload_config = self.overload
+            self.overload = self.overload_config.to_dict()
         if isinstance(self.speculative, dict):
             self.speculative_config = SpeculativeConfig.from_dict(
                 self.speculative)

@@ -252,6 +252,27 @@ class ServeFleetConfig:
     # decode engines stream load samples (metrics.rank<N>.jsonl) on this
     # cadence — the router's queue-depth/occupancy signal
     metrics_interval_s: float = 0.2
+    # router staleness gate for those samples (0 = derive from the
+    # metrics cadence: 4 intervals + 1s)
+    load_stale_s: float = 0.0
+    # prefill autoscaling: spawn another prefill worker when queue_wait
+    # (not prefill_s) dominates the decomposed TTFT and a backlog is
+    # pending; retire the newest one when the queue drains.  Bounded by
+    # [autoscale_min_prefill, autoscale_max_prefill] and a total budget
+    # of scale actions per run — journaled as serve.fleet.scale either
+    # way, scored like any fleet action.
+    autoscale: bool = False
+    autoscale_min_prefill: int = 1
+    autoscale_max_prefill: int = 4
+    autoscale_interval_s: float = 0.75
+    autoscale_budget: int = 6
+    autoscale_ewma_alpha: float = 0.4
+    # queue-wait EWMA thresholds (seconds): scale up past the first
+    # (when queue_wait also exceeds prefill_s), retire below the second
+    # once the backlog is empty — the hysteresis band keeps a borderline
+    # fleet from thrashing
+    autoscale_up_queue_wait_s: float = 0.3
+    autoscale_down_queue_wait_s: float = 0.1
     # tiny-GPT fixture geometry (every role builds the identical model
     # from the shared seed — what makes cross-process handoff bitwise)
     n_layer: int = 1
@@ -306,6 +327,7 @@ class _Request:
     temperature: float
     seed: int
     t_submit: float                  # wall clock (TTFT anchor)
+    priority: int = 0                # admission-class floor (journal only)
     session: str = ""                # routing key (multi-turn affinity)
     # pending|prefilling|decode_wait|routed|migrating|done|failed
     state: str = "pending"
@@ -414,6 +436,13 @@ class ServeFleetSupervisor:
         self._rolling: Optional[Dict[str, Any]] = None
         self._rolling_done = config.rolling_restart_at_s <= 0
         self._last_rebalance = 0.0
+        # prefill autoscaling state: decomposed-TTFT EWMAs (fed from the
+        # prefill manifests' t_start/prefill_s stamps) + action budget
+        self._qw_ewma: Optional[float] = None    # queue-wait seconds
+        self._pf_ewma: Optional[float] = None    # prefill seconds
+        self._scale_actions = 0
+        self._last_autoscale = 0.0
+        self._retiring: Optional[int] = None     # rank draining to retire
 
     # --------------------------------------------------------------- paths
     def _prefill_inbox(self, rank: int) -> str:
@@ -448,6 +477,9 @@ class ServeFleetSupervisor:
 
     def _engine_stop_path(self, rank: int) -> str:
         return os.path.join(self.spool_dir, f"{STOP_NAME}.decode{rank}")
+
+    def _prefill_stop_path(self, rank: int) -> str:
+        return os.path.join(self.spool_dir, f"{STOP_NAME}.prefill{rank}")
 
     def _sentinel_path(self, w: _Worker) -> str:
         return os.path.join(self.run_dir, f"{w.role}{w.rank}.exit.json")
@@ -508,13 +540,15 @@ class ServeFleetSupervisor:
     # ----------------------------------------------------------- admission
     def submit(self, tokens, max_new_tokens: int = 8, greedy: bool = True,
                temperature: float = 1.0, seed: int = 0,
-               session: Optional[str] = None) -> Optional[str]:
+               session: Optional[str] = None,
+               priority: int = 0) -> Optional[str]:
         """Admit one request into the fleet (or reject loudly when the
         bounded queue is full); returns the request id, or None on
         reject.  ``session`` is the routing key — turns of one
         conversation share it and land on the engine holding its paged
         blocks; it defaults to the request id (every request its own
-        session)."""
+        session).  ``priority`` rides the journal so overload scoring can
+        split SLO attainment by class."""
         import numpy as np
         tokens = np.asarray(tokens, np.int32)
         inflight = sum(1 for r in self.requests.values() if not r.terminal)
@@ -536,12 +570,13 @@ class ServeFleetSupervisor:
         req = _Request(
             rid=rid, tokens=tokens, max_new_tokens=int(max_new_tokens),
             greedy=bool(greedy), temperature=float(temperature),
-            seed=int(seed), t_submit=time.time(),
+            seed=int(seed), t_submit=time.time(), priority=int(priority),
             session=str(session) if session is not None else rid, ctx=ctx)
         self.requests[rid] = req
         self.journal.emit(EventKind.SERVE_REQUEST, request_id=rid,
                           prompt_len=int(tokens.shape[0]),
-                          max_new_tokens=int(max_new_tokens), priority=0,
+                          max_new_tokens=int(max_new_tokens),
+                          priority=int(priority),
                           queue_depth=inflight + 1, session=req.session,
                           t_submit=req.t_submit, trace=ctx.fields())
         return rid
@@ -550,7 +585,7 @@ class ServeFleetSupervisor:
     def _alive_prefill(self, ready_only: bool = True) -> List[_Worker]:
         out = []
         for w in self.workers.values():
-            if w.role != "prefill" or not w.alive:
+            if w.role != "prefill" or not w.alive or w.draining:
                 continue
             if ready_only and w.ready_inc != w.incarnation:
                 continue
@@ -591,9 +626,12 @@ class ServeFleetSupervisor:
         its ``metrics.rank<N>.jsonl`` stream (stale rows ignored)."""
         from .routing import read_engine_loads
         booked = self._booked()
-        rows = read_engine_loads(self.run_dir, self.decode_ranks,
-                                 stale_s=4 * self.config.metrics_interval_s
-                                 + 1.0)
+        stale_s = self.config.load_stale_s or (
+            4 * self.config.metrics_interval_s + 1.0)
+        rows = read_engine_loads(
+            self.run_dir, self.decode_ranks, stale_s=stale_s,
+            incarnations={r: self.workers[r].incarnation
+                          for r in self.decode_ranks})
         loads: Dict[int, float] = {}
         for rank in self.decode_ranks:
             reported = 0.0
@@ -976,6 +1014,120 @@ class ServeFleetSupervisor:
             self._last_rebalance = now
             self._start_migration(movable[0], cold, reason="hot_spot")
 
+    # ----------------------------------------------------------- autoscale
+    def _note_prefill_timing(self, req: _Request,
+                             manifest: Dict[str, Any]) -> None:
+        """Feed the autoscaler's decomposed-TTFT EWMAs from one landed
+        prefill manifest: queue_wait = submit → the worker picking the
+        order up (``t_start``), prefill = the work itself
+        (``prefill_s``) — the two phases whose ratio decides scaling."""
+        try:
+            t_start = float(manifest["t_start"])
+            pf_s = float(manifest["prefill_s"])
+        except (KeyError, TypeError, ValueError):
+            return   # pre-autoscale manifest layout — no sample
+        qw_s = max(0.0, t_start - req.t_submit)
+        a = self.config.autoscale_ewma_alpha
+        self._qw_ewma = qw_s if self._qw_ewma is None \
+            else a * qw_s + (1 - a) * self._qw_ewma
+        self._pf_ewma = pf_s if self._pf_ewma is None \
+            else a * pf_s + (1 - a) * self._pf_ewma
+
+    def _autoscale_retire_step(self) -> None:
+        """Advance an in-flight prefill retirement: wait out the victim's
+        live attempt, stop it orderly via its per-worker stop file, and
+        mark it gone once the process exits (mirrors the rolling-restart
+        drain, without the respawn)."""
+        if self._retiring is None:
+            return
+        w = self.workers.get(self._retiring)
+        if w is None:
+            self._retiring = None
+            return
+        if w.alive:
+            busy = any(not r.terminal and r.state == "prefilling"
+                       and r.worker == w.rank
+                       for r in self.requests.values())
+            if busy:
+                return
+            if not w.planned_stop:
+                from ..runtime.checkpoint_engine.storage import \
+                    atomic_write_text
+                atomic_write_text(self._prefill_stop_path(w.rank), "stop")
+                w.planned_stop = True
+            return
+        try:
+            os.remove(self._prefill_stop_path(w.rank))
+        except OSError:  # dslint: disable=swallowed-exception — crash-during-stop leaves nothing to sweep
+            pass
+        w.planned_stop = False
+        w.respawn_at = None      # a crash mid-retire must not respawn it
+        w.pending_detect_ts = None
+        w.gone = True
+        self._retiring = None
+
+    def _check_autoscale(self) -> None:
+        """Supervisor autoscaling for the prefill tier: spawn another
+        worker when queue_wait (NOT prefill_s) dominates decomposed TTFT
+        with a backlog pending; retire the newest one once the queue
+        drains.  Bounded by the fleet size window and a per-run action
+        budget; every action journals ``serve.fleet.scale``."""
+        cfg = self.config
+        if not cfg.autoscale or self._aborted is not None:
+            return
+        self._autoscale_retire_step()
+        if self._t0 is None or self._retiring is not None:
+            return
+        now = time.monotonic()
+        if now - self._last_autoscale < cfg.autoscale_interval_s \
+                or self._scale_actions >= cfg.autoscale_budget:
+            return
+        if self._qw_ewma is None or self._pf_ewma is None:
+            return   # no decomposed-TTFT sample yet — nothing to act on
+        pool = [w for w in self.workers.values()
+                if w.role == "prefill" and not w.gone]
+        n = len(pool)
+        pending = sum(1 for r in self.requests.values()
+                      if not r.terminal and r.state == "pending"
+                      and not r.local)
+        qw_ms = round(self._qw_ewma * 1000.0, 1)
+        pf_ms = round(self._pf_ewma * 1000.0, 1)
+        if pending > 0 and self._qw_ewma > self._pf_ewma \
+                and self._qw_ewma > cfg.autoscale_up_queue_wait_s \
+                and n < cfg.autoscale_max_prefill:
+            self._last_autoscale = now
+            self._scale_actions += 1
+            rank = max(self.workers) + 1
+            w = _Worker("prefill", rank)
+            self.workers[rank] = w
+            self.prefill_ranks = self.prefill_ranks + (rank,)
+            os.makedirs(self._prefill_inbox(rank), exist_ok=True)
+            self.journal.emit(EventKind.SERVE_FLEET_SCALE, action="up",
+                              role="prefill", worker=rank, n_prefill=n + 1,
+                              reason="queue_wait_dominant",
+                              queue_wait_ms=qw_ms, prefill_ms=pf_ms,
+                              budget=cfg.autoscale_budget
+                              - self._scale_actions,
+                              trace=self.trace.fields())
+            self._spawn(w)
+        elif pending == 0 and n > cfg.autoscale_min_prefill \
+                and self._qw_ewma < cfg.autoscale_down_queue_wait_s:
+            victim = max((w for w in pool if w.alive and not w.draining),
+                         key=lambda w: w.rank, default=None)
+            if victim is None:
+                return
+            self._last_autoscale = now
+            self._scale_actions += 1
+            victim.draining = True
+            self._retiring = victim.rank
+            self.journal.emit(EventKind.SERVE_FLEET_SCALE, action="down",
+                              role="prefill", worker=victim.rank,
+                              n_prefill=n - 1, reason="queue_drained",
+                              queue_wait_ms=qw_ms, prefill_ms=pf_ms,
+                              budget=cfg.autoscale_budget
+                              - self._scale_actions,
+                              trace=self.trace.fields())
+
     def _check_rolling(self) -> None:
         """Rolling-restart state machine: drain one engine (migrating its
         sessions to peers when any are live), stop it orderly via its
@@ -1066,6 +1218,7 @@ class ServeFleetSupervisor:
                 manifest = self._read_json(manifest_path)
                 if manifest is not None and \
                         int(manifest.get("attempt", -1)) == req.attempt:
+                    self._note_prefill_timing(req, manifest)
                     self._route_decode(req, manifest)
                 elif now - req.t_assigned > self.config.prefill_timeout_s:
                     self._retry_prefill(req, reason="timeout")
@@ -1117,6 +1270,7 @@ class ServeFleetSupervisor:
         self._check_heartbeats()
         self._check_ready()
         self._check_respawns()
+        self._check_autoscale()
         self._check_rolling()
         self._check_rebalance()
         self._check_migrations()
@@ -1164,7 +1318,8 @@ class ServeFleetSupervisor:
                                 greedy=it.get("greedy", True),
                                 temperature=it.get("temperature", 1.0),
                                 seed=it.get("seed", 0),
-                                session=it.get("session"))
+                                session=it.get("session"),
+                                priority=it.get("priority", 0))
                     i += 1
                 self.poll()
                 if self._aborted is not None:

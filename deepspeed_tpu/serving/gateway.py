@@ -42,10 +42,11 @@ from ..utils.logging import logger
 from .batcher import PrefixEntry, SlotBatcher
 from .config import ServingConfig
 from .metrics import ServingMetrics
+from .overload import AdmissionController, DegradationLadder, ShedDecision
 from .paging import SessionPager, cache_bank_bytes
 from .request import (QueueFullError, RequestCancelled, RequestFailed,
-                      RequestHandle, RequestState, RequestTimedOut,
-                      ServeRequest)
+                      RequestHandle, RequestShed, RequestState,
+                      RequestTimedOut, ServeRequest)
 
 
 class _PooledPrefix:
@@ -98,9 +99,31 @@ class ServingGateway:
         # compile-discipline gate: serving programs are shape-stable by
         # construction, so each program's FIRST compile is warmup and any
         # later one is a regression — journaled as perf.recompile and
-        # surfaced through metrics.recompiles / snapshot()
+        # surfaced through metrics.recompiles / snapshot().  The
+        # degradation ladder's rungs switch between REGISTERED programs
+        # (wide-chunk / shrunk-draft_k / pause sets), so degrading under
+        # load never trips this gate.
         self._watch = CompileWatch(self._batcher.registry, journal=journal,
                                    first_compile_free=True).open()
+        if config.warm_start:
+            # every serving program (both chunk widths, every spec
+            # ladder level) compiles NOW: an overload burst must never
+            # stall behind a first XLA compile, least of all when a
+            # degradation rung engages mid-storm
+            self._batcher.prewarm()
+        #: overload robustness (docs/serving.md "Overload & admission"):
+        #: SLO-driven admission shedding + the hysteretic degradation
+        #: ladder, both disabled unless serving.overload.enabled
+        self._overload: Optional[AdmissionController] = None
+        self._ladder: Optional[DegradationLadder] = None
+        if config.overload_config.enabled:
+            self._overload = AdmissionController(config.overload_config,
+                                                 config.queue_capacity)
+            rungs = ["max_tokens", "chunk_widen"]
+            if self._spec:
+                rungs += ["draft_k", "spec_pause"]
+            self._ladder = DegradationLadder(config.overload_config,
+                                            available=rungs)
         # RLock: submit() rejects (journal + depth read) while already
         # holding the condition for the queue-capacity check
         self._cond = threading.Condition(threading.RLock())
@@ -194,29 +217,51 @@ class ServingGateway:
             max_new_tokens=n_new, priority=int(priority),
             deadline=(handle.t_submit + deadline_s
                       if deadline_s is not None else None),
-            key=jax.random.fold_in(
-                self._base_key, int(seed) if seed is not None else seq),
+            # the jax key is derived at ADMISSION (scheduler thread): a
+            # shed submission must never pay a device dispatch
+            key=int(seed) if seed is not None else seq,
             greedy=not do_sample, temperature=float(temperature),
             eos_token_id=(eos_token_id if eos_token_id is not None
                           else cfg.eos_token_id),
             handle=handle,
             session_id=str(session_id) if session_id is not None else None)
         self.metrics.count("submitted")
+        decision = None
+        full = False
         with self._cond:
             if self._closed:
                 self._reject(rid, handle, "gateway_closed")
                 raise QueueFullError(f"gateway is shut down ({rid})")
-            if len(self._queue) >= cfg.queue_capacity:
-                self._reject(rid, handle, "queue_full")
-                raise QueueFullError(
-                    f"admission queue full ({cfg.queue_capacity}); "
-                    f"rejected {rid}")
-            heapq.heappush(self._queue, (req.sort_key(), req))
-            self._emit(EventKind.SERVE_REQUEST, request_id=rid,
-                       prompt_len=req.prompt_len, max_new_tokens=n_new,
-                       priority=req.priority, queue_depth=len(self._queue),
-                       t_submit=time.time(), trace=ctx.fields())
-            self._cond.notify_all()
+            if self._overload is not None:
+                # shed BEFORE the heap: the request is never accepted,
+                # so the lost == 0 invariant over accepted requests is
+                # untouched
+                decision = self._overload.should_shed(req.priority,
+                                                      len(self._queue))
+            if decision is None:
+                full = len(self._queue) >= cfg.queue_capacity
+            if decision is None and not full:
+                heapq.heappush(self._queue, (req.sort_key(), req))
+                self._emit(EventKind.SERVE_REQUEST, request_id=rid,
+                           prompt_len=req.prompt_len, max_new_tokens=n_new,
+                           priority=req.priority,
+                           queue_depth=len(self._queue),
+                           t_submit=time.time(), trace=ctx.fields())
+                self._cond.notify_all()
+        if decision is not None:
+            # journal + handle bookkeeping OUTSIDE the scheduler's lock:
+            # under an open-loop storm sheds/rejects are the common case,
+            # and saying no must never contend with the decode loop
+            self._shed(rid, handle, req.priority, decision)
+            raise RequestShed(
+                f"{rid} shed ({decision.reason}, class "
+                f"{decision.cls.name})", reason=decision.reason,
+                cls=decision.cls.name)
+        if full:
+            self._reject(rid, handle, "queue_full")
+            raise QueueFullError(
+                f"admission queue full ({cfg.queue_capacity}); "
+                f"rejected {rid}")
         return handle
 
     def cancel(self, handle: RequestHandle) -> bool:
@@ -267,6 +312,9 @@ class ServingGateway:
                 self.metrics.spec_accept_rate.snapshot()
             out[MetricName.SERVE_SPEC_TOKENS_PER_TICK] = \
                 self.metrics.spec_tokens_per_tick.snapshot()
+        if self._overload is not None:
+            out[MetricName.SERVE_SHED_TOTAL] = snap["shed"]
+            out[MetricName.SERVE_DEGRADE_RUNGS] = snap["degrade_rungs"]
         return out
 
     def _pull_compile_stats(self) -> None:
@@ -331,6 +379,21 @@ class ServingGateway:
         handle._finish(RequestState.REJECTED,
                        error=QueueFullError(f"{rid} rejected: {reason}"))
 
+    def _shed(self, rid: str, handle: RequestHandle, priority: int,
+              d: ShedDecision) -> None:
+        """Journals the decision made under the lock (``d`` carries the
+        depth the check saw); runs lock-free so shed storms cost the
+        scheduler nothing."""
+        self.metrics.count("shed")
+        self.metrics.count("rejected")
+        self._emit(EventKind.SERVE_SHED, request_id=rid,
+                   priority=priority, cls=d.cls.name,
+                   reason=d.reason, phase=d.phase,
+                   est_ttft_ms=round(d.est_ttft_ms, 3), slo_ms=d.slo_ms,
+                   queue_depth=d.queue_depth)
+        handle._finish(RequestState.REJECTED, error=RequestShed(
+            f"{rid} shed: {d.reason}", reason=d.reason, cls=d.cls.name))
+
     def _fail_pending(self, error: Exception) -> None:
         """cond must be held."""
         while self._queue:
@@ -357,6 +420,9 @@ class ServingGateway:
         try:
             while not self._stopped.is_set():
                 self._expire_queued()
+                # the ladder steps every iteration — idle ones included,
+                # which is what lets rungs RELEASE once the burst drains
+                self._overload_step()
                 self._admit_ready()
                 self._sweep_prefixes()
                 if self._active:
@@ -374,6 +440,37 @@ class ServingGateway:
                 self._closed = True
                 self._fail_pending(RequestFailed(f"scheduler loop died: {e}"))
             raise
+
+    def _overload_step(self) -> None:
+        """One degradation-ladder evaluation: queue pressure + the
+        dominant decomposed-TTFT phase pick the rung; each transition is
+        applied to the batcher/admission path and journaled."""
+        if self._ladder is None:
+            return
+        with self._cond:
+            depth = len(self._queue)
+        pressure = depth / max(1, self.config.queue_capacity)
+        phase = self._overload.dominant_phase(depth)
+        for rung, action, level in self._ladder.step(pressure, phase):
+            self._apply_rung(rung)
+            self.metrics.set_value("degrade_rungs", self._ladder.bitmask())
+            self.metrics.count("degrade_transitions")
+            self._emit(EventKind.SERVE_DEGRADE, rung=rung, action=action,
+                       phase=phase, pressure=round(pressure, 4),
+                       dwell_ticks=self._ladder.dwell_ticks[rung],
+                       level=level)
+
+    def _apply_rung(self, rung: str) -> None:
+        """Reconcile the batcher with the ladder's engaged-rung state
+        (the ``max_tokens`` rung needs no batcher change — admissions
+        read it directly)."""
+        eng = self._ladder.engaged
+        if rung in ("draft_k", "spec_pause"):
+            self._batcher.set_spec_level(
+                2 if eng.get("spec_pause") else
+                (1 if eng.get("draft_k") else 0))
+        elif rung == "chunk_widen":
+            self._batcher.set_chunk_wide(bool(eng.get("chunk_widen")))
 
     def _expire_queued(self) -> None:
         now = time.monotonic()
@@ -498,23 +595,42 @@ class ServingGateway:
         elif req.prefix_len > 0:
             # pool disabled: the prefix is just part of the prompt
             prefix = None
+        # degradation: the max_tokens rung caps the reply budget of NEW
+        # admissions only — an accepted request is degraded (it finishes
+        # sooner), never dropped
+        if self._ladder is not None and self._ladder.engaged.get(
+                "max_tokens"):
+            req.max_new_tokens = min(
+                req.max_new_tokens,
+                self.config.overload_config.max_new_tokens_cap)
         # fires between the tier/prefix restore and the slot prefill, so
         # chaos covers the widest admission window (a faulted admission
         # after a readmit must free the re-admitted blocks via the ledger)
         fault_injection.fire("serve.admit", request_id=req.rid, slot=row)
-        req.frontier = self._batcher.admit(row, req.tokens, req.key,
+        t_prefill = time.monotonic()
+        # the per-request PRNG key is derived here, not in submit():
+        # identical fold, identical sampling — but the dispatch runs on
+        # the scheduler thread, once per ACCEPTED request
+        key = jax.random.fold_in(self._base_key, req.key)
+        req.frontier = self._batcher.admit(row, req.tokens, key,
                                            req.greedy, req.temperature,
                                            prefix=prefix)
+        if self._overload is not None:
+            self._overload.note_prefill(
+                (time.monotonic() - t_prefill) * 1e3)
         if req.session_id is not None:
             self._begin_session_row(row, req, readmit, shared_prefix, t0)
         req.handle.t_admit = time.monotonic()
         req.handle.state = RequestState.DECODING
+        queued_ms = round((req.handle.t_admit
+                           - req.handle.t_submit) * 1e3, 3)
         with self._cond:
             self._active[row] = req
+            depth = len(self._queue)
+        if self._overload is not None:
+            self._overload.note_admit(queued_ms, depth)
         self._emit(EventKind.SERVE_ADMIT, request_id=req.rid, slot=row,
-                   queued_ms=round((req.handle.t_admit
-                                    - req.handle.t_submit) * 1e3, 3),
-                   prefix_hit=prefix_hit)
+                   queued_ms=queued_ms, prefix_hit=prefix_hit)
         self.metrics.count("admitted")
 
     def _try_readmit(self, req: ServeRequest):
@@ -599,12 +715,15 @@ class ServingGateway:
     def _decode_tick(self) -> None:
         fault_injection.fire("serve.decode_tick", tick=self._ticks,
                              active=len(self._active))
-        if self._spec:
-            # speculative round: window [B, draft_k+1], counts [B] —
-            # row b emitted window[b, :counts[b]] this tick
-            tokens, counts = self._batcher.tick()
+        # dispatch on the RETURN type, not config: a speculative round is
+        # (window [B, k+1], counts [B]) — row b emitted
+        # window[b, :counts[b]] this tick — while a plain tick (spec off,
+        # or paused by the ladder's spec_pause rung) is a [B] array
+        res = self._batcher.tick()
+        if isinstance(res, tuple):
+            tokens, counts = res
         else:
-            tokens, counts = self._batcher.tick(), None
+            tokens, counts = res, None
         self._ticks += 1
         now = time.monotonic()
         with self._cond:
@@ -637,6 +756,9 @@ class ServingGateway:
                 if h.t_first_token is None:
                     h.t_first_token = now
                     self.metrics.record_ttft(h.ttft_s)
+                    if self._overload is not None:
+                        self._overload.note_first_token(
+                            (now - (h.t_admit or h.t_submit)) * 1e3)
                 if (req.eos_token_id is not None
                         and tok == req.eos_token_id) \
                         or len(req.out) >= req.max_new_tokens:
@@ -658,8 +780,9 @@ class ServingGateway:
                         partial=np.asarray(req.out, np.int32)))
         self.metrics.record_tick(active=n_live, slots=self.config.slots,
                                  tokens=harvested)
+        round_k = self._batcher.round_draft_k
         if counts is not None and n_live:
-            proposed = n_live * self._batcher.draft_k
+            proposed = n_live * max(1, round_k)
             self.metrics.record_spec_round(accepted=accepted,
                                            proposed=proposed,
                                            emitted=harvested)
@@ -673,7 +796,7 @@ class ServingGateway:
                            self.metrics.snapshot()["tokens_per_s"], 3))
             if counts is not None and n_live:
                 self._emit(EventKind.SERVE_SPEC_ROUND, tick=self._ticks,
-                           active=n_live, draft_k=self._batcher.draft_k,
+                           active=n_live, draft_k=round_k,
                            accepted=accepted, emitted=harvested,
                            accept_rate=round(
                                accepted / max(1, proposed), 4))

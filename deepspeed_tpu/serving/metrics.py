@@ -29,6 +29,13 @@ class ServingMetrics:
         self.submitted = 0
         self.admitted = 0
         self.rejected = 0
+        #: submissions shed by the admission controller (each also counts
+        #: as rejected — shed is the overload-policy subset)
+        self.shed = 0
+        #: degradation-ladder rung engage/release transitions
+        self.degrade_transitions = 0
+        #: currently engaged rungs, as the RUNG_BITS bitmask gauge
+        self.degrade_rungs = 0
         self.completed = 0
         self.cancelled = 0
         self.timeouts = 0
@@ -134,6 +141,9 @@ class ServingMetrics:
                 "submitted": self.submitted,
                 "admitted": self.admitted,
                 "rejected": self.rejected,
+                "shed": self.shed,
+                "degrade_transitions": self.degrade_transitions,
+                "degrade_rungs": self.degrade_rungs,
                 "completed": self.completed,
                 "cancelled": self.cancelled,
                 "timeouts": self.timeouts,

@@ -1,0 +1,252 @@
+"""Overload control for the serving gateway: SLO-driven admission
+shedding + the hysteretic degradation ladder.
+
+Both pieces are pure host-side control logic (no jax, no threads) so they
+unit-test in microseconds; the gateway owns the locking and feeds them
+observations from its scheduler loop:
+
+- :class:`AdmissionController` — classifies each ``submit()`` by priority
+  into a :class:`~deepspeed_tpu.serving.config.PriorityClass` and decides
+  *before* the request is enqueued whether it must shed.  Two triggers:
+  the class's deterministic queue share (class ``batch`` at
+  ``queue_share=0.5`` sheds once the queue is half full — cheap traffic
+  gives way long before the hard ``queue_full`` bound), and the SLO
+  estimate (recent queue-wait + first-token EWMAs say the class's TTFT
+  budget cannot be met).  Shedding happens pre-admission, so the
+  ``lost == 0`` invariant over *accepted* requests is untouched.
+- :class:`DegradationLadder` — four quality rungs the gateway trades for
+  latency under sustained pressure, each engaging and releasing with
+  hysteresis (``engage_ticks`` consecutive iterations above
+  ``pressure_high``, ``release_ticks`` below ``pressure_low``).  Rung
+  selection is driven by the dominant phase of the decomposed TTFT
+  (PR 15's ``queue_wait → prefill → decode`` telescope): a prefill-bound
+  gateway widens its chunk, a decode-bound one shrinks ``draft_k`` /
+  pauses speculation, a queue-bound one caps reply budgets so slots
+  recycle sooner.
+
+Docs: ``docs/serving.md`` "Overload & admission".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .config import OverloadConfig, PriorityClass
+
+__all__ = ["AdmissionController", "DegradationLadder", "ShedDecision",
+           "RUNGS", "RUNG_BITS"]
+
+
+#: ladder rungs in fixed escalation order; each is tagged with the TTFT
+#: phase it relieves (the dominant-phase preference reorders within this)
+RUNGS: Tuple[Tuple[str, str], ...] = (
+    ("draft_k", "decode"),       # shrink speculative draft_k
+    ("max_tokens", "queue_wait"),  # cap new admissions' reply budget
+    ("spec_pause", "decode"),    # pause speculative decode entirely
+    ("chunk_widen", "prefill"),  # widen the prefill chunk
+)
+
+#: rung → bit in the ``serve.degrade_rungs`` gauge bitmask
+RUNG_BITS: Dict[str, int] = {name: 1 << i
+                             for i, (name, _) in enumerate(RUNGS)}
+
+
+class ShedDecision:
+    """Why one submission was shed (everything ``serve.shed`` journals)."""
+
+    __slots__ = ("cls", "reason", "phase", "est_ttft_ms", "slo_ms",
+                 "queue_depth")
+
+    def __init__(self, cls: PriorityClass, reason: str, phase: str,
+                 est_ttft_ms: float, slo_ms: Optional[float],
+                 queue_depth: int):
+        self.cls = cls
+        self.reason = reason
+        self.phase = phase
+        self.est_ttft_ms = est_ttft_ms
+        self.slo_ms = slo_ms
+        self.queue_depth = queue_depth
+
+
+class _Ewma:
+    """Exponentially weighted mean; None until the first sample."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float):
+        self.alpha = float(alpha)
+        self.value: Optional[float] = None
+
+    def add(self, x: float) -> None:
+        x = float(x)
+        self.value = x if self.value is None \
+            else self.alpha * x + (1.0 - self.alpha) * self.value
+
+
+class AdmissionController:
+    """Priority-class admission policy (pure; the gateway holds the lock).
+
+    The TTFT estimate for a submission arriving at queue depth ``d`` is
+
+        ``est = queue_wait_ewma * max(1, d / max(1, depth_ewma))
+        + prefill_ewma + first_token_ewma``
+
+    — recent admissions' queue wait, scaled by how much deeper the queue
+    is now than when those admissions were measured (an open-loop burst
+    outruns a lagging EWMA otherwise), plus the prefill and
+    admit→first-token costs the request still has to pay.
+    """
+
+    def __init__(self, cfg: OverloadConfig, queue_capacity: int):
+        self.cfg = cfg
+        self.queue_capacity = int(queue_capacity)
+        self.classes = cfg.priority_classes()
+        a = cfg.ewma_alpha
+        self._queue_wait_ms = _Ewma(a)
+        self._prefill_ms = _Ewma(a)
+        self._first_token_ms = _Ewma(a)
+        self._depth_at_admit = _Ewma(a)
+        #: shed totals by (class name, reason) — the bench/footer ledger
+        self.shed_counts: Dict[Tuple[str, str], int] = {}
+
+    # ------------------------------------------------------- observations
+
+    def note_admit(self, queued_ms: float, depth: int) -> None:
+        self._queue_wait_ms.add(queued_ms)
+        self._depth_at_admit.add(max(1.0, float(depth)))
+
+    def note_prefill(self, prefill_ms: float) -> None:
+        self._prefill_ms.add(prefill_ms)
+
+    def note_first_token(self, decode_ms: float) -> None:
+        self._first_token_ms.add(decode_ms)
+
+    # ------------------------------------------------------------- policy
+
+    def classify(self, priority: int) -> PriorityClass:
+        for cls in self.classes:
+            if priority >= cls.min_priority:
+                return cls
+        return self.classes[-1]
+
+    def est_ttft_ms(self, depth: int) -> float:
+        qw = self._queue_wait_ms.value or 0.0
+        scale = max(1.0, float(depth) / (self._depth_at_admit.value or 1.0))
+        return (qw * scale + (self._prefill_ms.value or 0.0)
+                + (self._first_token_ms.value or 0.0))
+
+    def dominant_phase(self, depth: int = 0) -> str:
+        """Which decomposed-TTFT phase currently costs the most."""
+        phases = {
+            "queue_wait": (self._queue_wait_ms.value or 0.0) * max(
+                1.0, float(depth) / (self._depth_at_admit.value or 1.0)),
+            "prefill": self._prefill_ms.value or 0.0,
+            "decode": self._first_token_ms.value or 0.0,
+        }
+        return max(phases, key=lambda k: (phases[k], k))
+
+    def should_shed(self, priority: int,
+                    depth: int) -> Optional[ShedDecision]:
+        """Shed decision for a submission at the current queue depth, or
+        None to admit.  Called before the request enters the queue."""
+        cls = self.classify(priority)
+        est = self.est_ttft_ms(depth)
+        phase = self.dominant_phase(depth)
+        if depth >= cls.queue_share * self.queue_capacity:
+            return self._count(ShedDecision(
+                cls, "queue_share", phase, est, cls.ttft_slo_ms, depth))
+        if cls.ttft_slo_ms is not None and \
+                est > self.cfg.shed_slo_factor * cls.ttft_slo_ms:
+            return self._count(ShedDecision(
+                cls, "slo", phase, est, cls.ttft_slo_ms, depth))
+        return None
+
+    def _count(self, d: ShedDecision) -> ShedDecision:
+        key = (d.cls.name, d.reason)
+        self.shed_counts[key] = self.shed_counts.get(key, 0) + 1
+        return d
+
+
+class DegradationLadder:
+    """Hysteretic rung state machine (pure; stepped from the scheduler
+    loop every iteration, idle ones included — that is what lets rungs
+    release after the burst drains)."""
+
+    def __init__(self, cfg: OverloadConfig,
+                 available: Optional[List[str]] = None):
+        self.cfg = cfg
+        names = [n for n, _ in RUNGS]
+        if available is not None:
+            unknown = sorted(set(available) - set(names))
+            if unknown:
+                raise ValueError(f"unknown ladder rungs {unknown} "
+                                 f"(known: {names})")
+            names = [n for n in names if n in available]
+        self.rungs = names
+        self.engaged: Dict[str, bool] = {n: False for n in names}
+        self._engage_order: List[str] = []   # most recent last
+        self._above = 0
+        self._below = 0
+        self._tick = 0
+        self._engaged_at: Dict[str, int] = {}
+        #: rung → total ticks spent engaged (dwell ledger for the bench)
+        self.dwell_ticks: Dict[str, int] = {n: 0 for n in names}
+        self.engagements: Dict[str, int] = {n: 0 for n in names}
+        self.releases: Dict[str, int] = {n: 0 for n in names}
+
+    @property
+    def level(self) -> int:
+        return sum(1 for v in self.engaged.values() if v)
+
+    def bitmask(self) -> int:
+        return sum(RUNG_BITS[n] for n, v in self.engaged.items() if v)
+
+    def _pick_engage(self, phase: str) -> Optional[str]:
+        """First disengaged rung relieving the dominant phase, else the
+        first disengaged rung in fixed escalation order."""
+        tags = dict(RUNGS)
+        for n in self.rungs:
+            if not self.engaged[n] and tags[n] == phase:
+                return n
+        for n in self.rungs:
+            if not self.engaged[n]:
+                return n
+        return None
+
+    def step(self, pressure: float,
+             phase: str) -> List[Tuple[str, str, int]]:
+        """Advance one scheduler iteration at the observed queue
+        ``pressure`` (depth / capacity).  Returns the transitions to
+        apply, each ``(rung, "engage"|"release", ladder level after)``
+        — at most one per step, so load swings walk the ladder a rung at
+        a time instead of slamming every lever at once."""
+        self._tick += 1
+        for n, v in self.engaged.items():
+            if v:
+                self.dwell_ticks[n] += 1
+        out: List[Tuple[str, str, int]] = []
+        if pressure >= self.cfg.pressure_high:
+            self._above += 1
+            self._below = 0
+            if self._above >= self.cfg.engage_ticks:
+                rung = self._pick_engage(phase)
+                if rung is not None:
+                    self.engaged[rung] = True
+                    self._engage_order.append(rung)
+                    self._engaged_at[rung] = self._tick
+                    self.engagements[rung] += 1
+                    self._above = 0
+                    out.append((rung, "engage", self.level))
+        elif pressure <= self.cfg.pressure_low:
+            self._below += 1
+            self._above = 0
+            if self._below >= self.cfg.release_ticks and self._engage_order:
+                rung = self._engage_order.pop()   # LIFO: undo newest first
+                self.engaged[rung] = False
+                self.releases[rung] += 1
+                self._below = 0
+                out.append((rung, "release", self.level))
+        else:
+            self._above = 0
+            self._below = 0
+        return out

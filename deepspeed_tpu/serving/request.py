@@ -38,6 +38,19 @@ class QueueFullError(RuntimeError):
     """submit() hit the bounded admission queue (or a closed gateway)."""
 
 
+class RequestShed(QueueFullError):
+    """The admission controller shed this submission before it entered
+    the queue (priority-class queue share exhausted, or the TTFT SLO
+    estimate said the class's budget cannot be met).  Subclasses
+    :class:`QueueFullError` so back-off handlers treat both alike;
+    ``reason``/``cls`` carry the journaled shed decision."""
+
+    def __init__(self, msg: str, reason: str = "", cls: str = ""):
+        super().__init__(msg)
+        self.reason = reason
+        self.cls = cls
+
+
 class RequestCancelled(RuntimeError):
     """The request was cancelled; ``partial`` holds tokens decoded so far."""
 
@@ -137,7 +150,8 @@ class ServeRequest:
     max_new_tokens: int
     priority: int                # higher admits first
     deadline: Optional[float]    # absolute time.monotonic() bound
-    key: Any                     # per-request PRNG key (jax array)
+    key: int                     # PRNG fold seed; the jax key is derived
+                                 # at admission (submit stays dispatch-free)
     greedy: bool
     temperature: float
     eos_token_id: Optional[int]

@@ -136,14 +136,20 @@ class HashRing:
 
 def read_engine_loads(run_dir: str, ranks: Iterable[int],
                       stale_s: float = 3.0,
-                      now: Optional[float] = None) -> Dict[int, Optional[dict]]:
+                      now: Optional[float] = None,
+                      incarnations: Optional[Mapping[int, int]] = None
+                      ) -> Dict[int, Optional[dict]]:
     """Tail each decode engine's ``metrics.rank<N>.jsonl`` stream for its
     latest load sample (``active`` slots, ``free_slots``, ``queue_depth``).
 
-    Returns ``{rank: row-or-None}``; a row older than ``stale_s`` (or a
-    missing/torn stream) reads as ``None`` — the caller falls back to its
-    own booking.  Only the file tail is read, so polling this every
-    supervisor tick stays cheap as streams grow.
+    Returns ``{rank: row-or-None}``; a row older than ``stale_s``, with an
+    unparseable ``ts``, or (when ``incarnations`` maps each rank to its
+    CURRENT incarnation) stamped by an older incarnation — a respawned
+    engine's pre-death sample can be wall-clock fresh yet describe a cache
+    that no longer exists — reads as ``None``: the caller falls back to
+    its own booking.  Missing/torn streams read as ``None`` too.  Only the
+    file tail is read, so polling this every supervisor tick stays cheap
+    as streams grow.
     """
     import time as _time
     now = _time.time() if now is None else float(now)
@@ -168,10 +174,23 @@ def read_engine_loads(run_dir: str, ranks: Iterable[int],
                 row = json.loads(line)
             except ValueError:
                 continue  # torn tail line — try the one before it
-            if isinstance(row, dict) and row.get("ts") is not None:
-                if now - float(row["ts"]) <= stale_s:
-                    out[rank] = row
+            if not isinstance(row, dict) or row.get("ts") is None:
                 break
+            try:
+                age = now - float(row["ts"])
+            except (TypeError, ValueError):
+                continue  # garbage ts — try the row before it
+            if incarnations is not None and rank in incarnations \
+                    and row.get("incarnation") is not None:
+                try:
+                    inc = int(row["incarnation"])
+                except (TypeError, ValueError):
+                    continue
+                if inc < int(incarnations[rank]):
+                    break  # older rows are older incarnations too
+            if age <= stale_s:
+                out[rank] = row
+            break
     return out
 
 

@@ -97,13 +97,14 @@ def _mark_ready(ready_dir: str, role: str, rank: int, inc: int) -> None:
 
 
 def _stop_requested(spool: str, role: str = "", rank: int = -1) -> bool:
-    """Global fleet stop — or, for a decode engine, its per-engine stop
-    file (the rolling-restart drain signal)."""
+    """Global fleet stop — or the worker's per-instance stop file: the
+    rolling-restart drain signal for a decode engine, the autoscale
+    retirement signal for a prefill worker."""
     from deepspeed_tpu.serving.fleet import STOP_NAME
     if os.path.exists(os.path.join(spool, STOP_NAME)):
         return True
-    return role == "decode" and os.path.exists(
-        os.path.join(spool, f"{STOP_NAME}.decode{rank}"))
+    return role in ("decode", "prefill") and os.path.exists(
+        os.path.join(spool, f"{STOP_NAME}.{role}{rank}"))
 
 
 def _scan_orders(inbox: str):
@@ -139,7 +140,7 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str,
                 cfg["incarnation"])
     seen = set()
     chunks_done = 0           # worker-global: KillAtStep lands mid-prefill
-    while not _stop_requested(spool):
+    while not _stop_requested(spool, "prefill", rank):
         worked = False
         for name in _scan_orders(inbox):
             if name in seen:
@@ -172,9 +173,14 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str,
             with tracer.span(SpanName.SERVE_FLEET_PUBLISH, request_id=rid,
                              attempt=attempt, **tfields):
                 banks = _host_banks(cache, frontier)
-                manifest = publish_bundle(bundles_dir, rid, attempt, banks,
-                                          prefix, frontier, worker=rank,
-                                          trace=ctx)
+                # t_start/prefill_s ride the manifest so the supervisor's
+                # autoscaler can decompose TTFT into queue_wait vs prefill
+                # without waiting for the journal to flush
+                manifest = publish_bundle(
+                    bundles_dir, rid, attempt, banks, prefix, frontier,
+                    worker=rank, trace=ctx,
+                    extra={"t_start": t_start,
+                           "prefill_s": round(t_prefilled - t_start, 6)})
             t_published = time.time()
             journal.emit(EventKind.SERVE_FLEET_BUNDLE, request_id=rid,
                          worker=rank, attempt=attempt,

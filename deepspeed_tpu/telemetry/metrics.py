@@ -90,6 +90,11 @@ class MetricName:
     #: histogram of tokens emitted per speculative tick (all live slots;
     #: 1..draft_k+1 each — the tokens/s lever speculation buys)
     SERVE_SPEC_TOKENS_PER_TICK = "serve.spec_tokens_per_tick"
+    #: requests shed by the admission controller (cumulative)
+    SERVE_SHED_TOTAL = "serve.shed_total"
+    #: currently engaged degradation-ladder rungs (bitmask gauge; 0 = the
+    #: gateway is running at full quality)
+    SERVE_DEGRADE_RUNGS = "serve.degrade_rungs"
     #: cumulative bytes the explicit grad-reduce collectives WOULD have
     #: moved at full precision (fp32 payload, both directions)
     COMM_LOGICAL_BYTES = "comm.logical_bytes"

@@ -102,6 +102,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             mean = sum(float(e["accept_rate"]) for e in spec) / len(spec)
             line += f"  spec_accept_rate={mean:.3f}"
         print(line, file=sys.stderr)
+    # overload footer: who was shed and why, how long each degradation
+    # rung was held, and what the autoscaler did — the journal's answer
+    # to "what did the gateway give up to survive the storm"
+    sheds = [e for e in events if e.get("kind") == "serve.shed"]
+    degs = [e for e in events if e.get("kind") == "serve.degrade"]
+    scales = [e for e in events if e.get("kind") == "serve.fleet.scale"]
+    if (sheds or degs or scales) and not args.as_json:
+        parts = []
+        if sheds:
+            by = {}
+            for e in sheds:
+                key = (f"p{e.get('priority', '?')}/{e.get('cls', '?')}"
+                       f"/{e.get('reason', '?')}")
+                by[key] = by.get(key, 0) + 1
+            parts.append("shed=" + ",".join(
+                f"{k}:{by[k]}" for k in sorted(by)))
+        if degs:
+            dwell = {}
+            engaged = {}
+            for e in degs:
+                rung = str(e.get("rung"))
+                if e.get("action") == "engage":
+                    engaged[rung] = engaged.get(rung, 0) + 1
+                else:
+                    dwell[rung] = max(dwell.get(rung, 0),
+                                      int(e.get("dwell_ticks") or 0))
+            parts.append("rungs=" + ",".join(
+                f"{r}:engages={engaged.get(r, 0)}"
+                + (f",max_dwell={dwell[r]}" if r in dwell else "")
+                for r in sorted(set(engaged) | set(dwell))))
+        if scales:
+            ups = sum(1 for e in scales if e.get("action") == "scale_up")
+            downs = len(scales) - ups
+            parts.append(f"autoscale=up:{ups},down:{downs}"
+                         f",n_prefill={scales[-1].get('n_prefill', '?')}")
+        print("overload: " + "  ".join(parts), file=sys.stderr)
     sfleet = [e for e in events
               if str(e.get("kind", "")).startswith("serve.fleet.")]
     if sfleet and not args.as_json:

@@ -193,6 +193,14 @@ _SERVE = [
     ("serve-fleet-goodput", {"JAX_PLATFORMS": "cpu"},
      ["scripts/serve_fleet_bench.py", "--print-json",
       "--out", "/tmp/BENCH_SERVE_FLEET_sweep.json"]),
+    # overload robustness: capacity knee + 3x open-loop storm through
+    # SLO admission / the degradation ladder + prefill autoscale
+    # (overload_bench owns the gate vs the committed BENCH_OVERLOAD.json;
+    # the sweep records knee_rps and the goodput ratio as trajectory)
+    ("serve-overload", {"JAX_PLATFORMS": "cpu"},
+     ["scripts/overload_bench.py", "--print-json",
+      "--out", "/tmp/BENCH_OVERLOAD_sweep.json",
+      "--baseline", "BENCH_OVERLOAD.json"]),
 ]
 
 CONFIG_SETS = {

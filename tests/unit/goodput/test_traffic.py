@@ -1,0 +1,125 @@
+"""Seeded open-loop traffic mixes: byte-identical schedules per seed,
+diurnal bursts that actually burst, heavy-tail prompt lengths, priority
+class mixing, and an open-loop driver that holds its schedule even when
+submissions are rejected."""
+
+import pytest
+
+from deepspeed_tpu.goodput.traffic import (TRAFFIC_MIXES, TrafficMix,
+                                           build_traffic_mix,
+                                           drive_open_loop,
+                                           traffic_mix_names)
+
+
+def test_registry_names_and_validation():
+    names = traffic_mix_names()
+    assert {"steady", "diurnal_burst", "heavy_tail_sessions"} <= set(names)
+    for n in names:
+        mix = build_traffic_mix(n, seed=0)
+        assert isinstance(mix, TrafficMix)
+        mix.validate()
+    with pytest.raises(KeyError):
+        build_traffic_mix("nope", seed=0)
+    with pytest.raises(ValueError):
+        build_traffic_mix("steady", seed=0, rate_hz=-1.0).validate()
+
+
+def test_schedule_is_deterministic_per_seed():
+    a = build_traffic_mix("diurnal_burst", seed=3).arrivals()
+    b = build_traffic_mix("diurnal_burst", seed=3).arrivals()
+    c = build_traffic_mix("diurnal_burst", seed=4).arrivals()
+    assert a == b
+    assert a != c
+    # sorted by arrival time, all inside the window
+    ts = [it["at_s"] for it in a]
+    assert ts == sorted(ts)
+    assert all(0.0 <= t for t in ts)
+    dur = build_traffic_mix("diurnal_burst", seed=3).duration_s
+    assert all(t < dur for t in ts)
+
+
+def test_diurnal_burst_rate_actually_bursts():
+    mix = build_traffic_mix("diurnal_burst", seed=0, duration_s=30.0,
+                            rate_hz=10.0, burst_every_s=10.0,
+                            burst_len_s=2.0, burst_factor=4.0)
+    arr = mix.arrivals()
+    in_burst = [it for it in arr if (it["at_s"] % 10.0) < 2.0]
+    off = [it for it in arr if (it["at_s"] % 10.0) >= 2.0]
+    in_rate = len(in_burst) / (3 * 2.0)          # 3 bursts x 2s
+    off_rate = len(off) / (3 * 8.0)
+    assert in_rate > 2.0 * off_rate, (in_rate, off_rate)
+    assert mix.rate_at(1.0) == pytest.approx(40.0)
+    assert mix.rate_at(5.0) == pytest.approx(10.0)
+
+
+def test_heavy_tail_prompts_and_sessions():
+    mix = build_traffic_mix("heavy_tail_sessions", seed=1,
+                            duration_s=60.0, rate_hz=20.0)
+    arr = mix.arrivals()
+    lens = sorted(len(it["tokens"]) for it in arr)
+    lo, hi = mix.prompt_len
+    assert all(lo <= n <= hi for n in lens)
+    p50 = lens[len(lens) // 2]
+    p99 = lens[int(len(lens) * 0.99)]
+    assert p99 >= 4 * p50, (p50, p99)            # the tail is heavy
+    sessions = {it["session"] for it in arr if it["session"] is not None}
+    assert len(sessions) > 1                     # multi-turn population
+
+
+def test_priority_class_mix_and_deadlines():
+    mix = build_traffic_mix("steady", seed=2, duration_s=30.0,
+                            rate_hz=20.0, interactive_fraction=0.25,
+                            interactive_priority=5, batch_priority=0,
+                            interactive_deadline_s=30.0)
+    arr = mix.arrivals()
+    inter = [it for it in arr if it["cls"] == "interactive"]
+    batch = [it for it in arr if it["cls"] == "batch"]
+    assert inter and batch
+    frac = len(inter) / len(arr)
+    assert 0.1 < frac < 0.45, frac
+    assert all(it["priority"] == 5 and it["deadline_s"] == 30.0
+               for it in inter)
+    assert all(it["priority"] == 0 and it["deadline_s"] is None
+               for it in batch)
+
+
+def test_drive_open_loop_holds_schedule_despite_rejections():
+    """Open-loop means the generator never waits for the server: a shed
+    submission is recorded and the NEXT arrival still fires on time."""
+    mix = build_traffic_mix("steady", seed=0, duration_s=2.0, rate_hz=5.0)
+    arrivals = mix.arrivals()
+    clock = {"t": 0.0}
+    calls = []
+
+    def fake_now():
+        return clock["t"]
+
+    def fake_sleep(dt):
+        clock["t"] += dt
+
+    def submit(it):
+        calls.append(clock["t"])
+        if len(calls) % 2 == 0:
+            raise RuntimeError("shed")
+        return f"h{len(calls)}"
+
+    recs = drive_open_loop(submit, arrivals, now_fn=fake_now,
+                           sleep_fn=fake_sleep)
+    assert len(recs) == len(arrivals) == len(calls)
+    # every submission fired exactly at its scheduled offset
+    for rec, it, t in zip(recs, arrivals, calls):
+        assert t == pytest.approx(it["at_s"])
+        assert rec["t_submit"] == pytest.approx(it["at_s"])
+    # errors are recorded per-arrival, not raised out of the loop
+    assert all(r["handle"] is not None for i, r in enumerate(recs)
+               if (i + 1) % 2 == 1)
+    assert all(isinstance(r["error"], RuntimeError) for i, r in
+               enumerate(recs) if (i + 1) % 2 == 0)
+
+
+def test_mix_registry_is_frozen_dataclass_with_overrides():
+    base = TRAFFIC_MIXES["steady"](seed=0)
+    over = build_traffic_mix("steady", seed=0, rate_hz=base.rate_hz * 2)
+    assert over.rate_hz == base.rate_hz * 2
+    with pytest.raises(Exception):
+        base.rate_hz = 1.0                       # frozen

@@ -132,6 +132,39 @@ def test_read_engine_loads_tail_stale_and_torn(tmp_path):
     assert loads[3] is None              # no stream at all
 
 
+def test_read_engine_loads_garbage_ts_and_stale_incarnation(tmp_path):
+    """Two staleness traps the wall-clock age check alone misses: a row
+    whose ``ts`` doesn't parse (skipped, the row before it is used), and
+    a wall-clock-FRESH row stamped by an older incarnation — a respawned
+    engine's pre-death sample describes a cache that no longer exists, so
+    it must read as None (booking fallback), never as 'least loaded'."""
+    run = str(tmp_path)
+    now = time.time()
+    with open(os.path.join(run, "metrics.rank0.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": now, "rank": 0, "active": 4,
+                            "incarnation": 0}) + "\n")
+        f.write(json.dumps({"ts": "not-a-number", "rank": 0,
+                            "active": 0}) + "\n")
+    # garbage ts on the newest row: fall back to the older good row
+    loads = read_engine_loads(run, [0], stale_s=3.0, now=now)
+    assert loads[0]["active"] == 4
+    # incarnation gate: the same fresh row is from incarnation 0; once
+    # the supervisor knows the engine is on incarnation 1, it's ignored
+    loads = read_engine_loads(run, [0], stale_s=3.0, now=now,
+                              incarnations={0: 1})
+    assert loads[0] is None
+    # ... and a row from the CURRENT incarnation still reads normally
+    loads = read_engine_loads(run, [0], stale_s=3.0, now=now,
+                              incarnations={0: 0})
+    assert loads[0]["active"] == 4
+    # rows without an incarnation stamp are not gated (pre-upgrade streams)
+    with open(os.path.join(run, "metrics.rank1.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": now, "rank": 1, "active": 2}) + "\n")
+    loads = read_engine_loads(run, [1], stale_s=3.0, now=now,
+                              incarnations={1: 5})
+    assert loads[1]["active"] == 2
+
+
 def test_route_marker_supersedes_straggler_orders(tmp_path):
     decode_dir = str(tmp_path / "decode")
     write_route_marker(decode_dir, "req-0", engine=0, d=1)
