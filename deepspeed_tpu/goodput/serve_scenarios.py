@@ -38,6 +38,12 @@ from ..runtime.supervision.events import ABORT_KINDS, EventKind, read_events
 from ..utils import fault_injection
 from .scenarios import ALL_RANKS, FaultSpec
 
+#: fault ``ranks`` value addressing the supervisor process itself (armed
+#: in-process by ``ServeFleetSupervisor.run`` — workers get theirs via
+#: ``DS_FAULT_PLAN``); mirrors ``serving.fleet.SUPERVISOR_RANK`` without
+#: importing the (jax-heavy) serving package at scoring time
+SUPERVISOR_RANK = -1
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeScenario:
@@ -213,8 +219,12 @@ def _burst_past_queue(seed: int) -> ServeScenario:
                     "admission queue must reject the overflow loudly "
                     "(serve.reject) and complete everything it accepted — "
                     "rejects are not goodput losses, lost accepts are",
-        seed=seed, n_requests=10, arrival_rate_hz=8.0,
-        fleet_overrides={"queue_capacity": 3},
+        # the arrival rate must beat the fleet's *streamed* service rate
+        # (the socket transport cut completion latency well under the old
+        # 8 Hz inter-arrival gap, and a queue that never fills proves
+        # nothing about pushback)
+        seed=seed, n_requests=12, arrival_rate_hz=32.0,
+        fleet_overrides={"queue_capacity": 2},
         expect={"min_goodput": 0.99, "max_lost": 0, "max_incidents": 0,
                 "min_rejected": 1,
                 "expect_kinds": (EventKind.SERVE_REJECT,)},
@@ -360,6 +370,48 @@ def _decode_death_during_handoff(seed: int) -> ServeScenario:
     ).validate()
 
 
+def _decode_death_during_stream(seed: int) -> ServeScenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(2)
+    survivor = 1 - victim
+    return ServeScenario(
+        name="decode_death_during_stream",
+        description=f"compound fault on the streamed transport: decode "
+                    f"engine {victim} is SIGKILLed processing its first "
+                    "inbound transport frame — an order + KV bundle "
+                    "mid-stream — so the orphaned order must re-route to "
+                    "the survivor from durable spool state; meanwhile the "
+                    "supervisor's own order channel to the prefill tier "
+                    "suffers injected connection resets: the per-peer "
+                    "circuit breaker must open (transport_degraded → "
+                    "spool fallback carries the order), then the ping "
+                    "auto-probe re-promotes the channel "
+                    "(transport_restored) — zero lost accepted requests",
+        seed=seed, n_decode=2, arrival_rate_hz=4.0,
+        sessions=_craft_sessions(2, (victim, survivor, victim, survivor,
+                                     victim, survivor)),
+        faults=(FaultSpec("serve.transport.recv", "KillAtStep",
+                          {"step": 0}, ranks=(victim,)),
+                # rank -1 = the supervisor process itself: both attempts
+                # of its first prefill order send fail (n=2 = retries+1),
+                # modelling a reset socket under a breaker with no retry
+                # headroom to hide behind
+                FaultSpec("serve.transport.send", "FailNTimes",
+                          {"n": 2, "match": "order:prefill"},
+                          ranks=(SUPERVISOR_RANK,)),),
+        fleet_overrides={"route_policy": "ring",
+                         "transport": {"failures_to_open": 1,
+                                       "retries": 1}},
+        expect={"min_goodput": 0.99, "max_lost": 0, "max_mttr_s": 180.0,
+                "expect_kinds": (
+                    EventKind.SERVE_FLEET_WORKER_LOST,
+                    EventKind.SERVE_FLEET_RESTART,
+                    EventKind.SERVE_FLEET_REQUEUE,
+                    EventKind.SERVE_FLEET_TRANSPORT_DEGRADED,
+                    EventKind.SERVE_FLEET_TRANSPORT_RESTORED)},
+    ).validate()
+
+
 def _fault_storm_burst(seed: int) -> ServeScenario:
     rng = random.Random(seed)
     victim = rng.randrange(2)
@@ -432,6 +484,7 @@ SERVE_SCENARIOS = {
     "hot_spot_rebalance": _hot_spot_rebalance,
     "rolling_restart_drain": _rolling_restart_drain,
     "decode_death_during_handoff": _decode_death_during_handoff,
+    "decode_death_during_stream": _decode_death_during_stream,
     "fault_storm_burst": _fault_storm_burst,
     "prefill_autoscale_burst": _prefill_autoscale_burst,
 }
@@ -554,6 +607,10 @@ def score_serve_events(events: List[dict], *,
         "drained_sessions": sum(int(e.get("sessions") or 0)
                                 for e in by_kind(EventKind.SERVE_FLEET_DRAIN)),
         "restarts": len(by_kind(EventKind.SERVE_FLEET_RESTART)),
+        "transport_degraded": len(by_kind(
+            EventKind.SERVE_FLEET_TRANSPORT_DEGRADED)),
+        "transport_restored": len(by_kind(
+            EventKind.SERVE_FLEET_TRANSPORT_RESTORED)),
         "scale_ups": sum(1 for e in scales if e.get("action") == "up"),
         "scale_downs": sum(1 for e in scales if e.get("action") == "down"),
         "shed": len(sheds),
@@ -639,7 +696,8 @@ def trace_report(run_dir: str,
     per-engine steady-state recompile counts (``decode.stats.r<N>.json``
     ``now`` minus ``warm`` — must be zero on every engine once warm)."""
     import glob as _glob
-    from ..telemetry.critical_path import (span_chain_coverage,
+    from ..telemetry.critical_path import (decompose_migrations,
+                                           span_chain_coverage,
                                            summarize_ttft)
     if events is None:
         events = read_events(os.path.join(run_dir, "events.jsonl"))
@@ -647,6 +705,27 @@ def trace_report(run_dir: str,
         "chain": span_chain_coverage(events),
         "ttft": summarize_ttft(events),
     }
+    # live-migration phase latencies, split by KV delivery path — the
+    # bench's evidence that streamed bundles beat spool-poll pickup
+    migs = [m for m in decompose_migrations(events) if m.get("phases")]
+    if migs:
+        by_via: Dict[str, List[float]] = {}
+        for m in migs:
+            by_via.setdefault(str(m.get("via") or "spool"), []).append(
+                float(m["phases"]["transfer_ms"]))
+        xfers = [t for ts in by_via.values() for t in ts]
+        block["migrations"] = {
+            "n": len(migs),
+            "transfer_ms": {
+                "mean": round(sum(xfers) / len(xfers), 3),
+                "max": round(max(xfers), 3)},
+            "transfer_ms_by_via": {
+                v: {"n": len(ts),
+                    "mean": round(sum(ts) / len(ts), 3)}
+                for v, ts in sorted(by_via.items())},
+        }
+    else:
+        block["migrations"] = None
     per_engine: Dict[str, int] = {}
     for path in sorted(_glob.glob(
             os.path.join(run_dir, "decode.stats.r*.json"))):

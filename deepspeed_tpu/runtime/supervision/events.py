@@ -95,6 +95,8 @@ class EventKind:
     SERVE_FLEET_MIGRATE_REJECT = "serve.fleet.migrate_reject"
     SERVE_FLEET_DRAIN = "serve.fleet.drain"
     SERVE_FLEET_SCALE = "serve.fleet.scale"
+    SERVE_FLEET_TRANSPORT_DEGRADED = "serve.fleet.transport_degraded"
+    SERVE_FLEET_TRANSPORT_RESTORED = "serve.fleet.transport_restored"
     SERVE_FLEET_DONE = "serve.fleet.done"
     SERVE_FLEET_ABORT = "serve.fleet.abort"
     PERF_RECOMPILE = "perf.recompile"
@@ -199,7 +201,7 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_FLEET_BUNDLE: ("request_id", "worker", "attempt",
                                    "prefix_len", "nbytes"),
     EventKind.SERVE_FLEET_BUNDLE_REJECT: ("request_id", "worker", "attempt",
-                                          "reason"),
+                                          "reason", "frame"),
     EventKind.SERVE_FLEET_MIGRATE: ("request_id", "from_worker", "to_worker",
                                     "mig", "state", "nbytes", "reason"),
     EventKind.SERVE_FLEET_MIGRATE_REJECT: ("request_id", "worker", "mig",
@@ -208,6 +210,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.SERVE_FLEET_SCALE: ("action", "role", "worker", "n_prefill",
                                   "reason", "queue_wait_ms", "prefill_ms",
                                   "budget"),
+    EventKind.SERVE_FLEET_TRANSPORT_DEGRADED: ("peer", "flow", "failures",
+                                               "reason"),
+    EventKind.SERVE_FLEET_TRANSPORT_RESTORED: ("peer", "flow", "open_s"),
     EventKind.SERVE_FLEET_DONE: ("accepted", "completed", "rejected", "lost",
                                  "wall_s"),
     EventKind.SERVE_FLEET_ABORT: ("reason", "role", "restarts"),

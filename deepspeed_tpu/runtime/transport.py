@@ -1,0 +1,730 @@
+"""Streamed fleet transport: framed TCP channels for orders, results and
+KV-page bundles, with verified integrity and filesystem-spool fallback.
+
+The serving fleet's three flows — orders (supervisor → worker), page /
+migration bundles (prefill → decode, decode → decode), results (worker →
+supervisor) — historically rode the shared filesystem spool alone: every
+hop was an atomic file write on one side and a poll-loop ``listdir`` on
+the other.  Durable and crash-visible, but each hop pays a poll interval,
+and the migration critical path (park → transfer → verify → readmit) pays
+several.  This module adds the network fast path **without changing the
+durability story**: the spool file is always written first, then the same
+document is pushed over a socket so the receiver acts on it immediately
+instead of waiting to discover the file.  A frame is therefore an
+*accelerator*, never the record of truth — any frame may be dropped,
+torn, or rejected and the run still completes from the spool alone.
+
+Frame format (all integers big-endian)::
+
+    magic    4 B   b"DSTP"
+    version  1 B   FRAME_VERSION
+    flags    1 B   reserved, must be 0
+    hlen     4 B   header length in bytes
+    blen     8 B   blob length in bytes
+    digest  32 B   SHA-256 over header-bytes + blob-bytes
+    header   hlen  UTF-8 JSON object; carries "flow" plus the flow's doc
+    blob     blen  optional binary payload (the bundle ``.npz`` bytes)
+
+Integrity contract: the digest covers everything after the preamble, so a
+torn, truncated, or bit-flipped frame is detected before the header is
+even parsed; a bad frame closes the connection (stream framing cannot be
+trusted past a corrupt length) and counts a reject — the spool copy is
+authoritative, so rejection costs latency, never data.  Bundle frames
+additionally carry the manifest ``sha256`` and the receiver re-verifies
+the blob against it before materializing the ``.npz`` (tmp + ``os.replace``
+— this module is in dslint ``non-atomic-write`` scope), which preserves
+the exact bundle-manifest integrity contract of the spool path.
+
+Degradation: each ``(peer, flow)`` pair has a circuit breaker.  Sends
+retry with exponential backoff + jitter under a deadline; enough
+consecutive failures open the breaker (journaled
+``serve.fleet.transport_degraded``) and that flow silently rides the
+spool alone until a periodic ping probe succeeds and closes it again
+(journaled ``serve.fleet.transport_restored``).  A dead socket therefore
+never loses an accepted request — it only restores the old latency.
+
+Fault points: ``serve.transport.send`` fires per send attempt (ctx:
+``step`` = attempt counter, ``path`` = ``"<flow>:<peer>"``) and
+``serve.transport.recv`` per received frame (ctx: ``step`` = frame
+counter, ``path`` = flow) — ``KillAtStep`` mid-stream, ``FailNTimes`` for
+connection resets, ``DelaySeconds``/``HangFor`` for stalls.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import json
+import os
+import random
+import select
+import socket
+import struct
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from ..utils import fault_injection
+
+MAGIC = b"DSTP"
+FRAME_VERSION = 1
+#: the three fleet flows plus the breaker's probe channel
+FLOWS = ("order", "bundle", "result", "ping")
+#: refuse absurd lengths before allocating buffers for them
+MAX_HEADER_BYTES = 1 << 20
+MAX_BLOB_BYTES = 256 << 20
+_PREAMBLE = struct.Struct(">4sBBIQ32s")  # magic ver flags hlen blen digest
+
+
+class TransportError(Exception):
+    """A send could not be completed within its retry/deadline budget."""
+
+
+class FrameError(ValueError):
+    """An inbound byte stream failed frame validation.
+
+    ``reason`` is one of ``bad_magic`` / ``bad_version`` / ``bad_flags`` /
+    ``oversize`` / ``truncated`` / ``digest_mismatch`` / ``bad_header`` /
+    ``bad_flow`` — the value journaled/counted as the frame-reject cause.
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+class Frame:
+    """One decoded transport frame: ``flow`` + JSON ``header`` + ``blob``."""
+
+    __slots__ = ("flow", "header", "blob")
+
+    def __init__(self, flow: str, header: Dict[str, Any], blob: bytes = b""):
+        self.flow = flow
+        self.header = header
+        self.blob = blob
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Frame(flow={self.flow!r}, header={self.header!r}, "
+                f"blob={len(self.blob)}B)")
+
+
+def encode_frame(flow: str, header: Mapping[str, Any],
+                 blob: bytes = b"") -> bytes:
+    """Serialize one frame.  ``header`` must be JSON-native; ``flow`` is
+    stamped into it so the wire form is self-describing."""
+    if flow not in FLOWS:
+        raise ValueError(f"unknown transport flow {flow!r} "
+                         f"(registered: {FLOWS})")
+    doc = dict(header)
+    doc["flow"] = flow
+    hbytes = json.dumps(doc, sort_keys=True).encode("utf-8")
+    digest = hashlib.sha256(hbytes + blob).digest()
+    return _PREAMBLE.pack(MAGIC, FRAME_VERSION, 0, len(hbytes),
+                          len(blob), digest) + hbytes + blob
+
+
+def decode_frames(buf: bytearray) -> List[Frame]:
+    """Consume every complete frame at the head of ``buf`` (in place).
+
+    Returns the decoded frames; leftover bytes (a frame still in flight)
+    stay in ``buf``.  Raises :class:`FrameError` on the first invalid
+    frame — the caller must drop the connection, because a stream whose
+    framing lied once cannot be resynchronized.
+    """
+    frames: List[Frame] = []
+    while True:
+        if len(buf) < _PREAMBLE.size:
+            return frames
+        magic, ver, flags, hlen, blen, digest = _PREAMBLE.unpack_from(buf)
+        if magic != MAGIC:
+            raise FrameError("bad_magic", magic.hex())
+        if ver != FRAME_VERSION:
+            raise FrameError("bad_version", str(ver))
+        if flags != 0:
+            raise FrameError("bad_flags", str(flags))
+        if hlen > MAX_HEADER_BYTES or blen > MAX_BLOB_BYTES:
+            raise FrameError("oversize", f"hlen={hlen} blen={blen}")
+        total = _PREAMBLE.size + hlen + blen
+        if len(buf) < total:
+            return frames
+        payload = bytes(buf[_PREAMBLE.size:total])
+        del buf[:total]
+        if hashlib.sha256(payload).digest() != digest:
+            raise FrameError("digest_mismatch")
+        try:
+            header = json.loads(payload[:hlen].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise FrameError("bad_header", str(e))
+        if not isinstance(header, dict):
+            raise FrameError("bad_header", "header is not an object")
+        flow = header.get("flow")
+        if flow not in FLOWS:
+            raise FrameError("bad_flow", repr(flow))
+        frames.append(Frame(flow, header, payload[hlen:]))
+
+
+# --------------------------------------------------------------------------
+# server
+
+
+class TransportServer:
+    """Listening end of a transport endpoint.
+
+    Non-blocking: :meth:`poll` drains whatever complete frames have
+    arrived across all connections; :meth:`wait` select-sleeps until
+    traffic (or timeout) so callers replace fixed-interval poll sleeps
+    with event-driven wakeups — that substitution, not the socket itself,
+    is where the migration transfer phase gets its latency back.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 on_reject: Optional[Callable[[str, str], None]] = None):
+        self._on_reject = on_reject
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._sock.setblocking(False)
+        self._conns: Dict[socket.socket, bytearray] = {}
+        self._recv_count = 0
+        self.frame_rejects = 0
+        self.bytes_received = 0
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._sock.getsockname()[:2]
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def _reject(self, reason: str, conn: socket.socket) -> None:
+        self.frame_rejects += 1
+        try:  # best-effort label: the conn may already be dead (EOF path)
+            source = "%s:%s" % conn.getpeername()[:2]
+        except OSError:
+            source = "?"
+        self._drop(conn)
+        if self._on_reject is not None:
+            self._on_reject(reason, source)
+
+    def _drop(self, conn: socket.socket) -> None:
+        self._conns.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:  # dslint: disable=swallowed-exception — socket may already be dead; dropping is the goal
+            pass
+
+    def wait(self, timeout: float) -> bool:
+        """Sleep until inbound traffic is ready or ``timeout`` elapses.
+        Returns True when something is readable."""
+        if timeout <= 0:
+            return False
+        try:
+            ready, _, _ = select.select(
+                [self._sock, *self._conns], [], [], timeout)
+        except OSError:
+            return False
+        return bool(ready)
+
+    def poll(self, timeout: float = 0.0) -> List[Frame]:
+        """Accept pending connections and drain complete frames."""
+        if timeout > 0:
+            self.wait(timeout)
+        while True:  # accept everything queued
+            try:
+                conn, _ = self._sock.accept()
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break
+            conn.setblocking(False)
+            self._conns[conn] = bytearray()
+        frames: List[Frame] = []
+        for conn in list(self._conns):
+            buf = self._conns[conn]
+            eof = False
+            while True:
+                try:
+                    chunk = conn.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    eof = True
+                    break
+                if not chunk:
+                    eof = True
+                    break
+                self.bytes_received += len(chunk)
+                buf.extend(chunk)
+            try:
+                got = decode_frames(buf)
+            except FrameError as e:
+                self._reject(e.reason, conn)
+                continue
+            for fr in got:
+                # step is 0-based like every other fault point: step=0
+                # lands on the endpoint's first received frame
+                fault_injection.fire("serve.transport.recv",
+                                     step=self._recv_count, path=fr.flow)
+                self._recv_count += 1
+                frames.append(fr)
+            if eof:
+                if buf:  # connection died mid-frame: a torn frame
+                    self._reject("truncated", conn)
+                else:
+                    self._drop(conn)
+        return frames
+
+    def close(self) -> None:
+        for conn in list(self._conns):
+            self._drop(conn)
+        try:
+            self._sock.close()
+        except OSError:  # dslint: disable=swallowed-exception — shutdown path; the listener is gone either way
+            pass
+
+
+# --------------------------------------------------------------------------
+# client
+
+
+class TransportClient:
+    """Sending end of one peer channel: persistent connection, connect/send
+    retry with exponential backoff + deterministic jitter, deadline-bounded.
+
+    ``resolve`` maps to the peer's current ``(host, port)`` — re-invoked on
+    every (re)connect so a respawned worker's new ephemeral port is picked
+    up without coordination.  Returning ``None`` means the peer is not
+    announcing yet; that attempt fails fast.
+    """
+
+    def __init__(self, resolve: Callable[[], Optional[Tuple[str, int]]], *,
+                 connect_timeout_s: float = 1.0, send_timeout_s: float = 2.0,
+                 retries: int = 2, backoff_s: float = 0.02,
+                 jitter: float = 0.25, seed: int = 0, name: str = "peer"):
+        self._resolve = resolve
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.send_timeout_s = float(send_timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.jitter = float(jitter)
+        self.name = name
+        self._rng = random.Random(seed)
+        self._sock: Optional[socket.socket] = None
+        self._ever_connected = False
+        self._send_count = 0
+        self.reconnects = 0
+        self.bytes_sent = 0
+        self.frames_sent = 0
+
+    def backoff_schedule(self) -> List[float]:
+        """The nominal (jitter-free) sleep before each retry attempt."""
+        return [self.backoff_s * (2 ** i) for i in range(self.retries)]
+
+    def _connect(self) -> socket.socket:
+        addr = self._resolve()
+        if addr is None:
+            raise TransportError(f"{self.name}: peer address unknown")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self.connect_timeout_s)
+        try:
+            sock.connect(tuple(addr))
+        except OSError as e:
+            sock.close()
+            raise TransportError(f"{self.name}: connect {addr} failed: {e}")
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ever_connected:
+            self.reconnects += 1
+        self._ever_connected = True
+        return sock
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # dslint: disable=swallowed-exception — closing a dead peer connection; nothing to salvage
+                pass
+            self._sock = None
+
+    def _peer_hung_up(self) -> bool:
+        """Half-open detection: channels are one-directional (the receiver
+        never writes back), so a cached connection turning readable means
+        FIN/RST — without this check the first ``sendall`` after a peer
+        dies succeeds silently into a dead socket and the frame is lost
+        with no failure for the circuit breaker to count."""
+        if self._sock is None:
+            return False
+        try:
+            r, _, _ = select.select([self._sock], [], [], 0.0)
+            if not r:
+                return False
+            return not self._sock.recv(1 << 12)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except (OSError, ValueError):
+            return True
+
+    def send(self, flow: str, header: Mapping[str, Any],
+             blob: bytes = b"") -> int:
+        """Deliver one frame; returns bytes written.  Retries per policy;
+        raises :class:`TransportError` once the budget is spent."""
+        data = encode_frame(flow, header, blob)
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                delay = self.backoff_s * (2 ** (attempt - 1))
+                delay *= 1.0 + self.jitter * self._rng.random()
+                time.sleep(delay)
+            step = self._send_count
+            self._send_count += 1
+            try:
+                fault_injection.fire("serve.transport.send",
+                                     step=step,
+                                     path=f"{flow}:{self.name}")
+                if self._peer_hung_up():
+                    self._close()
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._sock.settimeout(self.send_timeout_s)
+                self._sock.sendall(data)
+                self.bytes_sent += len(data)
+                self.frames_sent += 1
+                return len(data)
+            except (TransportError, OSError) as e:
+                self._close()
+                last = e
+        raise TransportError(
+            f"{self.name}: send({flow}) failed after "
+            f"{self.retries + 1} attempt(s): {last}")
+
+    def close(self) -> None:
+        self._close()
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+
+
+class CircuitBreaker:
+    """Per-(peer, flow) failure gate: CLOSED → OPEN after
+    ``failures_to_open`` consecutive failures; OPEN admits one probe per
+    ``probe_interval_s`` (HALF_OPEN); a success in any state closes it.
+
+    :meth:`record_success` / :meth:`record_failure` return the transition
+    (``"opened"`` / ``"closed"`` / ``None``) so the owner can journal
+    degradation exactly once per episode.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failures_to_open: int = 3,
+                 probe_interval_s: float = 0.5,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failures_to_open = max(1, int(failures_to_open))
+        self.probe_interval_s = float(probe_interval_s)
+        self._clock = clock
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+        self._last_probe: Optional[float] = None
+
+    def allow(self) -> bool:
+        """May a send be attempted right now?"""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.HALF_OPEN:
+            return False  # one probe already in flight
+        now = self._clock()
+        ref = self._last_probe if self._last_probe is not None \
+            else self.opened_at
+        if ref is None or now - ref >= self.probe_interval_s:
+            self.state = self.HALF_OPEN
+            self._last_probe = now
+            return True
+        return False
+
+    def probe_due(self) -> bool:
+        """OPEN and the probe interval has elapsed (drives auto-probe)."""
+        if self.state != self.OPEN:
+            return False
+        ref = self._last_probe if self._last_probe is not None \
+            else self.opened_at
+        return ref is None or self._clock() - ref >= self.probe_interval_s
+
+    def record_success(self) -> Optional[str]:
+        was_open = self.state != self.CLOSED
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = None
+        self._last_probe = None
+        return "closed" if was_open else None
+
+    def record_failure(self) -> Optional[str]:
+        if self.state == self.HALF_OPEN:
+            self.state = self.OPEN  # failed probe: stay dark
+            self._last_probe = self._clock()
+            return None
+        self.failures += 1
+        if self.state == self.CLOSED \
+                and self.failures >= self.failures_to_open:
+            self.state = self.OPEN
+            self.opened_at = self._clock()
+            self._last_probe = None
+            return "opened"
+        return None
+
+    def open_for_s(self) -> float:
+        if self.opened_at is None:
+            return 0.0
+        return max(0.0, self._clock() - self.opened_at)
+
+
+# --------------------------------------------------------------------------
+# fleet endpoint
+
+
+def endpoint_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, "spool", "transport")
+
+
+def endpoint_path(run_dir: str, role: str, rank: int) -> str:
+    return os.path.join(endpoint_dir(run_dir), f"{role}{rank}.json")
+
+
+def read_endpoint(run_dir: str, role: str,
+                  rank: int) -> Optional[Tuple[str, int]]:
+    """Resolve a peer's announced address; None while it isn't listening
+    (not spawned yet, or transport disabled on its side)."""
+    try:
+        with open(endpoint_path(run_dir, role, rank)) as f:
+            doc = json.load(f)
+        return str(doc["host"]), int(doc["port"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+class FleetTransport:
+    """One process's endpoint of the fleet transport: a server for inbound
+    frames, per-(peer, flow) clients + breakers for outbound, and the
+    bookkeeping (stats, journal hooks, endpoint announcement) the serving
+    integration shares between supervisor and workers.
+
+    ``journal``/``trace`` wire the breaker transitions to
+    ``serve.fleet.transport_degraded`` / ``transport_restored`` journal
+    rows; both are optional so the class stays usable in unit tests.
+    """
+
+    def __init__(self, cfg: Mapping[str, Any], run_dir: str, role: str,
+                 rank: int, journal=None, trace: Optional[dict] = None,
+                 host: str = "127.0.0.1"):
+        self.cfg = dict(cfg)
+        self.run_dir = run_dir
+        self.role = role
+        self.rank = int(rank)
+        self.journal = journal
+        self.trace = trace
+        port = 0
+        base = int(self.cfg.get("port_base", 0) or 0)
+        if base > 0:
+            # deterministic layout: supervisor at base, workers stacked
+            # above it by a stable role offset
+            port = base if role == "sup" \
+                else base + 1 + self.rank + (0 if role == "prefill" else 64)
+        self.server = TransportServer(host=host, port=port,
+                                      on_reject=self._note_reject)
+        self._clients: Dict[Tuple[str, str], TransportClient] = {}
+        self._breakers: Dict[Tuple[str, str], CircuitBreaker] = {}
+        self.fallbacks = 0          # sends skipped/failed onto the spool
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+        self.rejects_by_reason: Dict[str, int] = {}
+        self.bytes_by_flow: Dict[str, int] = {f: 0 for f in FLOWS}
+        self._announce()
+
+    # -- endpoint announcement -------------------------------------------
+    def _announce(self) -> None:
+        path = endpoint_path(self.run_dir, self.role, self.rank)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {"host": self.server.address[0], "port": self.server.port,
+               "role": self.role, "rank": self.rank, "pid": os.getpid()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    # -- outbound ---------------------------------------------------------
+    def _peer_key(self, peer_role: str, peer_rank: int) -> str:
+        return f"{peer_role}{peer_rank}"
+
+    def _client(self, peer: str, peer_role: str, peer_rank: int,
+                flow: str) -> TransportClient:
+        key = (peer, flow)
+        if key not in self._clients:
+            self._clients[key] = TransportClient(
+                lambda: read_endpoint(self.run_dir, peer_role, peer_rank),
+                connect_timeout_s=float(
+                    self.cfg.get("connect_timeout_s", 1.0)),
+                send_timeout_s=float(self.cfg.get("send_timeout_s", 2.0)),
+                retries=int(self.cfg.get("retries", 2)),
+                backoff_s=float(self.cfg.get("backoff_s", 0.02)),
+                jitter=float(self.cfg.get("backoff_jitter", 0.25)),
+                seed=hash((peer, flow)) & 0xFFFF,
+                name=f"{peer}/{flow}")
+        return self._clients[key]
+
+    def _breaker(self, peer: str, flow: str) -> CircuitBreaker:
+        key = (peer, flow)
+        if key not in self._breakers:
+            self._breakers[key] = CircuitBreaker(
+                failures_to_open=int(self.cfg.get("failures_to_open", 3)),
+                probe_interval_s=float(
+                    self.cfg.get("probe_interval_s", 0.5)))
+        return self._breakers[key]
+
+    def send(self, flow: str, peer_role: str, peer_rank: int,
+             header: Mapping[str, Any], blob: bytes = b"") -> bool:
+        """Best-effort push of one frame.  False means the spool is the
+        only carrier for this hop — never an error, by design."""
+        peer = self._peer_key(peer_role, peer_rank)
+        breaker = self._breaker(peer, flow)
+        if not breaker.allow():
+            self.fallbacks += 1
+            return False
+        client = self._client(peer, peer_role, peer_rank, flow)
+        try:
+            n = client.send(flow, header, blob)
+        except TransportError:
+            self.fallbacks += 1
+            if breaker.record_failure() == "opened":
+                self.breaker_opens += 1
+                self._journal_degraded(peer, flow, breaker)
+            return False
+        self.bytes_by_flow[flow] = self.bytes_by_flow.get(flow, 0) + n
+        if breaker.record_success() == "closed":
+            self.breaker_closes += 1
+            self._journal_restored(peer, flow, breaker)
+        return True
+
+    def forget_peer(self, peer_role: str, peer_rank: int) -> None:
+        """Drop cached connections to a peer known to be dead (it will
+        re-announce a fresh port on respawn)."""
+        peer = self._peer_key(peer_role, peer_rank)
+        for (p, flow), client in list(self._clients.items()):
+            if p == peer:
+                client.close()
+
+    def tick(self, peers: List[Tuple[str, int]]) -> None:
+        """Auto-probe: ping every open breaker whose probe is due so a
+        recovered peer is re-promoted without waiting for real traffic."""
+        for peer_role, peer_rank in peers:
+            peer = self._peer_key(peer_role, peer_rank)
+            for flow in ("order", "bundle", "result"):
+                key = (peer, flow)
+                breaker = self._breakers.get(key)
+                if breaker is None or not breaker.probe_due():
+                    continue
+                if not breaker.allow():
+                    continue
+                client = self._client(peer, peer_role, peer_rank, flow)
+                try:
+                    n = client.send("ping", {"from": f"{self.role}"
+                                                     f"{self.rank}"})
+                except TransportError:
+                    breaker.record_failure()
+                    continue
+                self.bytes_by_flow["ping"] += n
+                if breaker.record_success() == "closed":
+                    self.breaker_closes += 1
+                    self._journal_restored(peer, flow, breaker)
+
+    # -- inbound ----------------------------------------------------------
+    def poll(self, timeout: float = 0.0) -> List[Frame]:
+        return [fr for fr in self.server.poll(timeout)
+                if fr.flow != "ping"]
+
+    def wait(self, timeout: float) -> bool:
+        return self.server.wait(timeout)
+
+    def _note_reject(self, reason: str, source: str) -> None:
+        self.rejects_by_reason[reason] = \
+            self.rejects_by_reason.get(reason, 0) + 1
+
+    # -- bundle materialization ------------------------------------------
+    def store_bundle_blob(self, npz_path: str, blob: bytes,
+                          sha256: str) -> bool:
+        """Materialize a streamed bundle blob at its spool path if it is
+        not already there, verifying the manifest digest first — the same
+        integrity gate the filesystem path enforces at admission.  Returns
+        False (and writes nothing) on digest mismatch."""
+        if hashlib.sha256(blob).hexdigest() != sha256:
+            self._note_reject("digest_mismatch", npz_path)
+            return False
+        if os.path.exists(npz_path):
+            return True  # shared-spool deployment: publisher's copy wins
+        os.makedirs(os.path.dirname(npz_path), exist_ok=True)
+        tmp = npz_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, npz_path)
+        return True
+
+    # -- journaling & stats ----------------------------------------------
+    def _journal_degraded(self, peer: str, flow: str,
+                          breaker: CircuitBreaker) -> None:
+        if self.journal is None:
+            return
+        from .supervision.events import EventKind
+        self.journal.emit(EventKind.SERVE_FLEET_TRANSPORT_DEGRADED,
+                          peer=peer, flow=flow, failures=breaker.failures,
+                          reason="send_failed", trace=self.trace)
+
+    def _journal_restored(self, peer: str, flow: str,
+                          breaker: CircuitBreaker) -> None:
+        if self.journal is None:
+            return
+        from .supervision.events import EventKind
+        self.journal.emit(EventKind.SERVE_FLEET_TRANSPORT_RESTORED,
+                          peer=peer, flow=flow,
+                          open_s=round(breaker.open_for_s(), 6),
+                          trace=self.trace)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "bytes_by_flow": dict(self.bytes_by_flow),
+            "bytes_received": self.server.bytes_received,
+            "frames_sent": sum(c.frames_sent
+                               for c in self._clients.values()),
+            "frame_rejects": self.server.frame_rejects,
+            "rejects_by_reason": dict(self.rejects_by_reason),
+            "reconnects": sum(c.reconnects for c in self._clients.values()),
+            "fallbacks": self.fallbacks,
+            "breaker_opens": self.breaker_opens,
+            "breaker_closes": self.breaker_closes,
+        }
+
+    def metrics_sample(self) -> Dict[str, float]:
+        """Transport counters under their registered telemetry metric
+        names — journaled as one ``metrics.sample`` row at shutdown so
+        ``dump_run_events`` can print the transport footer."""
+        s = self.stats()
+        return {
+            "transport.bytes_orders": float(s["bytes_by_flow"]["order"]),
+            "transport.bytes_bundles": float(s["bytes_by_flow"]["bundle"]),
+            "transport.bytes_results": float(s["bytes_by_flow"]["result"]),
+            "transport.frames_sent": float(s["frames_sent"]),
+            "transport.frame_rejects": float(s["frame_rejects"]),
+            "transport.reconnects": float(s["reconnects"]),
+            "transport.fallbacks": float(s["fallbacks"]),
+            "transport.breaker_opens": float(s["breaker_opens"]),
+            "transport.breaker_closes": float(s["breaker_closes"]),
+        }
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+        self.server.close()
+        try:
+            os.remove(endpoint_path(self.run_dir, self.role, self.rank))
+        except OSError as e:
+            if e.errno != errno.ENOENT:
+                pass  # stale endpoint files are swept by the next spawn

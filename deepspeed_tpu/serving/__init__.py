@@ -35,7 +35,8 @@ directly.  Reference: ``docs/serving.md``.
 
 from .batcher import PrefixEntry, SlotBatcher  # noqa: F401
 from .config import (SERVING, OverloadConfig, PagingConfig,  # noqa: F401
-                     PriorityClass, ServingConfig, SpeculativeConfig)
+                     PriorityClass, ServingConfig, SpeculativeConfig,
+                     TransportConfig)
 from .fleet import (BundleCorruptError, ServeFleetConfig,  # noqa: F401
                     ServeFleetSupervisor)
 from .gateway import ServingGateway  # noqa: F401
@@ -49,7 +50,8 @@ from .request import (QueueFullError, RequestCancelled, RequestFailed,  # noqa: 
 
 __all__ = [
     "SERVING", "ServingConfig", "PagingConfig", "SpeculativeConfig",
-    "OverloadConfig", "PriorityClass", "AdmissionController",
+    "OverloadConfig", "TransportConfig", "PriorityClass",
+    "AdmissionController",
     "DegradationLadder", "ServingGateway",
     "ServingMetrics", "SlotBatcher", "PrefixEntry", "RequestHandle",
     "RequestState", "QueueFullError", "RequestShed", "RequestCancelled",

@@ -21,7 +21,12 @@ Same validated dataclass-model style as ``supervision/config.py``:
                    "pool_blocks": null, "park_capacity": 64,
                    "park_dir": null, "park_ttl_s": 600.0,
                    "park_verify": true, "hbm_high_watermark": null},
-        "speculative": {"enabled": false, "draft_k": 3, "draft": null}
+        "speculative": {"enabled": false, "draft_k": 3, "draft": null},
+        "transport": {"enabled": true, "port_base": 0,
+                      "connect_timeout_s": 1.0, "send_timeout_s": 2.0,
+                      "retries": 2, "backoff_s": 0.02,
+                      "backoff_jitter": 0.25, "fallback": true,
+                      "failures_to_open": 3, "probe_interval_s": 0.5}
     }}
 
 ``max_len`` is the per-slot cache length — bucketed to a power of two and
@@ -208,6 +213,79 @@ class OverloadConfig(DeepSpeedConfigModel):
             key=lambda c: -c.min_priority))
 
 
+@dataclasses.dataclass
+class TransportConfig(DeepSpeedConfigModel):
+    """The ``"serving"."transport"`` subsection: the streamed fleet
+    transport (``docs/serving.md`` "Streamed transport").  Framed TCP
+    channels accelerate the spool's three flows — orders, bundles,
+    results; the spool stays the durable record, so every knob here
+    trades latency, never correctness."""
+
+    #: stream frames alongside the spool writes (False: spool-only, the
+    #: pre-transport behavior — what the bitwise-parity e2e compares
+    #: against)
+    enabled: bool = True
+    #: fixed port layout base (supervisor at ``port_base``, workers
+    #: stacked above it); 0 = ephemeral ports announced via
+    #: ``spool/transport/<role><rank>.json`` — the default, safe for
+    #: parallel runs on one host
+    port_base: int = 0
+    #: per-attempt TCP connect deadline, seconds
+    connect_timeout_s: float = 1.0
+    #: per-attempt frame write deadline, seconds
+    send_timeout_s: float = 2.0
+    #: retries after a failed send attempt (total attempts = retries + 1)
+    retries: int = 2
+    #: exponential backoff base between retries, seconds (doubles per
+    #: retry)
+    backoff_s: float = 0.02
+    #: multiplicative jitter fraction on each backoff sleep
+    backoff_jitter: float = 0.25
+    #: degrade to the filesystem spool when a peer's breaker opens
+    #: (False: keep attempting every send — still never fatal, the spool
+    #: write has already happened either way)
+    fallback: bool = True
+    #: consecutive send failures that open a (peer, flow) breaker
+    failures_to_open: int = 3
+    #: seconds between auto-probe pings of an open breaker
+    probe_interval_s: float = 0.5
+
+    def __post_init__(self):
+        from ..runtime.config import DeepSpeedConfigError
+        if not isinstance(self.port_base, int) \
+                or isinstance(self.port_base, bool) \
+                or not 0 <= self.port_base <= 65000:
+            raise DeepSpeedConfigError(
+                f"serving.transport.port_base must be an int in "
+                f"[0, 65000], got {self.port_base!r}")
+        for key in ("connect_timeout_s", "send_timeout_s", "backoff_s",
+                    "probe_interval_s"):
+            val = getattr(self, key)
+            if not isinstance(val, (int, float)) \
+                    or isinstance(val, bool) or val <= 0:
+                raise DeepSpeedConfigError(
+                    f"serving.transport.{key} must be a number > 0, "
+                    f"got {val!r}")
+        if not isinstance(self.retries, int) \
+                or isinstance(self.retries, bool) \
+                or not 0 <= self.retries <= 16:
+            raise DeepSpeedConfigError(
+                f"serving.transport.retries must be an int in [0, 16], "
+                f"got {self.retries!r}")
+        if not isinstance(self.backoff_jitter, (int, float)) \
+                or isinstance(self.backoff_jitter, bool) \
+                or not 0.0 <= self.backoff_jitter <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.transport.backoff_jitter must be in [0, 1], "
+                f"got {self.backoff_jitter!r}")
+        if not isinstance(self.failures_to_open, int) \
+                or isinstance(self.failures_to_open, bool) \
+                or self.failures_to_open < 1:
+            raise DeepSpeedConfigError(
+                f"serving.transport.failures_to_open must be an int >= 1, "
+                f"got {self.failures_to_open!r}")
+
+
 #: keys a ``"speculative"."draft"`` geometry spec may carry
 _DRAFT_SPEC_KEYS = ("n_layer", "d_model", "n_head", "seed")
 
@@ -320,6 +398,9 @@ class ServingConfig(DeepSpeedConfigModel):
     #: SLO-driven admission + degradation ladder; see
     #: :class:`OverloadConfig`
     overload: Optional[Dict] = None
+    #: raw "transport" subsection (typed view: ``transport_config``) —
+    #: streamed fleet transport; see :class:`TransportConfig`
+    transport: Optional[Dict] = None
 
     paging_config: PagingConfig = dataclasses.field(
         default_factory=PagingConfig)
@@ -327,6 +408,8 @@ class ServingConfig(DeepSpeedConfigModel):
         default_factory=SpeculativeConfig)
     overload_config: OverloadConfig = dataclasses.field(
         default_factory=OverloadConfig)
+    transport_config: TransportConfig = dataclasses.field(
+        default_factory=TransportConfig)
 
     def __post_init__(self):
         if isinstance(self.paging, dict):
@@ -345,6 +428,11 @@ class ServingConfig(DeepSpeedConfigModel):
         elif isinstance(self.speculative, SpeculativeConfig):
             self.speculative_config = self.speculative
             self.speculative = self.speculative_config.to_dict()
+        if isinstance(self.transport, dict):
+            self.transport_config = TransportConfig.from_dict(self.transport)
+        elif isinstance(self.transport, TransportConfig):
+            self.transport_config = self.transport
+            self.transport = self.transport_config.to_dict()
         if self.slots < 1:
             raise ValueError(f"serving.slots must be >= 1, got {self.slots}")
         if self.prefill_chunk < 1:

@@ -289,6 +289,10 @@ class ServeFleetConfig:
     max_restarts: int = 2          # per worker, not whole-fleet
     respawn_backoff_s: float = 0.2
     local_prefill_fallback: bool = True
+    # streamed transport (serving/config.py::TransportConfig keys; None =
+    # all defaults, i.e. enabled with ephemeral ports).  Rides the child
+    # payload so respawned workers rebuild the same endpoint policy.
+    transport: Optional[Dict[str, Any]] = None
     # run driver
     run_timeout_s: float = 300.0
     poll_s: float = 0.05
@@ -313,6 +317,13 @@ class ServeFleetConfig:
         doc = dataclasses.asdict(self)
         doc["run_dir"] = run_dir
         return doc
+
+    def transport_config(self) -> Dict[str, Any]:
+        """The validated ``serving.transport`` subsection as a plain dict
+        (misconfiguration raises ``DeepSpeedConfigError`` here, before any
+        socket binds)."""
+        from .config import TransportConfig
+        return TransportConfig.from_dict(self.transport or {}).to_dict()
 
 
 # -------------------------------------------------------------- accounting
@@ -443,6 +454,25 @@ class ServeFleetSupervisor:
         self._scale_actions = 0
         self._last_autoscale = 0.0
         self._retiring: Optional[int] = None     # rank draining to retire
+        # streamed transport (runtime/transport.py): every spool write
+        # below still happens first — frames only let the other side act
+        # without waiting out a poll interval, and a dead socket degrades
+        # to the spool via the per-(peer, flow) breakers
+        tcfg = config.transport_config()
+        self.transport = None
+        if tcfg.get("enabled"):
+            from ..runtime.transport import FleetTransport
+            self.transport = FleetTransport(
+                tcfg, self.run_dir, "sup", SUPERVISOR_RANK,
+                journal=self.journal, trace=self.trace.fields())
+        # frame-delivered fast-path caches, consulted before the spool
+        # read they shadow (the file always exists by the time its frame
+        # does — sender ordering)
+        self._net_manifests: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._net_results: Dict[str, Dict[str, Any]] = {}
+        self._net_nacks: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._net_mig_nacks: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._net_mig_acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
 
     # --------------------------------------------------------------- paths
     def _prefill_inbox(self, rank: int) -> str:
@@ -705,6 +735,9 @@ class ServeFleetSupervisor:
     def _on_worker_death(self, w: _Worker, returncode, reason: str) -> None:
         detect_ts = time.time()
         w.alive = False
+        if self.transport is not None:
+            # drop cached connections: the respawn announces a fresh port
+            self.transport.forget_peer(w.role, w.rank)
         self.journal.emit(EventKind.SERVE_FLEET_WORKER_LOST, role=w.role,
                           worker=w.rank, incarnation=w.incarnation,
                           returncode=returncode, reason=reason,
@@ -793,6 +826,66 @@ class ServeFleetSupervisor:
         from ..runtime.checkpoint_engine.storage import atomic_write_text
         atomic_write_text(path, json.dumps(doc, sort_keys=True))
 
+    # ----------------------------------------------------------- transport
+    def _push_frame(self, flow: str, role: str, rank: int,
+                    header: Dict[str, Any], blob: bytes = b"") -> None:
+        """Best-effort stream of a doc the spool already holds durably —
+        a False/failed send costs the receiver one poll interval, nothing
+        else."""
+        if self.transport is not None:
+            self.transport.send(flow, role, rank, header, blob)
+
+    def _push_decode_order(self, engine: int, name: str,
+                           order: Dict[str, Any]) -> None:
+        """Stream a decode order; bundle-backed orders (prefill handoffs
+        and migrations) attach the npz bytes so the KV transfer itself
+        rides the socket — the receiver verifies the blob against the
+        manifest ``sha256`` before materializing it."""
+        if self.transport is None:
+            return
+        blob = b""
+        flow = "order"
+        if order.get("bundle"):
+            try:
+                with open(os.path.join(self.bundles_dir, order["bundle"]),
+                          "rb") as f:
+                    blob = f.read()
+                flow = "bundle"
+            except OSError:
+                blob = b""   # publisher's copy raced away: spool recovers
+                flow = "order"
+        self.transport.send(flow, "decode", engine,
+                            {"what": "order", "name": name, "doc": order,
+                             "sha256": order.get("sha256")}, blob)
+
+    def _drain_transport(self) -> None:
+        """Pull frame-delivered worker responses into the fast-path caches
+        the spool checks consult before their file reads."""
+        if self.transport is None:
+            return
+        for fr in self.transport.poll():
+            doc = fr.header.get("doc")
+            what = fr.header.get("what")
+            if not isinstance(doc, dict) or "rid" not in doc:
+                continue
+            rid = str(doc["rid"])
+            try:
+                if what == "manifest":
+                    self._net_manifests[(rid, int(doc["attempt"]))] = doc
+                elif what == "result":
+                    self._net_results[rid] = doc
+                elif what == "nack":
+                    self._net_nacks[(rid, int(doc["attempt"]))] = doc
+                elif what == "mig_nack":
+                    self._net_mig_nacks[(rid, int(doc["mig"]))] = doc
+                elif what == "mig_ack":
+                    self._net_mig_acks[(rid, int(doc["mig"]))] = doc
+            except (KeyError, TypeError, ValueError):
+                continue   # malformed fast-path doc: the spool copy rules
+        self.transport.tick([(w.role, w.rank)
+                             for w in self.workers.values()
+                             if w.alive and not w.gone])
+
     def _assign_prefill(self, req: _Request) -> None:
         """Place a pending request on a live prefill worker (round-robin,
         avoiding the previous owner on a retry) — or degrade."""
@@ -815,11 +908,17 @@ class ServeFleetSupervisor:
         req.worker = target.rank
         req.state = "prefilling"
         req.t_assigned = time.monotonic()
-        self._atomic_write(self._order_path(req), inject({
+        order = inject({
             "rid": req.rid, "attempt": req.attempt,
             "tokens": [int(t) for t in req.tokens],
             "t_submit": req.t_submit, "greedy": req.greedy,
-            "temperature": req.temperature, "seed": req.seed}, req.ctx))
+            "temperature": req.temperature, "seed": req.seed}, req.ctx)
+        order_path = self._order_path(req)
+        self._atomic_write(order_path, order)
+        self._push_frame("order", "prefill", target.rank,
+                         {"what": "order",
+                          "name": os.path.basename(order_path),
+                          "doc": order})
         if req.attempt > 0:
             self.journal.emit(EventKind.SERVE_FLEET_HANDOFF,
                               request_id=req.rid, from_worker=prev,
@@ -918,8 +1017,9 @@ class ServeFleetSupervisor:
         else:
             req.routed_via = "local"
         write_route_marker(self.decode_dir, req.rid, engine, req.d)
-        self._atomic_write(
-            self._decode_order_path(req.rid, req.d, engine), order)
+        order_path = self._decode_order_path(req.rid, req.d, engine)
+        self._atomic_write(order_path, order)
+        self._push_decode_order(engine, os.path.basename(order_path), order)
         req.state = "routed"
         return True
 
@@ -956,18 +1056,23 @@ class ServeFleetSupervisor:
         req.mig_deadline = time.monotonic() + self.config.migrate_timeout_s
         req.state = "migrating"
         self.router.pin(req.session, target)
-        self._atomic_write(
-            self._park_path(req.rid, req.mig, req.engine),
-            inject({"cmd": "park", "rid": req.rid, "mig": req.mig,
-                    "d": req.d, "reason": reason,
-                    "to_worker": int(target)}, req.ctx))
+        cmd = inject({"cmd": "park", "rid": req.rid, "mig": req.mig,
+                      "d": req.d, "reason": reason,
+                      "to_worker": int(target)}, req.ctx)
+        park_path = self._park_path(req.rid, req.mig, req.engine)
+        self._atomic_write(park_path, cmd)
+        self._push_frame("order", "decode", req.engine,
+                         {"what": "order",
+                          "name": os.path.basename(park_path),
+                          "doc": cmd})
 
     def _check_migrations(self) -> None:
         now = time.monotonic()
         for req in self.requests.values():
             if req.state != "migrating":
                 continue
-            ack = self._read_json(self._mig_ack_path(req.rid, req.mig))
+            ack = self._net_mig_acks.get((req.rid, req.mig)) \
+                or self._read_json(self._mig_ack_path(req.rid, req.mig))
             if ack is not None and int(ack.get("mig", -1)) == req.mig:
                 state = ack.get("state")
                 if state == "exported":
@@ -1215,7 +1320,8 @@ class ServeFleetSupervisor:
             elif req.state == "prefilling":
                 _npz, manifest_path = bundle_paths(
                     self.bundles_dir, req.rid, req.attempt)
-                manifest = self._read_json(manifest_path)
+                manifest = self._net_manifests.get((req.rid, req.attempt)) \
+                    or self._read_json(manifest_path)
                 if manifest is not None and \
                         int(manifest.get("attempt", -1)) == req.attempt:
                     self._note_prefill_timing(req, manifest)
@@ -1226,14 +1332,16 @@ class ServeFleetSupervisor:
                 # bundle in hand, no engine was live — retry placement
                 self._route_decode(req, req.manifest)
             elif req.state == "routed":
-                result = self._read_json(self._result_path(req.rid))
+                result = self._net_results.get(req.rid) \
+                    or self._read_json(self._result_path(req.rid))
                 if result is not None:
                     req.result = result
                     req.state = "done"
                     continue
                 if req.routed_via == "migrate":
-                    nack = self._read_json(
-                        self._mig_nack_path(req.rid, req.mig))
+                    nack = self._net_mig_nacks.get((req.rid, req.mig)) \
+                        or self._read_json(
+                            self._mig_nack_path(req.rid, req.mig))
                     if nack is not None:
                         # migration bundle failed verify on the target —
                         # bitrot costs a full re-prefill, never a wrong
@@ -1241,8 +1349,9 @@ class ServeFleetSupervisor:
                         self._remove_decode_order(req)
                         self._retry_prefill(req, reason="migrate_reject")
                     continue
-                nack = self._read_json(
-                    self._nack_path(req.rid, req.attempt))
+                nack = self._net_nacks.get((req.rid, req.attempt)) \
+                    or self._read_json(
+                        self._nack_path(req.rid, req.attempt))
                 if nack is not None and not req.local:
                     self._remove_decode_order(req)
                     self._retry_prefill(req, reason="bundle_reject")
@@ -1266,6 +1375,7 @@ class ServeFleetSupervisor:
         """One supervisor heartbeat: health, membership, routing."""
         if self._aborted is not None:
             return
+        self._drain_transport()
         self._check_processes()
         self._check_heartbeats()
         self._check_ready()
@@ -1302,6 +1412,17 @@ class ServeFleetSupervisor:
         schedule, poll the state machine, drain, summarize.  ``workload``
         items: ``{"at_s", "tokens", "max_new_tokens", ...}``."""
         cfg = self.config
+        # faults addressed to SUPERVISOR_RANK arm in this process for the
+        # run's duration (the DS_FAULT_PLAN env path only reaches spawned
+        # workers) — how chaos scenarios fail the supervisor's own
+        # transport sends without touching a worker
+        armed: List[Tuple[str, Any]] = []
+        if self.scenario is not None:
+            for spec in getattr(self.scenario, "faults", ()):
+                if spec.applies_to(SUPERVISOR_RANK, 0):
+                    armed.append((spec.point, fault_injection.install(
+                        spec.point, fault_injection.PLAN_FAULTS[spec.fault](
+                            **dict(spec.args)))))
         self.start()
         arrivals = sorted(workload, key=lambda it: it["at_s"])
         self._warm_barrier()
@@ -1325,14 +1446,34 @@ class ServeFleetSupervisor:
                 if self._aborted is not None:
                     break
                 if i == len(arrivals) and self._rolling_done and all(
-                        r.terminal for r in self.requests.values()):
+                        r.terminal for r in self.requests.values()) and not any(
+                        w.respawn_at is not None and not w.gone
+                        for w in self.workers.values()):
+                    # a pending respawn holds the exit: the failover
+                    # contract includes restoring the victim's capacity,
+                    # and the streamed transport can drain the workload
+                    # faster than the respawn backoff elapses
                     break
                 if time.monotonic() - t0 > cfg.run_timeout_s:
                     self._abort("run timeout")
                     break
-                time.sleep(cfg.poll_s)
+                if self.transport is not None:
+                    # event-driven poll: an inbound frame (manifest, ack,
+                    # result) wakes the state machine immediately instead
+                    # of waiting out the poll interval — this substitution
+                    # is the migration transfer phase's latency win
+                    self.transport.wait(cfg.poll_s)
+                else:
+                    time.sleep(cfg.poll_s)
         finally:
+            for point, fault in armed:
+                fault_injection.remove(point, fault)
             self._stop_workers()
+            if self.transport is not None:
+                self._drain_transport()
+                self.journal.emit(EventKind.METRICS_SAMPLE,
+                                  m=self.transport.metrics_sample())
+                self.transport.close()
         accepted = len(self.requests)
         completed = sum(1 for r in self.requests.values()
                         if r.state == "done")

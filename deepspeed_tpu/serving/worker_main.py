@@ -118,11 +118,52 @@ def _scan_orders(inbox: str):
 # ------------------------------------------------------------------ prefill
 
 
+def _drain_order_frames(transport, net_orders: dict, journal=None,
+                        bundles_dir: str = "") -> None:
+    """Pull streamed order frames into ``net_orders`` (name → doc) so the
+    scan loop processes them exactly like spool files.  Bundle frames
+    materialize their npz blob (digest-verified against the manifest
+    ``sha256``) before the order becomes visible; a blob failing that
+    check journals a frame-level ``serve.fleet.bundle_reject`` and the
+    order rides the publisher's spool copy instead."""
+    if transport is None:
+        return
+    from deepspeed_tpu.runtime.supervision.events import EventKind
+    for fr in transport.poll():
+        h = fr.header
+        doc = h.get("doc")
+        name = h.get("name")
+        if h.get("what") != "order" or not isinstance(doc, dict) \
+                or not isinstance(name, str) or not name:
+            continue
+        if fr.flow == "bundle" and fr.blob and doc.get("bundle") \
+                and bundles_dir:
+            ok = transport.store_bundle_blob(
+                os.path.join(bundles_dir, str(doc["bundle"])), fr.blob,
+                str(doc.get("sha256")))
+            if not ok and journal is not None:
+                journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
+                             request_id=doc.get("rid"),
+                             worker=doc.get("prefill_worker"),
+                             attempt=doc.get("attempt"),
+                             reason="frame_digest_mismatch", frame=True,
+                             trace=None)
+        net_orders[name] = doc
+
+
+def _idle_wait(transport, seconds: float) -> None:
+    """Idle like ``time.sleep`` but wake immediately on inbound frames."""
+    if transport is None:
+        time.sleep(seconds)
+    else:
+        transport.wait(seconds)
+
+
 def _prefill_loop(cfg: dict, batcher, journal, spool: str,
-                  tracer=None) -> None:
+                  tracer=None, transport=None) -> None:
     import numpy as np
     from deepspeed_tpu.runtime.supervision.events import EventKind
-    from deepspeed_tpu.serving.fleet import publish_bundle
+    from deepspeed_tpu.serving.fleet import SUPERVISOR_RANK, publish_bundle
     from deepspeed_tpu.serving.paging import _host_banks
     from deepspeed_tpu.telemetry.propagate import extract
     from deepspeed_tpu.telemetry.spans import SpanName, Tracer
@@ -139,17 +180,22 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str,
     _mark_ready(os.path.join(spool, "ready"), "prefill", rank,
                 cfg["incarnation"])
     seen = set()
+    net_orders: dict = {}     # streamed copies of spool orders, by name
     chunks_done = 0           # worker-global: KillAtStep lands mid-prefill
     while not _stop_requested(spool, "prefill", rank):
         worked = False
-        for name in _scan_orders(inbox):
+        _drain_order_frames(transport, net_orders, journal=journal)
+        for name in sorted(set(_scan_orders(inbox)) | set(net_orders)):
             if name in seen:
+                net_orders.pop(name, None)
                 continue
-            try:
-                with open(os.path.join(inbox, name)) as f:
-                    order = json.load(f)
-            except (OSError, ValueError):
-                continue      # torn/being-replaced — next scan gets it
+            order = net_orders.pop(name, None)
+            if order is None:
+                try:
+                    with open(os.path.join(inbox, name)) as f:
+                        order = json.load(f)
+                except (OSError, ValueError):
+                    continue  # torn/being-replaced — next scan gets it
             seen.add(name)
             worked = True
             rid, attempt = order["rid"], int(order["attempt"])
@@ -190,8 +236,17 @@ def _prefill_loop(cfg: dict, batcher, journal, spool: str,
                          prefill_s=round(t_prefilled - t_start, 6),
                          publish_s=round(t_published - t_prefilled, 6),
                          trace=tfields or None)
+            if transport is not None:
+                # stream the manifest so the supervisor routes without
+                # waiting out a spool-poll interval; the spool copy
+                # written above stays authoritative on any drop
+                with tracer.span(SpanName.SERVE_TRANSPORT_SEND,
+                                 request_id=rid, flow="result",
+                                 **tfields):
+                    transport.send("result", "sup", SUPERVISOR_RANK,
+                                   {"what": "manifest", "doc": manifest})
         if not worked:
-            time.sleep(0.02)
+            _idle_wait(transport, 0.02)
 
 
 # ------------------------------------------------------------------- decode
@@ -222,14 +277,15 @@ def _append_metrics(run_dir: str, rank: int, inc: int, active: int,
 
 
 def _decode_loop(cfg: dict, batcher, journal, spool: str,
-                 tracer=None) -> None:
+                 tracer=None, transport=None) -> None:
     import jax
     import numpy as np
     from deepspeed_tpu.runtime.checkpoint_engine.storage import \
         atomic_write_text
     from deepspeed_tpu.runtime.supervision.events import EventKind
     from deepspeed_tpu.serving.batcher import PrefixEntry
-    from deepspeed_tpu.serving.fleet import (BundleCorruptError,
+    from deepspeed_tpu.serving.fleet import (SUPERVISOR_RANK,
+                                             BundleCorruptError,
                                              bundle_paths, load_bundle,
                                              publish_bundle,
                                              rebuild_prefix_cache)
@@ -264,12 +320,18 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
     free = list(range(slots))
     active: dict = {}         # row -> request state
     seen = set()              # (rid, d) admitted/nacked, parks this life
+    net_orders: dict = {}     # streamed copies of spool orders, by name
     ticks = 0
     admits = 0                # serve.admit fault-step counter
     next_metrics = 0.0
 
-    def _nack(path: str, doc: dict) -> None:
+    def _nack(path: str, doc: dict, what: str = "") -> None:
         atomic_write_text(path, json.dumps(doc, sort_keys=True))
+        if transport is not None and what:
+            # stream the spool-durable ack/nack so the supervisor reacts
+            # this poll instead of next scan
+            transport.send("result", "sup", SUPERVISOR_RANK,
+                           {"what": what, "doc": doc})
 
     def _park(order: dict) -> None:
         """Handle one park command: export the held session's KV as a
@@ -290,13 +352,15 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
         ack_path = bundle_paths(bundles_dir, rid, mig, tag="m")[1]
         if os.path.exists(os.path.join(results_dir, f"{rid}.json")):
             seen.add(key)
-            _nack(ack_path, {"rid": rid, "mig": mig, "state": "done"})
+            _nack(ack_path, {"rid": rid, "mig": mig, "state": "done"},
+                  what="mig_ack")
             return
         row = next((r for r, st in active.items() if st["rid"] == rid),
                    None)
         if row is None:
             seen.add(key)
-            _nack(ack_path, {"rid": rid, "mig": mig, "state": "unheld"})
+            _nack(ack_path, {"rid": rid, "mig": mig, "state": "unheld"},
+                  what="mig_ack")
             return
         seen.add(key)
         st = active[row]
@@ -325,6 +389,13 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                      reason=order.get("reason"), t_park=t_park,
                      export_s=round(time.time() - t_park, 6),
                      trace=tfields or None)
+        if transport is not None:
+            # the exported manifest IS the park ack — stream it so the
+            # supervisor re-routes without a spool-poll round trip
+            with tracer.span(SpanName.SERVE_TRANSPORT_SEND,
+                             request_id=rid, flow="result", **tfields):
+                transport.send("result", "sup", SUPERVISOR_RANK,
+                               {"what": "mig_ack", "doc": manifest})
         batcher.release(row)
         free.append(row)
         del active[row]
@@ -341,30 +412,41 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
         # by a newer route marker: the respawn-rescan path — orders
         # persist, completions and re-routed stragglers don't repeat)
         waiting = 0
-        for name in _scan_orders(inbox):
-            try:
-                with open(os.path.join(inbox, name)) as f:
-                    order = json.load(f)
-            except (OSError, ValueError):
-                continue      # torn/being-replaced — next scan gets it
+        _drain_order_frames(transport, net_orders, journal=journal,
+                            bundles_dir=bundles_dir)
+        for name in sorted(set(_scan_orders(inbox)) | set(net_orders)):
+            order = net_orders.get(name)
+            via = "stream" if order is not None else "spool"
+            if order is None:
+                try:
+                    with open(os.path.join(inbox, name)) as f:
+                        order = json.load(f)
+                except (OSError, ValueError):
+                    continue  # torn/being-replaced — next scan gets it
             if order.get("cmd") == "park":
                 _park(order)
+                net_orders.pop(name, None)
                 continue
             rid, d = order["rid"], int(order.get("d", 0))
             if (rid, d) in seen:
+                net_orders.pop(name, None)
                 continue
             if os.path.exists(os.path.join(results_dir, f"{rid}.json")):
                 seen.add((rid, d))
+                net_orders.pop(name, None)
                 continue
             if not order_is_current(decode_root, rid, d, rank):
                 # superseded straggler (re-routed or migrated away while
-                # this engine was down) — never double-decode it
+                # this engine was down, or a stale frame outrun by a newer
+                # route marker) — never double-decode it
                 seen.add((rid, d))
+                net_orders.pop(name, None)
                 continue
             if not free:
                 waiting += 1
                 continue      # revisit once a slot frees up
             seen.add((rid, d))
+            net_orders.pop(name, None)
             attempt = int(order["attempt"])
             mig = order.get("mig")
             t_order = time.time()
@@ -410,7 +492,7 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                         _nack(os.path.join(
                             results_dir, f"{rid}.m{int(mig)}.nack.json"),
                             {"rid": rid, "mig": int(mig),
-                             "reason": str(e)[:200]})
+                             "reason": str(e)[:200]}, what="mig_nack")
                     else:
                         journal.emit(EventKind.SERVE_FLEET_BUNDLE_REJECT,
                                      request_id=rid,
@@ -420,7 +502,7 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                         _nack(os.path.join(
                             results_dir, f"{rid}.a{attempt}.nack.json"),
                             {"rid": rid, "attempt": attempt,
-                             "reason": str(e)[:200]})
+                             "reason": str(e)[:200]}, what="nack")
                     continue
             row = free.pop()
             t_admit = time.time()
@@ -437,7 +519,7 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                              (t_admit - order["t_submit"]) * 1000.0, 1),
                          prefix_hit=prefix is not None,
                          attempt=attempt, t_order=t_order,
-                         verify_ms=verify_ms, mig=mig,
+                         verify_ms=verify_ms, mig=mig, via=via,
                          trace=tfields or None)
             resume = order.get("resume") or {}
             r_out = [int(t) for t in resume.get("out", [])]
@@ -459,7 +541,7 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
             next_metrics = time.time() + metrics_interval
         # ---- one decode round
         if not active:
-            time.sleep(0.01)
+            _idle_wait(transport, 0.01)
             continue
         fault_injection.fire("serve.decode_tick", step=ticks, tick=ticks,
                              active=len(active))
@@ -477,13 +559,19 @@ def _decode_loop(cfg: dict, batcher, journal, spool: str,
                 continue
             ttft_ms = (st["first_ts"] - st["t_submit"]) * 1000.0
             rate = len(st["out"]) / max(now - st["t_admit"], 1e-9)
+            result_doc = {"rid": st["rid"], "attempt": st["attempt"],
+                          "tokens": st["out"],
+                          "ttft_ms": round(ttft_ms, 1),
+                          "t_done": now, "incarnation": inc}
             atomic_write_text(
                 os.path.join(results_dir, f"{st['rid']}.json"),
-                json.dumps({"rid": st["rid"], "attempt": st["attempt"],
-                            "tokens": st["out"],
-                            "ttft_ms": round(ttft_ms, 1),
-                            "t_done": now, "incarnation": inc},
-                           sort_keys=True))
+                json.dumps(result_doc, sort_keys=True))
+            if transport is not None:
+                with tracer.span(SpanName.SERVE_TRANSPORT_SEND,
+                                 request_id=st["rid"], flow="result",
+                                 **(st["trace"] or {})):
+                    transport.send("result", "sup", SUPERVISOR_RANK,
+                                   {"what": "result", "doc": result_doc})
             journal.emit(EventKind.SERVE_DONE, request_id=st["rid"],
                          slot=row, tokens_out=len(st["out"]),
                          ttft_ms=round(ttft_ms, 1),
@@ -506,8 +594,11 @@ def main() -> int:
     from deepspeed_tpu.utils import fault_injection  # noqa: F401
     from deepspeed_tpu.runtime.checkpoint_engine.storage import \
         atomic_write_text
-    from deepspeed_tpu.runtime.supervision.events import EventJournal
+    from deepspeed_tpu.runtime.supervision.events import (EventJournal,
+                                                          EventKind)
     from deepspeed_tpu.runtime.supervision.heartbeat import HeartbeatWriter
+    from deepspeed_tpu.runtime.transport import FleetTransport
+    from deepspeed_tpu.serving.config import TransportConfig
     from deepspeed_tpu.telemetry.export import write_trace
     from deepspeed_tpu.telemetry.propagate import clock_sync
     from deepspeed_tpu.telemetry.spans import Tracer
@@ -520,15 +611,31 @@ def main() -> int:
                              interval_s=float(cfg["heartbeat_interval_s"]),
                              journal=journal).start()
     tracer = Tracer(name=f"{role}{rank}")
+    tcfg = TransportConfig.from_dict(cfg.get("transport") or {}).to_dict()
+    transport = None
+    if tcfg.get("enabled"):
+        # announce this incarnation's endpoint before warmup so the
+        # supervisor's next (re)connect resolves the fresh port
+        transport = FleetTransport(tcfg, run_dir, role, rank,
+                                   journal=journal)
     try:
         batcher = _build_batcher(
             cfg, slots=int(cfg["slots"]) if role == "decode" else 1)
         if role == "decode":
-            _decode_loop(cfg, batcher, journal, spool, tracer=tracer)
+            _decode_loop(cfg, batcher, journal, spool, tracer=tracer,
+                         transport=transport)
         else:
-            _prefill_loop(cfg, batcher, journal, spool, tracer=tracer)
+            _prefill_loop(cfg, batcher, journal, spool, tracer=tracer,
+                          transport=transport)
     finally:
         writer.stop()
+        if transport is not None:
+            try:
+                journal.emit(EventKind.METRICS_SAMPLE,
+                             m=transport.metrics_sample())
+            except (OSError, ValueError):  # dslint: disable=swallowed-exception — telemetry never masks the exit path
+                pass
+            transport.close()
         # per-incarnation span export with the wall/monotonic handshake
         # fleet_report needs to rebase this process onto the shared clock
         try:

@@ -392,6 +392,10 @@ def decompose_migrations(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "t_park": m.get("t_park"),
             "ts": m.get("ts"),
             "readmitted": adm is not None,
+            # "stream" when the order+KV bundle rode a transport frame,
+            # "spool" when the target picked the order up off disk — lets
+            # the bench compare transfer_ms by delivery path
+            "via": (adm or {}).get("via"),
         }
         if adm is None:
             rec["phases"] = None

@@ -95,6 +95,29 @@ class MetricName:
     #: currently engaged degradation-ladder rungs (bitmask gauge; 0 = the
     #: gateway is running at full quality)
     SERVE_DEGRADE_RUNGS = "serve.degrade_rungs"
+    #: streamed-transport bytes pushed on the order flow (supervisor →
+    #: worker order/park frames)
+    TRANSPORT_BYTES_ORDERS = "transport.bytes_orders"
+    #: streamed-transport bytes pushed on the bundle flow (KV page /
+    #: migration bundle frames, blob included)
+    TRANSPORT_BYTES_BUNDLES = "transport.bytes_bundles"
+    #: streamed-transport bytes pushed on the result flow (worker →
+    #: supervisor manifests, results, nacks, migration acks)
+    TRANSPORT_BYTES_RESULTS = "transport.bytes_results"
+    #: transport frames successfully sent from this endpoint (all flows)
+    TRANSPORT_FRAMES_SENT = "transport.frames_sent"
+    #: inbound frames rejected by the integrity check (torn / truncated /
+    #: digest mismatch) — the spool copy remains authoritative
+    TRANSPORT_FRAME_REJECTS = "transport.frame_rejects"
+    #: connections re-established after a previous one existed
+    TRANSPORT_RECONNECTS = "transport.reconnects"
+    #: sends that fell back to the filesystem spool (breaker open or
+    #: retry budget spent)
+    TRANSPORT_FALLBACKS = "transport.fallbacks"
+    #: circuit-breaker open transitions (per peer × flow episode)
+    TRANSPORT_BREAKER_OPENS = "transport.breaker_opens"
+    #: circuit-breaker close transitions (probe or live send succeeded)
+    TRANSPORT_BREAKER_CLOSES = "transport.breaker_closes"
     #: cumulative bytes the explicit grad-reduce collectives WOULD have
     #: moved at full precision (fp32 payload, both directions)
     COMM_LOGICAL_BYTES = "comm.logical_bytes"

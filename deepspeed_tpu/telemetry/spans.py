@@ -99,6 +99,9 @@ class SpanName:
     #: decode-side bundle verification (digest + prefix agreement) and
     #: page rebuild before re-admission
     SERVE_FLEET_VERIFY = "serve.fleet.verify"
+    #: one streamed-transport frame send (connect + retries + write) from
+    #: a worker endpoint; flow/peer/bytes in args
+    SERVE_TRANSPORT_SEND = "serve.transport.send"
 
 
 #: every registered span name, as a frozenset of strings

@@ -84,6 +84,18 @@ Points currently wired:
                           ``path``, ``request_id``, ``mig``
                           (``CorruptRandomBytes`` models in-transit bitrot
                           — the verify must nack, never admit)
+``serve.transport.send``  before each streamed-transport send attempt; ctx:
+                          ``step`` (per-client attempt counter), ``path``
+                          (``"<flow>:<peer>"`` — ``FailNTimes`` with
+                          ``match`` models a connection reset on one flow,
+                          ``DelaySeconds``/``HangFor`` a stalled socket,
+                          ``KillAtStep`` a sender dying mid-stream)
+``serve.transport.recv``  per frame a transport server receives; ctx:
+                          ``step`` (endpoint-global frame counter), ``path``
+                          (the flow — ``KillAtStep`` kills the receiver
+                          mid-bundle-stream, leaving the sender a torn
+                          connection; the spool re-routes from durable
+                          state)
 ========================  =====================================================
 
 Subprocess fault plans (the goodput fleet's delivery channel): a parent
@@ -132,6 +144,8 @@ FAULT_POINTS = frozenset({
     "serve.bundle_write",
     "serve.migrate_export",
     "serve.migrate_admit",
+    "serve.transport.send",
+    "serve.transport.recv",
 })
 
 # points with faults installed; guarded by _lock for install/clear, read

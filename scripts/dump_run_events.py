@@ -183,6 +183,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"ttft-critical-path: requests={tt['requests']} "
                   f"mean={tt['mean_ttft_ms']}ms reconciled={tt['ok']}  "
                   + phases, file=sys.stderr)
+    # transport footer: what the socket fast path carried vs what fell
+    # back to the spool — bytes by flow (every endpoint's shutdown
+    # metrics.sample summed), reconnects, breaker episodes, frame rejects
+    tmetrics = [e.get("m") or {} for e in events
+                if e.get("kind") == "metrics.sample"
+                and any(str(k).startswith("transport.")
+                        for k in (e.get("m") or {}))]
+    if tmetrics and not args.as_json:
+        tot = {}
+        for m in tmetrics:
+            for k, v in m.items():
+                if str(k).startswith("transport."):
+                    tot[k] = tot.get(k, 0.0) + float(v or 0.0)
+        degraded = sum(1 for e in events
+                       if e.get("kind") == "serve.fleet.transport_degraded")
+        restored = sum(1 for e in events
+                       if e.get("kind") == "serve.fleet.transport_restored")
+        frame_nacks = sum(1 for e in events
+                          if e.get("kind") == "serve.fleet.bundle_reject"
+                          and e.get("frame"))
+        line = ("transport: "
+                f"bytes_orders={int(tot.get('transport.bytes_orders', 0))}"
+                f"  bytes_bundles="
+                f"{int(tot.get('transport.bytes_bundles', 0))}"
+                f"  bytes_results="
+                f"{int(tot.get('transport.bytes_results', 0))}"
+                f"  frames={int(tot.get('transport.frames_sent', 0))}"
+                f"  reconnects={int(tot.get('transport.reconnects', 0))}"
+                f"  fallbacks={int(tot.get('transport.fallbacks', 0))}"
+                f"  degraded={degraded}  restored={restored}")
+        rejects = int(tot.get("transport.frame_rejects", 0))
+        if rejects or frame_nacks:
+            line += f"  frame_rejects={rejects}"
+            if frame_nacks:
+                line += f"  frame_bundle_nacks={frame_nacks}"
+        print(line, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:
         by = {}

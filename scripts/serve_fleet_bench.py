@@ -20,12 +20,20 @@ handoffs) are deterministic given a scenario seed, so the gate compares
 them tight; wall-clock metrics (TTFT, MTTR) are reported and bounded only
 by each scenario's own generous expectations.
 
+Runs stream KV bundles, orders and results over the socket transport
+(``deepspeed_tpu/runtime/transport.py``) by default — every spool write
+still happens first, so ``--no-transport`` runs the identical matrix
+spool-only (the fallback path) for A/B comparison; the per-scenario
+``trace.migrations`` block records migration ``transfer_ms`` split by
+delivery path (``stream`` vs ``spool``).
+
 Usage:
     python scripts/serve_fleet_bench.py [--scenarios a,b,...] [--seed 7]
                                         [--out BENCH_SERVE_FLEET.json]
                                         [--baseline BENCH_SERVE_FLEET.json]
                                         [--goodput-tolerance 0.1]
                                         [--keep-runs DIR] [--print-json]
+                                        [--no-transport]
 
 Exit codes: 0 every scenario ok and no regression vs the baseline;
 1 any scenario failed its expectations (a lost accepted request, a
@@ -52,18 +60,27 @@ def run_matrix(args) -> dict:
 
     names = args.scenarios.split(",") if args.scenarios \
         else list(serve_scenario_names())
+    overrides = {"transport": {"enabled": False}} \
+        if args.no_transport else {}
     keep = args.keep_runs
     base_dir = keep or tempfile.mkdtemp(prefix="serve_fleet_bench_")
     scores = {}
     try:
         for name in names:
             scenario = build_serve_scenario(name, seed=args.seed)
+            if args.no_transport and any(
+                    "transport" in str(k)
+                    for k in scenario.expect.get("expect_kinds", ())):
+                print(f"[serve-fleet-bench] {name}: skipped — asserts "
+                      "transport events, running --no-transport",
+                      flush=True)
+                continue
             run_dir = os.path.join(base_dir, name)
             shutil.rmtree(run_dir, ignore_errors=True)
             print(f"[serve-fleet-bench] {name}: prefill={scenario.n_prefill} "
                   f"requests={scenario.n_requests} "
                   f"faults={len(scenario.faults)}", flush=True)
-            score = run_serve_scenario(run_dir, scenario)
+            score = run_serve_scenario(run_dir, scenario, **overrides)
             score.pop("summary", None)
             scores[name] = score
             trace = score.get("trace") or {}
@@ -76,6 +93,11 @@ def run_matrix(args) -> dict:
                   f"span_chain={(trace.get('chain') or {}).get('coverage')} "
                   f"ok={score['ok']}",
                   flush=True)
+            migs = trace.get("migrations")
+            if migs:
+                print(f"[serve-fleet-bench]   migrations={migs['n']} "
+                      f"transfer_ms={migs['transfer_ms']['mean']} "
+                      f"by_via={migs['transfer_ms_by_via']}", flush=True)
             if not score["ok"]:
                 for f in score["failures"]:
                     print(f"[serve-fleet-bench]   FAIL: {f}",
@@ -84,7 +106,8 @@ def run_matrix(args) -> dict:
         if not keep:
             shutil.rmtree(base_dir, ignore_errors=True)
     return {
-        "config": {"seed": args.seed, "scenarios": names},
+        "config": {"seed": args.seed, "scenarios": names,
+                   "transport": not args.no_transport},
         "scenarios": {
             name: {k: v for k, v in score.items() if k != "kinds"}
             for name, score in scores.items()
@@ -166,6 +189,11 @@ def main(argv=None) -> int:
     ap.add_argument("--print-json", action="store_true",
                     help="print a one-line JSON summary to stdout first "
                          "(for sweep drivers)")
+    ap.add_argument("--no-transport", action="store_true",
+                    help="run spool-only (streamed transport disabled) — "
+                         "the A/B baseline for transfer-latency "
+                         "comparison; scenarios that assert transport "
+                         "events are skipped")
     args = ap.parse_args(argv)
 
     baseline_path = args.baseline or args.out

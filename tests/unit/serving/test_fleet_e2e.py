@@ -109,3 +109,42 @@ def test_kill_prefill_mid_chunk_exact_output_and_no_recompiles(tmp_path):
     assert validate_trace(merged, require_registered_names=False) == []
     assert len(merged["fleetMeta"]["sources"]) >= 2, merged["fleetMeta"]
     assert not merged["fleetMeta"]["unaligned"], merged["fleetMeta"]
+
+
+def test_streamed_transport_output_bitwise_identical_to_spool_only(tmp_path):
+    """The socket transport is an accelerator, never the record of truth:
+    the same no-fault workload run streamed (default) and spool-only
+    (``transport.enabled=False``) must complete the same request set with
+    **bitwise-identical** token continuations — and the streamed run must
+    actually have carried frames, so the equivalence isn't vacuous."""
+    scenario = build_serve_scenario("fleet_baseline", seed=7)
+    scenario = dataclasses.replace(scenario, n_requests=3)
+
+    streamed_dir = str(tmp_path / "streamed")
+    streamed = run_serve_scenario(streamed_dir, scenario)
+    spool_only = run_serve_scenario(str(tmp_path / "spool_only"), scenario,
+                                    transport={"enabled": False})
+
+    for score in (streamed, spool_only):
+        assert score["ok"], score["failures"]
+        assert score["lost"] == 0 and score["goodput"] == 1.0, score
+
+    # identical request set, identical tokens, token for token
+    s_res = streamed["summary"]["results"]
+    f_res = spool_only["summary"]["results"]
+    assert set(s_res) == set(f_res)
+    for rid in s_res:
+        assert s_res[rid] == f_res[rid], rid
+    assert streamed["trace"]["steady_state_recompiles"] == 0
+
+    # the streamed run really used the wire: every endpoint journals its
+    # transport counters at shutdown, and orders+results moved as frames
+    events = read_events(os.path.join(streamed_dir, "events.jsonl"))
+    samples = [e.get("m") or {} for e in events
+               if e.get("kind") == EventKind.METRICS_SAMPLE]
+    frames = sum(m.get("transport.frames_sent", 0) for m in samples)
+    rejects = sum(m.get("transport.frame_rejects", 0) for m in samples)
+    assert frames > 0
+    assert rejects == 0
+    assert sum(m.get("transport.bytes_orders", 0) for m in samples) > 0
+    assert sum(m.get("transport.bytes_results", 0) for m in samples) > 0

@@ -1,0 +1,437 @@
+"""Unit coverage for the streamed fleet transport
+(``deepspeed_tpu/runtime/transport.py``): frame round-trip, every
+frame-reject reason, reconnect/backoff policy, the per-(peer, flow)
+circuit breaker, spool-fallback + degraded/restored journaling, the
+bundle-blob digest gate, and the receiver-side supersede guards that
+keep stale frames from ever being acted on.
+
+Everything here runs loopback sockets or pure functions — no jax, no
+subprocesses; the e2e streamed-vs-spool equivalence lives in
+``test_fleet_e2e.py``.
+"""
+
+import hashlib
+import json
+import os
+import socket
+import struct
+
+import pytest
+
+from deepspeed_tpu.runtime.transport import (
+    FLOWS, MAGIC, CircuitBreaker, FleetTransport, Frame, FrameError,
+    TransportClient, TransportError, TransportServer, _PREAMBLE,
+    decode_frames, encode_frame, endpoint_path, read_endpoint)
+
+
+# ------------------------------------------------------------------ framing
+
+
+def test_frame_roundtrip_header_and_blob():
+    blob = os.urandom(4096)
+    wire = encode_frame("bundle", {"what": "order", "name": "r0.json",
+                                   "doc": {"rid": "r0", "attempt": 1}}, blob)
+    buf = bytearray(wire)
+    frames = decode_frames(buf)
+    assert len(frames) == 1 and not buf      # fully consumed
+    fr = frames[0]
+    assert fr.flow == "bundle"
+    assert fr.header["doc"] == {"rid": "r0", "attempt": 1}
+    assert fr.header["flow"] == "bundle"     # wire form is self-describing
+    assert fr.blob == blob
+
+
+def test_frame_streaming_boundaries():
+    """Multiple frames in one buffer decode in order; a trailing partial
+    frame stays buffered until its bytes arrive."""
+    w1 = encode_frame("order", {"n": 1})
+    w2 = encode_frame("result", {"n": 2}, b"xy")
+    buf = bytearray(w1 + w2 + w2[:7])        # third frame torn mid-preamble
+    frames = decode_frames(buf)
+    assert [f.header["n"] for f in frames] == [1, 2]
+    assert bytes(buf) == w2[:7]              # leftover awaits more bytes
+    buf.extend(w2[7:])
+    assert [f.header["n"] for f in decode_frames(buf)] == [2]
+    assert not buf
+
+
+def test_encode_rejects_unknown_flow():
+    with pytest.raises(ValueError):
+        encode_frame("gossip", {})
+
+
+@pytest.mark.parametrize("mutate,reason", [
+    (lambda w: b"XXXX" + w[4:], "bad_magic"),
+    (lambda w: w[:4] + b"\x7f" + w[5:], "bad_version"),
+    (lambda w: w[:5] + b"\x01" + w[6:], "bad_flags"),
+    # absurd blob length: refused before any buffer is allocated
+    (lambda w: w[:10] + struct.pack(">Q", 1 << 40) + w[18:], "oversize"),
+    # bit-flip one payload byte: the SHA-256 digest catches it
+    (lambda w: w[:-1] + bytes([w[-1] ^ 0xFF]), "digest_mismatch"),
+])
+def test_frame_reject_reasons(mutate, reason):
+    wire = mutate(encode_frame("order", {"k": "v"}, b"payload"))
+    with pytest.raises(FrameError) as ei:
+        decode_frames(bytearray(wire))
+    assert ei.value.reason == reason
+
+
+def test_frame_reject_bad_header_and_flow():
+    # digest-valid frames whose *header* lies: not JSON / not an object /
+    # unknown flow — each must fail with its own reason
+    def forge(hbytes, blob=b""):
+        digest = hashlib.sha256(hbytes + blob).digest()
+        return _PREAMBLE.pack(MAGIC, 1, 0, len(hbytes), len(blob),
+                              digest) + hbytes + blob
+
+    with pytest.raises(FrameError) as ei:
+        decode_frames(bytearray(forge(b"not json")))
+    assert ei.value.reason == "bad_header"
+    with pytest.raises(FrameError) as ei:
+        decode_frames(bytearray(forge(b"[1, 2]")))
+    assert ei.value.reason == "bad_header"
+    with pytest.raises(FrameError) as ei:
+        decode_frames(bytearray(forge(json.dumps(
+            {"flow": "gossip"}).encode())))
+    assert ei.value.reason == "bad_flow"
+
+
+# ------------------------------------------------------------- server side
+
+
+def test_server_counts_torn_frame_at_eof_as_truncated():
+    rejects = []
+    server = TransportServer(on_reject=lambda r, s: rejects.append(r))
+    try:
+        wire = encode_frame("order", {"k": 1}, b"z" * 64)
+        sock = socket.create_connection(server.address)
+        sock.sendall(wire[:len(wire) - 5])   # die mid-frame
+        sock.close()
+        frames = []
+        for _ in range(50):
+            frames += server.poll(timeout=0.05)
+            if rejects:
+                break
+        assert frames == []
+        assert rejects == ["truncated"]
+        assert server.frame_rejects == 1
+    finally:
+        server.close()
+
+
+def test_server_drops_connection_on_corrupt_frame_but_survives():
+    server = TransportServer()
+    try:
+        wire = bytearray(encode_frame("order", {"k": 1}))
+        wire[-1] ^= 0xFF
+        sock = socket.create_connection(server.address)
+        sock.sendall(bytes(wire))
+        for _ in range(50):
+            server.poll(timeout=0.05)
+            if server.frame_rejects:
+                break
+        assert server.frame_rejects == 1
+        # the listener survives a poisoned connection: a fresh, honest
+        # sender still gets through
+        sock2 = socket.create_connection(server.address)
+        sock2.sendall(encode_frame("order", {"k": 2}))
+        got = []
+        for _ in range(50):
+            got += server.poll(timeout=0.05)
+            if got:
+                break
+        assert [f.header["k"] for f in got] == [2]
+        sock2.close()
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------------- client side
+
+
+def test_backoff_schedule_is_exponential():
+    client = TransportClient(lambda: None, retries=3, backoff_s=0.02)
+    assert client.backoff_schedule() == [0.02, 0.04, 0.08]
+
+
+def test_client_fails_fast_when_peer_never_announces():
+    client = TransportClient(lambda: None, retries=1, backoff_s=0.001)
+    with pytest.raises(TransportError) as ei:
+        client.send("order", {"k": 1})
+    assert "address unknown" in str(ei.value)
+
+
+def test_client_reconnects_after_peer_bounce():
+    server = TransportServer()
+    addr = {"v": server.address}
+    client = TransportClient(lambda: addr["v"], retries=2, backoff_s=0.001)
+    try:
+        client.send("order", {"n": 1})
+        assert client.reconnects == 0
+        # bounce the peer: new listener, new ephemeral port (the respawn
+        # story) — resolve() is re-invoked so the send lands anyway
+        server.close()
+        server = TransportServer()
+        addr["v"] = server.address
+        client.send("order", {"n": 2})
+        assert client.reconnects >= 1        # cached conn detected dead
+        got = []
+        for _ in range(50):
+            got += server.poll(timeout=0.05)
+            if got:
+                break
+        assert [f.header["n"] for f in got] == [2]
+    finally:
+        client.close()
+        server.close()
+
+
+def test_client_raises_after_retry_budget_against_dead_port():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead = probe.getsockname()
+    probe.close()                            # nobody listening here
+    client = TransportClient(lambda: dead, retries=1, backoff_s=0.001,
+                             connect_timeout_s=0.2)
+    with pytest.raises(TransportError) as ei:
+        client.send("result", {"k": 1})
+    assert "after 2 attempt(s)" in str(ei.value)
+
+
+# -------------------------------------------------------- circuit breaker
+
+
+def test_breaker_opens_probes_and_closes():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failures_to_open=3, probe_interval_s=0.5,
+                        clock=lambda: clock["t"])
+    assert br.state == br.CLOSED and br.allow()
+    assert br.record_failure() is None
+    assert br.record_failure() is None
+    assert br.record_failure() == "opened"   # transition reported once
+    assert br.state == br.OPEN
+    assert not br.allow()                    # freshly open: no traffic
+    clock["t"] = 0.6
+    assert br.probe_due()
+    assert br.allow()                        # one probe admitted
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()                    # ...and only one
+    assert br.record_failure() is None       # failed probe: stay dark
+    assert br.state == br.OPEN
+    clock["t"] = 1.0
+    assert not br.probe_due()                # interval restarts at probe
+    clock["t"] = 1.2
+    assert br.allow()
+    assert br.record_success() == "closed"
+    assert br.state == br.CLOSED and br.failures == 0
+    assert br.record_success() is None       # already closed: no edge
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(failures_to_open=2, clock=lambda: 0.0)
+    assert br.record_failure() is None
+    assert br.record_success() is None       # streak broken while CLOSED
+    assert br.record_failure() is None       # needs 2 *consecutive* again
+    assert br.state == br.CLOSED
+    assert br.record_failure() == "opened"
+
+
+def test_breaker_open_duration_uses_clock():
+    clock = {"t": 10.0}
+    br = CircuitBreaker(failures_to_open=1, clock=lambda: clock["t"])
+    br.record_failure()
+    clock["t"] = 12.5
+    assert br.open_for_s() == pytest.approx(2.5)
+    br.allow()
+    br.record_success()
+    assert br.open_for_s() == 0.0
+
+
+# ---------------------------------------------------------- fleet endpoint
+
+
+class _Journal:
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, kind, **fields):
+        self.rows.append((str(kind), fields))
+
+
+def _mk(tmp_path, role, rank, cfg=None, journal=None):
+    base = {"enabled": True, "retries": 0, "backoff_s": 0.001,
+            "connect_timeout_s": 0.2, "failures_to_open": 2,
+            "probe_interval_s": 0.0}
+    base.update(cfg or {})
+    return FleetTransport(base, str(tmp_path), role, rank, journal=journal)
+
+
+def test_fleet_transport_loopback_and_metrics(tmp_path):
+    sup = _mk(tmp_path, "sup", -1)
+    dec = _mk(tmp_path, "decode", 0)
+    try:
+        # endpoint announce: the spool file other processes resolve
+        assert read_endpoint(str(tmp_path), "decode", 0) == \
+            dec.server.address
+        assert sup.send("order", "decode", 0,
+                        {"what": "order", "name": "r0.json", "doc": {}})
+        assert dec.send("result", "sup", -1,
+                        {"what": "result", "doc": {"rid": "r0"}})
+        got = []
+        for _ in range(50):
+            got += dec.poll(timeout=0.05)
+            if got:
+                break
+        assert [f.flow for f in got] == ["order"]
+        m = sup.metrics_sample()
+        assert m["transport.bytes_orders"] > 0
+        assert m["transport.frames_sent"] == 1.0
+        assert m["transport.fallbacks"] == 0.0
+        assert set(m) == {
+            "transport.bytes_orders", "transport.bytes_bundles",
+            "transport.bytes_results", "transport.frames_sent",
+            "transport.frame_rejects", "transport.reconnects",
+            "transport.fallbacks", "transport.breaker_opens",
+            "transport.breaker_closes"}
+    finally:
+        sup.close()
+        dec.close()
+    # close() withdraws the announcement
+    assert not os.path.exists(endpoint_path(str(tmp_path), "decode", 0))
+
+
+def test_fleet_transport_degrades_then_restores(tmp_path):
+    """Dead peer: sends return False (spool is the carrier), the breaker
+    opens exactly once (one ``transport_degraded`` row), and the ping
+    auto-probe re-promotes the flow (one ``transport_restored`` row) when
+    the peer comes back."""
+    journal = _Journal()
+    sup = _mk(tmp_path, "sup", -1, journal=journal)
+    try:
+        # peer never announced → every send falls back; breaker opens on
+        # the configured consecutive-failure threshold, exactly once
+        for _ in range(4):
+            assert sup.send("order", "decode", 0, {"n": 1}) is False
+        assert sup.fallbacks == 4
+        assert sup.breaker_opens == 1
+        degraded = [f for k, f in journal.rows if "degraded" in k]
+        assert len(degraded) == 1
+        assert degraded[0]["peer"] == "decode0"
+        assert degraded[0]["flow"] == "order"
+
+        # peer appears; the next tick's ping probe closes the breaker
+        dec = _mk(tmp_path, "decode", 0)
+        try:
+            for _ in range(50):
+                sup.tick([("decode", 0)])
+                if sup.breaker_closes:
+                    break
+            assert sup.breaker_closes == 1
+            restored = [f for k, f in journal.rows if "restored" in k]
+            assert len(restored) == 1
+            assert restored[0]["peer"] == "decode0"
+            assert restored[0]["open_s"] >= 0.0
+            # real traffic flows again
+            assert sup.send("order", "decode", 0, {"n": 2}) is True
+        finally:
+            dec.close()
+    finally:
+        sup.close()
+
+
+def test_store_bundle_blob_digest_gate(tmp_path):
+    ft = _mk(tmp_path, "decode", 0)
+    try:
+        blob = os.urandom(512)
+        sha = hashlib.sha256(blob).hexdigest()
+        npz = str(tmp_path / "bundles" / "r0.a0.npz")
+        # wrong digest: nothing materialized, reject counted
+        assert ft.store_bundle_blob(npz, blob, "0" * 64) is False
+        assert not os.path.exists(npz)
+        assert ft.rejects_by_reason == {"digest_mismatch": 1}
+        # right digest: atomic materialization (no .tmp left behind)
+        assert ft.store_bundle_blob(npz, blob, sha) is True
+        with open(npz, "rb") as f:
+            assert f.read() == blob
+        assert not os.path.exists(npz + ".tmp")
+        # already present: the publisher's spool copy wins untouched —
+        # a redelivered frame must not rewrite the materialized file
+        with open(npz, "wb") as f:
+            f.write(b"publisher copy")
+        assert ft.store_bundle_blob(npz, blob, sha) is True
+        with open(npz, "rb") as f:
+            assert f.read() == b"publisher copy"
+    finally:
+        ft.close()
+
+
+# ------------------------------------------------------- supersede guards
+
+
+def test_drain_order_frames_last_frame_wins(tmp_path):
+    """The worker's net-order cache holds exactly one doc per order name —
+    a re-pushed (newer) frame replaces the older one, and non-order chatter
+    is ignored."""
+    from deepspeed_tpu.serving.worker_main import _drain_order_frames
+    sup = _mk(tmp_path, "sup", -1)
+    pre = _mk(tmp_path, "prefill", 2)
+    try:
+        sup.send("order", "prefill", 2,
+                 {"what": "order", "name": "r0.a0.json",
+                  "doc": {"rid": "r0", "attempt": 0}})
+        sup.send("order", "prefill", 2,
+                 {"what": "order", "name": "r0.a0.json",
+                  "doc": {"rid": "r0", "attempt": 0, "resent": True}})
+        sup.send("order", "prefill", 2, {"what": "noise"})
+        net = {}
+        for _ in range(50):
+            _drain_order_frames(pre, net)
+            if net.get("r0.a0.json", {}).get("resent"):
+                break
+        assert list(net) == ["r0.a0.json"]
+        assert net["r0.a0.json"]["resent"] is True
+    finally:
+        sup.close()
+        pre.close()
+
+
+def test_streamed_order_superseded_by_route_marker(tmp_path):
+    """A frame-delivered decode order is subject to the same route-marker
+    supersede guard as a spool file: once the request was re-routed to
+    another engine, the stale order must read as not-current."""
+    from deepspeed_tpu.serving.routing import (order_is_current,
+                                               write_route_marker)
+    decode_dir = str(tmp_path / "decode")
+    os.makedirs(os.path.join(decode_dir, "routes"), exist_ok=True)
+    write_route_marker(decode_dir, "r0", d=1, engine=1)
+    # engine 0's streamed copy of (r0, d=0) is a superseded straggler
+    assert not order_is_current(decode_dir, "r0", 0, 0)
+    # the engine actually holding the live route sees it as current
+    assert order_is_current(decode_dir, "r0", 1, 1)
+
+
+def test_stale_attempt_manifest_frame_is_ignored(tmp_path):
+    """Supervisor side: manifest/nack frames are keyed by (rid, attempt)
+    — a straggler frame from a superseded attempt can never satisfy the
+    current attempt's lookup, exactly like the attempt-stamped spool
+    filenames it shadows."""
+    net_manifests = {}
+    for fr in [Frame("result", {"what": "manifest",
+                                "doc": {"rid": "r0", "attempt": 0,
+                                        "bundle": "stale.npz"}}),
+               Frame("result", {"what": "manifest",
+                                "doc": {"rid": "r0", "attempt": 1,
+                                        "bundle": "live.npz"}})]:
+        doc = fr.header["doc"]
+        net_manifests[(doc["rid"], int(doc["attempt"]))] = doc
+    req_attempt = 1                          # attempt 0 was retried away
+    hit = net_manifests.get(("r0", req_attempt))
+    assert hit["bundle"] == "live.npz"
+    assert net_manifests[("r0", 0)]["bundle"] == "stale.npz"  # inert
+
+
+def test_flows_registry_is_closed():
+    """The flow set is part of the wire contract — growing it silently
+    would let old receivers hard-reject new senders (bad_flow drops the
+    connection), so changing it must be a conscious, versioned act."""
+    assert FLOWS == ("order", "bundle", "result", "ping")

@@ -130,6 +130,18 @@ def test_non_atomic_write_covers_runtime_engine():
     assert good == []
 
 
+def test_non_atomic_write_covers_runtime_transport():
+    # the fleet transport materializes streamed KV bundle blobs and
+    # endpoint announce files other processes read — torn writes there
+    # are exactly the corruption the frame digests exist to keep out
+    bad = lint('open(npz_path, "wb")\n',
+               "deepspeed_tpu/runtime/transport.py")
+    assert rules_of(bad) == ["non-atomic-write"]
+    good = lint('open(npz_path + ".tmp", "wb")\n',
+                "deepspeed_tpu/runtime/transport.py")
+    assert good == []
+
+
 def test_non_atomic_write_suppressible():
     findings = lint(
         'open(p, "wb")  # dslint: disable=non-atomic-write — test scratch\n',

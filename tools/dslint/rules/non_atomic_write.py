@@ -30,6 +30,10 @@ SCOPES = (
     # the serving pager's disk-park path persists session KV a follow-up
     # turn will trust — a torn park file must never be readable as valid
     "deepspeed_tpu/serving/paging.py",
+    # the fleet transport materializes streamed KV bundle blobs and
+    # endpoint announce files other processes read — a torn npz or
+    # half-written endpoint must never be observable
+    "deepspeed_tpu/runtime/transport.py",
 )
 
 EXEMPT_FUNCS = {"write_tmp", "_atomic_attempt"}
