@@ -79,6 +79,7 @@ def main(args=None) -> int:
                 p.terminate()
         for p in procs:
             try:
+                # dslint: disable=signal-handler-purity — the launcher IS the teardown path: it must reap the child tree before exiting, and it exits right after (nothing left to deadlock)
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()

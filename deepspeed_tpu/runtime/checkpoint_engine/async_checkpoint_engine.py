@@ -11,12 +11,12 @@ marker — so a crash mid-write never leaves a half-checkpoint advertised.
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ...utils.lock_watch import LockName, TrackedLock
 from ...utils.logging import logger
 from .checkpoint_engine import CheckpointEngine
 from .native_checkpoint_engine import (NativeCheckpointEngine, _ckpt_config,
@@ -35,7 +35,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
                                         thread_name_prefix="ckpt-writer")
         self._pending: List[Future] = []
         self._sync = NativeCheckpointEngine(self.ckpt_config)
-        self._lock = threading.Lock()
+        # guards _pending AND _last_error (the chain writes the latter from
+        # a writer thread; wait() reads-and-clears it from the train loop)
+        self._lock = TrackedLock(LockName.CKPT_ASYNC_PENDING)
         self._last_error: Optional[BaseException] = None
 
     # ----------------------------------------------------------------- save
@@ -76,7 +78,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 else:
                     logger.info(f"[async-ckpt] tag {tag} committed")
             except BaseException as e:  # surfaced on the next wait()
-                self._last_error = e
+                with self._lock:
+                    self._last_error = e
                 logger.error(f"[async-ckpt] writing tag {tag} FAILED — the "
                              f"latest marker was NOT published: {e!r}")
 
@@ -98,8 +101,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
             pending, self._pending = self._pending, []
         for f in pending:
             f.result()  # re-raise writer errors in the caller
-        if self._last_error is not None:
+        with self._lock:
             err, self._last_error = self._last_error, None
+        if err is not None:
             raise RuntimeError("async checkpoint write failed") from err
 
     def commit(self, tag: str) -> bool:

@@ -21,11 +21,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...utils.jsonl import read_jsonl
+from ...utils.lock_watch import LockName, TrackedRLock
 from ...utils.logging import logger
 
 
@@ -104,6 +104,8 @@ class EventKind:
     METRICS_SAMPLE = "metrics.sample"
     TRACE_CAPTURE = "trace.capture"
     TRACE_EXPORT = "trace.export"
+    CONCURRENCY_LOCK_CYCLE = "concurrency.lock_cycle"
+    CONCURRENCY_CONTENTION = "concurrency.contention"
 
 
 #: every registered kind, as a set of strings
@@ -222,6 +224,9 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
     EventKind.METRICS_SAMPLE: ("step",),
     EventKind.TRACE_CAPTURE: ("logdir", "started"),
     EventKind.TRACE_EXPORT: ("path", "spans"),
+    EventKind.CONCURRENCY_LOCK_CYCLE: ("lock_a", "lock_b", "thread_a",
+                                       "thread_b"),
+    EventKind.CONCURRENCY_CONTENTION: ("lock", "wait_s", "thread"),
 }
 
 
@@ -229,17 +234,21 @@ class EventJournal:
     """Append-only JSONL journal, safe to call from any thread (the
     watchdog thread and signal handlers both emit).
 
-    Each :meth:`emit` opens/append/flush/closes — a crashed process loses at
-    most the record being written, never earlier ones, and the file is
-    readable while the run is live.
+    Each :meth:`emit` lands as ONE ``os.write`` on an ``O_APPEND`` fd — the
+    kernel serializes whole records, so concurrent emitters (threads, or a
+    second process appending to the same journal) can never interleave
+    bytes mid-line, and a crashed process loses at most the record being
+    written.  The file is readable while the run is live.
     """
 
     def __init__(self, path: str, rank: int = 0):
         self.path = str(path)
         self.rank = int(rank)
-        # RLock: emit() may be re-entered by a signal handler that fires
-        # while the main thread is itself mid-emit — a plain Lock deadlocks
-        self._lock = threading.RLock()
+        # reentrant: emit() may be re-entered by a signal handler that
+        # fires while the main thread is itself mid-emit — a plain Lock
+        # deadlocks.  Tracked at JOURNAL_EMIT (innermost in LOCK_ORDER:
+        # everything journals, nothing is acquired while journaling).
+        self._lock = TrackedRLock(LockName.JOURNAL_EMIT)
         self._seq = 0
         d = os.path.dirname(self.path)
         if d:
@@ -260,9 +269,14 @@ class EventJournal:
                        "kind": rec["kind"], "repr": repr(fields)}
                 line = json.dumps(rec, default=str)
             try:
-                with open(self.path, "a") as f:
-                    f.write(line + "\n")
-                    f.flush()
+                # one O_APPEND write per record: whole-record atomicity even
+                # against emitters this lock doesn't cover (other processes)
+                fd = os.open(self.path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    os.write(fd, (line + "\n").encode("utf-8"))
+                finally:
+                    os.close(fd)
             except OSError as e:  # journal loss must not kill the run
                 logger.warning(f"[supervision] event journal write failed: {e}")
             return rec

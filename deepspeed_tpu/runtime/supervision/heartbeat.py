@@ -25,6 +25,7 @@ from time import monotonic as _monotonic
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...utils import fault_injection
+from ...utils.lock_watch import LockName, TrackedLock
 from ...utils.logging import logger
 from .events import EventKind
 
@@ -53,6 +54,9 @@ class HeartbeatWriter:
         self.journal = journal
         self.beats = 0
         self._step = 0
+        # guards beats/_step (written by both the beat thread and the train
+        # loop's note_step); the file write itself stays OUTSIDE the lock
+        self._lock = TrackedLock(LockName.SUPERVISION_HEARTBEAT)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(self.directory, exist_ok=True)
@@ -64,13 +68,16 @@ class HeartbeatWriter:
     def note_step(self, step: int) -> None:
         """Record the current step without writing — the next beat carries
         it (per-step writes would put a file op on the train hot path)."""
-        self._step = int(step)
+        with self._lock:
+            self._step = int(step)
 
     def beat(self, step: Optional[int] = None) -> None:
         """Write one heartbeat now (failures are logged, never fatal —
         losing a beat is strictly better than killing the host over it)."""
-        if step is not None:
-            self._step = int(step)
+        with self._lock:
+            if step is not None:
+                self._step = int(step)
+            cur_step = self._step
         try:
             fault_injection.fire("supervision.heartbeat", path=self.path,
                                  rank=self.rank)
@@ -80,14 +87,15 @@ class HeartbeatWriter:
             # ts/mono_ts pair doubles as a per-process clock handshake for
             # trace merging (wall − monotonic offset is constant per pid)
             payload = {"rank": self.rank, "pid": os.getpid(),
-                       "step": self._step, "ts": time.time(),
+                       "step": cur_step, "ts": time.time(),
                        "mono_ts": _monotonic(),
                        "interval_s": self.interval_s}
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f)
             os.replace(tmp, self.path)
-            self.beats += 1
+            with self._lock:
+                self.beats += 1
         except OSError as e:
             logger.warning(f"[supervision] heartbeat write failed: {e}")
 
@@ -105,10 +113,16 @@ class HeartbeatWriter:
         while not self._stop.wait(self.interval_s):
             self.beat()
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 1.0) -> None:
+        """Stop the beat thread; the join is bounded so a beat stuck on a
+        wedged filesystem cannot hang teardown."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=1.0)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "[supervision] heartbeat thread did not exit within "
+                    f"{timeout:.1f}s")
             self._thread = None
 
 

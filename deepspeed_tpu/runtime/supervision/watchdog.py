@@ -31,6 +31,7 @@ import traceback
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ...utils.lock_watch import LockName, TrackedRLock
 from ...utils.logging import logger
 from .events import EventKind
 
@@ -70,7 +71,9 @@ class StepWatchdog:
         self.on_expire = on_expire
         self.abort_signal = abort_signal
         self.expired_count = 0
-        self._cond = threading.Condition()
+        # reentrant so _ensure_thread can take it from arm()'s callers
+        self._cond = threading.Condition(
+            TrackedRLock(LockName.SUPERVISION_WATCHDOG))
         self._deadline: Optional[float] = None  # time.monotonic() when armed
         self._label: Optional[str] = None
         self._stop = False
@@ -78,7 +81,9 @@ class StepWatchdog:
 
     # ------------------------------------------------------------- arming
     def _ensure_thread(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
+        with self._cond:  # _stop/_thread share the cond with the loop
+            if self._thread is not None and self._thread.is_alive():
+                return
             self._stop = False  # re-armable after stop() (runner reuse)
             self._thread = threading.Thread(
                 target=self._loop, name="step-watchdog", daemon=True)
@@ -115,14 +120,20 @@ class StepWatchdog:
         finally:
             self._restore(prev)
 
-    def stop(self) -> None:
-        """Shut the watchdog thread down (end of run)."""
+    def stop(self, timeout: float = 1.0) -> None:
+        """Shut the watchdog thread down (end of run); the join is bounded
+        so a wedged expiry path cannot hang the caller's teardown."""
         with self._cond:
             self._stop = True
             self._deadline = None
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=1.0)
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+            if thread.is_alive():
+                logger.warning(
+                    "[supervision] watchdog thread did not exit within "
+                    f"{timeout:.1f}s")
 
     # ------------------------------------------------------------- expiry
     def _loop(self) -> None:

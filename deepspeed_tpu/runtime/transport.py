@@ -48,6 +48,14 @@ Fault points: ``serve.transport.send`` fires per send attempt (ctx:
 ``serve.transport.recv`` per received frame (ctx: ``step`` = frame
 counter, ``path`` = flow) — ``KillAtStep`` mid-stream, ``FailNTimes`` for
 connection resets, ``DelaySeconds``/``HangFor`` for stalls.
+
+Concurrency: deliberately NONE.  Every endpoint is non-blocking sockets +
+``select`` driven from its owner's poll loop (the fleet supervisor and the
+worker mains are single-threaded), so this module holds no locks and spawns
+no threads.  If a background poller thread is ever added, its shared state
+must use ``utils.lock_watch.TrackedLock(LockName.TRANSPORT_NET)`` — the
+name is already registered in the global ``LOCK_ORDER`` (and dslint's
+``lock-order`` rule flags any bare ``threading.Lock`` added here).
 """
 
 from __future__ import annotations

@@ -467,7 +467,10 @@ class ServeFleetSupervisor:
                 journal=self.journal, trace=self.trace.fields())
         # frame-delivered fast-path caches, consulted before the spool
         # read they shadow (the file always exists by the time its frame
-        # does — sender ordering)
+        # does — sender ordering).  Mutated ONLY from _drain_transport on
+        # the supervisor's poll thread (transport.poll() is select-based,
+        # not threaded), so they stay lock-free; anything that moves their
+        # fill onto another thread must guard them with a TrackedLock
         self._net_manifests: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._net_results: Dict[str, Dict[str, Any]] = {}
         self._net_nacks: Dict[Tuple[str, int], Dict[str, Any]] = {}

@@ -33,11 +33,12 @@ import numpy as np
 import jax
 
 from ..runtime.supervision.events import EventJournal, EventKind
-from ..telemetry.metrics import MetricName
+from ..telemetry.metrics import MetricName, lock_watch_metrics
 from ..telemetry.propagate import mint_context
 from ..telemetry.spans import SpanName, Tracer
 from ..utils import fault_injection
 from ..utils.compile_watch import CompileWatch
+from ..utils.lock_watch import LockName, TrackedRLock, install_journal
 from ..utils.logging import logger
 from .batcher import PrefixEntry, SlotBatcher
 from .config import ServingConfig
@@ -125,8 +126,16 @@ class ServingGateway:
             self._ladder = DegradationLadder(config.overload_config,
                                             available=rungs)
         # RLock: submit() rejects (journal + depth read) while already
-        # holding the condition for the queue-capacity check
-        self._cond = threading.Condition(threading.RLock())
+        # holding the condition for the queue-capacity check.  Tracked at
+        # SERVE_GATEWAY (outermost in LOCK_ORDER): the scheduler holds it
+        # while touching the pager, request handles, metrics, and the
+        # journal — the lock-order watchdog proves those nestings stay
+        # acyclic on every e2e run.
+        self._cond = threading.Condition(TrackedRLock(LockName.SERVE_GATEWAY))
+        if journal is not None:
+            # route concurrency.lock_cycle / .contention to this run's
+            # journal (process-global: last journal-carrying gateway wins)
+            install_journal(journal)
         self._queue: list = []               # heap of (sort_key, request)
         self._active: Dict[int, ServeRequest] = {}   # row -> request
         self._free_rows = list(range(config.slots))
@@ -291,8 +300,11 @@ class ServingGateway:
         """Stream this gateway's gauges through a telemetry
         :class:`~deepspeed_tpu.telemetry.metrics.MetricsSampler`: every
         sample row then carries queue depth, slot occupancy, TTFT
-        percentiles, and decode tokens/s next to the train-side fields."""
+        percentiles, and decode tokens/s next to the train-side fields.
+        Tracked-lock contention/hold stats ride along (the gateway is the
+        most lock-dense owner, so it carries the concurrency feed)."""
         sampler.attach_source(self._metrics_source)
+        sampler.attach_source(lock_watch_metrics)
 
     def _metrics_source(self) -> dict:
         snap = self.snapshot()
@@ -349,7 +361,14 @@ class ServingGateway:
             self._stopped.set()
             with self._cond:
                 self._cond.notify_all()
-            self._thread.join(timeout=30.0)
+            # bounded join: honor what is left of the caller's deadline
+            # (a wedged tick must not hang shutdown forever either way)
+            join_s = 30.0 if deadline is None \
+                else max(0.1, deadline - time.monotonic())
+            self._thread.join(timeout=join_s)
+            if self._thread.is_alive():
+                logger.warning("[serving] scheduler thread did not stop "
+                               f"within {join_s:.1f}s")
         self._pull_compile_stats()
         self._watch.close()   # journals perf.host_sync totals
 
@@ -382,8 +401,13 @@ class ServingGateway:
     def _shed(self, rid: str, handle: RequestHandle, priority: int,
               d: ShedDecision) -> None:
         """Journals the decision made under the lock (``d`` carries the
-        depth the check saw); runs lock-free so shed storms cost the
-        scheduler nothing."""
+        depth the check saw); runs free of the scheduler cond so shed
+        storms cost the decode loop nothing.  Not literally lock-free:
+        it takes serve.metrics, journal.emit, and serve.request — all
+        ranked below serve.gateway in LOCK_ORDER, so the path stays
+        legal even from callers holding the cond.  The journal emit is
+        one ``os.write`` per record: a shed storm from N submitter
+        threads can never tear lines."""
         self.metrics.count("shed")
         self.metrics.count("rejected")
         self._emit(EventKind.SERVE_SHED, request_id=rid,

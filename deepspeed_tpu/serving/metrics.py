@@ -12,11 +12,11 @@ hot path; the snapshot's ``ttft_s`` list is that reservoir, API-stable.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
 from ..telemetry.metrics import Histogram, MetricName
+from ..utils.lock_watch import LockName, TrackedLock
 
 #: TTFT samples kept (oldest dropped) — enough for p99 at bench scale
 _TTFT_CAP = 4096
@@ -24,7 +24,7 @@ _TTFT_CAP = 4096
 
 class ServingMetrics:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.SERVE_METRICS)
         self.t_start = time.monotonic()
         self.submitted = 0
         self.admitted = 0

@@ -42,7 +42,6 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
-import threading
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -54,6 +53,7 @@ import jax.numpy as jnp
 
 from ..runtime.supervision.events import EventKind
 from ..utils import fault_injection
+from ..utils.lock_watch import LockName, TrackedLock
 from ..utils.logging import logger
 
 __all__ = [
@@ -529,7 +529,7 @@ class SessionPager:
         self._batcher = batcher
         self._emit = emit if emit is not None else (lambda *a, **k: None)
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.SERVE_PAGER)
         self.sessions: "OrderedDict[str, TieredSession]" = OrderedDict()
         self.rows: Dict[int, _RowLedger] = {}
         self.slot_bytes = cache_bank_bytes(batcher.cache)

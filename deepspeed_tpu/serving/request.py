@@ -16,6 +16,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..utils.lock_watch import LockName, TrackedLock
+
 
 class RequestState:
     QUEUED = "queued"
@@ -80,7 +82,7 @@ class RequestHandle:
         self.request_id = request_id
         self._done = threading.Event()
         self._cancel = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.SERVE_REQUEST)
         self._tokens: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
         self.state = RequestState.QUEUED

@@ -28,17 +28,19 @@ from __future__ import annotations
 import json
 import math
 import os
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils import lock_watch
 from ..utils.jsonl import read_jsonl
+from ..utils.lock_watch import LockName, TrackedLock
 from ..utils.logging import logger
 
 __all__ = [
     "MetricName", "METRIC_NAMES", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "MetricsSampler", "read_metrics", "analytic_mfu",
     "peak_flops_per_chip", "host_rss_bytes", "live_buffer_bytes",
+    "lock_watch_metrics",
 ]
 
 
@@ -128,6 +130,18 @@ class MetricName:
     ROLLBACKS = "elastic.rollbacks"
     #: fleet incarnation index (how many whole-group restarts preceded us)
     RESTARTS = "elastic.restarts"
+    #: contended tracked-lock acquisitions, all locks, cumulative
+    #: (``utils/lock_watch.py`` — see docs/static-analysis.md)
+    CONCURRENCY_LOCK_CONTENTION = "concurrency.lock_contention"
+    #: cumulative seconds threads spent blocked on contended tracked locks
+    CONCURRENCY_LOCK_WAIT_S = "concurrency.lock_wait_s"
+    #: histogram block over tracked-lock hold times (bounded per-lock
+    #: reservoirs, maxima-preserving past the bound)
+    CONCURRENCY_LOCK_HOLD_S = "concurrency.lock_hold_s"
+    #: per-lock-name stats table {name: {acquisitions, contentions,
+    #: wait_s, hold_p99_s}} — what the dump_run_events concurrency
+    #: footer ranks top contended locks from
+    CONCURRENCY_LOCKS = "concurrency.locks"
 
 
 #: every registered metric name, as a frozenset of strings
@@ -150,7 +164,7 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_METRIC)
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -168,7 +182,7 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_METRIC)
         self._value: Optional[float] = None
 
     def set(self, value: float) -> None:
@@ -192,7 +206,7 @@ class Histogram:
     def __init__(self, name: str = "", cap: int = 4096):
         self.name = name
         self.cap = int(cap)
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_METRIC)
         self._samples: List[float] = []
         self._count = 0
         self._sum = 0.0
@@ -254,7 +268,7 @@ class MetricsRegistry:
 
     def __init__(self, name: str = "telemetry"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_REGISTRY)
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -297,6 +311,53 @@ class MetricsRegistry:
         return out
 
 
+def lock_watch_metrics() -> Dict[str, Any]:
+    """Sampler source feeding tracked-lock telemetry into ``metrics.sample``
+    rows: total contended acquisitions, total wait seconds, a hold-time
+    histogram block, and the per-lock table the ``dump_run_events.py``
+    concurrency footer ranks.  Returns ``{}`` before any tracked lock has
+    been acquired, so runs that never touch one emit no extra keys.
+
+    Attach with ``sampler.attach_source(lock_watch_metrics)`` (the serving
+    gateway does).
+    """
+    stats = lock_watch.lock_stats()
+    if not stats:
+        return {}
+    holds: List[float] = []
+    table: Dict[str, Any] = {}
+    contentions = 0
+    wait_s = 0.0
+    for name, s in stats.items():
+        holds.extend(s["holds"])
+        contentions += s["contentions"]
+        wait_s += s["wait_s"]
+        hs = sorted(s["holds"])
+        table[name] = {
+            "acquisitions": s["acquisitions"],
+            "contentions": s["contentions"],
+            "wait_s": round(s["wait_s"], 6),
+            "hold_p99_s": round(
+                hs[min(len(hs) - 1, math.ceil(0.99 * len(hs)) - 1)], 6)
+            if hs else None,
+        }
+    holds.sort()
+    n = len(holds)
+    return {
+        MetricName.CONCURRENCY_LOCK_CONTENTION: contentions,
+        MetricName.CONCURRENCY_LOCK_WAIT_S: round(wait_s, 6),
+        MetricName.CONCURRENCY_LOCK_HOLD_S: {
+            "count": n,
+            "mean": round(sum(holds) / n, 6) if n else None,
+            "p50": round(holds[min(n - 1, math.ceil(0.50 * n) - 1)], 6)
+            if n else None,
+            "p99": round(holds[min(n - 1, math.ceil(0.99 * n) - 1)], 6)
+            if n else None,
+        },
+        MetricName.CONCURRENCY_LOCKS: table,
+    }
+
+
 # ---------------------------------------------------------------- sampler
 class MetricsSampler:
     """Appends ``metrics.sample`` rows to a JSONL sidecar.
@@ -317,7 +378,7 @@ class MetricsSampler:
         self.rank = int(rank)
         self.interval_steps = max(1, int(interval_steps))
         self._journal = journal
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_SAMPLER)
         self._seq = 0
         self._sources: List[Callable[[], Dict[str, Any]]] = []
         if self.path:

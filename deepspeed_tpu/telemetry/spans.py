@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.lock_watch import LockName, TrackedLock
+
 __all__ = ["SpanName", "SPAN_NAMES", "SpanRecord", "Tracer"]
 
 
@@ -205,7 +207,7 @@ class Tracer:
         self.synced = bool(synced)
         self._sync_registry = sync_registry
         self._clock = time.monotonic
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.TELEMETRY_SPANS)
         self._records: List[SpanRecord] = []
         self._agg: Dict[str, Tuple[int, float]] = {}
         self._local = threading.local()

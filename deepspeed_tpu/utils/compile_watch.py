@@ -40,10 +40,11 @@ compile counts/seconds are a diffable per-PR artifact.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from .lock_watch import LockName, TrackedLock
 
 __all__ = [
     "hot_path", "CompileEvent", "CompiledProgramRegistry", "CompileWatch",
@@ -150,7 +151,7 @@ class CompiledProgramRegistry:
 
     def __init__(self, name: str = "programs"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(LockName.PERF_COMPILE_REGISTRY)
         self._programs: Dict[str, _WrappedProgram] = {}
         #: compiles owned by programs later re-registered under the same
         #: name — an un-cached (rebuilt-per-call) program keeps counting

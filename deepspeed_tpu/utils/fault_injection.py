@@ -117,6 +117,15 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+# this module must stay loadable as a STANDALONE file — fault-plan
+# children load it via spec_from_file_location with no parent package to
+# arm DS_FAULT_PLAN faults before anything else imports — so the tracked
+# lock degrades to a bare threading.Lock outside the package
+try:
+    from . import lock_watch
+except ImportError:
+    lock_watch = None
+
 #: Single source of truth for every wired fault point.  ``dslint``'s
 #: ``unregistered-fault-point`` rule checks ``fire``/``install``/``inject``
 #: call sites against this set — register new points HERE (and document
@@ -151,7 +160,11 @@ FAULT_POINTS = frozenset({
 # points with faults installed; guarded by _lock for install/clear, read
 # without it in fire() (list snapshot semantics are enough for tests)
 _faults: Dict[str, List["Fault"]] = {}
-_lock = threading.Lock()
+if lock_watch is None:
+    # dslint: disable=lock-order — standalone fault-plan child: no watchdog to feed
+    _lock = threading.Lock()
+else:
+    _lock = lock_watch.TrackedLock(lock_watch.LockName.FAULTS_INSTALL)
 
 
 class FaultError(OSError):

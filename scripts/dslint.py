@@ -12,6 +12,8 @@ Usage:
     python scripts/dslint.py --update-baseline    # regenerate (sorted)
     python scripts/dslint.py --list-rules
     python scripts/dslint.py deepspeed_tpu/comm   # restrict to a subtree
+    python scripts/dslint.py --changed            # only git-modified files
+    python scripts/dslint.py --jobs 4             # parallel parsing
 
 Exit codes: 0 clean vs baseline; 1 new findings; 2 usage error.
 Suppress a single line with ``# dslint: disable=<rule-id> — <reason>``.
@@ -34,6 +36,26 @@ from tools.dslint import (BASELINE_PATH, default_rules,  # noqa: E402
 from tools.dslint.project_checks import RULE_ID as DRIFT_RULE  # noqa: E402
 
 
+def git_changed_paths(root: str) -> List[str]:
+    """Repo-relative .py paths that differ from HEAD (staged, unstaged,
+    and untracked-but-not-ignored).  Deleted files drop out naturally:
+    a path with no file on disk lints nothing."""
+    import subprocess
+    paths = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"error: --changed needs git ({e})", file=sys.stderr)
+            raise SystemExit(2)
+        paths.update(line.strip() for line in out.splitlines()
+                     if line.strip().endswith(".py"))
+    return sorted(paths)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dslint", description=__doc__.splitlines()[0])
@@ -47,6 +69,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from the current findings "
                          "(deterministic: sorted keys)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files git reports as modified vs HEAD "
+                         "(plus untracked); same exit semantics, baseline "
+                         "still consulted")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse files across N processes (default 1)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -59,16 +87,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root, BASELINE_PATH)
-    findings = lint_tree(root)
+    partial = bool(args.paths) or args.changed
+    if partial and args.update_baseline:
+        print("error: --update-baseline requires a whole-tree run "
+              "(drop the path arguments / --changed)", file=sys.stderr)
+        return 2
+    if args.changed:
+        changed = git_changed_paths(root)
+        if args.paths:  # both: intersect — paths narrow the changed set
+            prefixes = tuple(p.rstrip("/").replace(os.sep, "/")
+                             for p in args.paths)
+            changed = [c for c in changed if c.startswith(prefixes)]
+        args.paths = changed
+        if not changed:
+            print("dslint: no changed .py files", file=sys.stderr)
+            return 0
+    # --changed/path runs skip parsing out-of-scope files entirely;
+    # drift checks still run and are prefix-filtered below
+    findings = lint_tree(root, jobs=args.jobs,
+                         paths=args.paths if partial else None)
     if args.paths:
         prefixes = tuple(p.rstrip("/").replace(os.sep, "/")
                          for p in args.paths)
         findings = [f for f in findings
                     if f.path.startswith(prefixes)]
-        if args.update_baseline:
-            print("error: --update-baseline requires a whole-tree run "
-                  "(drop the path arguments)", file=sys.stderr)
-            return 2
 
     if args.update_baseline:
         with open(baseline_path, "w", encoding="utf-8") as f:
@@ -77,6 +119,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     baseline = load_baseline(baseline_path) if not args.no_baseline else None
+    if baseline is not None and partial:
+        # a partial view can only judge staleness for the files it saw
+        prefixes = tuple(p.rstrip("/").replace(os.sep, "/")
+                         for p in args.paths)
+        for key in list(baseline):
+            if not key.startswith(prefixes):
+                del baseline[key]
     if baseline is None:
         new, stale = list(findings), 0
     else:

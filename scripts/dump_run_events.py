@@ -248,6 +248,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             by[e["kind"]] = by.get(e["kind"], 0) + 1
         print("telemetry: " + "  ".join(
             f"{k}={by[k]}" for k in sorted(by)), file=sys.stderr)
+    # concurrency footer: lock-order cycles (each one is a latent
+    # deadlock — the watchdog journals the two locks and threads), the
+    # most contended locks, and the worst hold-time p99 from the
+    # concurrency.locks metrics table
+    cycles = [e for e in events if e.get("kind") == "concurrency.lock_cycle"]
+    slow = [e for e in events if e.get("kind") == "concurrency.contention"]
+    locks = {}
+    for e in events:
+        if e.get("kind") != "metrics.sample":
+            continue
+        for name, row in (
+                (e.get("m") or {}).get("concurrency.locks") or {}).items():
+            cur = locks.setdefault(str(name),
+                                   {"contentions": 0, "hold_p99_s": 0.0})
+            cur["contentions"] = max(cur["contentions"],
+                                     int(row.get("contentions") or 0))
+            cur["hold_p99_s"] = max(cur["hold_p99_s"],
+                                    float(row.get("hold_p99_s") or 0.0))
+    if (cycles or slow or locks) and not args.as_json:
+        line = f"concurrency: cycles={len(cycles)}"
+        if cycles:
+            pairs = sorted({f"{e.get('lock_a', '?')}<->{e.get('lock_b', '?')}"
+                            for e in cycles})
+            line += " (" + ",".join(pairs) + ")"
+        if slow:
+            line += f"  slow_acquires={len(slow)}"
+        contended = sorted((n for n in locks if locks[n]["contentions"]),
+                           key=lambda n: -locks[n]["contentions"])[:3]
+        if contended:
+            line += "  top_contended=" + ",".join(
+                f"{n}:{locks[n]['contentions']}" for n in contended)
+        if locks:
+            worst = max(locks, key=lambda n: locks[n]["hold_p99_s"])
+            line += (f"  max_hold_p99={locks[worst]['hold_p99_s']:.6f}s"
+                     f"({worst})")
+        print(line, file=sys.stderr)
     aborts = sum(1 for e in events if e.get("kind") in ABORT_KINDS)
     if aborts:
         print(f"\n{len(events)} event(s), {aborts} abort-class",

@@ -110,6 +110,20 @@ def test_kill_prefill_mid_chunk_exact_output_and_no_recompiles(tmp_path):
     assert len(merged["fleetMeta"]["sources"]) >= 2, merged["fleetMeta"]
     assert not merged["fleetMeta"]["unaligned"], merged["fleetMeta"]
 
+    # ---- concurrency gate: no lock-order cycle observed anywhere in the
+    # supervisor (this process) or journaled by any worker, and the
+    # multi-writer journal has zero torn lines — every raw line parses
+    from deepspeed_tpu.utils.lock_watch import assert_no_lock_cycles
+    assert_no_lock_cycles()
+    assert not [e for e in events
+                if e["kind"] == EventKind.CONCURRENCY_LOCK_CYCLE]
+    with open(os.path.join(run_dir, "events.jsonl"),
+              encoding="utf-8") as f:
+        raw_lines = [l for l in f.read().splitlines() if l]
+    assert len(raw_lines) == len(events)
+    for line in raw_lines:
+        json.loads(line)
+
 
 def test_streamed_transport_output_bitwise_identical_to_spool_only(tmp_path):
     """The socket transport is an accelerator, never the record of truth:

@@ -67,6 +67,20 @@ def test_gateway_e2e_bitwise_vs_sequential(tmp_path):
     assert kinds.count(EventKind.SERVE_DONE) == 10
     assert EventKind.SERVE_TICK in kinds
 
+    # concurrency gate: the whole storm (scheduler thread + submitter +
+    # sampler) observed zero lock-order cycles, and the multi-threaded
+    # journal has zero torn lines (every line parses; read() skips
+    # garbage, so count raw lines directly)
+    from deepspeed_tpu.utils.lock_watch import assert_no_lock_cycles
+    assert_no_lock_cycles()
+    assert EventKind.CONCURRENCY_LOCK_CYCLE not in kinds
+    import json as _json
+    with open(journal.path, encoding="utf-8") as f:
+        raw_lines = [l for l in f.read().splitlines() if l]
+    assert len(raw_lines) == len(kinds)
+    for line in raw_lines:
+        _json.loads(line)
+
 
 def test_gateway_eos_early_stop_reuses_slot(tmp_path):
     """A request whose model emits its eos finishes early (output ends at

@@ -13,6 +13,12 @@ PROJECT = Project(
     fault_points={"ckpt.write", "data.next"},
     bucketing_helpers={"bucket_max_new_tokens", "bucket_cache_len",
                        "tile_cache_len"},
+    lock_name_map={"SERVE_GATEWAY": "serve.gateway",
+                   "SERVE_METRICS": "serve.metrics",
+                   "TELEMETRY_REGISTRY": "telemetry.registry",
+                   "JOURNAL_EMIT": "journal.emit"},
+    lock_order=("serve.gateway", "serve.metrics", "telemetry.registry",
+                "journal.emit"),
 )
 
 CKPT = "deepspeed_tpu/runtime/checkpoint_engine/fixture.py"
@@ -604,4 +610,310 @@ def test_untraced_fleet_event_scoped_and_suppressible():
         # dslint: disable=untraced-fleet-event — fixture without context
         journal.emit("fleet.spawn", pids=[1])
     """)
+    assert findings == []
+
+
+# --------------------------------------------------- unguarded-shared-state
+def test_unguarded_shared_state_fires_on_cross_thread_write():
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.count = 0
+                self._lock = TrackedLock(LockName.SERVE_METRICS)
+                self._t = threading.Thread(target=self._run, name="p",
+                                           daemon=True)
+
+            def _run(self):
+                self.count += 1
+
+            def snapshot(self):
+                return self.count
+
+            def stop(self):
+                self._t.join(timeout=1.0)
+    """, SERVE)
+    assert rules_of(findings) == ["unguarded-shared-state"]
+    assert "count" in findings[0].message
+
+
+def test_unguarded_shared_state_quiet_when_guarded_or_set_once():
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.count = 0
+                self.config = "set once before start()"
+                self._lock = TrackedLock(LockName.SERVE_METRICS)
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, name="p",
+                                           daemon=True)
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+                self._stop.set()
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count
+
+            def stop(self):
+                self._t.join(timeout=1.0)
+    """, SERVE)
+    assert findings == []
+
+
+def test_unguarded_shared_state_ignores_threadless_classes_and_suppression():
+    assert lint("""
+        class Plain:
+            def bump(self):
+                self.count += 1
+    """, SERVE) == []
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, name="p",
+                                           daemon=True)
+
+            def _run(self):
+                # dslint: disable=unguarded-shared-state — single writer, reader tolerates staleness
+                self.count = 1
+
+            def read(self):
+                return 0
+
+            def stop(self):
+                self._t.join(timeout=1.0)
+    """, SERVE)
+    assert findings == []
+
+
+# ------------------------------------------------------- blocking-under-lock
+def test_blocking_under_lock_fires_on_sleep_subprocess_and_join():
+    findings = lint("""
+        import subprocess
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = TrackedLock(LockName.SERVE_METRICS)
+
+            def a(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def b(self):
+                with self._lock:
+                    subprocess.run(["ls"])
+
+            def c(self, worker):
+                with self._lock:
+                    worker.join(timeout=2.0)
+    """, SERVE)
+    assert rules_of(findings) == ["blocking-under-lock"] * 3
+
+
+def test_blocking_under_lock_quiet_outside_lock_and_for_cond_wait():
+    findings = lint("""
+        import time
+
+        class W:
+            def __init__(self):
+                self._cond = threading.Condition(
+                    TrackedRLock(LockName.SERVE_GATEWAY))
+
+            def a(self):
+                time.sleep(0.5)
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+
+            def b(self, path):
+                with self._cond:
+                    with open(path, "a") as f:
+                        f.write("append-mode audit line")
+    """, SERVE)
+    assert findings == []
+
+
+def test_blocking_under_lock_suppressible():
+    findings = lint("""
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = TrackedLock(LockName.SERVE_METRICS)
+
+            def a(self):
+                with self._lock:
+                    # dslint: disable=blocking-under-lock — test-only fixture pacing
+                    time.sleep(0.01)
+    """, SERVE)
+    assert findings == []
+
+
+# ---------------------------------------------------------------- lock-order
+def test_lock_order_fires_on_bare_primitive_and_unregistered_name():
+    findings = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = TrackedLock("not.in.the.registry")
+    """, SERVE)
+    assert sorted(rules_of(findings)) == ["lock-order"] * 2
+
+
+def test_lock_order_fires_on_rank_inversion_and_quiet_in_order():
+    findings = lint("""
+        class W:
+            def __init__(self):
+                self._outer = TrackedLock(LockName.SERVE_GATEWAY)
+                self._inner = TrackedLock(LockName.SERVE_METRICS)
+
+            def bad(self):
+                with self._inner:
+                    with self._outer:
+                        pass
+
+            def good(self):
+                with self._outer:
+                    with self._inner:
+                        pass
+    """, SERVE)
+    assert rules_of(findings) == ["lock-order"]
+    assert "serve.gateway" in findings[0].message
+    assert "serve.metrics" in findings[0].message
+
+
+def test_lock_order_multi_item_with_and_condition_wrapping():
+    findings = lint("""
+        class W:
+            def __init__(self):
+                self._outer = TrackedLock(LockName.SERVE_GATEWAY)
+                self._inner = TrackedLock(LockName.SERVE_METRICS)
+                self._cond = threading.Condition(
+                    TrackedRLock(LockName.SERVE_GATEWAY))
+
+            def bad(self):
+                with self._inner, self._outer:
+                    pass
+    """, SERVE)
+    assert rules_of(findings) == ["lock-order"]
+
+
+def test_lock_order_suppressible():
+    findings = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                # dslint: disable=lock-order — scratch lock in a test fixture
+                self._a = threading.Lock()
+    """, SERVE)
+    assert findings == []
+
+
+# --------------------------------------------------------- thread-discipline
+def test_thread_discipline_fires_on_anonymous_daemonless_joinless():
+    findings = lint("""
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+    """, SERVE)
+    assert sorted(set(rules_of(findings))) == ["thread-discipline"]
+    msgs = " ".join(f.message for f in findings)
+    assert "name=" in msgs and "daemon=" in msgs and "join" in msgs
+
+
+def test_thread_discipline_quiet_on_named_daemon_joined():
+    findings = lint("""
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run, name="w",
+                                           daemon=True)
+                self._t.start()
+
+            def stop(self, timeout=1.0):
+                self._t.join(timeout=timeout)
+    """, SERVE)
+    assert findings == []
+
+
+def test_thread_discipline_str_join_is_not_a_thread_join():
+    findings = lint("""
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run, name="w",
+                                           daemon=True)
+
+            def render(self, parts):
+                return ", ".join(parts)
+    """, SERVE)
+    assert any("join" in f.message for f in findings)
+    assert rules_of(findings) == ["thread-discipline"]
+
+
+# ----------------------------------------------------- signal-handler-purity
+def test_signal_handler_purity_fires_on_lock_sleep_and_jax():
+    findings = lint("""
+        import signal
+        import time
+
+        def _handler(signum, frame):
+            with state._lock:
+                state.flag = True
+            time.sleep(1.0)
+            jax.block_until_ready(x)
+
+        signal.signal(signal.SIGTERM, _handler)
+    """, SERVE)
+    assert rules_of(findings) == ["signal-handler-purity"] * 3
+
+
+def test_signal_handler_purity_quiet_on_flags_and_journal():
+    findings = lint("""
+        import signal
+
+        def _handler(signum, frame):
+            state.preempt_requested = True
+            journal.emit("rollback", signum=signum)
+
+        signal.signal(signal.SIGTERM, _handler)
+    """, SERVE)
+    assert findings == []
+
+
+def test_signal_handler_purity_only_checks_registered_handlers():
+    findings = lint("""
+        import time
+
+        def not_a_handler(signum, frame):
+            time.sleep(1.0)
+    """, SERVE)
+    assert findings == []
+
+
+def test_signal_handler_purity_suppressible():
+    findings = lint("""
+        import signal
+
+        def _handler(signum, frame):
+            # dslint: disable=signal-handler-purity — teardown path, exits right after
+            proc.wait(timeout=5)
+
+        signal.signal(signal.SIGTERM, _handler)
+    """, SERVE)
     assert findings == []

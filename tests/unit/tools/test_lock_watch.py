@@ -1,0 +1,265 @@
+"""Runtime half of the concurrency-discipline PR: the lock-order
+watchdog.  Order-graph edges, cycle detection on a seeded two-lock
+inversion (journaled as ``concurrency.lock_cycle``), RLock reentrancy,
+Condition compatibility, stats, and the reset hook.  Also the journal
+torn-line hammer: N threads × M events must land as N*M parseable lines.
+"""
+
+import json
+import threading
+
+import pytest
+
+from deepspeed_tpu.runtime.supervision.events import EventJournal, read_events
+from deepspeed_tpu.utils import lock_watch
+from deepspeed_tpu.utils.lock_watch import (
+    LOCK_ORDER, LOCK_RANK, LockName, TrackedLock, TrackedRLock,
+    assert_no_lock_cycles, install_journal, lock_cycles, lock_stats,
+    order_graph, reset_lock_watch)
+
+
+@pytest.fixture(autouse=True)
+def _clean_watch():
+    reset_lock_watch()
+    yield
+    reset_lock_watch()
+
+
+# ----------------------------------------------------------------- registry
+def test_lock_order_covers_every_lock_name_exactly_once():
+    names = {v for k, v in vars(LockName).items()
+             if not k.startswith("_") and isinstance(v, str)}
+    assert set(LOCK_ORDER) == names
+    assert len(LOCK_ORDER) == len(set(LOCK_ORDER))
+    assert LOCK_RANK[LockName.JOURNAL_EMIT] == len(LOCK_ORDER) - 1
+
+
+def test_unregistered_name_rejected_at_construction():
+    with pytest.raises(ValueError, match="not registered"):
+        TrackedLock("serve.not_a_lock")
+
+
+# -------------------------------------------------------------- order graph
+def test_nested_acquisition_records_an_edge():
+    outer = TrackedLock(LockName.SERVE_GATEWAY)
+    inner = TrackedLock(LockName.SERVE_METRICS)
+    with outer:
+        with inner:
+            pass
+    g = order_graph()
+    assert g[LockName.SERVE_GATEWAY][LockName.SERVE_METRICS] == 1
+    assert_no_lock_cycles()
+
+
+def test_seeded_two_lock_inversion_detects_cycle_and_journals(tmp_path):
+    """THE acceptance fixture: thread A nests gateway→metrics, thread B
+    nests metrics→gateway.  The second ordering closes a cycle in the
+    order graph — no actual deadlock needed — and the watchdog journals
+    ``concurrency.lock_cycle`` naming both locks."""
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    install_journal(journal)
+    a = TrackedLock(LockName.SERVE_GATEWAY)
+    b = TrackedLock(LockName.SERVE_METRICS)
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    # sequential on purpose: the detector flags the *ordering*, not a
+    # lucky interleaving (a latent deadlock that never fired yet)
+    t1 = threading.Thread(target=forward, name="t-forward", daemon=True)
+    t1.start(); t1.join(timeout=5.0)
+    t2 = threading.Thread(target=backward, name="t-backward", daemon=True)
+    t2.start(); t2.join(timeout=5.0)
+
+    cycles = lock_cycles()
+    assert len(cycles) == 1
+    c = cycles[0]
+    assert {c["lock_a"], c["lock_b"]} == {LockName.SERVE_GATEWAY,
+                                          LockName.SERVE_METRICS}
+    assert {c["thread_a"], c["thread_b"]} == {"t-forward", "t-backward"}
+    with pytest.raises(AssertionError, match="cycle"):
+        assert_no_lock_cycles()
+
+    evs = read_events(journal.path, kind="concurrency.lock_cycle")
+    assert len(evs) == 1
+    assert {evs[0]["lock_a"], evs[0]["lock_b"]} == {
+        LockName.SERVE_GATEWAY, LockName.SERVE_METRICS}
+    assert evs[0]["thread_a"] in ("t-forward", "t-backward")
+    assert "while holding" in evs[0]["stacks"]
+
+
+def test_transitive_inversion_detected():
+    a = TrackedLock(LockName.SERVE_GATEWAY)
+    b = TrackedLock(LockName.SERVE_METRICS)
+    c = TrackedLock(LockName.TELEMETRY_REGISTRY)
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    assert_no_lock_cycles()          # a→b→c: still a DAG
+    with c:
+        with a:
+            pass                     # closes a→b→c→a
+    assert len(lock_cycles()) == 1
+
+
+def test_same_name_and_single_lock_never_cycle():
+    a = TrackedLock(LockName.SERVE_METRICS)
+    for _ in range(3):
+        with a:
+            pass
+    assert order_graph() == {}
+    assert_no_lock_cycles()
+
+
+# ---------------------------------------------------------------- reentrancy
+def test_rlock_reentry_adds_no_edge_and_counts_one_acquisition():
+    r = TrackedRLock(LockName.SERVE_GATEWAY)
+    inner = TrackedLock(LockName.SERVE_METRICS)
+    with r:
+        with r:                      # reentry: no new held-stack entry
+            with inner:
+                pass
+    g = order_graph()
+    assert g == {LockName.SERVE_GATEWAY: {LockName.SERVE_METRICS: 1}}
+    assert lock_stats()[LockName.SERVE_GATEWAY]["acquisitions"] == 1
+    assert not r.locked()
+
+
+def test_rlock_release_unowned_raises():
+    r = TrackedRLock(LockName.SERVE_GATEWAY)
+    with pytest.raises(RuntimeError, match="un-acquired"):
+        r.release()
+
+
+def test_condition_over_tracked_rlock_wait_notify():
+    cond = threading.Condition(TrackedRLock(LockName.SERVE_GATEWAY))
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter, name="t-waiter", daemon=True)
+    t.start()
+    with cond:
+        ready.append(1)
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert_no_lock_cycles()
+    # wait() fully releases; both threads' acquisitions are counted
+    assert lock_stats()[LockName.SERVE_GATEWAY]["acquisitions"] >= 2
+
+
+# --------------------------------------------------------------------- stats
+def test_stats_track_contention_and_holds():
+    lk = TrackedLock(LockName.SERVE_METRICS)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            release.wait(timeout=5.0)
+
+    t = threading.Thread(target=holder, name="t-holder", daemon=True)
+    t.start()
+    assert entered.wait(timeout=5.0)
+    grabbed = []
+
+    def contender():
+        with lk:
+            grabbed.append(1)
+
+    t2 = threading.Thread(target=contender, name="t-contender", daemon=True)
+    t2.start()
+    release.set()
+    t.join(timeout=5.0); t2.join(timeout=5.0)
+    assert grabbed == [1]
+    s = lock_stats()[LockName.SERVE_METRICS]
+    assert s["acquisitions"] == 2
+    assert s["contentions"] >= 1
+    assert s["wait_s"] >= 0.0
+    assert len(s["holds"]) == 2
+
+
+def test_reset_clears_everything():
+    a = TrackedLock(LockName.SERVE_GATEWAY)
+    b = TrackedLock(LockName.SERVE_METRICS)
+    with a:
+        with b:
+            pass
+    reset_lock_watch()
+    assert order_graph() == {}
+    assert lock_cycles() == []
+    assert lock_stats()[LockName.SERVE_GATEWAY]["acquisitions"] == 0
+
+
+def test_lock_watch_metrics_shape():
+    from deepspeed_tpu.telemetry.metrics import (MetricName,
+                                                 lock_watch_metrics)
+    lk = TrackedLock(LockName.SERVE_METRICS)
+    with lk:
+        pass
+    m = lock_watch_metrics()
+    assert m[MetricName.CONCURRENCY_LOCK_CONTENTION] >= 0
+    hold = m[MetricName.CONCURRENCY_LOCK_HOLD_S]
+    assert hold["count"] >= 1
+    assert hold["p99"] >= hold["p50"] >= 0.0
+    row = m[MetricName.CONCURRENCY_LOCKS][LockName.SERVE_METRICS]
+    assert row["acquisitions"] >= 1
+    assert set(row) == {"acquisitions", "contentions", "wait_s",
+                        "hold_p99_s"}
+
+
+# --------------------------------------------------------- journal integrity
+def test_journal_hammer_no_torn_lines(tmp_path):
+    """N threads × M events → exactly N*M parseable JSONL lines.  The
+    single-``os.write``-per-record emit path means concurrent appenders
+    can never interleave bytes mid-line."""
+    path = str(tmp_path / "events.jsonl")
+    journal = EventJournal(path)
+    n_threads, n_events = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        barrier.wait(timeout=10.0)
+        for i in range(n_events):
+            journal.emit("rollback", step=i, tag=f"t{tid}",
+                         pad="x" * (37 * (i % 7)))
+
+    threads = [threading.Thread(target=hammer, args=(t,),
+                                name=f"t-hammer-{t}", daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+
+    with open(path, encoding="utf-8") as f:
+        raw = f.read()
+    lines = raw.splitlines()
+    assert raw.endswith("\n")
+    assert len(lines) == n_threads * n_events
+    seen = set()
+    for line in lines:
+        rec = json.loads(line)          # any torn line raises here
+        assert rec["kind"] == "rollback"
+        seen.add((rec["tag"], rec["step"]))
+    assert len(seen) == n_threads * n_events
+    # seq is assigned under the journal lock: all distinct, max == count
+    evs = read_events(path)
+    seqs = [e["seq"] for e in evs]
+    assert len(set(seqs)) == len(seqs) == n_threads * n_events

@@ -97,6 +97,7 @@ class Project:
     - ``deepspeed_tpu/inference/bucketing.py`` — ``BUCKETING_HELPERS``
     - ``deepspeed_tpu/telemetry/spans.py`` — ``SpanName``
     - ``deepspeed_tpu/telemetry/metrics.py`` — ``MetricName``
+    - ``deepspeed_tpu/utils/lock_watch.py`` — ``LockName``, ``LOCK_ORDER``
 
     Tests inject the registries directly instead of passing a root.
     """
@@ -106,6 +107,7 @@ class Project:
     BUCKETING_MODULE = "deepspeed_tpu/inference/bucketing.py"
     SPANS_MODULE = "deepspeed_tpu/telemetry/spans.py"
     METRICS_MODULE = "deepspeed_tpu/telemetry/metrics.py"
+    LOCKS_MODULE = "deepspeed_tpu/utils/lock_watch.py"
 
     def __init__(self, root: Optional[str] = None,
                  event_kind_map: Optional[Dict[str, str]] = None,
@@ -114,7 +116,9 @@ class Project:
                  abort_kind_names: Optional[Set[str]] = None,
                  bucketing_helpers: Optional[Set[str]] = None,
                  span_name_map: Optional[Dict[str, str]] = None,
-                 metric_name_map: Optional[Dict[str, str]] = None):
+                 metric_name_map: Optional[Dict[str, str]] = None,
+                 lock_name_map: Optional[Dict[str, str]] = None,
+                 lock_order: Optional[Sequence[str]] = None):
         self.root = root
         self.event_kind_map: Dict[str, str] = event_kind_map or {}
         self.fault_points: Set[str] = set(fault_points or ())
@@ -123,6 +127,8 @@ class Project:
         self.bucketing_helpers: Set[str] = set(bucketing_helpers or ())
         self.span_name_map: Dict[str, str] = span_name_map or {}
         self.metric_name_map: Dict[str, str] = metric_name_map or {}
+        self.lock_name_map: Dict[str, str] = lock_name_map or {}
+        self.lock_order: List[str] = list(lock_order or ())
         self.summary_fields_line = 1
         self.abort_kinds_line = 1
         if root is not None:
@@ -139,6 +145,12 @@ class Project:
             if metric_name_map is None:
                 self.metric_name_map = self._parse_name_class(
                     os.path.join(root, self.METRICS_MODULE), "MetricName")
+            if lock_name_map is None:
+                self.lock_name_map = self._parse_name_class(
+                    os.path.join(root, self.LOCKS_MODULE), "LockName")
+            if lock_order is None:
+                self._parse_lock_order(
+                    os.path.join(root, self.LOCKS_MODULE))
 
     # ---------------------------------------------------------- registries
     @property
@@ -218,6 +230,38 @@ class Project:
                             and isinstance(stmt.value.value, str)):
                         out[stmt.targets[0].id] = stmt.value.value
         return out
+
+    @property
+    def lock_names(self) -> Set[str]:
+        return set(self.lock_name_map.values())
+
+    @property
+    def lock_rank(self) -> Dict[str, int]:
+        """name → position in ``LOCK_ORDER`` (outermost = 0)."""
+        return {n: i for i, n in enumerate(self.lock_order)}
+
+    def _parse_lock_order(self, path: str) -> None:
+        """The ``LOCK_ORDER`` tuple, as lock-name strings in rank order
+        (``LockName.X`` elements resolved through the parsed class)."""
+        if not os.path.exists(path):
+            return
+        tree = _parse_path(path)
+        for node in tree.body:
+            target = None
+            if isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            if not (isinstance(target, ast.Name)
+                    and target.id == "LOCK_ORDER" and value is not None):
+                continue
+            for elt in getattr(value, "elts", ()):
+                if isinstance(elt, ast.Attribute) \
+                        and elt.attr in self.lock_name_map:
+                    self.lock_order.append(self.lock_name_map[elt.attr])
+                elif isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    self.lock_order.append(elt.value)
 
     def _parse_bucketing(self, path: str) -> None:
         if not os.path.exists(path):
@@ -308,14 +352,49 @@ def iter_python_files(root: str):
                     yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
 
 
+_WORKER_PROJECT: Optional[Project] = None
+
+
+def _init_worker(project: Project) -> None:
+    global _WORKER_PROJECT
+    _WORKER_PROJECT = project
+
+
+def _lint_one(task: Tuple[str, str]) -> List[Finding]:
+    """Worker for parallel tree lints (module-level for pickling); the
+    Project is shipped once per worker via the pool initializer, and
+    workers run the default rule set."""
+    ap, rel = task
+    return lint_file(ap, rel, _WORKER_PROJECT, None)
+
+
 def lint_tree(root: str, rules: Optional[Sequence[Rule]] = None,
-              project: Optional[Project] = None) -> List[Finding]:
+              project: Optional[Project] = None, jobs: int = 1,
+              paths: Optional[Sequence[str]] = None) -> List[Finding]:
     """Lint the whole tree: every file under :data:`LINTED_DIRS` plus the
-    project-level drift checks (registry ↔ consumers ↔ docs)."""
+    project-level drift checks (registry ↔ consumers ↔ docs).
+
+    ``paths`` restricts which files are *parsed* (repo-relative prefixes —
+    the ``--changed`` fast path); drift checks always run.  ``jobs > 1``
+    fans per-file parsing out over processes (custom ``rules`` are
+    ignored on the parallel path: workers run the default set).
+    """
     project = project if project is not None else Project(root)
+    files = list(iter_python_files(root))
+    if paths is not None:
+        prefixes = tuple(p.rstrip("/").replace(os.sep, "/") for p in paths)
+        files = [fr for fr in files if fr[1].startswith(prefixes)] \
+            if prefixes else []
     findings: List[Finding] = []
-    for ap, rel in iter_python_files(root):
-        findings.extend(lint_file(ap, rel, project, rules))
+    if jobs > 1 and len(files) > 1 and rules is None:
+        from concurrent.futures import ProcessPoolExecutor
+        with ProcessPoolExecutor(max_workers=jobs, initializer=_init_worker,
+                                 initargs=(project,)) as ex:
+            for fs in ex.map(_lint_one, files, chunksize=8):
+                findings.extend(fs)
+    else:
+        for ap, rel in files:
+            findings.extend(lint_file(ap, rel, project, rules))
     from .project_checks import run_project_checks
     findings.extend(run_project_checks(root, project))
     return sorted(findings)

@@ -16,6 +16,11 @@ from .host_sync import HostSyncInHotPath  # noqa: F401
 from .missing_donation import MissingDonation  # noqa: F401
 from .telemetry_names import UnregisteredTelemetryName  # noqa: F401
 from .untraced_fleet_event import UntracedFleetEvent  # noqa: F401
+from .unguarded_shared_state import UnguardedSharedState  # noqa: F401
+from .blocking_under_lock import BlockingUnderLock  # noqa: F401
+from .lock_order import LockOrder  # noqa: F401
+from .thread_discipline import ThreadDiscipline  # noqa: F401
+from .signal_purity import SignalHandlerPurity  # noqa: F401
 
 ALL_RULES = (
     SwallowedException,
@@ -30,4 +35,9 @@ ALL_RULES = (
     MissingDonation,
     UnregisteredTelemetryName,
     UntracedFleetEvent,
+    UnguardedSharedState,
+    BlockingUnderLock,
+    LockOrder,
+    ThreadDiscipline,
+    SignalHandlerPurity,
 )

@@ -1,0 +1,247 @@
+"""Shared AST plumbing for the concurrency rules.
+
+The five concurrency rules (``unguarded-shared-state``,
+``blocking-under-lock``, ``lock-order``, ``thread-discipline``,
+``signal-handler-purity``) all reason about the same three things: which
+attributes of a class are locks, which locks a statement executes under,
+and which calls block.  That analysis lives here once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+#: constructor names that make an attribute "a lock" for with-detection
+LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition", "TrackedLock",
+                     "TrackedRLock"}
+
+#: constructor names whose product is a threading/queue primitive — the
+#: attributes they land on are exempt from unguarded-shared-state (the
+#: primitives synchronize themselves)
+PRIMITIVE_CONSTRUCTORS = LOCK_CONSTRUCTORS | {
+    "Event", "Thread", "Timer", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "ThreadPoolExecutor", "local", "WeakSet",
+}
+
+#: call attribute names treated as blocking (under a lock / in a handler)
+BLOCKING_ATTRS = {"sleep", "recv", "recv_into", "sendall", "accept",
+                  "connect", "select"}
+
+#: ``subprocess`` entry points that block (Popen itself forks, the rest
+#: wait for the child)
+SUBPROCESS_ATTRS = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def call_name(node: ast.Call) -> str:
+    """Rightmost name of the called expression (``a.b.c()`` → ``c``)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def call_root(node: ast.expr) -> str:
+    """Leftmost name of a dotted expression (``a.b.c`` → ``a``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def is_lock_constructor(node: ast.expr) -> bool:
+    """Any Lock/RLock/Condition/Tracked* constructor inside ``node``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and call_name(n) in LOCK_CONSTRUCTORS:
+            return True
+    return False
+
+
+def resolve_lock_name(node: ast.expr,
+                      lock_name_map: Dict[str, str]) -> Optional[str]:
+    """The registered lock-name string a ``TrackedLock(...)`` /
+    ``Condition(TrackedRLock(...))`` construction binds, or None."""
+    for n in ast.walk(node):
+        if not (isinstance(n, ast.Call)
+                and call_name(n) in ("TrackedLock", "TrackedRLock")
+                and n.args):
+            continue
+        arg = n.args[0]
+        if isinstance(arg, ast.Attribute) and arg.attr in lock_name_map:
+            return lock_name_map[arg.attr]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+def self_attr(node: ast.expr) -> Optional[str]:
+    """``self.X`` → ``"X"``, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class ClassInfo:
+    """Lock/thread facts about one ``ClassDef``, computed lazily by the
+    concurrency rules."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: Dict[str, ast.FunctionDef] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        #: attr name → registered lock-name string (None when untracked)
+        self.lock_attrs: Dict[str, Optional[str]] = {}
+        #: attrs assigned a threading/queue primitive anywhere
+        self.primitive_attrs: Set[str] = set()
+        #: method names passed as Thread(target=self.X)
+        self.thread_targets: Set[str] = set()
+        self._scan()
+
+    def _scan(self) -> None:
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    value = node.value
+                    if value is None:
+                        continue
+                    for t in targets:
+                        attr = self_attr(t)
+                        if attr is None:
+                            continue
+                        if is_lock_constructor(value):
+                            self.lock_attrs[attr] = resolve_lock_name(
+                                value, {})  # name resolved later w/ registry
+                            self.primitive_attrs.add(attr)
+                        elif isinstance(value, ast.Call) and call_name(
+                                value) in PRIMITIVE_CONSTRUCTORS:
+                            self.primitive_attrs.add(attr)
+                elif isinstance(node, ast.Call) \
+                        and call_name(node) == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = self_attr(kw.value)
+                            if target:
+                                self.thread_targets.add(target)
+
+    def resolve_lock_names(self, lock_name_map: Dict[str, str]) -> None:
+        """Re-resolve attr → lock-name with the project registry (the
+        initial scan has no registry to map ``LockName.X`` through)."""
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = node.value
+                if value is None:
+                    continue
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr in self.lock_attrs:
+                        name = resolve_lock_name(value, lock_name_map)
+                        if name is not None:
+                            self.lock_attrs[attr] = name
+
+    # ------------------------------------------------------- reachability
+    def reachable_from(self, roots: Set[str]) -> Set[str]:
+        """Transitive closure of ``self.X()`` calls starting at ``roots``."""
+        seen: Set[str] = set()
+        work = [r for r in roots if r in self.methods]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in ast.walk(self.methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = self_attr(node.func)
+                    if callee in self.methods and callee not in seen:
+                        work.append(callee)
+        return seen
+
+    def methods_called_only_under_lock(self) -> Set[str]:
+        """Methods whose every intra-class call site sits inside a
+        ``with self.<lock>:`` block — their bodies run lock-held, so
+        mutations inside them are guarded even without a syntactic with."""
+        locked_calls: Dict[str, int] = {}
+        total_calls: Dict[str, int] = {}
+        for meth in self.methods.values():
+            for node, held in walk_with_locks(meth, set(self.lock_attrs)):
+                if isinstance(node, ast.Call):
+                    callee = self_attr(node.func)
+                    if callee in self.methods:
+                        total_calls[callee] = total_calls.get(callee, 0) + 1
+                        if held:
+                            locked_calls[callee] = \
+                                locked_calls.get(callee, 0) + 1
+        return {m for m, n in total_calls.items()
+                if n and locked_calls.get(m, 0) == n}
+
+
+def with_lock_attrs(node: ast.With, lock_attrs: Set[str]) -> List[str]:
+    """The class lock attrs this ``with`` acquires (``with self.X:``)."""
+    out = []
+    for item in node.items:
+        attr = self_attr(item.context_expr)
+        if attr in lock_attrs:
+            out.append(attr)
+    return out
+
+
+def walk_with_locks(func: ast.AST, lock_attrs: Set[str],
+                    global_locks: Optional[Set[str]] = None):
+    """Yield ``(node, held)`` for every node under ``func`` where ``held``
+    is the ordered list of lock attrs/names held at that node (outermost
+    first).  ``global_locks`` adds module-level ``with _lock:`` names."""
+    global_locks = global_locks or set()
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        yield node, held
+        if isinstance(node, ast.With):
+            acquired = list(held)
+            for item in node.items:
+                ce = item.context_expr
+                attr = self_attr(ce)
+                if attr in lock_attrs:
+                    acquired.append(attr)
+                elif isinstance(ce, ast.Name) and ce.id in global_locks:
+                    acquired.append(ce.id)
+            inner = tuple(acquired)
+            for item in node.items:
+                yield from visit(item.context_expr, held)
+            for child in node.body:
+                yield from visit(child, inner)
+            return
+        # don't descend into nested defs with the held set — they run later
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not func:
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, ())
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held)
+
+    yield from visit(func, ())
+
+
+def module_global_locks(tree: ast.Module,
+                        lock_name_map: Dict[str, str]) -> Dict[str, str]:
+    """Module-level ``_lock = TrackedLock(...)`` globals: name → lock name
+    (untracked lock globals map to ``""``)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        if is_lock_constructor(node.value):
+            out[node.targets[0].id] = \
+                resolve_lock_name(node.value, lock_name_map) or ""
+    return out

@@ -1,0 +1,121 @@
+"""blocking-under-lock: no sleeping, subprocess spawning, socket traffic,
+thread joins, or non-append file IO while holding a lock.
+
+A lock held across a blocking call turns every other acquirer into a
+convoy — and under the global LOCK_ORDER it can park a whole subsystem
+behind one slow syscall.  The sanctioned exceptions: waiting on the held
+lock's *own* condition (``with self._cond: self._cond.wait()`` is the
+pattern, not a bug), append-mode file IO (the journal/sampler sidecar
+contract is one buffered append under the emit lock), and ``os.*``
+descriptor ops (the journal's single-``os.write`` emit path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from ..core import FileContext, Finding, Rule
+from ._concurrency_common import (BLOCKING_ATTRS, SUBPROCESS_ATTRS,
+                                  ClassInfo, call_name, call_root,
+                                  module_global_locks, walk_with_locks)
+
+#: receiver-name fragments that mark a ``.join()``/``.wait()`` as
+#: thread/process-flavored (vs ``str.join`` / ``Condition.wait``)
+_THREADY = ("thread", "proc", "pool", "worker", "child")
+
+
+def _receiver(node: ast.Call) -> Optional[ast.expr]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.value
+    return None
+
+
+def _dotted(node: Optional[ast.expr]) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def blocking_reason(node: ast.Call, held: Set[str]) -> Optional[str]:
+    """Why this call blocks, or None.  ``held`` is the set of held lock
+    attr/global names (to exempt the held condition's own ``.wait``)."""
+    name = call_name(node)
+    root = call_root(node.func)
+    if name == "sleep" and root == "time":
+        return "time.sleep"
+    if root == "subprocess" and name in SUBPROCESS_ATTRS:
+        return f"subprocess.{name}"
+    if name in BLOCKING_ATTRS and name != "sleep":
+        return f"socket .{name}()"
+    recv = _dotted(_receiver(node))
+    if name == "join":
+        if any(t in recv for t in _THREADY) \
+                or any(kw.arg == "timeout" for kw in node.keywords):
+            return f"{recv or '?'}.join()"
+        return None
+    if name == "wait":
+        # waiting on the lock we hold is the condition-variable pattern
+        tail = recv.rsplit(".", 1)[-1]
+        if tail in {h.lower() for h in held}:
+            return None
+        return f"{recv or '?'}.wait()"
+    if name == "open" and isinstance(node.func, ast.Name):
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+                and "a" in mode.value:
+            return None  # append-mode sidecar/journal write: sanctioned
+        return "non-append open()"
+    return None
+
+
+class BlockingUnderLock(Rule):
+    id = "blocking-under-lock"
+    description = ("no sleep/subprocess/socket/join/non-append file IO "
+                   "while holding a lock")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/")) \
+            and not relpath.endswith("utils/lock_watch.py")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        globals_ = set(module_global_locks(tree, ctx.project.lock_name_map))
+        classes = [c for c in ast.walk(tree) if isinstance(c, ast.ClassDef)]
+        covered = set()  # node ids already walked (avoid double-reporting
+        for cls in classes:  # blocking calls inside nested defs)
+            info = ClassInfo(cls)
+            for meth in info.methods.values():
+                if id(meth) in covered:
+                    continue
+                covered.update(id(n) for n in ast.walk(meth))
+                yield from self._check_func(
+                    meth, set(info.lock_attrs), globals_, ctx)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in covered:
+                covered.update(id(n) for n in ast.walk(node))
+                yield from self._check_func(node, set(), globals_, ctx)
+
+    def _check_func(self, func, lock_attrs: Set[str], globals_: Set[str],
+                    ctx: FileContext) -> Iterable[Finding]:
+        for node, held in walk_with_locks(func, lock_attrs, globals_):
+            if not held or not isinstance(node, ast.Call):
+                continue
+            reason = blocking_reason(node, set(held))
+            if reason:
+                yield ctx.finding(
+                    self.id, node,
+                    f"blocking call ({reason}) while holding lock(s) "
+                    f"{list(held)} — move the blocking work outside the "
+                    "with block, or snapshot state under the lock and "
+                    "operate on the copy")

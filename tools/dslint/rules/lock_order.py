@@ -1,0 +1,146 @@
+"""lock-order: tracked locks only, registered names only, nested
+acquisitions follow the single global order.
+
+Three facets, all checked against the single-source registry parsed from
+``deepspeed_tpu/utils/lock_watch.py`` (``LockName`` + ``LOCK_ORDER``):
+
+1. **No bare primitives.**  ``threading.Lock()``/``RLock()``/
+   ``Condition()`` constructions are findings — long-lived locks must be
+   ``TrackedLock``/``TrackedRLock`` (a ``Condition`` wrapping a tracked
+   lock is fine) so the runtime watchdog sees every acquisition.
+2. **Registered names.**  Every ``TrackedLock(...)`` construction must
+   name a registered ``LockName`` member.
+3. **Ordered nesting.**  A ``with`` acquiring lock B syntactically inside
+   a ``with`` holding lock A requires rank(A) < rank(B) in
+   ``LOCK_ORDER`` — the static mirror of the runtime order-graph cycle
+   detector (which also catches non-syntactic nesting across calls).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from ..core import FileContext, Finding, Rule
+from ._concurrency_common import (ClassInfo, call_name, call_root,
+                                  module_global_locks, walk_with_locks)
+
+_BARE = {"Lock", "RLock", "Condition"}
+
+
+class LockOrder(Rule):
+    id = "lock-order"
+    description = ("locks must be TrackedLock/TrackedRLock with registered "
+                   "LockName values; nested acquisitions must follow "
+                   "LOCK_ORDER")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/")) \
+            and not relpath.endswith("utils/lock_watch.py")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        lock_name_map = ctx.project.lock_name_map
+        lock_values = ctx.project.lock_names
+        rank = ctx.project.lock_rank
+        # facet 1+2: every lock construction site
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _BARE and call_root(node.func) == "threading":
+                if name == "Condition" and any(
+                        isinstance(n, ast.Call)
+                        and call_name(n).startswith("Tracked")
+                        for a in node.args for n in ast.walk(a)):
+                    continue  # Condition(TrackedRLock(...)): the pattern
+                yield ctx.finding(
+                    self.id, node,
+                    f"bare threading.{name}() — long-lived locks must be "
+                    "TrackedLock/TrackedRLock named in "
+                    "utils/lock_watch.py::LockName so the lock-order "
+                    "watchdog sees them")
+            elif name in ("TrackedLock", "TrackedRLock"):
+                yield from self._check_ctor(node, lock_name_map,
+                                            lock_values, ctx)
+        # facet 3: nested with-acquisitions vs LOCK_ORDER
+        if not rank:
+            return
+        globals_ = module_global_locks(tree, lock_name_map)
+        seen = set()
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = ClassInfo(cls)
+            info.resolve_lock_names(lock_name_map)
+            attr_names = {a: n for a, n in info.lock_attrs.items() if n}
+            for meth in info.methods.values():
+                if id(meth) in seen:
+                    continue
+                seen.update(id(n) for n in ast.walk(meth))
+                yield from self._check_nesting(
+                    meth, attr_names, globals_, rank, ctx)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in seen:
+                seen.update(id(n) for n in ast.walk(node))
+                yield from self._check_nesting(node, {}, globals_, rank, ctx)
+
+    def _check_ctor(self, node: ast.Call, lock_name_map: Dict[str, str],
+                    lock_values: Set[str],
+                    ctx: FileContext) -> Iterable[Finding]:
+        if not node.args:
+            yield ctx.finding(
+                self.id, node,
+                f"{call_name(node)}() without a LockName — every tracked "
+                "lock names itself against utils/lock_watch.py::LockName")
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute):
+            if arg.attr not in lock_name_map and lock_name_map:
+                yield ctx.finding(
+                    self.id, node,
+                    f"LockName.{arg.attr} is not defined in the "
+                    "utils/lock_watch.py::LockName registry")
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in lock_values and lock_values:
+                yield ctx.finding(
+                    self.id, node,
+                    f"lock name '{arg.value}' is not registered in "
+                    "utils/lock_watch.py::LockName — register it (and its "
+                    "LOCK_ORDER rank) first")
+
+    def _check_nesting(self, func, attr_names: Dict[str, str],
+                       globals_: Dict[str, str], rank: Dict[str, int],
+                       ctx: FileContext) -> Iterable[Finding]:
+        def to_name(held: str) -> str:
+            return attr_names.get(held) or globals_.get(held) or ""
+
+        for node, held in walk_with_locks(
+                func, set(attr_names), set(globals_)):
+            # held may be empty: a multi-item `with a, b:` can violate
+            # the order all by itself (items acquire left-to-right)
+            if not isinstance(node, ast.With):
+                continue
+            held_names = [to_name(h) for h in held]
+            for item in node.items:
+                acq = None
+                ce = item.context_expr
+                if isinstance(ce, ast.Attribute) \
+                        and ce.attr in attr_names:
+                    acq = attr_names[ce.attr]
+                elif isinstance(ce, ast.Name) and ce.id in globals_:
+                    acq = globals_[ce.id]
+                if acq is None or acq not in rank:
+                    continue
+                for h in held_names:
+                    if h and h in rank and rank[acq] <= rank[h]:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"acquiring '{acq}' while holding '{h}' "
+                            "violates LOCK_ORDER "
+                            f"(rank {rank[acq]} <= {rank[h]}) — a thread "
+                            "nesting these in the registered order "
+                            "deadlocks against this path")
+                # multi-item `with a, b:` acquires left-to-right
+                held_names.append(acq)

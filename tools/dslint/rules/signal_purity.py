@@ -1,0 +1,116 @@
+"""signal-handler-purity: a signal handler sets flags and journals —
+nothing else.
+
+A handler runs *inside* whatever bytecode the main thread happened to be
+executing.  Acquire a lock the interrupted frame holds and the process
+deadlocks; call into jax and the runtime's internal state is mid-mutation;
+block and the delivery window stretches over the whole wait.  Handlers
+registered via ``signal.signal(sig, fn)`` may: assign flags/latch
+``Event``s, ``journal.emit`` (the journal lock is a reentrant
+``TrackedRLock`` for exactly this), log, restore previous handlers, read
+clocks, re-raise via ``sys.exit``/``os.kill``.  Findings fire on lock
+acquisition (``with <lock>:`` / ``.acquire()``), any ``jax`` use, and
+blocking calls (sleep, subprocess, socket ops, ``.wait()``/``.join()``,
+``open()``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+from ._concurrency_common import (BLOCKING_ATTRS, SUBPROCESS_ATTRS,
+                                  call_name, call_root,
+                                  module_global_locks, self_attr)
+
+
+class SignalHandlerPurity(Rule):
+    id = "signal-handler-purity"
+    description = ("signal handlers may only set flags and journal — no "
+                   "locks, no jax, no blocking IO")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/"))
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        handlers = self._handler_names(tree)
+        if not handlers:
+            return
+        globals_ = set(module_global_locks(tree, ctx.project.lock_name_map))
+        # every function whose name was registered as a handler (by-name
+        # match covers defs, methods, and nested defs alike)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in handlers:
+                yield from self._check_handler(node, globals_, ctx)
+
+    @staticmethod
+    def _handler_names(tree: ast.Module) -> set:
+        names = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "signal"
+                    and call_root(node.func) == "signal"
+                    and len(node.args) >= 2):
+                continue
+            h = node.args[1]
+            if isinstance(h, ast.Name):
+                names.add(h.id)
+            else:
+                attr = self_attr(h)
+                if attr:
+                    names.add(attr)
+        return names
+
+    def _check_handler(self, func, globals_: set,
+                       ctx: FileContext) -> Iterable[Finding]:
+        name = func.name
+        for node in ast.walk(func):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ce = item.context_expr
+                    # any `<x>.foo_lock` / `<x>._cond` — not just self.X:
+                    # acquiring anyone's lock inside a handler deadlocks
+                    attr = ce.attr if isinstance(ce, ast.Attribute) else ""
+                    if ("lock" in attr or "cond" in attr
+                            or (isinstance(ce, ast.Name)
+                                and ce.id in globals_)):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"signal handler '{name}' acquires a lock — "
+                            "if the interrupted frame holds it, the "
+                            "process deadlocks; set a flag and handle it "
+                            "on the main path")
+            elif isinstance(node, ast.Call):
+                yield from self._check_call(node, name, ctx)
+            elif isinstance(node, ast.Name) and node.id == "jax":
+                yield ctx.finding(
+                    self.id, node,
+                    f"signal handler '{name}' touches jax — the runtime "
+                    "may be mid-dispatch in the interrupted frame")
+
+    def _check_call(self, node: ast.Call, handler: str,
+                    ctx: FileContext) -> Iterable[Finding]:
+        cname = call_name(node)
+        root = call_root(node.func)
+        reason = None
+        if cname == "acquire":
+            reason = "acquires a lock"
+        elif cname == "sleep" and root == "time":
+            reason = "blocks (time.sleep)"
+        elif root == "subprocess" and cname in SUBPROCESS_ATTRS:
+            reason = f"blocks (subprocess.{cname})"
+        elif cname in BLOCKING_ATTRS and cname != "sleep":
+            reason = f"blocks (socket .{cname}())"
+        elif cname in ("wait", "join"):
+            reason = f"blocks (.{cname}())"
+        elif cname == "open" and isinstance(node.func, ast.Name):
+            reason = "does file IO (open())"
+        if reason:
+            yield ctx.finding(
+                self.id, node,
+                f"signal handler '{handler}' {reason} — handlers may only "
+                "set flags/latches and journal (the journal lock is "
+                "reentrant for this); do the work on the main path")

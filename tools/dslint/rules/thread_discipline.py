@@ -1,0 +1,74 @@
+"""thread-discipline: every spawned thread is named, declares ``daemon=``,
+and its owner exposes a join path.
+
+An anonymous ``Thread-3`` in a watchdog stack dump is a hang nobody can
+attribute; an undeclared daemon flag is a process that either refuses to
+exit or dies mid-write depending on a default the author never chose; a
+thread no one joins is a shutdown race.  Checked shapes:
+
+- ``threading.Thread(...)`` must pass ``name=`` and ``daemon=``;
+- when the spawn site sits in a class, some method of that class must
+  ``.join(...)`` a thread (the stop/close/shutdown path); a module-level
+  spawn needs a module-level ``.join`` somewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import FileContext, Finding, Rule
+from ._concurrency_common import call_name, call_root
+
+
+def _has_join(scope: ast.AST) -> bool:
+    """Any ``<x>.join(...)`` call with no positional args (a thread join;
+    ``str.join`` always takes the iterable positionally)."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and not node.args:
+            return True
+    return False
+
+
+class ThreadDiscipline(Rule):
+    id = "thread-discipline"
+    description = ("threads must be named, set daemon= explicitly, and "
+                   "have an owner-side join path")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/"))
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        classes = [c for c in ast.walk(tree) if isinstance(c, ast.ClassDef)]
+        owner_of = {}
+        for cls in classes:
+            for n in ast.walk(cls):
+                owner_of[id(n)] = cls
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "Thread"
+                    and call_root(node.func) in ("threading", "Thread")):
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            if "name" not in kwargs:
+                yield ctx.finding(
+                    self.id, node,
+                    "threading.Thread(...) without name= — an anonymous "
+                    "thread in a watchdog stack dump is unattributable")
+            if "daemon" not in kwargs:
+                yield ctx.finding(
+                    self.id, node,
+                    "threading.Thread(...) without daemon= — declare the "
+                    "exit semantics instead of inheriting a default")
+            owner = owner_of.get(id(node))
+            scope = owner if owner is not None else tree
+            if not _has_join(scope):
+                where = f"class '{owner.name}'" if owner is not None \
+                    else "this module"
+                yield ctx.finding(
+                    self.id, node,
+                    f"thread spawned but {where} never .join()s one — "
+                    "expose a bounded stop()/shutdown() join path")

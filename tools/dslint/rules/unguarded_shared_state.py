@@ -1,0 +1,100 @@
+"""unguarded-shared-state: a class that spawns a thread must mutate the
+attributes both sides touch under its lock.
+
+The analysis per class: methods reachable from ``threading.Thread(
+target=self.X)`` targets form the *thread side*; every other method (the
+public surface and its helpers) forms the *main side*.  An attribute
+touched by both sides and mutated outside a ``with self.<lock>:`` block
+(and outside ``__init__``, which runs before the thread exists) is a data
+race waiting for load.
+
+Exemptions that keep this about real races:
+
+- attributes holding threading/queue primitives (``Event``, ``Thread``,
+  ``Lock``, ``Queue``…) — the primitive synchronizes itself;
+- writes in ``__init__`` — set-once-before-start;
+- methods whose *every* intra-class call site is inside a with-lock block
+  — their bodies run lock-held even without a syntactic ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import FileContext, Finding, Rule
+from ._concurrency_common import ClassInfo, self_attr, walk_with_locks
+
+
+class UnguardedSharedState(Rule):
+    id = "unguarded-shared-state"
+    description = ("attributes shared between a spawned thread and the "
+                   "public surface must be mutated under the class lock")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("deepspeed_tpu/", "scripts/")) \
+            and not relpath.endswith("utils/lock_watch.py")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterable[Finding]:
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(cls, ctx)
+
+    def _check_class(self, cls: ast.ClassDef,
+                     ctx: FileContext) -> Iterable[Finding]:
+        info = ClassInfo(cls)
+        if not info.thread_targets:
+            return
+        thread_side = info.reachable_from(info.thread_targets)
+        main_side = {m for m in info.methods
+                     if m not in thread_side and m != "__init__"}
+        locked_methods = info.methods_called_only_under_lock()
+        lock_attrs = set(info.lock_attrs)
+
+        # attr → touched-by sides; attr → unguarded write sites
+        touched: Dict[str, Set[str]] = {}
+        unguarded: List[Tuple[str, str, ast.AST]] = []
+        for mname, meth in info.methods.items():
+            side = "thread" if mname in thread_side else "main"
+            for node, held in walk_with_locks(meth, lock_attrs):
+                attr = None
+                is_write = False
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        attr = self_attr(t)
+                        if attr:
+                            is_write = True
+                            break
+                elif isinstance(node, ast.AugAssign):
+                    attr = self_attr(node.target)
+                    is_write = attr is not None
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    attr = self_attr(node)
+                if attr is None or attr in info.primitive_attrs \
+                        or attr in lock_attrs:
+                    continue
+                if mname != "__init__":
+                    touched.setdefault(attr, set()).add(side)
+                if is_write and mname != "__init__" and not held \
+                        and mname not in locked_methods:
+                    unguarded.append((attr, mname, node))
+
+        shared = {a for a, sides in touched.items()
+                  if "thread" in sides and "main" in sides}
+        # only meaningful when the main side is actually public surface
+        if not main_side:
+            return
+        for attr, mname, node in unguarded:
+            if attr in shared:
+                yield ctx.finding(
+                    self.id, node,
+                    f"'{cls.name}.{attr}' is shared between thread target"
+                    f"(s) {sorted(info.thread_targets)} and the public "
+                    f"surface but is mutated in '{mname}' without holding "
+                    "a class lock — wrap the mutation in 'with "
+                    "self.<lock>:' (a TrackedLock) or make the attribute "
+                    "a threading primitive")
