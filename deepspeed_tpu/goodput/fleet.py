@@ -80,6 +80,10 @@ class FleetConfig:
     drain_grace_s: float = 20.0
     incarnation_timeout_s: float = 240.0
     poll_s: float = 0.05
+    # elastic resize: incarnations >= 1 respawn at THIS world size (the
+    # dp-resharding resume path — checkpoints are global logical arrays,
+    # so a shrunk group loads the big group's tags natively)
+    resize_to: Optional[int] = None
 
     @classmethod
     def from_scenario(cls, scenario: Scenario, **overrides) -> "FleetConfig":
@@ -89,9 +93,15 @@ class FleetConfig:
                     seed=scenario.seed,
                     nan_abort_threshold=scenario.nan_abort_threshold,
                     max_restarts=scenario.max_restarts,
-                    drain_on_bounce=scenario.drain_on_bounce)
+                    drain_on_bounce=scenario.drain_on_bounce,
+                    resize_to=getattr(scenario, "resize_to", None))
         base.update(overrides)
         return cls(**base)
+
+    def world_for(self, incarnation: int) -> int:
+        if self.resize_to is not None and incarnation >= 1:
+            return int(self.resize_to)
+        return self.world_size
 
     def child_payload(self, run_dir: str) -> Dict[str, Any]:
         doc = dataclasses.asdict(self)
@@ -138,7 +148,7 @@ class FleetSupervisor:
         env["JAX_PLATFORMS"] = "cpu"
         env["DS_FLEET_CONFIG"] = self._config_path
         env["DS_FLEET_RANK"] = str(rank)
-        env["DS_FLEET_WORLD"] = str(self.config.world_size)
+        env["DS_FLEET_WORLD"] = str(self.config.world_for(incarnation))
         env["DS_FLEET_INC"] = str(incarnation)
         env[TRACE_ENV] = to_env(child_context(self.trace))
         plan = self.scenario.plan_for(rank, incarnation) \
@@ -177,7 +187,9 @@ class FleetSupervisor:
         """A new incarnation must not read the dead one's liveness: stale
         sentinels would misclassify exits, stale beats would look like
         dead-then-recovered ranks to the new monitor."""
-        for rank in range(self.config.world_size):
+        stale_worlds = max(self.config.world_size,
+                           self.config.resize_to or 0)
+        for rank in range(stale_worlds):
             try:
                 os.remove(self._sentinel_path(rank))
             except FileNotFoundError:  # dslint: disable=swallowed-exception — a missing sentinel is the normal case (first incarnation / crashed rank)
@@ -284,25 +296,32 @@ class FleetSupervisor:
         """Spawn the group, watch it, and classify how it ended:
         ``done`` / ``rank_exit`` / ``preempt`` / ``timeout``."""
         cfg = self.config
+        world = cfg.world_for(incarnation)
         self._pre_spawn_cleanup()
         # fresh monitor per incarnation: cadence tracking across a restart
         # gap would read the downtime as one giant drifted interval
         monitor = HeartbeatMonitor(
             self.heartbeat_dir, gap_s=cfg.heartbeat_gap_s,
-            journal=self.journal, expected_ranks=cfg.world_size,
+            journal=self.journal, expected_ranks=world,
             slow_factor=cfg.slow_factor,
             slow_min_intervals=cfg.slow_min_intervals)
+        if incarnation >= 1 and world != cfg.world_for(incarnation - 1):
+            self.journal.emit(EventKind.FLEET_RESIZE,
+                              incarnation=incarnation,
+                              from_world=cfg.world_for(incarnation - 1),
+                              to_world=world, reason="elastic_shrink",
+                              trace=self.trace.fields())
         procs = {rank: self._spawn_rank(rank, incarnation)
-                 for rank in range(cfg.world_size)}
+                 for rank in range(world)}
         self.journal.emit(EventKind.FLEET_SPAWN, incarnation=incarnation,
-                          world_size=cfg.world_size,
+                          world_size=world,
                           pids=[p.pid for p in procs.values()],
                           trace=self.trace.fields())
         deadline = time.monotonic() + cfg.incarnation_timeout_s
         statuses: Dict[int, Dict[str, Any]] = {}
         detect_ts: Optional[float] = None
         crashed = False
-        while len(statuses) < cfg.world_size:
+        while len(statuses) < world:
             time.sleep(cfg.poll_s)
             try:
                 monitor.check()
@@ -387,7 +406,13 @@ class FleetSupervisor:
 def run_scenario(run_dir: str, scenario: Scenario,
                  **config_overrides) -> Dict[str, Any]:
     """Run one scenario to completion and score it — the single call the
-    bench script and the tier-1 smoke test share."""
+    bench script and the tier-1 smoke test share.  Pipeline-mode scenarios
+    (``scenario.mode == "pipeline"``) run on the MPMD stage-group fleet
+    (:mod:`~deepspeed_tpu.runtime.pipe.fleet`) — same run-dir layout, same
+    journal contract, scored by the same ``score_scenario_run``."""
+    if getattr(scenario, "mode", "engine") == "pipeline":
+        from ..runtime.pipe.fleet import run_pipeline_scenario
+        return run_pipeline_scenario(run_dir, scenario, **config_overrides)
     from .score import score_scenario_run
     supervisor = FleetSupervisor(
         run_dir, FleetConfig.from_scenario(scenario, **config_overrides),

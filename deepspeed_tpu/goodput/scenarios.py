@@ -79,6 +79,14 @@ class Scenario:
     seed: int
     faults: Tuple[FaultSpec, ...] = ()
     actions: Tuple[CorruptTagAction, ...] = ()
+    #: which fleet runs it: ``engine`` = N data-parallel engine ranks
+    #: (``goodput/fleet.py``), ``pipeline`` = N MPMD stage-group processes
+    #: (``runtime/pipe/fleet.py``) — for pipeline mode ``world_size`` is
+    #: the stage count and a fault's ``ranks`` name stages
+    mode: str = "engine"
+    #: engine mode only: respawn restarted incarnations at THIS world size
+    #: (elastic resize — the dp-resharding resume path under test)
+    resize_to: Optional[int] = None
     #: whole-group respawns the supervisor may spend before aborting
     max_restarts: int = 2
     #: SIGTERM-drain survivors on a bounce instead of SIGKILL (a dead rank
@@ -105,6 +113,17 @@ class Scenario:
     def validate(self) -> "Scenario":
         if self.world_size < 1:
             raise ValueError(f"{self.name}: world_size must be >= 1")
+        if self.mode not in ("engine", "pipeline"):
+            raise ValueError(
+                f"{self.name}: unknown mode {self.mode!r} "
+                f"(engine | pipeline)")
+        if self.resize_to is not None:
+            if self.mode != "engine":
+                raise ValueError(
+                    f"{self.name}: resize_to is an engine-mode knob")
+            if not 1 <= self.resize_to:
+                raise ValueError(
+                    f"{self.name}: resize_to must be >= 1")
         if self.target_steps < self.save_interval:
             raise ValueError(
                 f"{self.name}: target_steps ({self.target_steps}) below "
@@ -264,6 +283,125 @@ def _partial_cluster_restart(seed: int) -> Scenario:
     ).validate()
 
 
+def _eight_rank_consensus_storm(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(8)
+    step = rng.randint(5, 6)
+    return Scenario(
+        name="eight_rank_consensus_storm",
+        description=f"8 ranks, SIGKILL rank {victim} at step {step}: the "
+                    "two-phase commit barrier and the resume consensus each "
+                    "field 8 contending voters over the shared FS — the "
+                    "contention case the 2-rank matrix never exercises",
+        world_size=8, target_steps=8, save_interval=2, seed=seed,
+        faults=(FaultSpec("train.step", "KillAtStep", {"step": step},
+                          ranks=(victim,)),),
+        expect={"min_goodput": 0.3, "max_mttr_s": 180.0,
+                "expect_kinds": ("fleet.rank_exit", "fleet.restart",
+                                 "ckpt.resume_consensus"),
+                "allow_abort_kinds": ("ckpt.commit_timeout",)},
+    ).validate()
+
+
+def _elastic_resize_shrink(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(4)
+    step = rng.randint(5, 6)
+    return Scenario(
+        name="elastic_resize_shrink",
+        description=f"4 ranks, SIGKILL rank {victim} at step {step}; the "
+                    "restarted incarnation respawns at world size 2 (spot "
+                    "capacity shrank) — dp-resharding resume must load the "
+                    "4-rank tag, and the replayed window must be bitwise "
+                    "(the fixture batches are rank-identical, so a replay "
+                    "fingerprint mismatch means the reshard corrupted the "
+                    "trajectory)",
+        world_size=4, target_steps=10, save_interval=2, seed=seed,
+        resize_to=2,
+        faults=(FaultSpec("train.step", "KillAtStep", {"step": step},
+                          ranks=(victim,)),),
+        expect={"min_goodput": 0.3, "max_mttr_s": 180.0,
+                "expect_kinds": ("fleet.rank_exit", "fleet.restart",
+                                 "fleet.resize", "ckpt.resume_consensus"),
+                "allow_abort_kinds": ("ckpt.commit_timeout",)},
+    ).validate()
+
+
+def _stage_loss_restart(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    victim = 1 + rng.randrange(1)  # never stage 0: the journal anchor
+    step = rng.randint(4, 5)
+    return Scenario(
+        name="stage_loss_restart",
+        description=f"MPMD pipeline, SIGKILL stage {victim} at step {step} "
+                    "mid-1F1B: survivors quiesce at the microbatch barrier "
+                    "on the epoch bump, the victim respawns alone, the "
+                    "group consensus-resumes onto the newest committed tag "
+                    "and the loader replays — the continuation must be "
+                    "bitwise-identical to an unfaulted run",
+        world_size=2, target_steps=8, save_interval=2, seed=seed,
+        mode="pipeline",
+        faults=(FaultSpec("train.step", "KillAtStep", {"step": step},
+                          ranks=(victim,)),),
+        expect={"min_goodput": 0.5, "max_mttr_s": 60.0,
+                "expect_kinds": ("pipe.stage_lost", "pipe.stage_respawn",
+                                 "pipe.quiesce", "fleet.restart",
+                                 "ckpt.resume_consensus")},
+    ).validate()
+
+
+def _dcn_stall_mid_1f1b(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    victim = rng.randrange(2)
+    return Scenario(
+        name="dcn_stall_mid_1f1b",
+        description=f"stage {victim}'s first activation-flow sends hit "
+                    "injected DCN resets: the per-peer breaker must open "
+                    "(pipe.transport_degraded), the spooled activation "
+                    "bundles must carry the boundary traffic, and the run "
+                    "must finish with zero restarts and zero wasted steps",
+        world_size=2, target_steps=6, save_interval=2, seed=seed,
+        mode="pipeline",
+        # 9 = failures_to_open(3) sends × attempts-per-send(1 + retries 2):
+        # enough consecutive exhausted sends to open the breaker, then the
+        # injector runs dry and the probe can re-promote the channel
+        faults=(FaultSpec("serve.transport.send", "FailNTimes",
+                          {"n": 9, "match": "activation"},
+                          ranks=(victim,)),),
+        expect={"min_goodput": 0.999, "max_wasted_steps": 0,
+                "max_incidents": 0,
+                "expect_kinds": ("pipe.transport_degraded",)},
+    ).validate()
+
+
+def _fault_storm_during_pipeline_drain(seed: int) -> Scenario:
+    rng = random.Random(seed)
+    step = 2 * rng.randint(2, 3)  # lands exactly on a save boundary
+    return Scenario(
+        name="fault_storm_during_pipeline_drain",
+        description=f"compound pipeline storm: stage 0's shard write for "
+                    f"the step-{step} tag drags (injected delay) while "
+                    f"stage 1 — already past its own vote — is killed on "
+                    "its next step fire, so the death lands while the "
+                    "other stage is still mid-checkpoint-vote; the commit "
+                    "barrier may time out (allowed), but the bounded "
+                    "restart must still converge with zero invariant "
+                    "violations",
+        world_size=2, target_steps=8, save_interval=2, seed=seed,
+        mode="pipeline",
+        faults=(FaultSpec("ckpt.rank_write", "DelaySeconds",
+                          {"seconds": 1.5, "n": 1,
+                           "match": f"step-{step:06d}"},
+                          ranks=(0,)),
+                FaultSpec("train.step", "KillAtStep", {"step": step},
+                          ranks=(1,))),
+        expect={"min_goodput": 0.3, "max_mttr_s": 90.0,
+                "expect_kinds": ("pipe.stage_lost", "pipe.stage_respawn",
+                                 "fleet.restart"),
+                "allow_abort_kinds": ("ckpt.commit_timeout",)},
+    ).validate()
+
+
 #: name → factory(seed); iteration order is the bench matrix order
 SCENARIOS = {
     "baseline_clean": _baseline_clean,
@@ -274,6 +412,11 @@ SCENARIOS = {
     "nan_poisoned_window": _nan_poisoned_window,
     "preempt_during_rollback": _preempt_during_rollback,
     "partial_cluster_restart": _partial_cluster_restart,
+    "eight_rank_consensus_storm": _eight_rank_consensus_storm,
+    "elastic_resize_shrink": _elastic_resize_shrink,
+    "stage_loss_restart": _stage_loss_restart,
+    "dcn_stall_mid_1f1b": _dcn_stall_mid_1f1b,
+    "fault_storm_during_pipeline_drain": _fault_storm_during_pipeline_drain,
 }
 
 

@@ -1,0 +1,336 @@
+"""Stage-group fleet runner: N MPMD stage processes under one supervisor.
+
+:class:`PipelineFleetSupervisor` spawns one OS process per pipeline stage
+(each running :mod:`~deepspeed_tpu.runtime.pipe.stage_main` with its own
+compiled program on a single CPU device) and babysits the group the way
+``goodput/fleet.py`` babysits the engine fleet — same sentinel contract,
+same journal, same scoring.  The failure model differs in one crucial way:
+
+**a stage death does not bounce the group.**  The SPMD pipeline dies whole
+(one program, one mesh); the MPMD pipeline survives a stage loss with a
+*bounded* recovery:
+
+1. the supervisor detects the dead stage and journals ``pipe.stage_lost``
+   then ``fleet.restart`` (same restart budget accounting as the engine
+   fleet, so ``score.py`` MTTR math applies unchanged);
+2. it bumps the fleet **epoch** in ``control.json`` — survivors discover
+   the bump inside their next blocking exchange receive and quiesce at the
+   microbatch barrier (``pipe.quiesce``), abandoning the in-flight step;
+3. the victim alone is respawned under the new epoch
+   (``pipe.stage_respawn``; ``fleet.spawn`` re-emitted so incarnation
+   spans stay well-defined for the split-brain invariant);
+4. the whole group consensus-resumes (round ``e<epoch>``) onto the newest
+   two-phase-committed tag and the resumable loader replays the in-flight
+   window — the continuation is bitwise-identical to an unfaulted run,
+   which the goodput invariants (replay fingerprints) verify.
+
+MTTR decomposes as detect → respawn → warm → requiesce → replay
+(:func:`~deepspeed_tpu.telemetry.critical_path.decompose_stage_restarts`),
+with phases clamped so they sum to the journal MTTR exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ...telemetry.propagate import (TRACE_ENV, child_context, mint_context,
+                                    to_env)
+from ...utils import fault_injection
+from ...utils.logging import logger
+from ..supervision.events import EventJournal, EventKind
+from ..supervision.heartbeat import HeartbeatMonitor
+
+#: journal rank the supervisor writes under (stages use 0..num_stages-1)
+SUPERVISOR_RANK = -1
+
+
+@dataclasses.dataclass
+class PipelineFleetConfig:
+    """Geometry + knobs for one MPMD pipeline fleet run.  The whole
+    payload rides ``DS_PIPE_CONFIG`` so stage respawns are stateless."""
+
+    num_stages: int = 2
+    target_steps: int = 8
+    save_interval: int = 2
+    seed: int = 0
+    # tiny-GPT fixture geometry (shared by every stage)
+    micro_batch: int = 2
+    num_micro: int = 2
+    n_layer: int = 2
+    n_head: int = 2
+    d_model: int = 32
+    seq_len: int = 32
+    dataset_size: int = 256
+    vocab_size: int = 256
+    lr: float = 1e-3
+    # supervision knobs pushed into every stage
+    heartbeat_interval_s: float = 0.2
+    heartbeat_gap_s: float = 2.0
+    slow_factor: Optional[float] = 2.0
+    slow_min_intervals: int = 2
+    barrier_deadline_s: float = 5.0
+    consensus_deadline_s: float = 60.0
+    exchange_deadline_s: float = 60.0
+    #: fleet-transport knobs (breaker/retry); empty = defaults
+    transport: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # supervisor policy
+    max_restarts: int = 2
+    run_timeout_s: float = 240.0
+    poll_s: float = 0.05
+
+    @classmethod
+    def from_scenario(cls, scenario, **overrides) -> "PipelineFleetConfig":
+        base = dict(num_stages=scenario.world_size,
+                    target_steps=scenario.target_steps,
+                    save_interval=scenario.save_interval,
+                    seed=scenario.seed,
+                    max_restarts=scenario.max_restarts)
+        base.update(overrides)
+        return cls(**base)
+
+    def child_payload(self, run_dir: str) -> Dict[str, Any]:
+        doc = dataclasses.asdict(self)
+        doc["run_dir"] = run_dir
+        return doc
+
+
+class PipelineFleetSupervisor:
+    """Spawn → watch → quiesce-and-respawn the victim, bounded budget."""
+
+    def __init__(self, run_dir: str,
+                 config: Optional[PipelineFleetConfig] = None,
+                 scenario=None):
+        if config is None:
+            if scenario is None:
+                raise ValueError("need a PipelineFleetConfig or a Scenario")
+            config = PipelineFleetConfig.from_scenario(scenario)
+        self.config = config
+        self.scenario = scenario
+        self.run_dir = str(run_dir)
+        self.heartbeat_dir = os.path.join(self.run_dir, "heartbeats")
+        self.log_dir = os.path.join(self.run_dir, "logs")
+        for d in (self.run_dir, self.log_dir):
+            os.makedirs(d, exist_ok=True)
+        self.journal = EventJournal(
+            os.path.join(self.run_dir, "events.jsonl"), rank=SUPERVISOR_RANK)
+        self.trace = mint_context()
+        self._payload = json.dumps(
+            config.child_payload(self.run_dir), sort_keys=True)
+        self._log_handles: List[Any] = []
+        self._write_control(0)
+
+    # ----------------------------------------------------------- control
+    def _write_control(self, epoch: int) -> None:
+        from ..checkpoint_engine.storage import atomic_write_text
+        atomic_write_text(os.path.join(self.run_dir, "control.json"),
+                          json.dumps({"epoch": int(epoch)}))
+
+    # ------------------------------------------------------------- spawn
+    def _child_env(self, stage: int, epoch: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DS_PIPE_CONFIG"] = self._payload
+        env["DS_PIPE_STAGE"] = str(stage)
+        env["DS_PIPE_EPOCH"] = str(epoch)
+        env[TRACE_ENV] = to_env(child_context(self.trace))
+        plan = self.scenario.plan_for(stage, epoch) \
+            if self.scenario is not None else ""
+        if plan:
+            env[fault_injection.PLAN_ENV] = plan
+        else:
+            env.pop(fault_injection.PLAN_ENV, None)
+        return env
+
+    def _spawn_stage(self, stage: int, epoch: int) -> subprocess.Popen:
+        log_path = os.path.join(self.log_dir, f"e{epoch}.stage{stage}.log")
+        log = open(log_path, "ab")
+        self._log_handles.append(log)
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "deepspeed_tpu.runtime.pipe.stage_main"],
+            env=self._child_env(stage, epoch),
+            stdout=log, stderr=subprocess.STDOUT,
+            cwd=self.run_dir)
+
+    def _sentinel_path(self, stage: int) -> str:
+        return os.path.join(self.run_dir, f"rank{stage}.exit.json")
+
+    def _read_sentinel(self, stage: int) -> Optional[dict]:
+        try:
+            with open(self._sentinel_path(stage)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # no orderly exit record: the stage just died
+
+    def _pre_spawn_cleanup(self) -> None:
+        for stage in range(self.config.num_stages):
+            try:
+                os.remove(self._sentinel_path(stage))
+            except FileNotFoundError:  # dslint: disable=swallowed-exception — a missing sentinel is the normal case on first spawn
+                pass
+        shutil.rmtree(self.heartbeat_dir, ignore_errors=True)
+
+    def _emit_spawn(self, epoch: int, procs: Dict[int, subprocess.Popen]
+                    ) -> None:
+        self.journal.emit(EventKind.FLEET_SPAWN, incarnation=epoch,
+                          world_size=self.config.num_stages,
+                          pids=[p.pid for p in procs.values()],
+                          trace=self.trace.fields())
+
+    # --------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.monotonic()
+        deadline = t0 + cfg.run_timeout_s
+        restarts = 0
+        epoch = 0
+        self._pre_spawn_cleanup()
+        self._write_control(0)
+        monitor = HeartbeatMonitor(
+            self.heartbeat_dir, gap_s=cfg.heartbeat_gap_s,
+            journal=self.journal, expected_ranks=cfg.num_stages,
+            slow_factor=cfg.slow_factor,
+            slow_min_intervals=cfg.slow_min_intervals)
+        procs = {s: self._spawn_stage(s, 0) for s in range(cfg.num_stages)}
+        self._emit_spawn(0, procs)
+        done: Dict[int, dict] = {}
+        try:
+            while len(done) < cfg.num_stages:
+                time.sleep(cfg.poll_s)
+                try:
+                    monitor.check()
+                except Exception as e:  # observability must not kill the fleet
+                    logger.warning(
+                        f"[pipe-fleet] heartbeat check failed: {e!r}")
+                for stage, proc in list(procs.items()):
+                    if stage in done:
+                        continue
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    sentinel = self._read_sentinel(stage)
+                    if rc == 0 and sentinel is not None \
+                            and sentinel.get("status") == "done":
+                        done[stage] = sentinel
+                        self.journal.emit(EventKind.FLEET_RANK_EXIT,
+                                          incarnation=epoch, rank=stage,
+                                          returncode=rc, status="done",
+                                          trace=self.trace.fields())
+                        continue
+                    # ---- a stage died: bounded victim respawn
+                    detect_ts = time.time()
+                    self.journal.emit(EventKind.FLEET_RANK_EXIT,
+                                      incarnation=epoch, rank=stage,
+                                      returncode=rc, status="crashed",
+                                      trace=self.trace.fields())
+                    self.journal.emit(EventKind.PIPE_STAGE_LOST,
+                                      stage=stage, incarnation=epoch,
+                                      returncode=rc, reason="stage_exit",
+                                      detect_ts=detect_ts)
+                    if restarts >= cfg.max_restarts:
+                        self._kill_all(procs, done, epoch)
+                        self.journal.emit(EventKind.FLEET_ABORT,
+                                          incarnation=epoch,
+                                          reason="restart budget exhausted",
+                                          restarts=restarts,
+                                          trace=self.trace.fields())
+                        return {"completed": False,
+                                "aborted": "restart budget exhausted",
+                                "final_step": None, "epochs": epoch + 1,
+                                "restarts": restarts,
+                                "wall_s": round(time.monotonic() - t0, 3)}
+                    restarts += 1
+                    epoch += 1
+                    self.journal.emit(EventKind.FLEET_RESTART,
+                                      incarnation=epoch, restarts=restarts,
+                                      budget=cfg.max_restarts,
+                                      reason="stage_exit",
+                                      detect_ts=detect_ts,
+                                      trace=self.trace.fields())
+                    # epoch bump BEFORE the respawn: survivors quiesce out
+                    # of their blocking receives while the victim boots
+                    self._write_control(epoch)
+                    try:
+                        os.remove(self._sentinel_path(stage))
+                    except FileNotFoundError:  # dslint: disable=swallowed-exception — a crashed stage rarely leaves a sentinel
+                        pass
+                    procs[stage] = self._spawn_stage(stage, epoch)
+                    self._emit_spawn(epoch, procs)
+                    self.journal.emit(EventKind.PIPE_STAGE_RESPAWN,
+                                      stage=stage, incarnation=epoch,
+                                      restarts=restarts,
+                                      budget=cfg.max_restarts,
+                                      pid=procs[stage].pid)
+                if time.monotonic() > deadline:
+                    logger.error(
+                        f"[pipe-fleet] run exceeded {cfg.run_timeout_s}s "
+                        f"— killing the group")
+                    self._kill_all(procs, done, epoch)
+                    self.journal.emit(EventKind.FLEET_ABORT,
+                                      incarnation=epoch,
+                                      reason="run timeout",
+                                      restarts=restarts,
+                                      trace=self.trace.fields())
+                    return {"completed": False, "aborted": "run timeout",
+                            "final_step": None, "epochs": epoch + 1,
+                            "restarts": restarts,
+                            "wall_s": round(time.monotonic() - t0, 3)}
+            final = max(s.get("final_step", 0) for s in done.values())
+            wall = time.monotonic() - t0
+            self.journal.emit(EventKind.FLEET_DONE, incarnation=epoch,
+                              final_step=final, wall_s=round(wall, 3),
+                              trace=self.trace.fields())
+            return {"completed": True, "aborted": None, "final_step": final,
+                    "epochs": epoch + 1, "restarts": restarts,
+                    "wall_s": round(wall, 3)}
+        finally:
+            for h in self._log_handles:
+                try:
+                    h.close()
+                except OSError as e:  # a leaked handle must not mask the run
+                    logger.warning(f"[pipe-fleet] log close failed: {e}")
+            self._log_handles = []
+
+    def _kill_all(self, procs, done, epoch: int) -> None:
+        for stage, proc in procs.items():
+            if stage in done or proc.poll() is not None:
+                continue
+            proc.kill()
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"[pipe-fleet] stage {stage} ignored "
+                               f"SIGKILL wait")
+            self.journal.emit(EventKind.FLEET_RANK_EXIT, incarnation=epoch,
+                              rank=stage, returncode=proc.returncode,
+                              status="bounced", trace=self.trace.fields())
+
+
+def run_pipeline_scenario(run_dir: str, scenario,
+                          **config_overrides) -> Dict[str, Any]:
+    """Run one pipeline-mode scenario to completion and score it with the
+    same journal scorer the engine fleet uses."""
+    from ...goodput.score import score_scenario_run
+    supervisor = PipelineFleetSupervisor(
+        run_dir,
+        PipelineFleetConfig.from_scenario(scenario, **config_overrides),
+        scenario=scenario)
+    result = supervisor.run()
+    score = score_scenario_run(run_dir, scenario)
+    score["fleet"] = result
+    if not result["completed"]:
+        score["ok"] = False
+        score["failures"] = list(score.get("failures", ())) + [
+            f"fleet did not complete: {result['aborted']}"]
+    return score

@@ -1,0 +1,310 @@
+"""Subprocess entry point for one MPMD pipeline stage group.
+
+``python -m deepspeed_tpu.runtime.pipe.stage_main`` is spawned once per
+stage by :class:`~deepspeed_tpu.runtime.pipe.fleet.PipelineFleetSupervisor`.
+Each process compiles and runs *its own* per-stage program (see
+``mpmd.py``) and exchanges boundary activations/gradients with its
+neighbors over the framed TCP fleet transport (``activation`` flow,
+SHA-256-verified, spool fallback).
+
+Environment contract (mirrors ``goodput/rank_main.py``):
+
+========================  ==============================================
+``DS_PIPE_CONFIG``        JSON run config payload (geometry + knobs)
+``DS_PIPE_STAGE``         this process's stage index
+``DS_PIPE_EPOCH``         spawn epoch (bumped by the supervisor on every
+                          bounded restart; stale peers quiesce on it)
+``DS_FAULT_PLAN``         scenario faults, armed at import by
+                          ``utils/fault_injection.py``
+``DS_TRACE_CONTEXT``      supervisor trace context (joined, not minted)
+========================  ==============================================
+
+Exit contract: an atomic ``rank<N>.exit.json`` sentinel (``status:
+done``, final step) plus exit code 0 on an orderly finish; anything else
+is classified ``crashed`` by the supervisor and triggers a bounded
+victim respawn.
+
+Recovery protocol (the quiesce/restart state machine in
+``docs/pipeline-mpmd.md``): a surviving stage discovers an epoch bump
+*inside* a blocking exchange recv (:class:`mpmd.QuiesceSignal`), abandons
+the in-flight step at the microbatch barrier, re-runs resume consensus at
+round ``e<epoch>``, reloads the newest two-phase-committed tag, and the
+resumable loader replays the in-flight window — so the continuation is
+bitwise-identical to an unfaulted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _env(name: str, default=None, required: bool = False):
+    val = os.environ.get(name, None)
+    if val is None or val == "":
+        if required:
+            print(f"[stage_main] missing required env {name}", file=sys.stderr)
+            sys.exit(2)
+        return default
+    return val
+
+
+def _write_sentinel(run_dir: str, stage: int, epoch: int, status: str,
+                    final_step: int, steps: int) -> None:
+    from ..checkpoint_engine.storage import atomic_write_text
+    atomic_write_text(
+        os.path.join(run_dir, f"rank{stage}.exit.json"),
+        json.dumps({"rank": int(stage), "incarnation": int(epoch),
+                    "status": status, "final_step": int(final_step),
+                    "steps": int(steps)}))
+
+
+def main() -> int:
+    cfg = json.loads(_env("DS_PIPE_CONFIG", required=True))
+    stage = int(_env("DS_PIPE_STAGE", required=True))
+    epoch = int(_env("DS_PIPE_EPOCH", "0"))
+    run_dir = cfg["run_dir"]
+    world = int(cfg["num_stages"])
+    started = time.time()
+
+    # single CPU device per stage process — each stage is its own program
+    from ...utils.platform import force_cpu_platform
+    force_cpu_platform(n_devices=1, persistent_cache=False)
+
+    import jax
+    import numpy as np
+
+    from ...models import gpt as gpt_mod
+    from ...models import gpt_pipeline
+    from ...telemetry import propagate
+    from ...telemetry.export import write_trace
+    from ...telemetry.metrics import MetricsRegistry, MetricsSampler
+    from ...telemetry.spans import SpanName, Tracer
+    from ...utils import fault_injection
+    from ..checkpoint_engine.commit import (CommitContext,
+                                            FileConsensusChannel,
+                                            agree_resume_tag,
+                                            publish_commit,
+                                            wait_for_ready,
+                                            write_rank_manifest)
+    from ..checkpoint_engine.config import CheckpointCommitConfig
+    from ..data_pipeline.resumable import ResumableDataLoader
+    from ..supervision.events import EventJournal, EventKind
+    from ..supervision.heartbeat import HeartbeatWriter
+    from ..transport import FleetTransport
+    from . import mpmd
+
+    journal = EventJournal(os.path.join(run_dir, "events.jsonl"), rank=stage)
+    parent = propagate.from_env()
+    trace = propagate.child_context(parent) if parent else None
+    trace_fields = trace.fields() if trace else None
+    tracer = Tracer(enabled=True, name=f"stage{stage}")
+
+    heartbeat = HeartbeatWriter(
+        os.path.join(run_dir, "heartbeats"), rank=stage,
+        interval_s=float(cfg.get("heartbeat_interval_s", 0.2)),
+        journal=journal)
+    heartbeat.start()
+
+    registry = MetricsRegistry(name=f"stage{stage}")
+    sampler = MetricsSampler(
+        registry, os.path.join(run_dir, f"metrics.rank{stage}.jsonl"),
+        rank=stage, interval_steps=1, journal=journal)
+
+    transport = FleetTransport(
+        dict(cfg.get("transport", {})), run_dir, role="stage", rank=stage,
+        journal=journal, trace=trace_fields,
+        degraded_kind=EventKind.PIPE_TRANSPORT_DEGRADED,
+        restored_kind=EventKind.PIPE_TRANSPORT_RESTORED)
+    sampler.attach_source(transport.metrics_sample)
+    sampler.start()
+
+    control_path = os.path.join(run_dir, "control.json")
+
+    def current_epoch() -> int:
+        try:
+            with open(control_path) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError):
+            return 0
+
+    exchange = mpmd.TransportExchange(
+        transport, run_dir, stage, epoch_fn=current_epoch,
+        deadline_s=float(cfg.get("exchange_deadline_s", 30.0)),
+        tracer=tracer)
+
+    # ---- model: every stage materializes the same seeded init, then runs
+    # only its own layer slice; the shared (embedding/head) params live on
+    # all stages with stage 0 owning the reduction order.
+    pcfg = gpt_pipeline.GPTPipeConfig(
+        vocab_size=int(cfg.get("vocab_size", 256)),
+        max_seq_len=int(cfg["seq_len"]),
+        n_layer=int(cfg["n_layer"]),
+        n_head=int(cfg["n_head"]),
+        d_model=int(cfg["d_model"]),
+        dtype=jax.numpy.float32, vocab_round_to=128,
+        num_stages=world,
+        num_micro_batches=int(cfg["num_micro"]),
+    )
+    params0 = gpt_mod.init(pcfg, jax.random.PRNGKey(int(cfg["seed"])))
+    blocks0, shared0 = gpt_pipeline.split_params(pcfg, params0)
+    stage0_slice = mpmd.slice_stage_params(pcfg, stage, blocks0)
+
+    class _FixtureDataset:
+        """Deterministic random tokens — identical on every stage (the
+        same fixture the engine goodput fleet trains on)."""
+
+        def __init__(self, n: int, seq: int, seed: int):
+            rng = np.random.default_rng(seed)
+            self.data = rng.integers(
+                0, 256, size=(n, seq + 1)).astype(np.int32)
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return {"tokens": self.data[i]}
+
+    batch_size = int(cfg["num_micro"]) * int(cfg["micro_batch"])
+    dataset = _FixtureDataset(int(cfg.get("dataset_size", 256)),
+                              int(cfg["seq_len"]), int(cfg["seed"]))
+    loader = ResumableDataLoader(
+        dataset, batch_size=batch_size, shuffle=True, seed=int(cfg["seed"]),
+        journal=journal, journal_batches=(stage == 0))
+
+    # shape-only template: never drawn through the loader, so the journaled
+    # DATA_BATCH trajectory starts at the real step 0
+    tmpl = {"tokens": np.zeros((batch_size, int(cfg["seq_len"]) + 1),
+                               np.int32)}
+    micro_tmpl = gpt_pipeline._split_micro(pcfg, tmpl)
+
+    programs = mpmd.StagePrograms(pcfg, micro_tmpl, shared0)
+    worker = mpmd.StageWorker(
+        stage, pcfg, programs, stage0_slice, shared0, exchange,
+        journal=journal, tracer=tracer, lr=float(cfg.get("lr", 1e-3)))
+    worker.epoch = epoch
+
+    journal.emit(EventKind.PIPE_STAGE_WARM, stage=stage, incarnation=epoch,
+                 warm_s=round(time.time() - started, 3), pid=os.getpid())
+
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    commit_cfg = CheckpointCommitConfig(
+        barrier_deadline_s=float(cfg.get("barrier_deadline_s", 5.0)),
+        barrier_poll_s=0.01, barrier_backoff_max_s=0.05,
+        consensus_deadline_s=float(cfg.get("consensus_deadline_s", 30.0)),
+        sweep_on_start=False)
+
+    target = int(cfg["target_steps"])
+    save_interval = int(cfg["save_interval"])
+    requiesces = 0
+
+    def resume(at_epoch: int) -> int:
+        """All-stages consensus onto the newest committed tag; a ``None``
+        tag means no commit exists yet — reset to the seeded init so a
+        replay from step 0 is still the same trajectory."""
+        channel = FileConsensusChannel(
+            os.path.join(run_dir, "consensus"), stage, world,
+            round_id=f"e{at_epoch}",
+            deadline_s=commit_cfg.consensus_deadline_s,
+            poll_s=0.02) if world > 1 else None
+        ctx = CommitContext(world_size=world, rank=stage, config=commit_cfg,
+                            journal=journal, heartbeat=heartbeat,
+                            channel=channel)
+        tag = agree_resume_tag(ckpt_dir, ctx)
+        if tag is None:
+            sm, sv = mpmd.adam_init(stage0_slice)
+            shm, shv = mpmd.adam_init(shared0)
+            worker.load_state_trees(
+                {"stage": stage0_slice, "stage_m": sm, "stage_v": sv,
+                 "shared": shared0, "shared_m": shm, "shared_v": shv},
+                adam_t=0)
+            loader.skip_to(0)
+            step = 0
+        else:
+            step, loader_state = mpmd.load_stage_shard(
+                ckpt_dir, tag, stage, worker)
+            if loader_state:
+                loader.load_state_dict(loader_state)
+            else:
+                loader.skip_to(step)
+        journal.emit(EventKind.PIPE_RESUME, stage=stage, epoch=at_epoch,
+                     step=step, tag=tag)
+        return step
+
+    def save(step: int) -> None:
+        tag = f"step-{step:06d}"
+        fault_injection.fire("ckpt.rank_write", step=step,
+                             path=f"{tag}/stage{stage}")
+        mpmd.save_stage_shard(ckpt_dir, tag, stage, worker, step,
+                              loader_state=loader.state_dict())
+        write_rank_manifest(ckpt_dir, tag, stage, world)
+        if stage == 0:
+            ok, missing, dead = wait_for_ready(
+                ckpt_dir, tag, world, config=commit_cfg,
+                heartbeat=heartbeat, journal=journal)
+            if ok:
+                publish_commit(ckpt_dir, tag, world, journal=journal)
+
+    step = resume(epoch)
+    status = "done"
+    try:
+        while step < target:
+            try:
+                exchange.check_epoch(worker.epoch)
+                fault_injection.fire("train.step", step=step)
+                batch = next(loader)
+                micro = gpt_pipeline._split_micro(pcfg, batch)
+                loss = worker.train_step(step, micro)
+                heartbeat.note_step(step)
+                if stage == 0:
+                    journal.emit(EventKind.PIPE_STEP, step=step,
+                                 epoch=worker.epoch, loss=loss,
+                                 micro=int(cfg["num_micro"]),
+                                 requiesced=requiesces)
+                sampler.sample(step=step)
+                step += 1
+                if step % save_interval == 0:
+                    save(step)
+            except mpmd.QuiesceSignal as q:
+                # a peer died and was respawned under a newer epoch:
+                # abandon the in-flight step at the microbatch barrier,
+                # re-consensus, and replay from the committed tag
+                requiesces += 1
+                journal.emit(EventKind.PIPE_QUIESCE, stage=stage,
+                             epoch=q.epoch, step=step,
+                             reason="epoch_advanced")
+                with tracer.span(SpanName.PIPE_REQUIESCE, stage=stage,
+                                 epoch=q.epoch):
+                    worker.epoch = current_epoch()
+                    worker.requiesces = requiesces
+                    worker.abandon_step()
+                    exchange.drop_before_epoch(worker.epoch)
+                    step = resume(worker.epoch)
+    except mpmd.ExchangeTimeout as e:
+        print(f"[stage_main] stage {stage} exchange timeout: {e}",
+              file=sys.stderr)
+        status = "stalled"
+    finally:
+        heartbeat.stop()
+        try:
+            write_trace(
+                os.path.join(run_dir, f"trace.stage{stage}.inc{epoch}.json"),
+                tracer,
+                extra={"clockSync": dict(propagate.clock_sync(),
+                                         role="stage", rank=stage,
+                                         incarnation=epoch)})
+        except (OSError, ValueError) as e:
+            print(f"[stage_main] trace export failed: {e}", file=sys.stderr)
+        transport.close()
+
+    if status != "done":
+        return 1
+    _write_sentinel(run_dir, stage, current_epoch(), "done", step, step)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,8 +65,17 @@ class EventKind:
     FLEET_SPAWN = "fleet.spawn"
     FLEET_RANK_EXIT = "fleet.rank_exit"
     FLEET_RESTART = "fleet.restart"
+    FLEET_RESIZE = "fleet.resize"
     FLEET_DONE = "fleet.done"
     FLEET_ABORT = "fleet.abort"
+    PIPE_STAGE_WARM = "pipe.stage_warm"
+    PIPE_STAGE_LOST = "pipe.stage_lost"
+    PIPE_STAGE_RESPAWN = "pipe.stage_respawn"
+    PIPE_QUIESCE = "pipe.quiesce"
+    PIPE_RESUME = "pipe.resume"
+    PIPE_STEP = "pipe.step"
+    PIPE_TRANSPORT_DEGRADED = "pipe.transport_degraded"
+    PIPE_TRANSPORT_RESTORED = "pipe.transport_restored"
     SERVE_REQUEST = "serve.request"
     SERVE_ADMIT = "serve.admit"
     SERVE_REJECT = "serve.reject"
@@ -162,8 +171,21 @@ SUMMARY_FIELDS: Dict[str, Tuple[str, ...]] = {
                                 "status"),
     EventKind.FLEET_RESTART: ("incarnation", "restarts", "budget", "reason",
                               "detect_ts"),
+    EventKind.FLEET_RESIZE: ("incarnation", "from_world", "to_world",
+                             "reason"),
     EventKind.FLEET_DONE: ("incarnation", "final_step", "wall_s"),
     EventKind.FLEET_ABORT: ("incarnation", "reason", "restarts"),
+    EventKind.PIPE_STAGE_WARM: ("stage", "incarnation", "warm_s", "pid"),
+    EventKind.PIPE_STAGE_LOST: ("stage", "incarnation", "returncode",
+                                "reason", "detect_ts"),
+    EventKind.PIPE_STAGE_RESPAWN: ("stage", "incarnation", "restarts",
+                                   "budget", "pid"),
+    EventKind.PIPE_QUIESCE: ("stage", "epoch", "step", "reason"),
+    EventKind.PIPE_RESUME: ("stage", "epoch", "step", "tag"),
+    EventKind.PIPE_STEP: ("step", "epoch", "loss", "micro", "requiesced"),
+    EventKind.PIPE_TRANSPORT_DEGRADED: ("peer", "flow", "failures",
+                                        "reason"),
+    EventKind.PIPE_TRANSPORT_RESTORED: ("peer", "flow", "failures"),
     EventKind.SERVE_REQUEST: ("request_id", "prompt_len", "max_new_tokens",
                               "priority", "queue_depth"),
     EventKind.SERVE_ADMIT: ("request_id", "slot", "queued_ms", "prefix_hit"),

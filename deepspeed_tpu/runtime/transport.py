@@ -75,8 +75,9 @@ from ..utils import fault_injection
 
 MAGIC = b"DSTP"
 FRAME_VERSION = 1
-#: the three fleet flows plus the breaker's probe channel
-FLOWS = ("order", "bundle", "result", "ping")
+#: the three serving fleet flows, the pipeline boundary-tensor flow, and
+#: the breaker's probe channel
+FLOWS = ("order", "bundle", "result", "activation", "ping")
 #: refuse absurd lengths before allocating buffers for them
 MAX_HEADER_BYTES = 1 << 20
 MAX_BLOB_BYTES = 256 << 20
@@ -524,13 +525,20 @@ class FleetTransport:
 
     def __init__(self, cfg: Mapping[str, Any], run_dir: str, role: str,
                  rank: int, journal=None, trace: Optional[dict] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 degraded_kind: Optional[str] = None,
+                 restored_kind: Optional[str] = None):
         self.cfg = dict(cfg)
         self.run_dir = run_dir
         self.role = role
         self.rank = int(rank)
         self.journal = journal
         self.trace = trace
+        # breaker transitions journal under these kinds; the serving fleet
+        # keeps its serve.fleet.transport_* rows, the MPMD pipeline reuses
+        # the same machinery under its own kinds
+        self.degraded_kind = degraded_kind
+        self.restored_kind = restored_kind
         port = 0
         base = int(self.cfg.get("port_base", 0) or 0)
         if base > 0:
@@ -626,7 +634,7 @@ class FleetTransport:
         recovered peer is re-promoted without waiting for real traffic."""
         for peer_role, peer_rank in peers:
             peer = self._peer_key(peer_role, peer_rank)
-            for flow in ("order", "bundle", "result"):
+            for flow in (f for f in FLOWS if f != "ping"):
                 key = (peer, flow)
                 breaker = self._breakers.get(key)
                 if breaker is None or not breaker.probe_due():
@@ -682,7 +690,9 @@ class FleetTransport:
         if self.journal is None:
             return
         from .supervision.events import EventKind
-        self.journal.emit(EventKind.SERVE_FLEET_TRANSPORT_DEGRADED,
+        kind = self.degraded_kind or \
+            EventKind.SERVE_FLEET_TRANSPORT_DEGRADED
+        self.journal.emit(kind,
                           peer=peer, flow=flow, failures=breaker.failures,
                           reason="send_failed", trace=self.trace)
 
@@ -691,7 +701,9 @@ class FleetTransport:
         if self.journal is None:
             return
         from .supervision.events import EventKind
-        self.journal.emit(EventKind.SERVE_FLEET_TRANSPORT_RESTORED,
+        kind = self.restored_kind or \
+            EventKind.SERVE_FLEET_TRANSPORT_RESTORED
+        self.journal.emit(kind,
                           peer=peer, flow=flow,
                           open_s=round(breaker.open_for_s(), 6),
                           trace=self.trace)
@@ -719,6 +731,8 @@ class FleetTransport:
             "transport.bytes_orders": float(s["bytes_by_flow"]["order"]),
             "transport.bytes_bundles": float(s["bytes_by_flow"]["bundle"]),
             "transport.bytes_results": float(s["bytes_by_flow"]["result"]),
+            "transport.bytes_activations":
+                float(s["bytes_by_flow"]["activation"]),
             "transport.frames_sent": float(s["frames_sent"]),
             "transport.frame_rejects": float(s["frame_rejects"]),
             "transport.reconnects": float(s["reconnects"]),

@@ -53,6 +53,7 @@ from .propagate import wall_offset_s
 __all__ = [
     "TTFT_PHASES",
     "MTTR_PHASES",
+    "PIPE_MTTR_PHASES",
     "MIGRATION_PHASES",
     "request_chains",
     "span_chain_coverage",
@@ -61,6 +62,7 @@ __all__ = [
     "decompose_mttr",
     "decompose_migrations",
     "decompose_training_restarts",
+    "decompose_stage_restarts",
     "collect_process_traces",
     "merge_fleet_trace",
     "missing_worker_telemetry",
@@ -72,6 +74,9 @@ TTFT_PHASES = ("queue_wait_ms", "prefill_ms", "publish_ms", "spool_ms",
 
 #: MTTR phase keys (telescoping: they sum to the incident's MTTR exactly)
 MTTR_PHASES = ("respawn_ms", "warm_ms", "handoff_ms")
+
+#: MPMD pipeline stage-restart phase keys (same telescoping contract)
+PIPE_MTTR_PHASES = ("respawn_ms", "warm_ms", "requiesce_ms", "replay_ms")
 
 #: live-migration phase keys: park/export on the source engine, spool
 #: transfer of the page bundle, digest verify on the target, re-admission
@@ -465,6 +470,73 @@ def decompose_training_restarts(
     return out
 
 
+def decompose_stage_restarts(
+        events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per MPMD pipeline stage restart: detect→respawn→warm→requiesce→replay.
+
+    Anchors match ``score.py``'s MTTR definition exactly — ``detect_ts``
+    from the ``fleet.restart`` row to the first ``data.batch`` after it
+    (stage 0 is the only batch journaler, and its first post-restart batch
+    lands only after the victim respawned AND every survivor re-ran the
+    resume consensus) — so ``sum(phases)/1000 == mttr_s`` up to rounding.
+    Interior boundaries: the supervisor's ``pipe.stage_respawn`` (victim
+    process relaunched), the victim's ``pipe.stage_warm`` (its per-stage
+    program rebuilt), and the last pre-recovery ``pipe.resume`` (the
+    consensus round the whole group re-joined); the tail is the loader
+    replay up to the first re-trained batch.
+    """
+    evs = _sorted_events(events)
+    out: List[Dict[str, Any]] = []
+    for restart in evs:
+        if restart.get("kind") != EventKind.FLEET_RESTART:
+            continue
+        detect = float(restart.get("detect_ts") or restart.get("ts", 0.0))
+        restart_ts = float(restart.get("ts", 0.0))
+        respawn_ts = warm_ts = resume_ts = t_rec = None
+        for e in evs:
+            ts = float(e.get("ts", 0.0))
+            if ts <= restart_ts:
+                continue
+            kind = e.get("kind", "")
+            if kind == EventKind.PIPE_STAGE_RESPAWN and respawn_ts is None:
+                respawn_ts = ts
+            elif kind == EventKind.PIPE_STAGE_WARM and warm_ts is None \
+                    and respawn_ts is not None:
+                warm_ts = ts
+            elif kind == EventKind.PIPE_RESUME:
+                # keep the LAST resume before recovery: consensus ends when
+                # the slowest stage re-joins, not when the first one votes
+                if t_rec is None:
+                    resume_ts = ts
+            if kind == EventKind.DATA_BATCH and t_rec is None:
+                t_rec = ts
+                break
+        victims = [e.get("stage") for e in evs
+                   if e.get("kind") == EventKind.PIPE_STAGE_LOST
+                   and float(e.get("ts", 0.0)) <= restart_ts]
+        rec: Dict[str, Any] = {
+            "incarnation": restart.get("incarnation"),
+            "reason": restart.get("reason"),
+            "stage": victims[-1] if victims else None,
+            "detect_ts": detect,
+            "recovered": t_rec is not None,
+        }
+        if t_rec is None:
+            rec["mttr_s"] = None
+            rec["phases"] = None
+            out.append(rec)
+            continue
+        respawn, warm, requiesce, replay = _clamped_phases(
+            detect, [respawn_ts, warm_ts, resume_ts], t_rec)
+        rec["mttr_s"] = round(t_rec - detect, 3)
+        rec["phases"] = {"respawn_ms": round(respawn, 3),
+                         "warm_ms": round(warm, 3),
+                         "requiesce_ms": round(requiesce, 3),
+                         "replay_ms": round(replay, 3)}
+        out.append(rec)
+    return out
+
+
 # ------------------------------------------------------- trace merging
 
 def collect_process_traces(run_dir: str) -> List[Dict[str, Any]]:
@@ -625,13 +697,20 @@ def merge_fleet_trace(run_dir: str,
         pid += 1
 
     incidents = [m for m in decompose_mttr(evs) if m["recovered"]]
-    incidents += [m for m in decompose_training_restarts(evs)
-                  if m["recovered"]]
+    stage_restarts = [m for m in decompose_stage_restarts(evs)
+                      if m["recovered"] and m.get("stage") is not None]
+    if stage_restarts:
+        # a pipeline-fleet journal: the stage decomposition supersedes the
+        # generic training one (same fleet.restart rows, finer anchors)
+        incidents += stage_restarts
+    else:
+        incidents += [m for m in decompose_training_restarts(evs)
+                      if m["recovered"]]
     if incidents:
         merged.append(_proc_meta(pid, "mttr"))
         for tid_i, m in enumerate(incidents):
             cursor = float(m["detect_ts"]) * 1e6
-            for k in MTTR_PHASES:
+            for k in m["phases"]:
                 dur_us = m["phases"][k] * 1e3
                 if dur_us <= 0:
                     continue

@@ -106,6 +106,9 @@ class MetricName:
     #: streamed-transport bytes pushed on the result flow (worker →
     #: supervisor manifests, results, nacks, migration acks)
     TRANSPORT_BYTES_RESULTS = "transport.bytes_results"
+    #: streamed-transport bytes pushed on the activation flow (MPMD
+    #: pipeline boundary activations/grads + reduce frames, blob included)
+    TRANSPORT_BYTES_ACTIVATIONS = "transport.bytes_activations"
     #: transport frames successfully sent from this endpoint (all flows)
     TRANSPORT_FRAMES_SENT = "transport.frames_sent"
     #: inbound frames rejected by the integrity check (torn / truncated /

@@ -104,6 +104,20 @@ class SpanName:
     #: one streamed-transport frame send (connect + retries + write) from
     #: a worker endpoint; flow/peer/bytes in args
     SERVE_TRANSPORT_SEND = "serve.transport.send"
+    #: one MPMD pipeline step on a stage process: full 1F1B tick walk +
+    #: grad reduce + optimizer apply (step/stage in args)
+    PIPE_STEP = "pipe.step"
+    #: one 1F1B schedule tick (fwd, bwd or idle op in args)
+    PIPE_TICK = "pipe.tick"
+    #: blocking receive of one boundary activation/grad frame (kind,
+    #: micro, from_stage, spooled in args)
+    PIPE_EXCHANGE_RECV = "pipe.exchange_recv"
+    #: shared-grad star reduce at the step boundary (stage 0 sums stage
+    #: contributions in stage order and broadcasts the total)
+    PIPE_GRAD_REDUCE = "pipe.grad_reduce"
+    #: quiesce-to-resume window on a surviving stage (epoch bump observed
+    #: → consensus resume complete)
+    PIPE_REQUIESCE = "pipe.requiesce"
 
 
 #: every registered span name, as a frozenset of strings

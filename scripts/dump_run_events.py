@@ -19,6 +19,7 @@ journal / no events.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -218,6 +219,36 @@ def main(argv: Optional[List[str]] = None) -> int:
             line += f"  frame_rejects={rejects}"
             if frame_nacks:
                 line += f"  frame_bundle_nacks={frame_nacks}"
+        print(line, file=sys.stderr)
+    # pipeline footer: the MPMD stage-group story — steps (and how many
+    # were abandoned to a requiesce), stage losses/respawns, quiesces,
+    # transport degradation, and the activation-flow bytes from the
+    # per-stage metrics sidecars next to the journal
+    pipe = [e for e in events if str(e.get("kind", "")).startswith("pipe.")]
+    if pipe and not args.as_json:
+        by = {}
+        for e in pipe:
+            by[e["kind"]] = by.get(e["kind"], 0) + 1
+        line = "pipeline: " + "  ".join(
+            f"{k.split('.', 1)[1]}={by[k]}" for k in sorted(by))
+        steps = [e for e in pipe if e["kind"] == "pipe.step"]
+        if steps:
+            requiesced = sum(1 for e in steps if e.get("requiesced"))
+            line += (f"  requiesced_steps={requiesced}"
+                     f"  final_loss={steps[-1].get('loss')}")
+        # each stage's transport counters are cumulative — take the last
+        # parseable row per sidecar and sum across stages
+        act_bytes = 0
+        run_dir = os.path.dirname(os.path.abspath(path))
+        for mpath in sorted(glob.glob(
+                os.path.join(run_dir, "metrics.rank*.jsonl"))):
+            from deepspeed_tpu.telemetry.metrics import read_metrics
+            rows = [r.get("m") or {} for r in read_metrics(mpath)]
+            vals = [float(m.get("transport.bytes_activations") or 0.0)
+                    for m in rows]
+            act_bytes += int(max(vals)) if vals else 0
+        if act_bytes:
+            line += f"  bytes_activations={act_bytes}"
         print(line, file=sys.stderr)
     fleet = [e for e in events if str(e.get("kind", "")).startswith("fleet.")]
     if fleet and not args.as_json:

@@ -49,9 +49,9 @@ def main(argv=None) -> int:
 
     from deepspeed_tpu.runtime.supervision.events import read_events
     from deepspeed_tpu.telemetry.critical_path import (
-        decompose_migrations, decompose_mttr, decompose_training_restarts,
-        merge_fleet_trace, missing_worker_telemetry, span_chain_coverage,
-        summarize_ttft)
+        decompose_migrations, decompose_mttr, decompose_stage_restarts,
+        decompose_training_restarts, merge_fleet_trace,
+        missing_worker_telemetry, span_chain_coverage, summarize_ttft)
     from deepspeed_tpu.telemetry.export import validate_trace
 
     run_dir = args.run_dir
@@ -85,7 +85,13 @@ def main(argv=None) -> int:
         "ttft": summarize_ttft(events),
         "migrations": decompose_migrations(events),
         "mttr": decompose_mttr(events),
-        "training_restarts": decompose_training_restarts(events),
+        # a stage-group pipeline run decomposes its restarts per victim
+        # stage (respawn/warm/requiesce/replay); an engine fleet keeps
+        # the whole-group respawn/warm/handoff attribution
+        "training_restarts": (
+            [m for m in decompose_stage_restarts(events)
+             if m.get("stage") is not None]
+            or decompose_training_restarts(events)),
         "problems": problems,
     }
     if args.as_json:
@@ -114,14 +120,15 @@ def main(argv=None) -> int:
             else:
                 print(f"  migration {who}: abandoned (never readmitted)")
         for m in report["mttr"] + report["training_restarts"]:
-            who = (f"{m.get('role')}{m.get('worker')}"
-                   if m.get("role") is not None
-                   else f"restart inc{m.get('incarnation')}")
+            if m.get("role") is not None:
+                who = f"{m.get('role')}{m.get('worker')}"
+            elif m.get("stage") is not None:
+                who = f"stage{m['stage']} inc{m.get('incarnation')}"
+            else:
+                who = f"restart inc{m.get('incarnation')}"
             if m["recovered"]:
-                ph = m["phases"]
-                print(f"  mttr {who}: {m['mttr_s']}s = respawn "
-                      f"{ph['respawn_ms']}ms + warm {ph['warm_ms']}ms + "
-                      f"handoff {ph['handoff_ms']}ms")
+                print(f"  mttr {who}: {m['mttr_s']}s = " + " + ".join(
+                    f"{k[:-3]} {v}ms" for k, v in m["phases"].items()))
             else:
                 print(f"  mttr {who}: never recovered")
         for p in problems:

@@ -56,6 +56,33 @@ def check_rank_telemetry(run_dir: str, world_size: int) -> bool:
     return proc.returncode == 0
 
 
+def check_mttr_decomposition(run_dir: str) -> list:
+    """Every recovered incident's critical-path phases must sum to the
+    journal MTTR *exactly* (the clamping contract of
+    ``telemetry/critical_path.py``) — for stage-group pipelines that is
+    the detect → respawn → warm → requiesce → replay decomposition
+    ``docs/pipeline-mpmd.md`` promises.  A decomposition that drifts from
+    the journal means the phase anchors regressed, and fails the
+    scenario like any other expectation."""
+    from deepspeed_tpu.runtime.supervision.events import read_events
+    from deepspeed_tpu.telemetry.critical_path import (
+        decompose_stage_restarts, decompose_training_restarts)
+    evs = read_events(os.path.join(run_dir, "events.jsonl"))
+    stage_rows = [m for m in decompose_stage_restarts(evs)
+                  if m["recovered"] and m.get("stage") is not None]
+    rows = stage_rows or [m for m in decompose_training_restarts(evs)
+                          if m["recovered"]]
+    problems = []
+    for m in rows:
+        total_s = sum(m["phases"].values()) / 1000.0
+        if abs(total_s - m["mttr_s"]) > 2e-3:
+            problems.append(
+                f"MTTR decomposition drifts from the journal: phases sum "
+                f"to {total_s:.3f}s but mttr_s={m['mttr_s']} "
+                f"(incarnation {m.get('incarnation')})")
+    return problems
+
+
 def run_matrix(args) -> dict:
     from deepspeed_tpu.goodput import build_scenario, run_scenario
     from deepspeed_tpu.goodput.scenarios import scenario_names
@@ -83,6 +110,11 @@ def run_matrix(args) -> dict:
                 score.setdefault("failures", []).append(
                     "a rank produced no parseable metrics.jsonl "
                     "(run_report --expect-rank-metrics)")
+            decomp_problems = check_mttr_decomposition(run_dir)
+            score["mttr_decomposition_ok"] = not decomp_problems
+            if decomp_problems:
+                score["ok"] = False
+                score.setdefault("failures", []).extend(decomp_problems)
             scores[name] = score
             print(f"[goodput-bench]   goodput={score['goodput']} "
                   f"wasted={score['wasted_steps']} "
@@ -153,6 +185,9 @@ def main(argv=None) -> int:
     ap.add_argument("--goodput-tolerance", type=float, default=0.1)
     ap.add_argument("--keep-runs", default=None,
                     help="keep per-scenario run dirs under this directory")
+    ap.add_argument("--print-json", action="store_true",
+                    help="emit a one-line JSON summary on stdout "
+                         "(the mfu_sweep trajectory-log contract)")
     args = ap.parse_args(argv)
 
     baseline_path = args.baseline or args.out
@@ -173,6 +208,14 @@ def main(argv=None) -> int:
         json.dump(result, f, indent=1, sort_keys=True)
         f.write("\n")
     os.replace(tmp, args.out)
+    if args.print_json:
+        print(json.dumps({
+            "bench": "goodput", "summary": result["summary"],
+            "detail": {
+                name: {"ok": s["ok"], "goodput": s["goodput"],
+                       "mttr_max": s["mttr_s"]["max"],
+                       "violations": s["invariant_violations"]["total"]}
+                for name, s in result["scenarios"].items()}}))
     s = result["summary"]
     print(f"wrote {args.out}: {s['ok']}/{s['scenarios']} scenarios ok, "
           f"mean goodput {s['mean_goodput']}, "

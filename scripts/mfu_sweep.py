@@ -203,6 +203,23 @@ _SERVE = [
       "--baseline", "BENCH_OVERLOAD.json"]),
 ]
 
+# MPMD pipeline rows (CPU fixture — the stage-group fleet spawns its own
+# single-device CPU stage processes, so these run anywhere): the three
+# pipeline-mode goodput scenarios, one row each so goodput/MTTR regress
+# per-scenario in the trajectory log.  goodput_bench owns the committed
+# BENCH_GOODPUT.json gate; the sweep writes to a scratch artifact and
+# records the trajectory (docs/pipeline-mpmd.md).
+_PIPE_BENCH = ["scripts/goodput_bench.py", "--print-json",
+               "--out", "/tmp/BENCH_GOODPUT_pipe_sweep.json"]
+_PIPE = [
+    ("pipe-stage-loss", {"JAX_PLATFORMS": "cpu"},
+     _PIPE_BENCH + ["--scenarios", "stage_loss_restart"]),
+    ("pipe-dcn-stall", {"JAX_PLATFORMS": "cpu"},
+     _PIPE_BENCH + ["--scenarios", "dcn_stall_mid_1f1b"]),
+    ("pipe-fault-storm", {"JAX_PLATFORMS": "cpu"},
+     _PIPE_BENCH + ["--scenarios", "fault_storm_during_pipeline_drain"]),
+]
+
 CONFIG_SETS = {
     "full": _FULL,
     "remat": _REMAT,
@@ -210,6 +227,7 @@ CONFIG_SETS = {
     "short": _SHORT,
     "comm": _COMM,
     "serve": _SERVE,
+    "pipe": _PIPE,
 }
 
 RUN_TIMEOUT_S = 1200
@@ -284,9 +302,14 @@ def main(argv=None):
                     help="which sweep row list to run (default: full)")
     args = ap.parse_args(argv)
     configs = CONFIG_SETS[args.config_set]
-    path = args.logfile or f"/tmp/mfu_sweep_{args.config_set}.jsonl"
-    # the comm/serve sets run CPU fixtures — no TPU tunnel needed
-    if args.config_set not in ("comm", "serve") and not preflight() \
+    # the pipe set is the committed-trajectory log by default (the
+    # pipeline fixture rows are cheap and deterministic enough to diff)
+    path = args.logfile or (
+        os.path.join(REPO, "bench_artifacts", "bench_log.jsonl")
+        if args.config_set == "pipe"
+        else f"/tmp/mfu_sweep_{args.config_set}.jsonl")
+    # the comm/serve/pipe sets run CPU fixtures — no TPU tunnel needed
+    if args.config_set not in ("comm", "serve", "pipe") and not preflight() \
             and os.environ.get("SWEEP_SKIP_PREFLIGHT") != "1":
         sys.exit(1)
     with open(path, "a") as log:

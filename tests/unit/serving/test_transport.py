@@ -289,7 +289,8 @@ def test_fleet_transport_loopback_and_metrics(tmp_path):
         assert m["transport.fallbacks"] == 0.0
         assert set(m) == {
             "transport.bytes_orders", "transport.bytes_bundles",
-            "transport.bytes_results", "transport.frames_sent",
+            "transport.bytes_results", "transport.bytes_activations",
+            "transport.frames_sent",
             "transport.frame_rejects", "transport.reconnects",
             "transport.fallbacks", "transport.breaker_opens",
             "transport.breaker_closes"}
@@ -434,4 +435,4 @@ def test_flows_registry_is_closed():
     """The flow set is part of the wire contract — growing it silently
     would let old receivers hard-reject new senders (bad_flow drops the
     connection), so changing it must be a conscious, versioned act."""
-    assert FLOWS == ("order", "bundle", "result", "ping")
+    assert FLOWS == ("order", "bundle", "result", "activation", "ping")

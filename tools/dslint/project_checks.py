@@ -33,7 +33,7 @@ RULE_ID = "event-kind-drift"
 KIND_DOCS = ("docs/run-supervision.md", "docs/data-determinism.md",
              "docs/checkpoint-durability.md", "docs/serving.md",
              "docs/performance.md", "docs/goodput.md",
-             "docs/telemetry.md")
+             "docs/telemetry.md", "docs/pipeline-mpmd.md")
 
 TELEMETRY_RULE_ID = "telemetry-name-drift"
 TELEMETRY_DOC = "docs/telemetry.md"
